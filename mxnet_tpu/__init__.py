@@ -1,0 +1,29 @@
+"""mxnet_tpu: a TPU-native deep learning framework with MXNet's capabilities.
+
+Brand-new implementation targeting JAX/XLA/Pallas/pjit — the reference
+(Apache MXNet v0.11, /root/reference) defines the capability surface
+(NDArray/Symbol/Module/Gluon/KVStore/IO/...), not the architecture.  The
+C++ engine/executor/kernels collapse into trace→XLA-compile→async-dispatch;
+what this package provides is everything above that line, TPU-first.
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, current_context, cpu, gpu, tpu, num_gpus
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random
+from . import random as rnd
+from . import autograd
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
+from .attribute import AttrScope
+from . import name
+from .name import NameManager, Prefix
+from . import test_utils
+
+__version__ = "0.1.0"

@@ -1,0 +1,48 @@
+"""Attribute scoping.
+
+Mirrors /root/reference/python/mxnet/attribute.py — ``with mx.AttrScope(
+ctx_group='layer0'):`` attaches attributes to every symbol created inside.
+``ctx_group`` is how the reference expressed model parallelism
+(example/model-parallel-lstm); here those groups become sharding
+annotations at bind time (see parallel/).
+"""
+from __future__ import annotations
+
+__all__ = ["AttrScope"]
+
+
+class AttrScope:
+    _current = None
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("Attributes need to be a string")
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr):
+        """Merge user-supplied attrs with the scope's."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        self._old_scope = AttrScope._current
+        attr = AttrScope._current._attr.copy() if AttrScope._current else {}
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        AttrScope._current = self._old_scope
+
+    @staticmethod
+    def current():
+        if AttrScope._current is None:
+            AttrScope._current = AttrScope()
+        return AttrScope._current

@@ -1,0 +1,279 @@
+"""Imperative autograd.
+
+TPU-native analogue of the reference's AutogradRuntime
+(/root/reference/src/ndarray/autograd.{h,cc} + python/mxnet/autograd.py):
+``record()`` tapes every imperative op; ``backward()`` walks the tape in
+reverse, computing each op's VJP with ``jax.vjp`` of its pure lowering —
+the per-op FGradient declarations of the reference collapse into JAX
+autodiff, and custom heads (SoftmaxOutput etc.) carry their reference
+semantics via ``jax.custom_vjp`` in the op library.
+
+Per-op backward functions are jitted and cached by (op, params), so a
+training loop's backward pass reuses compiled kernels exactly like the
+forward path.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad"]
+
+_STATE = threading.local()
+
+
+def _state():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+        _STATE.tape = []
+    return _STATE
+
+
+def is_recording():
+    return _state().recording
+
+
+def is_training():
+    return _state().training
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_record = is_record
+        self._enter_train = train_mode
+        self._prev = None
+
+    def __enter__(self):
+        st = _state()
+        self._prev = (st.recording, st.training)
+        if self._enter_record is not None:
+            st.recording = self._enter_record
+        if self._enter_train is not None:
+            st.training = self._enter_train
+        return self
+
+    def __exit__(self, *args):
+        st = _state()
+        st.recording, st.training = self._prev
+
+
+def record(train_mode=True):
+    """Returns a scope recording ops onto the tape (reference: autograd.record)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def set_recording(is_recording):  # noqa: A002 - reference API name
+    st = _state()
+    prev = st.recording
+    st.recording = bool(is_recording)
+    return prev
+
+
+def set_training(train_mode):
+    st = _state()
+    prev = st.training
+    st.training = bool(train_mode)
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class _TapeNode:
+    """One recorded op (the reference's AGNode, autograd.h:42-71)."""
+
+    __slots__ = ("op", "params_key", "fn", "raw_inputs", "n_nd_inputs",
+                 "inputs", "outputs", "n_total_outputs")
+
+    def __init__(self, op, params, fn, raw_inputs, n_nd_inputs, inputs,
+                 outputs, n_total_outputs):
+        self.op = op
+        self.params_key = _freeze(params)
+        self.fn = fn
+        self.raw_inputs = raw_inputs
+        self.n_nd_inputs = n_nd_inputs
+        self.inputs = inputs          # list of NDArray (weakly held is fine)
+        self.outputs = outputs
+        self.n_total_outputs = n_total_outputs
+
+
+def _freeze(params):
+    def h(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(h(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, h(x)) for k, x in v.items()))
+        return v
+    return (tuple(sorted((k, h(v)) for k, v in params.items())))
+
+
+_VJP_CACHE = {}
+
+
+def _vjp_apply(op, params_key, fn):
+    """Jitted backward: (inputs, cotangents) -> input grads."""
+    key = (op.name, params_key)
+    cached = _VJP_CACHE.get(key)
+    if cached is None:
+        @jax.jit
+        def bwd(raw_inputs, cots):
+            _, vjp_fn = jax.vjp(lambda *a: fn(*a), *raw_inputs)
+            return vjp_fn(cots)
+        cached = bwd
+        _VJP_CACHE[key] = cached
+    return cached
+
+
+def mark_variable(nd):
+    """Mark a leaf variable for gradient (AutogradRuntime::MarkVariables)."""
+    nd._tape_node = None  # leaves have no producing node
+
+
+def mark_variables(variables, gradients=None, grad_reqs="write"):
+    from .ndarray.ndarray import NDArray
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        gradients = [gradients] if gradients is not None else None
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for i, v in enumerate(variables):
+        v._grad = gradients[i] if gradients is not None else None
+        if v._grad is None:
+            import jax.numpy as _jnp
+            from .ndarray.ndarray import NDArray as _ND
+            v._grad = _ND(_jnp.zeros_like(v._data), v._ctx)
+        v._grad_req = grad_reqs[i]
+        mark_variable(v)
+
+
+def record_op(op, params, nd_inputs, nd_outputs, raw_inputs=None):
+    """Record one executed op (AutogradRuntime::RecordOp).
+
+    ``raw_inputs`` is the exact positional tuple the lowering was called with
+    (including any appended PRNG key) so the VJP replays the same forward.
+    """
+    st = _state()
+    fn = op.jitted(**params)
+    raw = raw_inputs if raw_inputs is not None \
+        else tuple(a._data for a in nd_inputs)
+    node = _TapeNode(op, params, fn, tuple(raw), len(nd_inputs),
+                     list(nd_inputs), list(nd_outputs),
+                     None)
+    for i, o in enumerate(nd_outputs):
+        o._tape_node = node
+        o._tape_index = i
+    st.tape.append(node)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from head arrays, accumulating into leaf ``.grad``.
+
+    Reference: MXAutogradBackward → AutogradRuntime::ComputeGradient
+    (src/ndarray/autograd.cc) — there the tape becomes an NNVM graph run by a
+    GraphExecutor; here we walk the recorded nodes in reverse, jitted VJP per
+    node.
+    """
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # Collect reachable nodes by reverse DFS from heads
+    visited = set()
+    order = []
+
+    def visit(node):
+        if node is None or id(node) in visited:
+            return
+        visited.add(id(node))
+        for inp in node.inputs:
+            visit(inp._tape_node)
+        order.append(node)
+
+    for h in heads:
+        visit(h._tape_node)
+
+    # cotangent per produced NDArray, keyed by id
+    cot = {}
+    for h, hg in zip(heads, head_grads):
+        g = hg._data if isinstance(hg, NDArray) else (
+            jnp.ones_like(h._data) if hg is None else jnp.asarray(hg))
+        cot[id(h)] = cot.get(id(h), 0) + g
+
+    leaf_grads = {}
+
+    for node in reversed(order):
+        # full cotangent structure matching fn's output pytree
+        probe = jax.eval_shape(lambda *a: node.fn(*a), *node.raw_inputs)
+        flat_probe = probe if isinstance(probe, (tuple, list)) else [probe]
+        cots = []
+        for i, p in enumerate(flat_probe):
+            if i < len(node.outputs):
+                o = node.outputs[i]
+                g = cot.get(id(o))
+                cots.append(g if g is not None
+                            else jnp.zeros(p.shape, p.dtype))
+            else:
+                cots.append(jnp.zeros(p.shape, p.dtype))
+        cots = tuple(cots) if isinstance(probe, (tuple, list)) else cots[0]
+        bwd = _vjp_apply(node.op, node.params_key, node.fn)
+        in_grads = bwd(node.raw_inputs, cots)
+        for inp, g in zip(node.inputs, in_grads[:node.n_nd_inputs]):
+            if g is None or (hasattr(g, "dtype") and
+                             g.dtype == jax.dtypes.float0):
+                continue
+            if inp._tape_node is not None:
+                cot[id(inp)] = cot.get(id(inp), 0) + g
+            elif inp._grad is not None:  # marked leaf
+                leaf_grads[id(inp)] = leaf_grads.get(id(inp), 0) + g
+                leaf_grads.setdefault("_nd_%d" % id(inp), inp)
+
+    for key, g in list(leaf_grads.items()):
+        if isinstance(key, str):
+            continue
+        nd = leaf_grads["_nd_%d" % key]
+        if nd._grad_req == "add":
+            nd._grad._set_data(nd._grad._data + g)
+        else:
+            nd._grad._set_data(jnp.asarray(g, nd._data.dtype))
+
+    if not retain_graph:
+        _state().tape = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables (reference autograd.grad)."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(v._grad, v._grad_req) for v in variables]
+    for v in variables:
+        v._grad = NDArray(jnp.zeros_like(v._data), v._ctx)
+        v._grad_req = "write"
+    backward(heads, head_grads, retain_graph=bool(retain_graph),
+             train_mode=train_mode)
+    out = [v._grad for v in variables]
+    for v, (g, req) in zip(variables, saved):
+        v._grad, v._grad_req = g, req
+    return out

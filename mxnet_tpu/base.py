@@ -1,0 +1,36 @@
+"""Base utilities for the TPU-native framework.
+
+Plays the role of the reference's ``python/mxnet/base.py`` (ctypes bridge,
+handle types, ``check_call``) — but there is no C ABI to cross for the compute
+path: ops lower to XLA via JAX.  What remains here is the shared error type,
+string/registry helpers, and a few numeric aliases.
+
+Reference: /root/reference/python/mxnet/base.py
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: base.py:MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+def check_call(ret):
+    """Kept for API compatibility; no C calls to check in the TPU build."""
+    if ret:  # pragma: no cover - compatibility shim
+        raise MXNetError(str(ret))
+
+
+def _as_list(obj):
+    """Return obj wrapped in a list if it is not already a list/tuple."""
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]

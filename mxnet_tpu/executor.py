@@ -1,0 +1,287 @@
+"""Executor: a bound, compiled symbolic graph.
+
+TPU-native analogue of the reference GraphExecutor
+(/root/reference/src/executor/graph_executor.cc + python/mxnet/executor.py).
+Where the reference built a backward graph (nnvm Gradient pass), planned
+memory, and pushed cached engine ops per node (RunOps :1421), this executor
+traces the whole Symbol into ONE JAX function and jit-compiles it:
+
+- forward      → jitted graph evaluation (XLA fusion ≈ PlanMemory+bulking)
+- backward     → jitted forward+vjp program (gradient pass ≈ jax.vjp);
+                 XLA CSEs the recomputed forward when both run in one step
+- aux states   → threaded functionally and written back (BatchNorm stats)
+- grad_req     → write / add / null per argument, as in the reference
+
+Recompilation happens automatically per input shape (the reference's
+BucketingModule rebinds per bucket; XLA's jit cache plays that role).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
+                 group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad) if args_grad else {}
+        self.aux_dict = dict(aux_states) if aux_states else {}
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = dict(grad_req or {})
+        for n in self._arg_names:
+            self._grad_req.setdefault(n, "null")
+            if self._grad_req[n] != "null" and n not in self.grad_dict:
+                a = self.arg_dict.get(n)
+                if a is not None:
+                    self.grad_dict[n] = NDArray(jnp.zeros_like(a._data),
+                                                self._ctx)
+        self._group2ctx = group2ctx
+        self._monitor_callback = None
+        self.outputs = []
+        self._fwd_cache = {}
+        self._grad_fn = None
+        self._plan = self._build_plan()
+
+    # -- graph compilation -------------------------------------------------
+    def _build_plan(self):
+        """Assemble the pure graph function over (args, aux, rng, train)."""
+        nodes = self._symbol._topo_nodes()
+        sym_outputs = self._symbol._outputs
+
+        def graph_fn(arg_vals, aux_vals, rng, train):
+            vals = {}
+            new_aux = {}
+
+            for i, node in enumerate(nodes):
+                if node.is_var:
+                    if node.is_aux_var:
+                        vals[id(node)] = [aux_vals[node.name]]
+                    else:
+                        vals[id(node)] = [arg_vals[node.name]]
+                    continue
+                inputs = [vals[id(inp)][idx] for inp, idx in node.inputs]
+                params = dict(node.params)
+                if node.op.takes_train:
+                    params["_train"] = train
+                if node.op.needs_rng:
+                    inputs.append(jax.random.fold_in(rng, i))
+                out = node.op.fn(*inputs, **node.op.canon_params(params))
+                flat = list(out) if isinstance(out, (tuple, list)) else [out]
+                n_vis = node.op.num_outputs(node.params)
+                vis, extra = flat[:n_vis], flat[n_vis:]
+                vals[id(node)] = vis
+                if node.op.mutate_aux and extra and train:
+                    aux_inputs = [inp for inp, _ in node.inputs
+                                  if inp.is_aux_var]
+                    for aux_node, new_val in zip(aux_inputs[-len(extra):],
+                                                 extra):
+                        new_aux[aux_node.name] = new_val
+
+            outs = [vals[id(n)][i] for n, i in sym_outputs]
+            return outs, new_aux
+
+        return graph_fn
+
+    def _fwd(self, train):
+        fn = self._fwd_cache.get(train)
+        if fn is None:
+            plan = self._plan
+            fn = jax.jit(functools.partial(plan, train=train))
+            self._fwd_cache[train] = fn
+        return fn
+
+    def _make_grad_fn(self):
+        if self._grad_fn is not None:
+            return self._grad_fn
+        plan = self._plan
+        diff_names = tuple(sorted(
+            n for n, r in self._grad_req.items() if r != "null"
+            and n in self.arg_dict))
+
+        @jax.jit
+        def grad_fn(arg_vals, aux_vals, rng, ograds):
+            fixed = {k: v for k, v in arg_vals.items()
+                     if k not in diff_names}
+
+            def f(diff_args):
+                merged = dict(fixed)
+                merged.update(diff_args)
+                outs, new_aux = plan(merged, aux_vals, rng, True)
+                return tuple(outs), new_aux
+
+            diff_args = {k: arg_vals[k] for k in diff_names}
+            outs, vjp, new_aux = jax.vjp(f, diff_args, has_aux=True)
+            grads = vjp(tuple(ograds))[0]
+            return outs, new_aux, grads
+
+        self._grad_fn = grad_fn
+        return grad_fn
+
+    # -- execution ---------------------------------------------------------
+    def _raw_args(self):
+        return {k: v._data for k, v in self.arg_dict.items()}
+
+    def _raw_aux(self):
+        return {k: v._data for k, v in self.aux_dict.items()}
+
+    def forward(self, is_train=False, **kwargs):
+        from . import random as _random
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %s" % k)
+            self.arg_dict[k]._set_data(
+                v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        rng = _random.next_key()
+        self._last_rng = rng
+        outs, new_aux = self._fwd(bool(is_train))(
+            self._raw_args(), self._raw_aux(), rng)
+        if is_train:
+            for k, v in new_aux.items():
+                self.aux_dict[k]._set_data(v)
+        self.outputs = [NDArray(o, self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, arr in zip(self._output_names, self.outputs):
+                self._monitor_callback(name, arr)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if all(r == "null" for r in self._grad_req.values()):
+            return
+        grad_fn = self._make_grad_fn()
+        if out_grads is None:
+            ograds = [jnp.ones(o.shape, o._data.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                      for g in out_grads]
+        rng = getattr(self, "_last_rng", None)
+        if rng is None:
+            from . import random as _random
+            rng = _random.next_key()
+        outs, new_aux, grads = grad_fn(self._raw_args(), self._raw_aux(),
+                                       rng, tuple(ograds))
+        self.outputs = [NDArray(o, self._ctx) for o in outs]
+        for name, g in grads.items():
+            req = self._grad_req.get(name, "null")
+            if req == "null":
+                continue
+            dst = self.grad_dict.get(name)
+            if dst is None:
+                continue
+            if req == "add":
+                dst._set_data(dst._data + g)
+            else:
+                dst._set_data(g)
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        """Fused train step: one compiled program for fwd+bwd+aux update."""
+        from . import random as _random
+        for k, v in kwargs.items():
+            self.arg_dict[k]._set_data(
+                v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        grad_fn = self._make_grad_fn()
+        rng = _random.next_key()
+        probe_outs, _ = jax.eval_shape(
+            lambda a, x, r: self._plan(a, x, r, True),
+            self._raw_args(), self._raw_aux(), jax.ShapeDtypeStruct(
+                (2,), _np.uint32))
+        if out_grads is None:
+            ograds = tuple(jnp.ones(o.shape, o.dtype) for o in probe_outs)
+        else:
+            ograds = tuple(g._data if isinstance(g, NDArray)
+                           else jnp.asarray(g) for g in out_grads)
+        outs, new_aux, grads = grad_fn(self._raw_args(), self._raw_aux(),
+                                       rng, ograds)
+        for k, v in new_aux.items():
+            self.aux_dict[k]._set_data(v)
+        self.outputs = [NDArray(o, self._ctx) for o in outs]
+        for name, g in grads.items():
+            req = self._grad_req.get(name, "null")
+            if req == "null" or name not in self.grad_dict:
+                continue
+            dst = self.grad_dict[name]
+            if req == "add":
+                dst._set_data(dst._data + g)
+            else:
+                dst._set_data(g)
+        return self.outputs
+
+    # -- parameter management ----------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(jnp.asarray(
+                    array.asnumpy() if isinstance(array, NDArray)
+                    else array, self.arg_dict[name]._data.dtype))
+            elif not allow_extra_params:
+                raise ValueError("Find name \"%s\" that is not in the "
+                                 "arguments" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(jnp.asarray(
+                        array.asnumpy() if isinstance(array, NDArray)
+                        else array, self.aux_dict[name]._data.dtype))
+                elif not allow_extra_params:
+                    raise ValueError("Find name \"%s\" that is not in the "
+                                     "auxiliary states" % name)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new shapes (jit handles recompilation)."""
+        from . import nd
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            new_args[name] = cur if cur.shape == shape else \
+                nd.zeros(shape, ctx=self._ctx, dtype=cur.dtype)
+        new_aux = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[name]
+            new_aux[name] = cur if cur.shape == shape else \
+                nd.zeros(shape, ctx=self._ctx, dtype=cur.dtype)
+        grad_req = self._grad_req
+        args_grad = {n: nd.zeros(a.shape, ctx=self._ctx, dtype=a.dtype)
+                     for n, a in new_args.items()
+                     if grad_req.get(n, "null") != "null"}
+        return Executor(self._symbol, self._ctx, new_args, args_grad,
+                        grad_req, new_aux, group2ctx=self._group2ctx)

@@ -1,0 +1,53 @@
+"""Automatic naming of symbols.
+
+Mirrors /root/reference/python/mxnet/name.py: a thread-shared NameManager
+hands out ``convolution0``, ``convolution1``, ... so auto-created parameter
+variables get the reference's deterministic names (``convolution0_weight``)
+— which is what makes checkpoints and ``init_params`` line up.
+"""
+from __future__ import annotations
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class NameManager:
+    _current = None
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        self._old_manager = NameManager._current
+        NameManager._current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        NameManager._current = self._old_manager
+
+    @staticmethod
+    def current():
+        if NameManager._current is None:
+            NameManager._current = NameManager()
+        return NameManager._current
+
+
+class Prefix(NameManager):
+    """Prepends a prefix to every auto-generated name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

@@ -1,0 +1,12 @@
+"""The ``nd`` namespace: NDArray + every registered operator as a function.
+
+Mirrors /root/reference/python/mxnet/ndarray/__init__.py.
+"""
+from .ndarray import (NDArray, array, empty, zeros, ones, full, arange,
+                      concatenate, moveaxis, imperative_invoke, waitall)
+from .utils import save, load
+from . import register as _register
+from .sparse import (BaseSparseNDArray, RowSparseNDArray, CSRNDArray,
+                     cast_storage, sparse_retain)
+
+_register.populate(globals())

@@ -1,0 +1,546 @@
+"""NDArray: the imperative tensor.
+
+TPU-native analogue of the reference NDArray
+(/root/reference/include/mxnet/ndarray.h:93-888 + python/mxnet/ndarray/
+ndarray.py).  Wraps an immutable ``jax.Array`` and supplies MXNet's mutable
+surface on top:
+
+- JAX dispatch is already async (the reference built a dependency engine for
+  this; XLA gives it natively) — ``wait_to_read`` maps to
+  ``block_until_ready``, ``asnumpy`` blocks like the reference's.
+- Mutation (``x[:] = v``, in-place arithmetic, optimizer write-back) swaps
+  the wrapped buffer; under jit, donation makes this a true in-place update,
+  playing the role of the reference's PlanMemory/inplace machinery.
+- Basic slices return copies, not aliasing views (XLA has no aliasing);
+  the reference's view semantics are rarely load-bearing in user code.
+
+Every registered operator appears as both a method-style call via
+``mxnet_tpu.nd.<op>`` (generated in register.py) and operator overloads here.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, numeric_types, integer_types
+from ..context import Context, current_context
+from ..ops import get_op
+
+__all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+           "concatenate", "moveaxis", "imperative_invoke", "waitall"]
+
+def _resolve_dtype(dtype):
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    return _np.dtype(dtype)
+
+
+class NDArray:
+    """An MXNet-semantics tensor backed by a jax.Array."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_tape_node", "_tape_index",
+                 "_grad_req", "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data = data
+        self._ctx = ctx if ctx is not None else current_context()
+        self._grad = None
+        self._grad_req = None
+        self._tape_node = None
+        self._tape_index = 0
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(str(self._data.dtype))
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return NDArray(jnp.transpose(self._data), self._ctx)
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(str(s) for s in self.shape),
+            self._ctx)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asnumpy())
+        raise ValueError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    # -- synchronization (engine WaitToRead analogue) ----------------------
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+
+    wait_to_write = wait_to_read
+
+    # -- conversions -------------------------------------------------------
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def astype(self, dtype, copy=True):
+        return NDArray(self._data.astype(_resolve_dtype(dtype)), self._ctx)
+
+    def copy(self):
+        return NDArray(jnp.copy(self._data), self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            if other is self:
+                raise MXNetError("cannot copy an array onto itself")
+            other._set_data(jax.device_put(self._data,
+                                           other._ctx.jax_device()))
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()),
+                           other)
+        raise TypeError("copyto does not support type %s" % type(other))
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    def tostype(self, stype):
+        if stype in (None, "default"):
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        return imperative_invoke("Reshape", (self,), {"shape": shape})
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def expand_dims(self, axis):
+        return imperative_invoke("expand_dims", (self,), {"axis": axis})
+
+    def flatten(self):
+        return imperative_invoke("Flatten", (self,), {})
+
+    def broadcast_to(self, shape):
+        return imperative_invoke("broadcast_to", (self,), {"shape": shape})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return imperative_invoke("transpose", (self,), {"axes": axes})
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        from .. import autograd
+        self._grad = NDArray(jnp.zeros_like(self._data), self._ctx)
+        self._grad_req = grad_req
+        autograd.mark_variable(self)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    # -- mutation ----------------------------------------------------------
+    def _set_data(self, new_data):
+        self._data = new_data
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, _np.ndarray) or isinstance(value, numeric_types):
+            value = jnp.asarray(value, dtype=self._data.dtype)
+        if isinstance(key, slice) and key == slice(None):
+            self._set_data(jnp.broadcast_to(
+                jnp.asarray(value, self._data.dtype), self.shape))
+            return
+        key = self._canon_key(key)
+        self._set_data(self._data.at[key].set(value))
+
+    def _canon_key(self, key):
+        def conv(k):
+            if isinstance(k, NDArray):
+                return k._data.astype(jnp.int32)
+            return k
+        if isinstance(key, tuple):
+            return tuple(conv(k) for k in key)
+        return conv(key)
+
+    def __getitem__(self, key):
+        key = self._canon_key(key)
+        out = self._data[key]
+        return NDArray(out, self._ctx)
+
+    # -- arithmetic --------------------------------------------------------
+    def _binary(self, other, op_name, scalar_op_name, reverse=False):
+        if isinstance(other, NDArray):
+            args = (other, self) if reverse else (self, other)
+            name = op_name if args[0].shape == args[1].shape else \
+                op_name.replace("elemwise_", "broadcast_")
+            return imperative_invoke(name, args, {})
+        if isinstance(other, numeric_types):
+            return imperative_invoke(scalar_op_name, (self,),
+                                     {"scalar": float(other)})
+        raise TypeError("unsupported operand type %s" % type(other))
+
+    def __add__(self, other):
+        return self._binary(other, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binary(other, "elemwise_sub", "_rminus_scalar",
+                            reverse=True) if isinstance(other, NDArray) else \
+            imperative_invoke("_rminus_scalar", (self,),
+                              {"scalar": float(other)})
+
+    def __mul__(self, other):
+        return self._binary(other, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "elemwise_div", "_div_scalar")
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, other):
+        if isinstance(other, NDArray):
+            return self._binary(other, "elemwise_div", "_rdiv_scalar",
+                                reverse=True)
+        return imperative_invoke("_rdiv_scalar", (self,),
+                                 {"scalar": float(other)})
+
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, other):
+        return self._binary(other, "elemwise_mod", "_mod_scalar")
+
+    def __rmod__(self, other):
+        if isinstance(other, NDArray):
+            return self._binary(other, "elemwise_mod", "_rmod_scalar",
+                                reverse=True)
+        return imperative_invoke("_rmod_scalar", (self,),
+                                 {"scalar": float(other)})
+
+    def __pow__(self, other):
+        return self._binary(other, "elemwise_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        return imperative_invoke("_rpower_scalar", (self,),
+                                 {"scalar": float(other)})
+
+    def __neg__(self):
+        return imperative_invoke("negative", (self,), {})
+
+    def __abs__(self):
+        return imperative_invoke("abs", (self,), {})
+
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._set_data(out._data)
+        return self
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._set_data(out._data)
+        return self
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._set_data(out._data)
+        return self
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._set_data(out._data)
+        return self
+
+    __idiv__ = __itruediv__
+
+    def _compare(self, other, op_name, scalar_name):
+        if isinstance(other, NDArray):
+            return imperative_invoke(op_name, (self, other), {})
+        return imperative_invoke(scalar_name, (self,),
+                                 {"scalar": float(other)})
+
+    def __eq__(self, other):
+        if isinstance(other, (NDArray,) + numeric_types):
+            return self._compare(other, "broadcast_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (NDArray,) + numeric_types):
+            return self._compare(other, "broadcast_not_equal",
+                                 "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, other):
+        return self._compare(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._compare(other, "broadcast_greater_equal",
+                             "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._compare(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._compare(other, "broadcast_lesser_equal",
+                             "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    # convenience reductions mirroring the reference's method surface
+    def sum(self, *args, **kwargs):
+        return imperative_invoke("sum", (self,), _reduce_kwargs(args, kwargs))
+
+    def mean(self, *args, **kwargs):
+        return imperative_invoke("mean", (self,), _reduce_kwargs(args, kwargs))
+
+    def max(self, *args, **kwargs):
+        return imperative_invoke("max", (self,), _reduce_kwargs(args, kwargs))
+
+    def min(self, *args, **kwargs):
+        return imperative_invoke("min", (self,), _reduce_kwargs(args, kwargs))
+
+    def argmax(self, *args, **kwargs):
+        return imperative_invoke("argmax", (self,),
+                                 _reduce_kwargs(args, kwargs))
+
+    def argmin(self, *args, **kwargs):
+        return imperative_invoke("argmin", (self,),
+                                 _reduce_kwargs(args, kwargs))
+
+    def clip(self, a_min, a_max):
+        return imperative_invoke("clip", (self,),
+                                 {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return imperative_invoke("abs", (self,), {})
+
+    def square(self):
+        return imperative_invoke("square", (self,), {})
+
+    def sqrt(self):
+        return imperative_invoke("sqrt", (self,), {})
+
+    def exp(self):
+        return imperative_invoke("exp", (self,), {})
+
+    def log(self):
+        return imperative_invoke("log", (self,), {})
+
+    def sigmoid(self):
+        return imperative_invoke("sigmoid", (self,), {})
+
+    def tanh(self):
+        return imperative_invoke("tanh", (self,), {})
+
+    def relu(self):
+        return imperative_invoke("relu", (self,), {})
+
+    def softmax(self, *args, **kwargs):
+        return imperative_invoke("softmax", (self,), kwargs)
+
+    def slice_axis(self, axis, begin, end):
+        return imperative_invoke("slice_axis", (self,),
+                                 {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return imperative_invoke("take", (self, indices),
+                                 {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kwargs):
+        kwargs["depth"] = depth
+        return imperative_invoke("one_hot", (self,), kwargs)
+
+
+def _reduce_kwargs(args, kwargs):
+    if args:
+        kwargs = dict(kwargs)
+        kwargs["axis"] = args[0]
+    return kwargs
+
+
+# ---------------------------------------------------------------------------
+# Imperative invoke: the analogue of MXImperativeInvoke
+# (/root/reference/src/c_api/c_api_ndarray.cc:486-553) — execute one op
+# eagerly, write back mutated aux states, and record on the autograd tape.
+# ---------------------------------------------------------------------------
+
+def imperative_invoke(op_name, inputs, params, out=None):
+    op = get_op(op_name) if isinstance(op_name, str) else op_name
+    params = {k: v for k, v in params.items() if v is not None}
+    params = op.canon_params(params)
+
+    from .. import autograd as _ag
+    if op.takes_train:
+        params["_train"] = _ag.is_training()
+
+    raw_inputs = []
+    nd_inputs = []
+    for a in inputs:
+        if isinstance(a, NDArray):
+            raw_inputs.append(a._data)
+            nd_inputs.append(a)
+        elif a is None:
+            continue
+        else:
+            arr = jnp.asarray(a)
+            raw_inputs.append(arr)
+            nd_inputs.append(NDArray(arr))
+
+    if op.needs_rng:
+        from .. import random as _random
+        raw_inputs.append(_random.next_key())
+
+    result = op.jitted(**params)(*raw_inputs)
+    flat = list(result) if isinstance(result, (tuple, list)) else [result]
+
+    n_out = op.num_outputs(params)
+    visible, extra = flat[:n_out], flat[n_out:]
+
+    # write back mutated aux states (BatchNorm moving stats): the trailing
+    # `extra` values correspond 1:1 to the trailing aux inputs.
+    if op.mutate_aux and extra:
+        aux_nd = nd_inputs[-len(extra):]
+        for nd_arr, new_val in zip(aux_nd, extra):
+            nd_arr._set_data(new_val)
+
+    ctx = nd_inputs[0]._ctx if nd_inputs else current_context()
+    outputs = [NDArray(o, ctx) for o in visible]
+
+    if _ag.is_recording():
+        _ag.record_op(op, params, nd_inputs, outputs,
+                      raw_inputs=tuple(raw_inputs))
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs, outputs):
+            dst._set_data(src._data)
+        return out if isinstance(out, (list, tuple)) or len(outputs) > 1 \
+            else outs[0]
+    if len(outputs) == 1:
+        return outputs[0]
+    return outputs
+
+
+def waitall():
+    """Block until all launched work completes (Engine::WaitForAll)."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# Creation routines
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        if dtype is not None:
+            src = src.astype(_resolve_dtype(dtype))
+        return NDArray(jax.device_put(src, ctx.jax_device()), ctx)
+    np_arr = _np.asarray(source_array)
+    if dtype is None:
+        # reference semantics: keep an ndarray source's dtype, default
+        # everything else (lists, scalars) to float32 (mx_real_t)
+        if isinstance(source_array, _np.ndarray) and \
+                np_arr.dtype != _np.float64:
+            dtype = np_arr.dtype
+        else:
+            dtype = _np.float32
+    np_arr = np_arr.astype(dtype)
+    return NDArray(jax.device_put(np_arr, ctx.jax_device()), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
+    data = jnp.zeros(shape, dtype=_resolve_dtype(dtype))
+    return NDArray(jax.device_put(data, ctx.jax_device()), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
+    data = jnp.ones(shape, dtype=_resolve_dtype(dtype))
+    return NDArray(jax.device_put(data, ctx.jax_device()), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
+    data = jnp.full(shape, val, dtype=_resolve_dtype(dtype))
+    return NDArray(jax.device_put(data, ctx.jax_device()), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    return imperative_invoke("_arange", (), {
+        "start": start, "stop": stop, "step": step, "repeat": repeat,
+        "dtype": str(_resolve_dtype(dtype))})
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return imperative_invoke("Concat", tuple(arrays),
+                             {"num_args": len(arrays), "dim": axis})
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray(jnp.moveaxis(tensor._data, source, destination),
+                   tensor._ctx)

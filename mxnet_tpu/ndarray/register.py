@@ -1,0 +1,28 @@
+"""Generate the ``nd.<op>`` function namespace from the op registry.
+
+The reference autogenerates Python functions for every registered C++ op at
+import time (python/mxnet/ndarray/register.py → MXImperativeInvoke); here
+the same surface is generated over the JAX op registry.
+"""
+from __future__ import annotations
+
+from ..ops.registry import _OP_REGISTRY
+from .ndarray import NDArray, imperative_invoke
+
+
+def _make_op_func(name, op):
+    def generic_op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        return imperative_invoke(op, args, kwargs, out=out)
+    generic_op.__name__ = name
+    generic_op.__doc__ = (op.fn.__doc__ or "") + \
+        "\n\nAuto-generated from operator `%s`." % op.name
+    return generic_op
+
+
+def populate(namespace):
+    """Install one function per registered op into ``namespace``."""
+    for name, op in list(_OP_REGISTRY.items()):
+        if name not in namespace:
+            namespace[name] = _make_op_func(name, op)

@@ -1,0 +1,172 @@
+"""Sparse NDArray facades.
+
+The reference implements real row_sparse/csr storage
+(/root/reference/include/mxnet/ndarray.h:82-87, src/operator/tensor/
+cast_storage-inl.h); XLA has no sparse buffers, so the TPU-native design is
+*masked-dense*: a RowSparseNDArray/CSRNDArray carries a dense jax.Array (so
+every operator works unchanged, and XLA fuses the masking) plus the sparse
+index metadata the Python surface exposes (``.indices``, ``.data``,
+``.indptr``).  Gradient row-sparsity for embeddings is recovered by the
+optimizer layer instead (lazy row updates), which is where the reference
+cashed in sparsity too (sparse sgd_update, optimizer_op.cc).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "sparse_retain",
+           "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+    def asnumpy(self):
+        return super().asnumpy()
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(str(s) for s in self.shape),
+                                  self.context)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows-present sparse tensor (reference: kRowSparseStorage)."""
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        dense = self.asnumpy()
+        nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0,
+                               axis=1))[0]
+        return array(nz.astype(_np.int64), dtype="int64")
+
+    @property
+    def data(self):
+        dense = self.asnumpy()
+        idx = self.indices.asnumpy().astype(_np.int64)
+        return array(dense[idx])
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        return cast_storage(self, stype)
+
+    def retain(self, indices):
+        return sparse_retain(self, indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: kCSRStorage)."""
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "csr"
+
+    def _csr_parts(self):
+        dense = self.asnumpy()
+        indptr = [0]
+        indices = []
+        data = []
+        for row in dense:
+            nz = _np.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            data.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return (_np.asarray(data, dense.dtype),
+                _np.asarray(indices, _np.int64),
+                _np.asarray(indptr, _np.int64))
+
+    @property
+    def data(self):
+        return array(self._csr_parts()[0])
+
+    @property
+    def indices(self):
+        return array(self._csr_parts()[1], dtype="int64")
+
+    @property
+    def indptr(self):
+        return array(self._csr_parts()[2], dtype="int64")
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        return cast_storage(self, stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from dense, (data, indices), or another."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _np.asarray(data.asnumpy() if isinstance(data, NDArray)
+                           else data, dtype=dtype or _np.float32)
+        indices = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                              else indices).astype(_np.int64)
+        if shape is None:
+            nrows = int(indices.max()) + 1 if indices.size else 0
+            shape = (nrows,) + data.shape[1:]
+        dense = _np.zeros(shape, dtype=data.dtype)
+        if indices.size:
+            dense[indices] = data
+        return RowSparseNDArray(jnp.asarray(dense))
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return RowSparseNDArray(jnp.asarray(src.astype(dtype or src.dtype)))
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from dense or (data, indices, indptr)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = (
+            a.asnumpy() if isinstance(a, NDArray) else _np.asarray(a)
+            for a in arg1)
+        ncols = shape[1] if shape else (int(indices.max()) + 1
+                                        if indices.size else 0)
+        nrows = shape[0] if shape else len(indptr) - 1
+        dense = _np.zeros((nrows, ncols), dtype=dtype or data.dtype)
+        for r in range(nrows):
+            for j in range(int(indptr[r]), int(indptr[r + 1])):
+                dense[r, int(indices[j])] = data[j]
+        return CSRNDArray(jnp.asarray(dense))
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return CSRNDArray(jnp.asarray(src.astype(dtype or src.dtype)))
+
+
+def cast_storage(arr, stype):
+    """Reference op cast_storage (src/operator/tensor/cast_storage.cc)."""
+    if stype in (None, "default"):
+        return NDArray(arr._data, arr.context)
+    if stype == "row_sparse":
+        return RowSparseNDArray(arr._data, arr.context)
+    if stype == "csr":
+        return CSRNDArray(arr._data, arr.context)
+    raise ValueError("unknown storage type %s" % stype)
+
+
+def sparse_retain(arr, indices):
+    """Keep only the given rows (src/operator/tensor/sparse_retain.cc)."""
+    idx = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) \
+        else _np.asarray(indices, _np.int64)
+    mask = _np.zeros((arr.shape[0],), dtype=bool)
+    mask[idx] = True
+    kept = arr._data * jnp.asarray(
+        mask.reshape((-1,) + (1,) * (arr.ndim - 1)), arr._data.dtype)
+    return RowSparseNDArray(kept, arr.context)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    from . import zeros as _zeros
+    base = _zeros(shape, ctx=ctx, dtype=dtype)
+    return cast_storage(base, stype)

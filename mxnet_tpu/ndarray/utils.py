@@ -1,0 +1,44 @@
+"""NDArray save/load.
+
+API-compatible with the reference's ``mx.nd.save/load``
+(/root/reference/python/mxnet/ndarray/utils.py:158-248): accepts a single
+array, a list, or a str->NDArray dict, and round-trips exactly that
+structure.  The container is an uncompressed ``.npz`` (a zip of raw numpy
+buffers) rather than the reference's custom V2 binary
+(src/ndarray/ndarray.cc:809-817) — same two-artifact checkpoint contract,
+portable, and mmap-friendly for large parameter maps.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load"]
+
+_LIST_KEY = "__mx_list_%d"
+
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    elif isinstance(data, (list, tuple)):
+        payload = {_LIST_KEY % i: v.asnumpy() for i, v in enumerate(data)}
+    else:
+        raise ValueError("data needs to either be a NDArray, dict of str to "
+                         "NDArray or a list of NDArray")
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname):
+    with _np.load(fname, allow_pickle=False) as zf:
+        keys = list(zf.keys())
+        if keys and all(k.startswith("__mx_list_") for k in keys):
+            out = [None] * len(keys)
+            for k in keys:
+                out[int(k[len("__mx_list_"):])] = array(zf[k])
+            return out
+        return {k: array(zf[k]) for k in keys}

@@ -1,0 +1,14 @@
+"""Operator catalog: registry + all op families.
+
+Importing this package registers every operator, mirroring how the
+reference's static registration (NNVM_REGISTER_OP at library load) populates
+the op registry before any frontend call.
+"""
+from .registry import OpDef, register_op, get_op, list_ops, alias
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import rnn  # noqa: F401
+
+__all__ = ["OpDef", "register_op", "get_op", "list_ops", "alias"]

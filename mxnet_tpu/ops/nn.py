@@ -1,0 +1,703 @@
+"""Neural-network operators.
+
+TPU-native lowerings of the reference's core NN ops
+(/root/reference/src/operator/{convolution,fully_connected,batch_norm,
+pooling,activation,leaky_relu,dropout,lrn,instance_norm,l2_normalization,
+softmax_output,...}-inl.h).  Convolutions map straight onto
+``lax.conv_general_dilated`` (the MXU path — XLA picks the tiling the
+reference delegated to cuDNN's autotuner, cudnn_algoreg-inl.h); pooling is
+``lax.reduce_window``; everything else is fused elementwise work that XLA
+folds into neighbouring matmuls.
+
+Loss heads (SoftmaxOutput, *RegressionOutput, SVMOutput) reproduce the
+reference's *implicit gradient* contract via ``jax.custom_vjp``: their
+forward is the prediction, and backward injects (pred - label) style
+gradients regardless of what is chained above — exactly the fused
+softmax+CE behaviour of src/operator/softmax_output-inl.h.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, alias
+
+
+def _tuplize(x, n):
+    if x is None or x == ():
+        return (1,) * n
+    if isinstance(x, int):
+        return (x,) * n
+    return tuple(x)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (/root/reference/src/operator/fully_connected-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("FullyConnected",
+             arg_names=lambda p: (["data", "weight"] if p.get("no_bias")
+                                  else ["data", "weight", "bias"]),
+             param_defaults={"num_hidden": 0, "no_bias": False,
+                             "flatten": True})
+def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                     flatten=True):
+    if flatten and data.ndim > 2:
+        data = data.reshape((data.shape[0], -1))
+    out = jnp.matmul(data, weight.T)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (/root/reference/src/operator/convolution-inl.h)
+# ---------------------------------------------------------------------------
+
+def _conv_dnums(ndim):
+    # NC(spatial...) data, OI(spatial...) weights — MXNet's native layout
+    sp = "DHW"[-ndim:]
+    return lax.conv_dimension_numbers(
+        (1, 1) + (1,) * ndim, (1, 1) + (1,) * ndim,
+        ("NC" + sp, "OI" + sp, "NC" + sp))
+
+
+@register_op("Convolution",
+             arg_names=lambda p: (["data", "weight"] if p.get("no_bias")
+                                  else ["data", "weight", "bias"]),
+             param_defaults={"kernel": (), "stride": (), "dilate": (),
+                             "pad": (), "num_filter": 0, "num_group": 1,
+                             "no_bias": False, "workspace": 1024,
+                             "cudnn_tune": None, "cudnn_off": False,
+                             "layout": None})
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                 pad=(), num_filter=0, num_group=1, no_bias=False,
+                 workspace=1024, cudnn_tune=None, cudnn_off=False, layout=None):
+    ndim = len(kernel)
+    stride = _tuplize(stride, ndim)
+    dilate = _tuplize(dilate, ndim)
+    pad = _tuplize(pad if pad else 0, ndim)
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dnums(ndim),
+        feature_group_count=num_group)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+@register_op("Deconvolution",
+             arg_names=lambda p: (["data", "weight"] if p.get("no_bias", True)
+                                  else ["data", "weight", "bias"]),
+             param_defaults={"kernel": (), "stride": (), "dilate": (),
+                             "pad": (), "adj": (), "target_shape": (),
+                             "num_filter": 0, "num_group": 1, "no_bias": True,
+                             "workspace": 512, "cudnn_tune": None,
+                             "cudnn_off": False, "layout": None})
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                   pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
+                   no_bias=True, workspace=512, cudnn_tune=None,
+                   cudnn_off=False, layout=None):
+    # Transposed convolution = gradient of Convolution wrt data
+    # (src/operator/deconvolution-inl.h) — lax expresses it as an lhs-dilated
+    # conv with flipped kernels.
+    ndim = len(kernel)
+    stride = _tuplize(stride, ndim)
+    dilate = _tuplize(dilate, ndim)
+    pad = _tuplize(pad if pad else 0, ndim)
+    adj = _tuplize(adj if adj else 0, ndim)
+    # effective kernel extent
+    pads = []
+    for i in range(ndim):
+        k_eff = (kernel[i] - 1) * dilate[i] + 1
+        pads.append((k_eff - 1 - pad[i], k_eff - 1 - pad[i] + adj[i]))
+    # weight layout for Deconvolution is (in_channel, out_channel/group, *k)
+    if num_group > 1:
+        ci = data.shape[1]
+        w = weight.reshape((num_group, ci // num_group, -1) + tuple(kernel))
+        w = jnp.flip(w, axis=tuple(range(3, 3 + ndim)))
+        w = jnp.swapaxes(w, 1, 2).reshape(
+            (-1, ci // num_group) + tuple(kernel))
+    else:
+        w = jnp.flip(weight, axis=tuple(range(2, 2 + ndim)))
+        w = jnp.swapaxes(w, 0, 1)  # → (out, in, *k)
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * ndim, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate,
+        dimension_numbers=_conv_dnums(ndim),
+        feature_group_count=num_group)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (/root/reference/src/operator/pooling-inl.h, nn/pool.h)
+# ---------------------------------------------------------------------------
+
+@register_op("Pooling", arg_names=("data",),
+             param_defaults={"kernel": (), "pool_type": "max", "stride": (),
+                             "pad": (), "global_pool": False,
+                             "pooling_convention": "valid", "cudnn_off": False})
+def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
+             global_pool=False, pooling_convention="valid", cudnn_off=False):
+    ndim = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = _tuplize(kernel, ndim)
+    stride = _tuplize(stride, ndim)
+    pad = _tuplize(pad if pad else 0, ndim)
+    pads = []
+    for i in range(ndim):
+        lo = pad[i]
+        hi = pad[i]
+        if pooling_convention == "full":
+            # ceil-mode output: pad extra on the high side
+            size = data.shape[2 + i] + 2 * pad[i]
+            out_sz = -(-(size - kernel[i]) // stride[i]) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i]
+            hi += max(0, need - size)
+        pads.append((lo, hi))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = [(0, 0), (0, 0)] + pads
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype),
+                                 lax.max, window, strides, padding)
+    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype),
+                               lax.add, window, strides, padding)
+    if pool_type == "sum":
+        return summed
+    # avg: count includes padding, matching the reference default
+    denom = 1.0
+    for k in kernel:
+        denom *= k
+    return summed / jnp.asarray(denom, data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Activations (/root/reference/src/operator/activation-inl.h, leaky_relu-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("Activation", arg_names=("data",),
+             param_defaults={"act_type": "relu"})
+def _activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %s" % act_type)
+
+
+@register_op("LeakyReLU",
+             arg_names=lambda p: (["data", "gamma"]
+                                  if p.get("act_type") == "prelu" else ["data"]),
+             param_defaults={"act_type": "leaky", "slope": 0.25,
+                             "lower_bound": 0.125, "upper_bound": 0.334})
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "rrelu":
+        # deterministic midpoint at inference (reference trains with a drawn
+        # slope; the random path rides the Dropout-style rng plumbing later)
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, mid * data)
+    raise ValueError("unknown act_type %s" % act_type)
+
+
+# ---------------------------------------------------------------------------
+# Softmax family (/root/reference/src/operator/nn/softmax-inl.h,
+# softmax_activation-inl.h, softmax_output-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("softmax", arg_names=("data",),
+             param_defaults={"axis": -1, "temperature": None})
+def _softmax(data, axis=-1, temperature=None):
+    if temperature:
+        data = data / temperature
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register_op("log_softmax", arg_names=("data",),
+             param_defaults={"axis": -1, "temperature": None})
+def _log_softmax(data, axis=-1, temperature=None):
+    if temperature:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register_op("SoftmaxActivation", arg_names=("data",),
+             param_defaults={"mode": "instance"})
+def _softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape((data.shape[0], -1)),
+                          axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, preserve_shape, normalization,
+                        smooth_alpha, out_grad):
+    if multi_output:
+        prob = jax.nn.softmax(data, axis=1)
+    elif preserve_shape:
+        prob = jax.nn.softmax(data, axis=-1)
+    else:
+        prob = jax.nn.softmax(data.reshape((data.shape[0], -1)),
+                              axis=-1).reshape(data.shape)
+    return prob
+
+
+@register_op("SoftmaxOutput", arg_names=("data", "label"),
+             backward_ignore=("label",),
+             param_defaults={"grad_scale": 1.0, "ignore_label": -1.0,
+                             "multi_output": False, "use_ignore": False,
+                             "preserve_shape": False, "normalization": "null",
+                             "smooth_alpha": 0.0, "out_grad": False})
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", smooth_alpha=0.0, out_grad=False):
+    multi_output = bool(multi_output)
+    use_ignore = bool(use_ignore)
+
+    @jax.custom_vjp
+    def core(d, l):
+        return _softmax_output_fwd(d, l, grad_scale, ignore_label,
+                                   multi_output, use_ignore, preserve_shape,
+                                   normalization, smooth_alpha, out_grad)
+
+    def core_fwd(d, l):
+        prob = core(d, l)
+        return prob, (prob, l)
+
+    def core_bwd(res, g):
+        prob, l = res
+        # fused softmax+cross-entropy gradient: prob - one_hot(label)
+        # (/root/reference/src/operator/softmax_output-inl.h)
+        axis = 1 if multi_output else -1
+        nclass = prob.shape[axis]
+        lbl = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lbl, nclass, dtype=prob.dtype)
+        if smooth_alpha:
+            onehot = onehot * (1.0 - smooth_alpha) + \
+                smooth_alpha / (nclass - 1) * (1.0 - onehot)
+        if multi_output:
+            onehot = jnp.moveaxis(onehot, -1, 1)
+        grad = prob - onehot.reshape(prob.shape)
+        valid = None
+        if use_ignore:
+            mask = (lbl != jnp.asarray(ignore_label, lbl.dtype))
+            bmask = jnp.expand_dims(mask, axis).astype(prob.dtype)
+            grad = grad * jnp.broadcast_to(bmask, prob.shape).reshape(prob.shape)
+            valid = jnp.maximum(jnp.sum(mask.astype(prob.dtype)), 1.0)
+        if normalization == "batch":
+            grad = grad / prob.shape[0]
+        elif normalization == "valid" and valid is not None:
+            grad = grad / valid
+        grad = grad * grad_scale
+        if out_grad:
+            grad = grad * g
+        return grad.astype(prob.dtype), jnp.zeros_like(l)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core(data, label)
+
+alias("SoftmaxOutput", "Softmax")
+
+
+@register_op("softmax_cross_entropy", arg_names=("data", "label"),
+             backward_ignore=("label",))
+def _softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked).reshape((1,))
+
+
+# ---------------------------------------------------------------------------
+# Regression / SVM heads (/root/reference/src/operator/regression_output-inl.h)
+# ---------------------------------------------------------------------------
+
+def _make_regression(name, fwd, grad_fn):
+    @jax.custom_vjp
+    def core(data, label, grad_scale=1.0):
+        return fwd(data)
+
+    def core_fwd(data, label, grad_scale):
+        out = fwd(data)
+        return out, (out, label, grad_scale)
+
+    def core_bwd(res, g):
+        out, label, grad_scale = res
+        # reference scales by grad_scale / num_output
+        # (regression_output-inl.h: out.Size()/out.shape_[0])
+        n = out.size // out.shape[0] if out.ndim > 1 else 1
+        grad = grad_fn(out, label.reshape(out.shape)) * (grad_scale / n)
+        return grad.astype(out.dtype), jnp.zeros_like(label), None
+
+    core.defvjp(core_fwd, core_bwd)
+
+    @register_op(name, arg_names=("data", "label"),
+                 backward_ignore=("label",),
+                 param_defaults={"grad_scale": 1.0})
+    def _op(data, label, grad_scale=1.0):
+        return core(data, label, grad_scale)
+    return _op
+
+
+_make_regression("LinearRegressionOutput", lambda x: x,
+                 lambda out, label: out - label)
+_make_regression("MAERegressionOutput", lambda x: x,
+                 lambda out, label: jnp.sign(out - label))
+_make_regression("LogisticRegressionOutput", jax.nn.sigmoid,
+                 lambda out, label: out - label)
+
+
+@register_op("SVMOutput", arg_names=("data", "label"),
+             backward_ignore=("label",),
+             param_defaults={"margin": 1.0, "regularization_coefficient": 1.0,
+                             "use_linear": False})
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    @jax.custom_vjp
+    def core(d, l):
+        return d
+
+    def core_fwd(d, l):
+        return d, (d, l)
+
+    def core_bwd(res, g):
+        d, l = res
+        lbl = jax.nn.one_hot(l.astype(jnp.int32), d.shape[1], dtype=d.dtype)
+        y = 2.0 * lbl - 1.0  # +1 for target class, -1 otherwise
+        viol = (margin - y * d) > 0
+        if use_linear:
+            grad = jnp.where(viol, -y * regularization_coefficient, 0.0)
+        else:
+            grad = jnp.where(viol, -2.0 * regularization_coefficient *
+                             (margin - y * d) * y, 0.0)
+        return grad.astype(d.dtype), jnp.zeros_like(l)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core(data, label)
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (/root/reference/src/operator/batch_norm-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("BatchNorm", arg_names=("data", "gamma", "beta"),
+             aux_names=("moving_mean", "moving_var"),
+             mutate_aux=True, takes_train=True,
+             num_outputs=lambda p: 3 if p.get("output_mean_var") else 1,
+             param_defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                             "use_global_stats": False,
+                             "output_mean_var": False, "axis": 1,
+                             "cudnn_off": False})
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, cudnn_off=False, _train=False):
+    """Returns (visible outputs..., new_moving_mean, new_moving_var).
+
+    The trailing aux values mirror the reference's in-place update of
+    aux_states during training (batch_norm-inl.h: moving = moving * momentum
+    + batch * (1 - momentum)); the imperative/executor layer writes them back.
+    """
+    ax = axis % data.ndim
+    reduce_axes = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    if fix_gamma:
+        gamma = lax.stop_gradient(jnp.ones_like(gamma))
+    if _train and not use_global_stats:
+        mean = jnp.mean(data, axis=reduce_axes)
+        var = jnp.var(data, axis=reduce_axes)
+        new_mm = moving_mean * momentum + lax.stop_gradient(mean) * (1 - momentum)
+        new_mv = moving_var * momentum + lax.stop_gradient(var) * (1 - momentum)
+    else:
+        mean = moving_mean
+        var = moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) \
+        * gamma.reshape(bshape) + beta.reshape(bshape)
+    if output_mean_var:
+        return out, mean, inv, new_mm, new_mv
+    return out, new_mm, new_mv
+
+
+# ---------------------------------------------------------------------------
+# Other normalizations
+# ---------------------------------------------------------------------------
+
+@register_op("LRN", arg_names=("data",),
+             param_defaults={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0,
+                             "nsize": 5})
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    # cross-channel local response norm (src/operator/lrn-inl.h)
+    sq = jnp.square(data)
+    half = nsize // 2
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    window = (1, nsize) + (1,) * (data.ndim - 2)
+    ssum = lax.reduce_window(jnp.pad(sq, pad), jnp.asarray(0, data.dtype),
+                             lax.add, window, (1,) * data.ndim,
+                             [(0, 0)] * data.ndim)
+    return data / jnp.power(knorm + (alpha / nsize) * ssum, beta)
+
+
+@register_op("InstanceNorm", arg_names=("data", "gamma", "beta"),
+             param_defaults={"eps": 1e-3})
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+
+
+@register_op("L2Normalization", arg_names=("data",),
+             param_defaults={"eps": 1e-10, "mode": "instance"})
+def _l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+# ---------------------------------------------------------------------------
+# Dropout (/root/reference/src/operator/dropout-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("Dropout", arg_names=("data",), needs_rng=True, takes_train=True,
+             param_defaults={"p": 0.5, "mode": "training"})
+def _dropout(data, rng, p=0.5, mode="training", _train=False):
+    if not _train and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, data.shape)
+    return jnp.where(mask, data / keep, jnp.zeros_like(data))
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (/root/reference/src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("SequenceLast",
+             arg_names=lambda p: (["data", "sequence_length"]
+                                  if p.get("use_sequence_length") else ["data"]),
+             param_defaults={"use_sequence_length": False})
+def _sequence_last(data, sequence_length=None, use_sequence_length=False):
+    if not use_sequence_length:
+        return data[-1]
+    idx = sequence_length.astype(jnp.int32) - 1
+    return data[idx, jnp.arange(data.shape[1])]
+
+
+@register_op("SequenceMask",
+             arg_names=lambda p: (["data", "sequence_length"]
+                                  if p.get("use_sequence_length") else ["data"]),
+             param_defaults={"use_sequence_length": False, "value": 0.0})
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0):
+    if not use_sequence_length:
+        return data
+    t = jnp.arange(data.shape[0])[:, None]
+    mask = t < sequence_length.astype(jnp.int32)[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register_op("SequenceReverse",
+             arg_names=lambda p: (["data", "sequence_length"]
+                                  if p.get("use_sequence_length") else ["data"]),
+             param_defaults={"use_sequence_length": False})
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False):
+    if not use_sequence_length:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < lens, lens - 1 - t, t)
+    return data[src, jnp.arange(data.shape[1])[None, :]]
+
+
+# ---------------------------------------------------------------------------
+# Spatial ops: UpSampling, BilinearSampler, GridGenerator, ROIPooling
+# ---------------------------------------------------------------------------
+
+@register_op("UpSampling",
+             arg_names=lambda p: ["arg%d" % i for i in
+                                  range(int(p.get("num_args", 1)))],
+             param_defaults={"scale": 1, "num_filter": 0,
+                             "sample_type": "nearest",
+                             "multi_input_mode": "concat", "num_args": 1,
+                             "workspace": 512})
+def _upsampling(*args, scale=1, num_filter=0, sample_type="nearest",
+                multi_input_mode="concat", num_args=1, workspace=512):
+    outs = []
+    target = args[0].shape[2] * scale
+    for a in args:
+        s = target // a.shape[2]
+        up = jnp.repeat(jnp.repeat(a, s, axis=2), s, axis=3)
+        outs.append(up)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        out = outs[0]
+        for o in outs[1:]:
+            out = out + o
+        return out
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("BilinearSampler", arg_names=("data", "grid"))
+def _bilinear_sampler(data, grid):
+    # grid: (N, 2, H, W) in [-1, 1] (src/operator/bilinear_sampler-inl.h)
+    N, C, H, W = data.shape
+    gx = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+    x1 = x0 + 1; y1 = y0 + 1
+    wx = gx - x0; wy = gy - y0
+
+    def gather(y, x):
+        yi = jnp.clip(y, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(x, 0, W - 1).astype(jnp.int32)
+        b = jnp.arange(N)[:, None, None]
+        return data[b, :, yi, xi]  # (N, Ho, Wo, C)
+
+    val = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+           + gather(y0, x1) * (wx * (1 - wy))[..., None]
+           + gather(y1, x0) * ((1 - wx) * wy)[..., None]
+           + gather(y1, x1) * (wx * wy)[..., None])
+    return jnp.moveaxis(val, -1, 1)
+
+
+@register_op("GridGenerator", arg_names=("data",),
+             param_defaults={"transform_type": "affine", "target_shape": (0, 0)})
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    H, W = target_shape
+    if transform_type == "affine":
+        N = data.shape[0]
+        theta = data.reshape((N, 2, 3))
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        out = jnp.einsum("nij,jk->nik", theta, coords)  # (N, 2, HW)
+        return out.reshape((N, 2, H, W))
+    # warp: data is flow field (N, 2, H, W)
+    N = data.shape[0]
+    ys = jnp.arange(H, dtype=data.dtype)
+    xs = jnp.arange(W, dtype=data.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    gx = (gx + data[:, 0]) * 2.0 / (W - 1) - 1.0
+    gy = (gy + data[:, 1]) * 2.0 / (H - 1) - 1.0
+    return jnp.stack([gx, gy], axis=1)
+
+
+@register_op("SpatialTransformer", arg_names=("data", "loc"),
+             param_defaults={"target_shape": (0, 0),
+                             "transform_type": "affine",
+                             "sampler_type": "bilinear"})
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear"):
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+@register_op("ROIPooling", arg_names=("data", "rois"),
+             param_defaults={"pooled_size": (0, 0), "spatial_scale": 1.0})
+def _roi_pooling(data, rois, pooled_size=(0, 0), spatial_scale=1.0):
+    # rois: (R, 5) = [batch_idx, x1, y1, x2, y2] (src/operator/roi_pooling.cc)
+    PH, PW = pooled_size
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        img = data[b]  # (C, H, W)
+        ph = jnp.arange(PH); pw = jnp.arange(PW)
+        hs = jnp.floor(ph * rh / PH).astype(jnp.int32) + y1
+        he = jnp.ceil((ph + 1) * rh / PH).astype(jnp.int32) + y1
+        ws = jnp.floor(pw * rw / PW).astype(jnp.int32) + x1
+        we = jnp.ceil((pw + 1) * rw / PW).astype(jnp.int32) + x1
+        yy = jnp.arange(H)[None, :]
+        xx = jnp.arange(W)[None, :]
+        ymask = (yy >= hs[:, None]) & (yy < he[:, None])  # (PH, H)
+        xmask = (xx >= ws[:, None]) & (xx < we[:, None])  # (PW, W)
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]  # (PH,PW,H,W)
+        neg = jnp.asarray(-jnp.inf, data.dtype)
+        masked = jnp.where(m[None], img[:, None, None, :, :], neg)
+        out = jnp.max(masked, axis=(-1, -2))  # (C, PH, PW)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register_op("Correlation", arg_names=("data1", "data2"),
+             param_defaults={"kernel_size": 1, "max_displacement": 1,
+                             "stride1": 1, "stride2": 1, "pad_size": 0,
+                             "is_multiply": True})
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    # FlowNet-style correlation (src/operator/correlation.cc), simplified to
+    # the kernel_size=1 fast path; general kernels average over the patch.
+    d = max_displacement
+    pad = [(0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)]
+    a = jnp.pad(data1, pad)
+    b = jnp.pad(data2, pad)
+    N, C, H, W = a.shape
+    outs = []
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            shifted = jnp.roll(b, (-dy, -dx), axis=(2, 3))
+            if is_multiply:
+                outs.append(jnp.mean(a * shifted, axis=1))
+            else:
+                outs.append(jnp.mean(jnp.abs(a - shifted), axis=1))
+    out = jnp.stack(outs, axis=1)
+    return out[:, :, ::stride1, ::stride1]
+
+
+@register_op("IdentityAttachKLSparseReg", arg_names=("data",),
+             param_defaults={"sparseness_target": 0.1, "penalty": 0.001,
+                             "momentum": 0.9})
+def _identity_attach_kl(data, sparseness_target=0.1, penalty=0.001,
+                        momentum=0.9):
+    return data

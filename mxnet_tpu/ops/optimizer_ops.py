@@ -1,0 +1,135 @@
+"""Optimizer update operators.
+
+TPU-native equivalents of /root/reference/src/operator/optimizer_op-inl.h.
+In the reference these run as graph ops so the KVStore server can execute
+updates remotely (update_on_kvstore); here they are pure functions returning
+the *new* (weight, states...) — the optimizer/KVStore layer writes results
+back, and inside a pjit'd train step XLA turns the write-back into an
+in-place donation.
+
+Semantics match the reference exactly (rescale_grad, clip_gradient applied
+before wd, update order) so convergence curves are comparable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _rescale(grad, rescale_grad, clip_gradient):
+    grad = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        grad = jnp.clip(grad, -clip_gradient, clip_gradient)
+    return grad
+
+
+@register_op("sgd_update", arg_names=("weight", "grad"),
+             param_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                             "clip_gradient": -1.0})
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0):
+    grad = _rescale(grad, rescale_grad, clip_gradient)
+    return weight - lr * (grad + wd * weight)
+
+
+@register_op("sgd_mom_update", arg_names=("weight", "grad", "mom"),
+             num_outputs=2,
+             param_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                             "rescale_grad": 1.0, "clip_gradient": -1.0})
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    grad = _rescale(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (grad + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register_op("mp_sgd_update", arg_names=("weight", "grad", "weight32"),
+             num_outputs=2,
+             param_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                             "clip_gradient": -1.0})
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    # fp16 weights with fp32 master copy (mp_sgd_update in the reference)
+    grad = _rescale(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * (grad + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register_op("mp_sgd_mom_update",
+             arg_names=("weight", "grad", "mom", "weight32"), num_outputs=3,
+             param_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                             "rescale_grad": 1.0, "clip_gradient": -1.0})
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    grad = _rescale(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (grad + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register_op("adam_update", arg_names=("weight", "grad", "mean", "var"),
+             num_outputs=3,
+             param_defaults={"lr": 0.001, "beta1": 0.9, "beta2": 0.999,
+                             "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
+                             "clip_gradient": -1.0})
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    grad = _rescale(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * grad
+    new_var = beta2 * var + (1 - beta2) * jnp.square(grad)
+    new_weight = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_weight, new_mean, new_var
+
+
+@register_op("rmsprop_update", arg_names=("weight", "grad", "n"),
+             num_outputs=2,
+             param_defaults={"lr": 0.001, "gamma1": 0.95, "epsilon": 1e-8,
+                             "wd": 0.0, "rescale_grad": 1.0,
+                             "clip_gradient": -1.0, "clip_weights": -1.0})
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    grad = _rescale(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(grad) + gamma1 * n
+    new_weight = weight - lr * grad / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_weight = jnp.clip(new_weight, -clip_weights, clip_weights)
+    return new_weight, new_n
+
+
+@register_op("rmspropalex_update",
+             arg_names=("weight", "grad", "n", "g", "delta"), num_outputs=4,
+             param_defaults={"lr": 0.001, "gamma1": 0.95, "gamma2": 0.9,
+                             "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
+                             "clip_gradient": -1.0, "clip_weights": -1.0})
+def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    grad = _rescale(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(grad) + gamma1 * n
+    new_g = (1 - gamma1) * grad + gamma1 * g
+    new_delta = gamma2 * delta - lr * grad / \
+        jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_weight = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_weight = jnp.clip(new_weight, -clip_weights, clip_weights)
+    return new_weight, new_n, new_g, new_delta
+
+
+@register_op("ftrl_update", arg_names=("weight", "grad", "z", "n"),
+             num_outputs=3,
+             param_defaults={"lr": 0.1, "lamda1": 0.01, "beta": 1.0,
+                             "wd": 0.0, "rescale_grad": 1.0,
+                             "clip_gradient": -1.0})
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    grad = _rescale(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(grad)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + grad - sigma * weight
+    new_weight = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_weight, new_z, new_n

@@ -1,0 +1,165 @@
+"""Random sampling operators.
+
+TPU-native equivalents of /root/reference/src/operator/random/ — uniform,
+normal, gamma, exponential, poisson, negative binomial samplers plus the
+per-row ``sample_*`` family and ``sample_multinomial``.
+
+The reference draws from a per-device PRNG resource
+(ResourceRequest::kRandom); here every random op takes an explicit JAX PRNG
+key as its last positional input (``needs_rng``), threaded by the caller
+from ``mxnet_tpu.random``'s global seed state — functional randomness is
+the TPU-native discipline (XLA-friendly, reproducible across shardings).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, alias
+
+
+def _shape(shape):
+    if shape is None or shape == ():
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register_op("_random_uniform", arg_names=(), needs_rng=True,
+             param_defaults={"low": 0.0, "high": 1.0, "shape": (),
+                             "dtype": "float32"})
+def _random_uniform(rng, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(rng, _shape(shape), jnp.dtype(dtype or "float32"),
+                              minval=low, maxval=high)
+
+
+@register_op("_random_normal", arg_names=(), needs_rng=True,
+             param_defaults={"loc": 0.0, "scale": 1.0, "shape": (),
+                             "dtype": "float32"})
+def _random_normal(rng, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(rng, _shape(shape),
+                                           jnp.dtype(dtype or "float32"))
+
+
+@register_op("_random_gamma", arg_names=(), needs_rng=True,
+             param_defaults={"alpha": 1.0, "beta": 1.0, "shape": (),
+                             "dtype": "float32"})
+def _random_gamma(rng, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return beta * jax.random.gamma(rng, alpha, _shape(shape),
+                                   jnp.dtype(dtype or "float32"))
+
+
+@register_op("_random_exponential", arg_names=(), needs_rng=True,
+             param_defaults={"lam": 1.0, "shape": (), "dtype": "float32"})
+def _random_exponential(rng, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(rng, _shape(shape),
+                                  jnp.dtype(dtype or "float32")) / lam
+
+
+@register_op("_random_poisson", arg_names=(), needs_rng=True,
+             param_defaults={"lam": 1.0, "shape": (), "dtype": "float32"})
+def _random_poisson(rng, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(rng, lam, _shape(shape)).astype(
+        jnp.dtype(dtype or "float32"))
+
+
+@register_op("_random_negative_binomial", arg_names=(), needs_rng=True,
+             param_defaults={"k": 1, "p": 1.0, "shape": (),
+                             "dtype": "float32"})
+def _random_negative_binomial(rng, k=1, p=1.0, shape=(), dtype="float32"):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    kg, kp = jax.random.split(rng)
+    lam = jax.random.gamma(kg, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(kp, lam).astype(jnp.dtype(dtype or "float32"))
+
+
+@register_op("_random_generalized_negative_binomial", arg_names=(),
+             needs_rng=True,
+             param_defaults={"mu": 1.0, "alpha": 1.0, "shape": (),
+                             "dtype": "float32"})
+def _random_gnb(rng, mu=1.0, alpha=1.0, shape=(), dtype="float32"):
+    kg, kp = jax.random.split(rng)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(kg, r, _shape(shape)) * (mu * alpha)
+    return jax.random.poisson(kp, lam).astype(jnp.dtype(dtype or "float32"))
+
+
+alias("_random_uniform", "uniform", "random_uniform")
+alias("_random_normal", "normal", "random_normal")
+alias("_random_gamma", "random_gamma")
+alias("_random_exponential", "random_exponential")
+alias("_random_poisson", "random_poisson")
+alias("_random_negative_binomial", "random_negative_binomial")
+
+
+# -- per-row sample_* family (tensor distribution params) -------------------
+
+@register_op("sample_uniform", arg_names=("low", "high"), needs_rng=True,
+             param_defaults={"shape": (), "dtype": "float32"})
+def _sample_uniform(low, high, rng, shape=(), dtype="float32"):
+    s = _shape(shape)
+    u = jax.random.uniform(rng, low.shape + s, jnp.dtype(dtype or "float32"))
+    return low.reshape(low.shape + (1,) * len(s)) + \
+        u * (high - low).reshape(low.shape + (1,) * len(s))
+
+
+@register_op("sample_normal", arg_names=("mu", "sigma"), needs_rng=True,
+             param_defaults={"shape": (), "dtype": "float32"})
+def _sample_normal(mu, sigma, rng, shape=(), dtype="float32"):
+    s = _shape(shape)
+    n = jax.random.normal(rng, mu.shape + s, jnp.dtype(dtype or "float32"))
+    return mu.reshape(mu.shape + (1,) * len(s)) + \
+        n * sigma.reshape(sigma.shape + (1,) * len(s))
+
+
+@register_op("sample_gamma", arg_names=("alpha", "beta"), needs_rng=True,
+             param_defaults={"shape": (), "dtype": "float32"})
+def _sample_gamma(alpha, beta, rng, shape=(), dtype="float32"):
+    s = _shape(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(rng, jnp.broadcast_to(a, alpha.shape + s),
+                         dtype=jnp.dtype(dtype or "float32"))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register_op("sample_exponential", arg_names=("lam",), needs_rng=True,
+             param_defaults={"shape": (), "dtype": "float32"})
+def _sample_exponential(lam, rng, shape=(), dtype="float32"):
+    s = _shape(shape)
+    e = jax.random.exponential(rng, lam.shape + s,
+                               jnp.dtype(dtype or "float32"))
+    return e / lam.reshape(lam.shape + (1,) * len(s))
+
+
+@register_op("sample_poisson", arg_names=("lam",), needs_rng=True,
+             param_defaults={"shape": (), "dtype": "float32"})
+def _sample_poisson(lam, rng, shape=(), dtype="float32"):
+    s = _shape(shape)
+    l = jnp.broadcast_to(lam.reshape(lam.shape + (1,) * len(s)),
+                         lam.shape + s)
+    return jax.random.poisson(rng, l).astype(jnp.dtype(dtype or "float32"))
+
+
+@register_op("sample_multinomial", arg_names=("data",), needs_rng=True,
+             param_defaults={"shape": (), "get_prob": False,
+                             "dtype": "int32"},
+             num_outputs=lambda p: 2 if p.get("get_prob") else 1)
+def _sample_multinomial(data, rng, shape=(), get_prob=False, dtype="int32"):
+    # data: (..., K) probabilities (src/operator/random/multisample_op.cc)
+    s = _shape(shape)
+    n = 1
+    for d in s:
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-20))
+    flat = logits.reshape((-1, logits.shape[-1]))
+    draws = jax.random.categorical(rng, flat[:, None, :], axis=-1,
+                                   shape=(flat.shape[0], max(n, 1)))
+    out = draws.reshape(data.shape[:-1] + (s if s else ()))
+    out = out.astype(jnp.dtype(dtype or "int32"))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(flat, axis=-1), draws.astype(jnp.int32),
+            axis=-1).reshape(out.shape)
+        return out, logp
+    return out

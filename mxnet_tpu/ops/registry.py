@@ -1,0 +1,144 @@
+"""Operator registry.
+
+TPU-native analogue of the reference's NNVM op registry
+(``NNVM_REGISTER_OP`` / ``MXNET_REGISTER_OP_PROPERTY``, see
+/root/reference/include/mxnet/op_attr_types.h:171-240).  Each operator is a
+pure JAX function ``fn(*arrays, **params) -> array | tuple`` plus metadata:
+
+- ``arg_names`` — named inputs (data + learnable params), possibly a function
+  of the op's kwargs (e.g. Concat's ``num_args``);
+- ``aux_names`` — auxiliary states excluded from gradient (BatchNorm moving
+  stats), mirroring ``ListAuxiliaryStates`` in the reference;
+- ``num_outputs`` — static or a function of kwargs;
+- ``flatten_outputs`` — whether a single-element tuple unwraps.
+
+There is no FCompute<cpu>/FCompute<gpu> split: one jnp/lax lowering serves all
+backends, and XLA performs the kernel fusion the reference's graph executor
+did by hand (PlanMemory / inplace / op bulking,
+/root/reference/src/executor/graph_executor.cc:869-875,1328-1396).
+
+Shape/dtype inference — the reference's per-op ``FInferShape``/``FInferType``
+(/root/reference/src/executor/infer_graph_attr_pass.cc) — is derived
+automatically from the lowering via ``jax.eval_shape``: no per-op inference
+code can disagree with the kernel.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["OpDef", "register_op", "get_op", "list_ops", "alias"]
+
+_OP_REGISTRY: dict = {}
+
+
+def _hashable(value):
+    """Canonicalize a param value into something hashable for the jit cache."""
+    if isinstance(value, (list, tuple)):
+        return tuple(_hashable(v) for v in value)
+    if isinstance(value, dict):
+        return tuple(sorted((k, _hashable(v)) for k, v in value.items()))
+    return value
+
+
+class OpDef:
+    """A registered operator."""
+
+    def __init__(self, name, fn, arg_names=("data",), aux_names=(),
+                 num_outputs=1, param_defaults=None, mutate_aux=False,
+                 backward_ignore=(), needs_rng=False, takes_train=False):
+        self.name = name
+        self.fn = fn
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._num_outputs = num_outputs
+        self.param_defaults = dict(param_defaults or {})
+        # aux inputs the op updates in place during training (BatchNorm)
+        self.mutate_aux = mutate_aux
+        # arg names that never receive gradient (labels of loss heads)
+        self.backward_ignore = tuple(backward_ignore)
+        # op draws randomness: fn takes a PRNG key as its LAST positional arg
+        # (the analogue of ResourceRequest::kRandom,
+        # /root/reference/include/mxnet/resource.h:36-57)
+        self.needs_rng = needs_rng
+        # op behaves differently in training: fn takes kwarg ``_train``
+        # (the analogue of OpContext::is_train)
+        self.takes_train = takes_train
+        self._jit_cache = {}
+
+    # -- metadata ---------------------------------------------------------
+    def arg_names(self, params=None):
+        if callable(self._arg_names):
+            return list(self._arg_names(params or {}))
+        return list(self._arg_names)
+
+    def aux_names(self, params=None):
+        if callable(self._aux_names):
+            return list(self._aux_names(params or {}))
+        return list(self._aux_names)
+
+    def num_outputs(self, params=None):
+        if callable(self._num_outputs):
+            return self._num_outputs(params or {})
+        return self._num_outputs
+
+    def canon_params(self, params):
+        """Merge with defaults, drop Nones not in defaults, make hashable key."""
+        merged = dict(self.param_defaults)
+        merged.update({k: v for k, v in params.items() if v is not None or k in merged})
+        return merged
+
+    # -- execution --------------------------------------------------------
+    def jitted(self, **params):
+        """A jitted closure of fn over params (cached per param set)."""
+        key = _hashable(params)
+        fun = self._jit_cache.get(key)
+        if fun is None:
+            fun = jax.jit(functools.partial(self.fn, **params))
+            self._jit_cache[key] = fun
+        return fun
+
+    def __call__(self, *arrays, **params):
+        return self.jitted(**self.canon_params(params))(*arrays)
+
+    def abstract_eval(self, *avals, **params):
+        """Shape/dtype inference via jax.eval_shape (replaces FInferShape)."""
+        return jax.eval_shape(functools.partial(self.fn, **self.canon_params(params)),
+                              *avals)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register_op(name, arg_names=("data",), aux_names=(), num_outputs=1,
+                param_defaults=None, mutate_aux=False, backward_ignore=(),
+                needs_rng=False, takes_train=False):
+    """Decorator registering ``fn`` as operator ``name``."""
+    def _reg(fn):
+        op = OpDef(name, fn, arg_names=arg_names, aux_names=aux_names,
+                   num_outputs=num_outputs, param_defaults=param_defaults,
+                   mutate_aux=mutate_aux, backward_ignore=backward_ignore,
+                   needs_rng=needs_rng, takes_train=takes_train)
+        _OP_REGISTRY[name] = op
+        return fn
+    return _reg
+
+
+def alias(name, *aliases):
+    """Register additional names for an existing op."""
+    op = _OP_REGISTRY[name]
+    for a in aliases:
+        _OP_REGISTRY[a] = op
+
+
+def get_op(name):
+    op = _OP_REGISTRY.get(name)
+    if op is None:
+        raise KeyError("Operator %s is not registered" % name)
+    return op
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)

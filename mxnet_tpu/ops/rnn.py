@@ -1,0 +1,159 @@
+"""Fused RNN operator.
+
+TPU-native replacement for the reference's GPU-only cuDNN fused RNN
+(/root/reference/src/operator/cudnn_rnn-inl.h; the CPU path is
+``LOG(FATAL) "Not Implemented"``, rnn-inl.h:124,320).  Lowering strategy:
+
+- the input projection for ALL timesteps is one large (T*N, I) x (I, G*H)
+  matmul — MXU-shaped work hoisted out of the recurrence;
+- the recurrence itself is ``lax.scan`` over time with the (N, H) x (H, G*H)
+  hidden matmul per step — XLA compiles the loop once, static shapes;
+- bidirectional runs the reverse direction as a flipped scan and concats;
+- multi-layer stacks feed the previous layer's (T, N, D*H) output upward.
+
+Weight layout is a single packed parameter vector like cuDNN's filter blob:
+for each layer, then each direction: [W(G*H, in), R(G*H, H), bW(G*H),
+bR(G*H)].  Gate order: LSTM i,f,g,o; GRU r,z,n — matching cuDNN so
+``mx.rnn.FusedRNNCell.unfuse`` semantics carry over.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total packed parameter count (mirrors cudnn_rnn-inl.h filter sizing)."""
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * D
+        per_dir = G * state_size * (in_sz + state_size) + 2 * G * state_size
+        total += per_dir * D
+    return total
+
+
+def _unpack(params, num_layers, input_size, state_size, bidirectional, mode):
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    H = state_size
+    out = []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        dirs = []
+        for _ in range(D):
+            W = params[off:off + G * H * in_sz].reshape((G * H, in_sz))
+            off += G * H * in_sz
+            R = params[off:off + G * H * H].reshape((G * H, H))
+            off += G * H * H
+            bW = params[off:off + G * H]
+            off += G * H
+            bR = params[off:off + G * H]
+            off += G * H
+            dirs.append((W, R, bW, bR))
+        out.append(dirs)
+    return out
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, xw, R, bR):
+            h, c = carry
+            gates = xw + jnp.matmul(h, R.T) + bR
+            i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
+            f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
+            g = jnp.tanh(gates[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+    elif mode == "gru":
+        def step(carry, xw, R, bR):
+            (h,) = carry
+            rh = jnp.matmul(h, R.T) + bR
+            r = jax.nn.sigmoid(xw[:, 0 * H:1 * H] + rh[:, 0 * H:1 * H])
+            z = jax.nn.sigmoid(xw[:, 1 * H:2 * H] + rh[:, 1 * H:2 * H])
+            n = jnp.tanh(xw[:, 2 * H:3 * H] + r * rh[:, 2 * H:3 * H])
+            h2 = (1 - z) * n + z * h
+            return (h2,), h2
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+        def step(carry, xw, R, bR):
+            (h,) = carry
+            h2 = act(xw + jnp.matmul(h, R.T) + bR)
+            return (h2,), h2
+    return step
+
+
+def _run_direction(x, Wt, R, bW, bR, h0, c0, mode, H, reverse):
+    # x: (T, N, in); hoist the input projection out of the scan (MXU batch)
+    T, N = x.shape[0], x.shape[1]
+    xw = jnp.matmul(x.reshape((T * N, -1)), Wt.T).reshape((T, N, -1)) + bW
+    step = _cell_step(mode, H)
+    carry = (h0, c0) if mode == "lstm" else (h0,)
+
+    def body(carry, xw_t):
+        return step(carry, xw_t, R, bR)
+
+    carry, ys = lax.scan(body, carry, xw, reverse=reverse)
+    return carry, ys
+
+
+@register_op("RNN",
+             arg_names=lambda p: (["data", "parameters", "state", "state_cell"]
+                                  if p.get("mode") == "lstm"
+                                  else ["data", "parameters", "state"]),
+             takes_train=True, needs_rng=True,
+             num_outputs=lambda p: (
+                 (3 if p.get("mode") == "lstm" else 2)
+                 if p.get("state_outputs") else 1),
+             param_defaults={"state_size": 0, "num_layers": 1,
+                             "bidirectional": False, "mode": "lstm",
+                             "p": 0.0, "state_outputs": False,
+                             "lstm_state_clip_min": None,
+                             "lstm_state_clip_max": None})
+def _rnn(data, parameters, state, state_cell=None, rng=None, state_size=0,
+         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+         state_outputs=False, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, _train=False):
+    if mode != "lstm" and state_cell is not None and rng is None:
+        # non-LSTM callers pass only 3 named inputs, so the appended PRNG
+        # key arrives in the state_cell slot — rebind it
+        rng, state_cell = state_cell, None
+    T, N, I = data.shape
+    H = state_size
+    D = 2 if bidirectional else 1
+    layers = _unpack(parameters, num_layers, I, H, bidirectional, mode)
+    x = data
+    h_states, c_states = [], []
+    for li, dirs in enumerate(layers):
+        outs = []
+        for di, (W, R, bW, bR) in enumerate(dirs):
+            idx = li * D + di
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            carry, ys = _run_direction(x, W, R, bW, bR, h0, c0, mode, H,
+                                       reverse=(di == 1))
+            h_states.append(carry[0])
+            if mode == "lstm":
+                c_states.append(carry[1])
+            outs.append(ys)
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and _train and li < num_layers - 1 and rng is not None:
+            key = jax.random.fold_in(rng, li)
+            mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
+            x = jnp.where(mask, x / (1.0 - p), jnp.zeros_like(x))
+    out = x  # (T, N, D*H)
+    if not state_outputs:
+        return out
+    hs = jnp.stack(h_states)
+    if mode == "lstm":
+        return out, hs, jnp.stack(c_states)
+    return out, hs

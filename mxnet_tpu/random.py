@@ -1,0 +1,58 @@
+"""Global PRNG state.
+
+The reference seeds per-device random resources via ``mx.random.seed``
+(/root/reference/python/mxnet/random.py, src/resource.cc).  Here a single
+functional JAX key chain is the source of randomness; every random op pulls
+``next_key()``, so runs are reproducible after ``seed(n)`` regardless of
+device layout.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key"]
+
+_LOCK = threading.Lock()
+# lazy: creating a key touches the device backend, which must not happen at
+# import time (it would initialize/occupy the TPU for every importer)
+_KEY = None
+
+
+def seed(seed_state):
+    """Seed the global generator (reference: mx.random.seed)."""
+    global _KEY
+    with _LOCK:
+        _KEY = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split one key off the global chain."""
+    global _KEY
+    with _LOCK:
+        if _KEY is None:
+            _KEY = jax.random.PRNGKey(0)
+        _KEY, sub = jax.random.split(_KEY)
+    return sub
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    from .ndarray.ndarray import imperative_invoke
+    return imperative_invoke("_random_uniform", (), {
+        "low": low, "high": high, "shape": shape, "dtype": dtype}, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    from .ndarray.ndarray import imperative_invoke
+    return imperative_invoke("_random_normal", (), {
+        "loc": loc, "scale": scale, "shape": shape, "dtype": dtype}, out=out)
+
+
+def randint(low, high, shape=(), dtype="int32", ctx=None, out=None):
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+    key = next_key()
+    data = jax.random.randint(key, tuple(shape) if shape else (),
+                              low, high).astype(jnp.dtype(dtype))
+    return NDArray(data)

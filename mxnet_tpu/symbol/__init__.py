@@ -1,0 +1,25 @@
+"""The ``sym`` namespace: Symbol + every registered operator as a creator.
+
+Mirrors /root/reference/python/mxnet/symbol/__init__.py.
+"""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     populate as _populate)
+from . import shape_hints  # noqa: F401 - registers FInferShape analogues
+
+_populate(globals())
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return globals()["_zeros"](shape=shape, dtype=str(dtype or "float32"),
+                               **kwargs)
+
+
+def ones(shape, dtype=None, **kwargs):
+    return globals()["_ones"](shape=shape, dtype=str(dtype or "float32"),
+                              **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
+    return globals()["_arange"](start=start, stop=stop, step=step,
+                                repeat=repeat,
+                                dtype=str(dtype or "float32"), **kwargs)

@@ -1,0 +1,115 @@
+"""Parameter shape inference hints.
+
+The reference's per-op FInferShape fills in *unknown input* shapes (conv
+weights, BN gammas, ...) from the data shape during simple_bind
+(src/executor/infer_graph_attr_pass.cc).  Forward inference here is free
+(jax.eval_shape runs the lowering abstractly); these hints supply only the
+reverse direction: given known data shapes + op params, the shapes of the
+learnable/auxiliary inputs.
+
+Each hint: ``fn(shape_map: {arg_name: shape|None}, params) -> {name: shape}``.
+"""
+from __future__ import annotations
+
+from ..ops import get_op
+from ..ops.rnn import rnn_param_size
+
+
+def _register(op_name, fn):
+    get_op(op_name).shape_hint = fn
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+def _fc_hint(shapes, params):
+    data = shapes.get("data")
+    nh = int(params.get("num_hidden", 0))
+    out = {}
+    if data is not None:
+        in_dim = _prod(data[1:]) if params.get("flatten", True) else data[-1]
+        out["weight"] = (nh, in_dim)
+    out["bias"] = (nh,)
+    return out
+
+
+_register("FullyConnected", _fc_hint)
+
+
+def _conv_hint(shapes, params):
+    data = shapes.get("data")
+    nf = int(params.get("num_filter", 0))
+    kernel = tuple(params.get("kernel", ()))
+    ng = int(params.get("num_group", 1))
+    out = {"bias": (nf,)}
+    if data is not None:
+        out["weight"] = (nf, data[1] // ng) + kernel
+    return out
+
+
+_register("Convolution", _conv_hint)
+
+
+def _deconv_hint(shapes, params):
+    data = shapes.get("data")
+    nf = int(params.get("num_filter", 0))
+    kernel = tuple(params.get("kernel", ()))
+    ng = int(params.get("num_group", 1))
+    out = {"bias": (nf,)}
+    if data is not None:
+        out["weight"] = (data[1], nf // ng) + kernel
+    return out
+
+
+_register("Deconvolution", _deconv_hint)
+
+
+def _channel_hint(*names):
+    def hint(shapes, params):
+        data = shapes.get("data")
+        if data is None:
+            return {}
+        axis = int(params.get("axis", 1))
+        c = data[axis % len(data)]
+        return {n: (c,) for n in names}
+    return hint
+
+
+_register("BatchNorm", _channel_hint("gamma", "beta", "moving_mean",
+                                     "moving_var"))
+_register("InstanceNorm", _channel_hint("gamma", "beta"))
+_register("LeakyReLU", _channel_hint("gamma"))
+
+
+def _embedding_hint(shapes, params):
+    return {"weight": (int(params.get("input_dim", 0)),
+                       int(params.get("output_dim", 0)))}
+
+
+_register("Embedding", _embedding_hint)
+
+
+def _rnn_hint(shapes, params):
+    data = shapes.get("data")
+    if data is None:
+        return {}
+    T, N, I = data
+    H = int(params.get("state_size", 0))
+    L = int(params.get("num_layers", 1))
+    bi = bool(params.get("bidirectional", False))
+    D = 2 if bi else 1
+    mode = params.get("mode", "lstm")
+    out = {
+        "parameters": (rnn_param_size(L, I, H, bi, mode),),
+        "state": (L * D, N, H),
+    }
+    if mode == "lstm":
+        out["state_cell"] = (L * D, N, H)
+    return out
+
+
+_register("RNN", _rnn_hint)

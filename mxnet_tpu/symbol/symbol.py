@@ -1,0 +1,643 @@
+"""Symbol: the declarative graph API.
+
+TPU-native analogue of the reference Symbol
+(/root/reference/python/mxnet/symbol/symbol.py + nnvm's Symbol/Graph).  A
+Symbol is an immutable DAG of op nodes over named variables; binding it
+traces the graph into a single JAX function and jit-compiles it — the
+pipeline that in the reference was simple_bind → GraphExecutor::Init →
+nnvm passes (Gradient/PlaceDevice/PlanMemory/AttachOpExecs,
+src/executor/graph_executor.cc:1556) collapses into trace→XLA (SURVEY §3.2).
+
+Missing learnable inputs are auto-created as variables with reference
+naming (``convolution0_weight``), auxiliary states (BatchNorm moving stats)
+are tracked separately, and shape/dtype inference runs the registered
+lowerings abstractly via ``jax.eval_shape`` with per-op hints filling
+parameter shapes (the analogue of each op's FInferShape).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from ..attribute import AttrScope
+from ..base import MXNetError
+from ..name import NameManager
+from ..ops import get_op
+from ..ops.registry import _OP_REGISTRY
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+class _SymNode:
+    """One op application in the graph."""
+
+    __slots__ = ("op", "name", "params", "inputs", "attrs", "is_var",
+                 "is_aux_var")
+
+    def __init__(self, op, name, params, inputs, attrs=None, is_var=False,
+                 is_aux_var=False):
+        self.op = op
+        self.name = name
+        self.params = params or {}
+        self.inputs = inputs  # list of (node, out_index)
+        self.attrs = dict(attrs or {})
+        self.is_var = is_var
+        self.is_aux_var = is_aux_var
+
+    def num_outputs(self):
+        if self.is_var:
+            return 1
+        return self.op.num_outputs(self.params)
+
+    def output_names(self):
+        if self.is_var:
+            return [self.name]
+        n = self.num_outputs()
+        if n == 1:
+            return ["%s_output" % self.name]
+        return ["%s_output%d" % (self.name, i) for i in range(n)]
+
+
+class Symbol:
+    """A handle onto one or more outputs of a graph."""
+
+    __slots__ = ("_node", "_indices")
+
+    def __init__(self, node, indices=None):
+        self._node = node
+        self._indices = indices  # list of (node, idx); None → all of _node
+
+    # -- handle helpers ----------------------------------------------------
+    @property
+    def _outputs(self):
+        """List of (node, out_index) this symbol denotes."""
+        if self._indices is not None:
+            return self._indices
+        return [(self._node, i) for i in range(self._node.num_outputs())]
+
+    @property
+    def name(self):
+        outs = self._outputs
+        if len(outs) == 1:
+            return outs[0][0].name
+        return None  # grouped symbol, like the reference returns None
+
+    def __repr__(self):
+        if self._indices is not None and len(self._indices) > 1:
+            return "<Symbol group [%s]>" % ", ".join(
+                n.name for n, _ in self._indices)
+        return "<Symbol %s>" % (self.name,)
+
+    def __iter__(self):
+        return (Symbol(n, [(n, i)]) for n, i in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        outs = self._outputs
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index in names:
+                index = names.index(index)
+            else:
+                raise ValueError("Cannot find output %s" % index)
+        if isinstance(index, slice):
+            return Symbol(self._node, outs[index])
+        return Symbol(outs[index][0], [outs[index]])
+
+    def __copy__(self):
+        return Symbol(self._node, self._indices)
+
+    def __deepcopy__(self, memo):
+        return Symbol(self._node, self._indices)
+
+    # -- graph traversal ---------------------------------------------------
+    def _topo_nodes(self):
+        """Topological order of nodes reachable from this symbol."""
+        seen = set()
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for n, _ in self._outputs:
+            visit(n)
+        return order
+
+    def list_arguments(self):
+        return [n.name for n in self._topo_nodes()
+                if n.is_var and not n.is_aux_var]
+
+    def list_outputs(self):
+        names = []
+        for n, i in self._outputs:
+            names.append(n.output_names()[i])
+        return names
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo_nodes() if n.is_aux_var]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_var]
+
+    def get_internals(self):
+        outs = []
+        for n in self._topo_nodes():
+            for i in range(n.num_outputs()):
+                outs.append((n, i))
+        return Symbol(self._node, outs)
+
+    def get_children(self):
+        nodes = []
+        for n, _ in self._outputs:
+            nodes.extend(n.inputs)
+        if not nodes:
+            return None
+        return Symbol(nodes[0][0], nodes)
+
+    # -- attributes --------------------------------------------------------
+    def attr(self, key):
+        outs = self._outputs
+        if len(outs) == 1:
+            return outs[0][0].attrs.get(key)
+        return None
+
+    def list_attr(self):
+        outs = self._outputs
+        if len(outs) == 1:
+            return dict(outs[0][0].attrs)
+        return {}
+
+    def attr_dict(self):
+        out = {}
+        for n in self._topo_nodes():
+            if n.attrs:
+                out[n.name] = dict(n.attrs)
+        return out
+
+    def _set_attr(self, **kwargs):
+        for n, _ in self._outputs:
+            n.attrs.update(kwargs)
+
+    # -- composition -------------------------------------------------------
+    def _binary(self, other, op_name, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _apply_op(get_op(op_name), None, [a, b], {})
+        if isinstance(other, (int, float)):
+            return _apply_op(get_op(scalar_op), None, [self],
+                             {"scalar": float(other)})
+        raise TypeError("type %s not supported" % type(other))
+
+    def __add__(self, other):
+        return self._binary(other, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _apply_op(get_op("_rminus_scalar"), None, [self],
+                         {"scalar": float(other)})
+
+    def __mul__(self, other):
+        return self._binary(other, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "elemwise_div", "_div_scalar")
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, other):
+        return _apply_op(get_op("_rdiv_scalar"), None, [self],
+                         {"scalar": float(other)})
+
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, other):
+        return self._binary(other, "elemwise_power", "_power_scalar")
+
+    def __neg__(self):
+        return _apply_op(get_op("negative"), None, [self], {})
+
+    def __eq__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return self._binary(other, "broadcast_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return self._binary(other, "broadcast_not_equal",
+                                "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, other):
+        return self._binary(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binary(other, "broadcast_greater_equal",
+                            "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binary(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binary(other, "broadcast_lesser_equal",
+                            "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    # convenience op methods mirroring the reference's generated methods
+    def reshape(self, shape, **kwargs):
+        return _apply_op(get_op("Reshape"), kwargs.get("name"), [self],
+                         {"shape": shape})
+
+    def astype(self, dtype):
+        return _apply_op(get_op("Cast"), None, [self], {"dtype": str(dtype)})
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes, _ = self._infer(args, kwargs)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self.infer_shape(*args, **kwargs)
+        except MXNetError:
+            return None, None, None
+
+    def infer_type(self, *args, **kwargs):
+        known = {}
+        if args:
+            for name, t in zip(self.list_arguments(), args):
+                if t is not None:
+                    known[name] = _np.dtype(t)
+        known.update({k: _np.dtype(v) for k, v in kwargs.items()})
+        # types ride the same abstract evaluation as shapes
+        try:
+            _, _, _, avals = self._infer((), {}, dtype_hint=known,
+                                         require_shapes=False)
+        except MXNetError:
+            return None, None, None
+        args_t = [avals["arg:" + n][1] for n in self.list_arguments()]
+        outs_t = [avals["out:%d" % i][1] for i in range(len(self._outputs))]
+        aux_t = [avals["aux:" + n][1]
+                 for n in self.list_auxiliary_states()]
+        return args_t, outs_t, aux_t
+
+    def _infer(self, args, kwargs, dtype_hint=None, require_shapes=True):
+        """Joint shape+dtype inference over the graph via jax.eval_shape."""
+        import jax
+
+        arg_names = self.list_arguments()
+        known_shapes = {}
+        if args:
+            for name, s in zip(arg_names, args):
+                if s is not None:
+                    known_shapes[name] = tuple(s)
+        for k, v in kwargs.items():
+            if v is not None:
+                known_shapes[k] = tuple(v)
+        dtype_hint = dtype_hint or {}
+
+        aval = {}   # id(node) -> list of ShapeDtypeStruct per output
+        named = {}
+
+        def node_aval(node):
+            if id(node) in aval:
+                return aval[id(node)]
+            if node.is_var:
+                shape = known_shapes.get(node.name)
+                dtype = dtype_hint.get(node.name, _np.float32)
+                sds = (jax.ShapeDtypeStruct(shape, dtype)
+                       if shape is not None else None)
+                aval[id(node)] = [sds]
+                return aval[id(node)]
+            in_avals = []
+            unknown = {}
+            for i, (inp, idx) in enumerate(node.inputs):
+                ia = node_aval(inp)[idx]
+                in_avals.append(ia)
+                if ia is None:
+                    unknown[i] = inp
+            if unknown:
+                hint = getattr(node.op, "shape_hint", None)
+                if hint is None:
+                    missing = [n.name for n in unknown.values()]
+                    raise MXNetError(
+                        "cannot infer shape of %s (inputs of %s); provide "
+                        "shapes or register a shape hint" %
+                        (missing, node.name))
+                names = node.op.arg_names(node.params) + \
+                    node.op.aux_names(node.params)
+                shape_map = {names[i]: (tuple(a.shape) if a is not None
+                                        else None)
+                             for i, a in enumerate(in_avals)}
+                hinted = hint(shape_map, node.params)
+                for i, vnode in unknown.items():
+                    hs = hinted.get(names[i])
+                    if hs is None:
+                        raise MXNetError("shape hint for %s could not infer "
+                                         "%s" % (node.name, names[i]))
+                    dtype = dtype_hint.get(vnode.name, _np.float32)
+                    sds = jax.ShapeDtypeStruct(tuple(hs), dtype)
+                    aval[id(vnode)] = [sds]
+                    in_avals[i] = sds
+            fn_inputs = list(in_avals)
+            params = dict(node.params)
+            if node.op.takes_train:
+                params["_train"] = True
+            if node.op.needs_rng:
+                fn_inputs.append(
+                    jax.ShapeDtypeStruct((2,), _np.uint32))
+            out = node.op.abstract_eval(*fn_inputs,
+                                        **node.op.canon_params(params))
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            # visible outputs only (drop trailing aux-update values)
+            n_vis = node.op.num_outputs(node.params)
+            aval[id(node)] = outs[:n_vis]
+            return aval[id(node)]
+
+        for n, i in self._outputs:
+            node_aval(n)
+
+        nodes = self._topo_nodes()
+        for node in nodes:
+            if node.is_var:
+                a = aval.get(id(node), [None])[0]
+                if a is None and require_shapes:
+                    raise MXNetError("cannot fully infer shape of %s"
+                                     % node.name)
+                key = ("aux:" if node.is_aux_var else "arg:") + node.name
+                named[key] = (tuple(a.shape), a.dtype) if a is not None \
+                    else (None, None)
+        for i, (n, idx) in enumerate(self._outputs):
+            a = node_aval(n)[idx]
+            named["out:%d" % i] = (tuple(a.shape), a.dtype)
+
+        arg_shapes = [named["arg:" + n][0] for n in arg_names]
+        out_shapes = [named["out:%d" % i][0]
+                      for i in range(len(self._outputs))]
+        aux_shapes = [named["aux:" + n][0]
+                      for n in self.list_auxiliary_states()]
+        return arg_shapes, out_shapes, aux_shapes, named
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        from ..context import current_context
+        from .. import nd
+        ctx = ctx or current_context()
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes for simple_bind")
+        type_dict = type_dict or {}
+        args = {}
+        for name, shape in zip(self.list_arguments(), arg_shapes):
+            dtype = type_dict.get(name, _np.float32)
+            args[name] = nd.zeros(shape, ctx=ctx, dtype=dtype)
+        aux = {}
+        for name, shape in zip(self.list_auxiliary_states(), aux_shapes):
+            aux[name] = nd.zeros(shape, ctx=ctx,
+                                 dtype=type_dict.get(name, _np.float32))
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {
+                name: nd.zeros(a.shape, ctx=ctx, dtype=a.dtype)
+                for name, a in args.items()}
+        return Executor(self, ctx, args, args_grad, grad_req, aux,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        from ..context import current_context
+        ctx = ctx or current_context()
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(self.list_auxiliary_states(), aux_states))
+        return Executor(self, ctx, args or {}, args_grad, grad_req,
+                        aux_states or {}, group2ctx=group2ctx,
+                        shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        exe = self.bind(ctx, args=kwargs, grad_req="null")
+        return exe.forward()
+
+    def grad(self, wrt):  # pragma: no cover - reference-deprecated API
+        raise NotImplementedError("use bind().backward()")
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        """nnvm-style JSON (reference format: nodes/arg_nodes/heads)."""
+        nodes = self._topo_nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            entry = {
+                "op": "null" if n.is_var else n.op.name,
+                "name": n.name,
+                "inputs": [[nid[id(i)], idx, 0] for i, idx in n.inputs],
+            }
+            attrs = {}
+            for k, v in n.params.items():
+                attrs[k] = str(v)
+            if n.attrs:
+                attrs.update({"__%s__" % k if not k.startswith("__") else k: v
+                              for k, v in n.attrs.items()})
+            if n.is_aux_var:
+                attrs["__aux__"] = "True"
+            if attrs:
+                entry["attrs"] = attrs
+            out_nodes.append(entry)
+        heads = [[nid[id(n)], i, 0] for n, i in self._outputs]
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_var]
+        return json.dumps({
+            "nodes": out_nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 1100]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for n in self._topo_nodes():
+            if n.is_var:
+                lines.append("Variable:%s" % n.name)
+            else:
+                ins = ", ".join("%s[%d]" % (i.name, idx)
+                                for i, idx in n.inputs)
+                lines.append("Op:%s, Name=%s, Inputs=[%s]"
+                             % (n.op.name, n.name, ins))
+        return "\n".join(lines)
+
+
+def _parse_attr_value(v):
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load_json(json_str):
+    """Load a Symbol from its JSON string (reference: mx.sym.load_json)."""
+    data = json.loads(json_str)
+    nodes = []
+    for entry in data["nodes"]:
+        attrs = entry.get("attrs", entry.get("param", {})) or {}
+        user_attrs = {k[2:-2]: v for k, v in attrs.items()
+                      if k.startswith("__") and k.endswith("__")
+                      and k != "__aux__"}
+        params = {k: _parse_attr_value(v) for k, v in attrs.items()
+                  if not (k.startswith("__") and k.endswith("__"))}
+        if entry["op"] == "null":
+            node = _SymNode(None, entry["name"], {}, [], attrs=user_attrs,
+                            is_var=True,
+                            is_aux_var=attrs.get("__aux__") == "True")
+        else:
+            op = get_op(entry["op"])
+            inputs = [(nodes[i], idx) for i, idx, *_ in entry["inputs"]]
+            node = _SymNode(op, entry["name"], params, inputs,
+                            attrs=user_attrs)
+        nodes.append(node)
+    heads = data.get("heads", [[len(nodes) - 1, 0, 0]])
+    outs = [(nodes[h[0]], h[1]) for h in heads]
+    if len(outs) == 1:
+        return Symbol(outs[0][0], outs)
+    return Symbol(outs[0][0], outs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a named variable (reference: mx.sym.Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attr = AttrScope.current().get(attr)
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else \
+            init.dumps() if hasattr(init, "dumps") else str(init)
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            attrs[k] = str(v)
+    node = _SymNode(None, name, {}, [], attrs=attrs, is_var=True)
+    return Symbol(node, [(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (reference: mx.sym.Group)."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs[0][0], outs)
+
+
+# ---------------------------------------------------------------------------
+# Symbolic op application (the analogue of MXSymbolCreateAtomicSymbol +
+# Compose, c_api_symbolic.cc)
+# ---------------------------------------------------------------------------
+
+def _apply_op(op, name, sym_args, params, **sym_kwargs):
+    hint = op.name.lower().replace("_", "")
+    if op.name.startswith("_"):
+        hint = "op" + hint
+    name = NameManager.current().get(name, hint)
+    attrs = AttrScope.current().get(None)
+
+    arg_names = op.arg_names(params)
+    aux_names = op.aux_names(params)
+
+    inputs = [None] * len(arg_names)
+    # positional then keyword symbol inputs
+    for i, s in enumerate(sym_args):
+        if i >= len(arg_names):
+            raise MXNetError("too many positional inputs for %s" % op.name)
+        inputs[i] = s
+    for k, v in sym_kwargs.items():
+        if k in arg_names:
+            inputs[arg_names.index(k)] = v
+        else:
+            raise MXNetError("unknown input %s for %s" % (k, op.name))
+    # auto-create variables for missing learnable inputs
+    filled = []
+    for argname, s in zip(arg_names, inputs):
+        if s is None:
+            s = Variable("%s_%s" % (name, argname))
+        filled.append(s)
+    for auxname in aux_names:
+        v = Variable("%s_%s" % (name, auxname))
+        v._outputs[0][0].is_aux_var = True
+        filled.append(v)
+
+    node_inputs = []
+    for s in filled:
+        outs = s._outputs
+        if len(outs) != 1:
+            raise MXNetError("input symbols must have a single output")
+        node_inputs.append(outs[0])
+
+    node = _SymNode(op, name, params, node_inputs, attrs=attrs)
+    return Symbol(node, [(node, i) for i in range(node.num_outputs())])
+
+
+def make_symbol_function(op, func_name):
+    def creator(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        sym_args = list(args)
+        sym_kwargs = {}
+        params = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                sym_kwargs[k] = v
+            else:
+                params[k] = v
+        return _apply_op(op, name, sym_args, params, **sym_kwargs)
+    creator.__name__ = func_name
+    creator.__doc__ = (op.fn.__doc__ or "") + \
+        "\n\nSymbolic version of operator `%s`." % op.name
+    return creator
+
+
+def populate(namespace):
+    for opname, op in list(_OP_REGISTRY.items()):
+        if opname not in namespace:
+            namespace[opname] = make_symbol_function(op, opname)

@@ -1,0 +1,165 @@
+"""NDArray semantics tests — ports the core assertions of the reference's
+tests/python/unittest/test_ndarray.py to the TPU-native NDArray."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert (a.asnumpy() == 0).all()
+    b = nd.ones((2,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.5)
+    assert (c.asnumpy() == 7.5).all()
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_array_equal(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_elementwise_arith():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[4.0, 3.0], [2.0, 1.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[5, 5], [5, 5]])
+    np.testing.assert_allclose((a - b).asnumpy(), [[-3, -1], [1, 3]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[4, 6], [6, 4]])
+    np.testing.assert_allclose((a / b).asnumpy(),
+                               np.array([[0.25, 2 / 3], [1.5, 4]]),
+                               rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose((2 + a).asnumpy(), [[3, 4], [5, 6]])
+    np.testing.assert_allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    np.testing.assert_allclose((10 / a).asnumpy(), [[10, 5], [10/3, 2.5]],
+                               rtol=1e-6)
+    np.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+
+
+def test_inplace_arith():
+    a = nd.ones((2, 2))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+    a /= 2
+    np.testing.assert_allclose(a.asnumpy(), 3 * np.ones((2, 2)))
+    a -= 1
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal((a != b).asnumpy(), [1, 0, 1])
+    np.testing.assert_array_equal((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal((a >= 2).asnumpy(), [0, 1, 1])
+    np.testing.assert_array_equal((a < b).asnumpy(), [1, 0, 0])
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_array_equal(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_array_equal(a[1:3].asnumpy(),
+                                  np.arange(12).reshape(3, 4)[1:3])
+    a[1] = 0
+    assert (a.asnumpy()[1] == 0).all()
+    a[:] = 5
+    assert (a.asnumpy() == 5).all()
+    a[0, 2] = -1
+    assert a.asnumpy()[0, 2] == -1
+
+
+def test_setitem_broadcast_full_slice():
+    a = nd.zeros((2, 3))
+    a[:] = nd.array([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(a.asnumpy(), [[1, 2, 3], [1, 2, 3]])
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose((2, 0, 1)).shape == (4, 2, 3)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+
+
+def test_copy_and_context():
+    a = nd.array([1.0, 2.0])
+    b = a.copy()
+    b[:] = 9
+    np.testing.assert_array_equal(a.asnumpy(), [1, 2])
+    c = a.copyto(mx.cpu(0))
+    assert c.context.device_type == "cpu"
+    d = a.as_in_context(a.context)
+    assert d is a
+    a.wait_to_read()
+    nd.waitall()
+
+
+def test_astype_scalar():
+    a = nd.array([3.7])
+    assert a.astype("int32").dtype == np.int32
+    assert a.asscalar() == np.float32(3.7)
+    assert float(nd.sum(a).asscalar()) == pytest.approx(3.7, rel=1e-6)
+
+
+def test_reductions_methods():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.sum().asscalar() == 15
+    np.testing.assert_array_equal(a.sum(0).asnumpy(), [3, 5, 7])
+    assert a.mean().asscalar() == pytest.approx(2.5)
+    assert a.max().asscalar() == 5
+    assert a.min().asscalar() == 0
+    np.testing.assert_array_equal(a.argmax(1).asnumpy(), [2, 2])
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "t.params")
+    a, b = nd.array([1.0, 2.0]), nd.ones((2, 2))
+    nd.save(fname, [a, b])
+    alist = nd.load(fname)
+    assert len(alist) == 2
+    np.testing.assert_array_equal(alist[0].asnumpy(), a.asnumpy())
+    nd.save(fname, {"w": a, "b": b})
+    adict = nd.load(fname)
+    assert set(adict) == {"w", "b"}
+    np.testing.assert_array_equal(adict["b"].asnumpy(), b.asnumpy())
+
+
+def test_concatenate():
+    a = nd.ones((2, 3))
+    b = nd.zeros((3, 3))
+    c = nd.concatenate([a, b], axis=0)
+    assert c.shape == (5, 3)
+
+
+def test_sparse_facade():
+    from mxnet_tpu.ndarray import sparse
+    dense = np.zeros((4, 3), dtype=np.float32)
+    dense[1] = [1, 2, 3]
+    dense[3] = [4, 5, 6]
+    rsp = sparse.row_sparse_array((np.array([[1, 2, 3], [4, 5, 6]],
+                                            dtype=np.float32), [1, 3]),
+                                  shape=(4, 3))
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 3])
+    np.testing.assert_array_equal(rsp.data.asnumpy(), dense[[1, 3]])
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    kept = sparse.sparse_retain(rsp, [3])
+    np.testing.assert_array_equal(kept.asnumpy()[1], 0)
+    np.testing.assert_array_equal(kept.asnumpy()[3], dense[3])
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 0, 3, 3, 6])

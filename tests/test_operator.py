@@ -1,0 +1,343 @@
+"""Operator semantics tests — numeric checks of the jnp/lax lowerings
+against numpy references, modelled on the reference's
+tests/python/unittest/test_operator.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_fully_connected():
+    x = np.random.randn(4, 10).astype(np.float32)
+    w = np.random.randn(3, 10).astype(np.float32)
+    b = np.random.randn(3).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=3)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T + b, rtol=1e-5)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=3,
+                             no_bias=True)
+    np.testing.assert_allclose(out2.asnumpy(), x @ w.T, rtol=1e-5)
+    # 4D input flattens
+    x4 = np.random.randn(2, 2, 5, 1).astype(np.float32)
+    out3 = nd.FullyConnected(nd.array(x4), nd.array(w), nd.array(b),
+                             num_hidden=3)
+    np.testing.assert_allclose(out3.asnumpy(),
+                               x4.reshape(2, -1) @ w.T + b, rtol=1e-5)
+
+
+def test_convolution_shapes():
+    x = nd.array(np.random.randn(2, 3, 8, 8).astype(np.float32))
+    w = nd.array(np.random.randn(4, 3, 3, 3).astype(np.float32))
+    b = nd.zeros((4,))
+    y = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert y.shape == (2, 4, 6, 6)
+    y = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                       stride=(2, 2))
+    assert y.shape == (2, 4, 4, 4)
+    # grouped
+    wg = nd.array(np.random.randn(6, 1, 3, 3).astype(np.float32))
+    yg = nd.Convolution(x, wg, nd.zeros((6,)), kernel=(3, 3), num_filter=6,
+                        num_group=3, pad=(1, 1))
+    assert yg.shape == (2, 6, 8, 8)
+    # 1x1 conv equals matmul
+    w1 = np.random.randn(5, 3, 1, 1).astype(np.float32)
+    y1 = nd.Convolution(x, nd.array(w1), nd.zeros((5,)), kernel=(1, 1),
+                        num_filter=5)
+    ref = np.einsum("nchw,oc->nohw", x.asnumpy(), w1[:, :, 0, 0])
+    np.testing.assert_allclose(y1.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deconvolution_inverts_shape():
+    x = nd.array(np.random.randn(2, 4, 5, 5).astype(np.float32))
+    w = nd.array(np.random.randn(4, 3, 3, 3).astype(np.float32))
+    y = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=3, stride=(2, 2),
+                         pad=(1, 1), adj=(1, 1))
+    assert y.shape == (2, 3, 10, 10)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    ymax = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                      pool_type="max")
+    np.testing.assert_array_equal(ymax.asnumpy().reshape(2, 2),
+                                  [[5, 7], [13, 15]])
+    yavg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                      pool_type="avg")
+    np.testing.assert_allclose(yavg.asnumpy().reshape(2, 2),
+                               [[2.5, 4.5], [10.5, 12.5]])
+    yg = nd.Pooling(nd.array(x), global_pool=True, pool_type="max")
+    assert yg.shape == (1, 1, 1, 1)
+    assert yg.asnumpy().item() == 15
+    # full (ceil) convention: 4x4 input, 3x3 kernel, stride 2 → 2x2 out
+    yfull = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                       pool_type="max", pooling_convention="full")
+    assert yfull.shape == (1, 1, 2, 2)
+
+
+def test_activation():
+    x = nd.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(nd.Activation(x, act_type="relu").asnumpy(),
+                               [0, 0, 0, 0.5, 2])
+    np.testing.assert_allclose(nd.Activation(x, act_type="sigmoid").asnumpy(),
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(nd.Activation(x, act_type="tanh").asnumpy(),
+                               np.tanh(x.asnumpy()), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(nd.Activation(x, act_type="softrelu").asnumpy(),
+                               np.log1p(np.exp(x.asnumpy())), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(nd.LeakyReLU(x, act_type="leaky",
+                                            slope=0.1).asnumpy(),
+                               np.where(x.asnumpy() > 0, x.asnumpy(),
+                                        0.1 * x.asnumpy()), rtol=1e-6)
+
+
+def test_softmax_family():
+    x = np.random.randn(3, 5).astype(np.float32)
+    p = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(p, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    lp = nd.log_softmax(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(lp, np.log(p), rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_inference_vs_train():
+    x = np.random.randn(8, 3, 4, 4).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32) + 0.5
+    beta = np.random.randn(3).astype(np.float32)
+    mm = np.random.randn(3).astype(np.float32)
+    mv = np.random.rand(3).astype(np.float32) + 0.5
+    # inference uses moving stats
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mm), nd.array(mv), fix_gamma=False, eps=1e-3)
+    ref = (x - mm[None, :, None, None]) / np.sqrt(mv + 1e-3)[None, :, None, None] \
+        * gamma[None, :, None, None] + beta[None, :, None, None]
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+    # training normalizes with batch stats and updates aux
+    mm_nd, mv_nd = nd.array(mm), nd.array(mv)
+    with mx.autograd.record():
+        out_t = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                             mm_nd, mv_nd, fix_gamma=False, momentum=0.9)
+    m = out_t.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, beta, atol=1e-2)
+    bm = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(mm_nd.asnumpy(), 0.9 * mm + 0.1 * bm,
+                               rtol=1e-4, atol=1e-5)
+    # fix_gamma treats gamma as 1
+    out_fg = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                          nd.array(mm), nd.array(mv), fix_gamma=True, eps=1e-3)
+    ref_fg = (x - mm[None, :, None, None]) / np.sqrt(mv + 1e-3)[None, :, None, None] \
+        + beta[None, :, None, None]
+    np.testing.assert_allclose(out_fg.asnumpy(), ref_fg, rtol=1e-4, atol=1e-4)
+
+
+def test_broadcast_reduce():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.sum(a, axis=1).asnumpy(), x.sum(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(nd.mean(a, axis=(0, 2)).asnumpy(),
+                               x.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(nd.max(a, axis=2, keepdims=True).asnumpy(),
+                               x.max(2, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a, axis=1, exclude=True).asnumpy(),
+                               x.sum((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.broadcast_add(nd.array(x), nd.ones((1, 3, 1))).asnumpy(),
+        x + 1, rtol=1e-6)
+    nrm = nd.norm(a).asnumpy()
+    np.testing.assert_allclose(nrm, [np.sqrt((x ** 2).sum())], rtol=1e-5)
+
+
+def test_matrix_ops():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                               a @ b, rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(),
+        a @ b, rtol=1e-4)
+    ba = np.random.randn(2, 3, 4).astype(np.float32)
+    bb = np.random.randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.batch_dot(nd.array(ba), nd.array(bb)).asnumpy(),
+        np.matmul(ba, bb), rtol=1e-4)
+    # concat / split / stack
+    c = nd.Concat(nd.ones((2, 2)), nd.zeros((2, 3)), num_args=2, dim=1)
+    assert c.shape == (2, 5)
+    parts = nd.SliceChannel(nd.array(np.arange(12).reshape(2, 6)),
+                            num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    s = nd.stack(nd.ones((2,)), nd.zeros((2,)), num_args=2, axis=0)
+    assert s.shape == (2, 2)
+    # slice/pad/tile/repeat/reverse
+    x = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert nd.slice(x, begin=(0, 1, 0), end=(2, 3, 2)).shape == (2, 2, 2)
+    assert nd.slice_axis(x, axis=2, begin=1, end=3).shape == (2, 3, 2)
+    assert nd.tile(x, reps=(2, 1, 1)).shape == (4, 3, 4)
+    assert nd.repeat(x, repeats=2, axis=1).shape == (2, 6, 4)
+    np.testing.assert_array_equal(
+        nd.reverse(nd.array([1.0, 2.0, 3.0]), axis=0).asnumpy(), [3, 2, 1])
+    p = nd.Pad(nd.ones((1, 1, 2, 2)), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=5)
+    assert p.shape == (1, 1, 4, 4)
+    assert p.asnumpy()[0, 0, 0, 0] == 5
+
+
+def test_indexing_ops():
+    w = np.random.randn(10, 4).astype(np.float32)
+    idx = nd.array([1, 5, 9])
+    emb = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    np.testing.assert_allclose(emb.asnumpy(), w[[1, 5, 9]], rtol=1e-6)
+    t = nd.take(nd.array(w), idx)
+    np.testing.assert_allclose(t.asnumpy(), w[[1, 5, 9]], rtol=1e-6)
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    np.testing.assert_array_equal(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    d = np.random.randn(3, 5).astype(np.float32)
+    pk = nd.pick(nd.array(d), nd.array([0, 2, 4]), axis=1)
+    np.testing.assert_allclose(pk.asnumpy(), d[np.arange(3), [0, 2, 4]])
+    bt = nd.batch_take(nd.array(d), nd.array([0, 2, 4]))
+    np.testing.assert_allclose(bt.asnumpy(), d[np.arange(3), [0, 2, 4]])
+
+
+def test_ordering():
+    x = np.random.randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(nd.sort(nd.array(x), axis=1).asnumpy(),
+                               np.sort(x, 1), rtol=1e-6)
+    np.testing.assert_array_equal(
+        nd.argsort(nd.array(x), axis=1).asnumpy().astype(int),
+        np.argsort(x, 1))
+    tk = nd.topk(nd.array(x), axis=1, k=2, ret_typ="value")
+    np.testing.assert_allclose(tk.asnumpy(), np.sort(x, 1)[:, -1:-3:-1],
+                               rtol=1e-6)
+    tki = nd.topk(nd.array(x), axis=1, k=1)
+    np.testing.assert_array_equal(tki.asnumpy().astype(int).ravel(),
+                                  np.argmax(x, 1))
+
+
+def test_where_clip_cast():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x, y = nd.array([1.0, 2.0, 3.0]), nd.array([9.0, 8.0, 7.0])
+    np.testing.assert_array_equal(nd.where(cond, x, y).asnumpy(), [1, 8, 3])
+    np.testing.assert_array_equal(
+        nd.clip(nd.array([-2.0, 0.5, 3.0]), a_min=0, a_max=1).asnumpy(),
+        [0, 0.5, 1])
+    assert nd.Cast(x, dtype="int32").dtype == np.int32
+
+
+def test_unary_zoo():
+    x = np.random.rand(5).astype(np.float32) + 0.5
+    for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                      ("square", np.square), ("abs", np.abs),
+                      ("rsqrt", lambda v: 1 / np.sqrt(v)),
+                      ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                      ("tanh", np.tanh), ("sin", np.sin), ("cos", np.cos),
+                      ("log1p", np.log1p), ("expm1", np.expm1)]:
+        out = getattr(nd, name)(nd.array(x)).asnumpy()
+        np.testing.assert_allclose(out, ref(x), rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
+def test_elemwise_grad_via_autograd():
+    x = nd.array(np.random.rand(4).astype(np.float32) + 0.5)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.sum(nd.log(x) * 2.0)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2.0 / x.asnumpy(), rtol=1e-5)
+
+
+def test_regression_outputs():
+    x = np.random.randn(4, 3).astype(np.float32)
+    lbl = np.random.randn(4, 3).astype(np.float32)
+    data = nd.array(x)
+    data.attach_grad()
+    with mx.autograd.record():
+        out = nd.LinearRegressionOutput(data, nd.array(lbl))
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)
+    out.backward()
+    # reference grad: (out - label) * grad_scale / num_output
+    np.testing.assert_allclose(data.grad.asnumpy(), (x - lbl) / 3,
+                               rtol=1e-5)
+    with mx.autograd.record():
+        out = nd.LogisticRegressionOutput(data, nd.array(lbl))
+    sig = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+    out.backward()
+    np.testing.assert_allclose(data.grad.asnumpy(), (sig - lbl) / 3,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_optimizer_update_ops():
+    w = np.random.randn(5).astype(np.float32)
+    g = np.random.randn(5).astype(np.float32)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01)
+    np.testing.assert_allclose(out.asnumpy(), w - 0.1 * (g + 0.01 * w),
+                               rtol=1e-5)
+    mom = np.zeros(5, np.float32)
+    new_w, new_m = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(mom),
+                                     lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(new_m.asnumpy(), -0.1 * g, rtol=1e-5)
+    np.testing.assert_allclose(new_w.asnumpy(), w - 0.1 * g, rtol=1e-5)
+    m = np.zeros(5, np.float32)
+    v = np.zeros(5, np.float32)
+    nw, nm, nv = nd.adam_update(nd.array(w), nd.array(g), nd.array(m),
+                                nd.array(v), lr=0.01)
+    np.testing.assert_allclose(nm.asnumpy(), 0.1 * g, rtol=1e-5)
+    np.testing.assert_allclose(nv.asnumpy(), 0.001 * g * g, rtol=1e-4)
+
+
+def test_random_ops_shapes_and_determinism():
+    mx.random.seed(7)
+    a = nd.uniform(low=0, high=1, shape=(100,))
+    mx.random.seed(7)
+    b = nd.uniform(low=0, high=1, shape=(100,))
+    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    n = nd.normal(loc=5.0, scale=0.1, shape=(1000,))
+    assert abs(float(n.asnumpy().mean()) - 5.0) < 0.05
+    s = nd.sample_multinomial(nd.array([[0.0, 1.0, 0.0]]), shape=(8,))
+    assert (s.asnumpy() == 1).all()
+
+
+def test_sequence_ops():
+    data = np.random.randn(4, 3, 2).astype(np.float32)  # (T, N, C)
+    lens = np.array([2, 4, 1], np.float32)
+    last = nd.SequenceLast(nd.array(data), nd.array(lens),
+                           use_sequence_length=True)
+    np.testing.assert_allclose(last.asnumpy(),
+                               data[[1, 3, 0], np.arange(3)], rtol=1e-6)
+    masked = nd.SequenceMask(nd.array(data), nd.array(lens),
+                             use_sequence_length=True, value=-1)
+    assert (masked.asnumpy()[2:, 0] == -1).all()
+    assert (masked.asnumpy()[1:, 2] == -1).all()
+    rev = nd.SequenceReverse(nd.array(data), nd.array(lens),
+                             use_sequence_length=True)
+    np.testing.assert_allclose(rev.asnumpy()[0, 1], data[3, 1], rtol=1e-6)
+    np.testing.assert_allclose(rev.asnumpy()[0, 0], data[1, 0], rtol=1e-6)
+
+
+def test_rnn_op_modes():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, N, I, H = 3, 2, 4, 5
+    for mode in ("rnn_relu", "rnn_tanh", "lstm", "gru"):
+        psz = rnn_param_size(1, I, H, False, mode)
+        data = nd.array(np.random.randn(T, N, I).astype(np.float32) * 0.1)
+        params = nd.array(np.random.randn(psz).astype(np.float32) * 0.1)
+        h0 = nd.zeros((1, N, H))
+        kwargs = dict(state_size=H, num_layers=1, mode=mode)
+        if mode == "lstm":
+            out = nd.RNN(data, params, h0, nd.zeros((1, N, H)), **kwargs)
+        else:
+            out = nd.RNN(data, params, h0, **kwargs)
+        assert out.shape == (T, N, H)
+
+
+def test_lrn_l2norm_instancenorm():
+    x = np.random.randn(2, 4, 3, 3).astype(np.float32)
+    out = nd.LRN(nd.array(x), nsize=3)
+    assert out.shape == x.shape
+    l2 = nd.L2Normalization(nd.array(x), mode="instance")
+    flat = l2.asnumpy().reshape(2, -1)
+    np.testing.assert_allclose((flat ** 2).sum(1), [1, 1], rtol=1e-4)
+    inorm = nd.InstanceNorm(nd.array(x), nd.ones((4,)), nd.zeros((4,)))
+    np.testing.assert_allclose(inorm.asnumpy().mean(axis=(2, 3)),
+                               np.zeros((2, 4)), atol=1e-5)
