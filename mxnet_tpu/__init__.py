@@ -25,5 +25,21 @@ from .attribute import AttrScope
 from . import name
 from .name import NameManager, Prefix
 from . import test_utils
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from . import kvstore
+from . import kvstore as kv
+from . import model
+from . import module
+from . import module as mod
+from .module import Module
+from . import recordio
+from . import gluon
 
 __version__ = "0.1.0"

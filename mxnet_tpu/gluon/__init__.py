@@ -1,0 +1,12 @@
+"""Gluon: the imperative neural-network API
+(reference python/mxnet/gluon/__init__.py)."""
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from .utils import split_data, split_and_load, clip_global_norm

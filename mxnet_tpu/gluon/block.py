@@ -1,0 +1,469 @@
+"""Gluon Block / HybridBlock.
+
+Port of /root/reference/python/mxnet/gluon/block.py (Block :115,
+HybridBlock :283, hybridize→CachedOp :361-363), TPU-native:
+
+- Imperative (non-hybridized) calls run eager NDArray ops on the autograd
+  tape, exactly like the reference.
+- ``hybridize()`` builds a **CachedOp = one jitted XLA program** for the
+  whole block: the block's ``hybrid_forward`` is traced with a functional
+  namespace (``F`` = raw-jnp shim over the op registry) over input + param
+  tracers; BatchNorm-style auxiliary state updates are captured during
+  tracing and returned as extra outputs, then written back — the same
+  contract the reference's CachedOp had with mutable aux NDArrays
+  (src/c_api/c_api_ndarray.cc:616-651).  Backward goes through the
+  imperative tape as a single VJP of the fused program.
+
+Deferred parameter shapes (zeros in shape) resolve on the first eager
+forward via per-layer ``infer_shape`` hooks, mirroring the reference's
+deferred init.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, imperative_invoke
+from ..ops import get_op
+from ..ops.registry import OpDef
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+# ---------------------------------------------------------------------------
+# Functional namespace for tracing (F when hybridized)
+# ---------------------------------------------------------------------------
+
+_TRACE_STATE = threading.local()
+
+
+class _TraceCtx:
+    def __init__(self, param_tracers, rng, train):
+        self.param_tracers = param_tracers
+        self.rng = rng
+        self.train = train
+        self.counter = 0
+        self.aux_updates = []  # (id(aux_tracer), new_value)
+
+
+def _trace_ctx():
+    return getattr(_TRACE_STATE, "ctx", None)
+
+
+class _JnpF:
+    """F for traced execution: registry ops over raw jnp arrays."""
+
+    def __getattr__(self, name):
+        op = get_op(name)
+
+        def call(*args, **params):
+            ctx = _trace_ctx()
+            args = list(args)
+            if op.takes_train:
+                params["_train"] = ctx.train if ctx else False
+            if op.needs_rng:
+                if ctx is not None:
+                    key = jax.random.fold_in(ctx.rng, ctx.counter)
+                    ctx.counter += 1
+                else:
+                    from .. import random as _random
+                    key = _random.next_key()
+                args.append(key)
+            out = op.fn(*args, **op.canon_params(params))
+            flat = list(out) if isinstance(out, (tuple, list)) else [out]
+            n_vis = op.num_outputs(params)
+            vis, extra = flat[:n_vis], flat[n_vis:]
+            if extra and ctx is not None:
+                # trailing aux inputs correspond 1:1 to the extras
+                aux_args = args[len(args) - len(extra) -
+                                (1 if op.needs_rng else 0):
+                                len(args) - (1 if op.needs_rng else 0)]
+                for a, v in zip(aux_args, extra):
+                    ctx.aux_updates.append((id(a), v))
+            if len(vis) == 1:
+                return vis[0]
+            return tuple(vis)
+        call.__name__ = name
+        return call
+
+
+_F_JNP = _JnpF()
+
+
+# ---------------------------------------------------------------------------
+# Name scoping
+# ---------------------------------------------------------------------------
+
+class _BlockScope:
+    """Name/prefix management (reference block.py:29)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _global_count(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+_GLOBAL_COUNTERS = {}
+
+
+def _global_count(hint):
+    count = _GLOBAL_COUNTERS.get(hint, 0)
+    _GLOBAL_COUNTERS[hint] = count + 1
+    return "%s%d" % (hint, count)
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+
+class Block:
+    """Base of all layers and models (reference gluon/block.py:115)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = []
+        self._reg_params = {}
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(
+                key=i, block=_indent(str(block), 2))
+            for i, block in enumerate(self._children))
+        return s.format(name=self.__class__.__name__, modstr=modstr) \
+            if self._children else self.__class__.__name__ + "()"
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError("Changing attribute type for %s from %s "
+                                "to %s is not allowed." %
+                                (name, type(existing), type(value)))
+        if isinstance(value, Block):
+            self.register_child(value)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self):
+        ret = ParameterDict(self._params.prefix)
+        ret.update(self.params)
+        for child in self._children:
+            ret.update(child.collect_params())
+        return ret
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing,
+                                   ignore_extra, restore_prefix=self.prefix)
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose,
+                                         force_reinit=force_reinit)
+
+    def hybridize(self, active=True):
+        for child in self._children:
+            child.hybridize(active)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    first = lines.pop(0)
+    return first + ("\n" + " " * num_spaces).join([""] + lines) \
+        if lines else first
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
+
+class HybridBlock(Block):
+    """Block convertible to one fused XLA program (reference block.py:283)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._cached_param_list = None
+
+    def hybridize(self, active=True):
+        self._active = active
+        self._cached_op = None
+        super().hybridize(active)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s." % (str(block), str(type(block))))
+        super().register_child(block)
+        self._cached_op = None
+
+    # -- eager path --------------------------------------------------------
+    def _call_eager(self, *args):
+        try:
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred(*args)
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, *args, **params)
+
+    def _finish_deferred(self, *args):
+        self.infer_shape(*args)
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def infer_shape(self, *args):
+        """Layers with deferred params override this to fill shapes."""
+        raise MXNetError(
+            "Deferred initialization failed because shape cannot be "
+            "inferred for %s. Override infer_shape." % self.name)
+
+    # -- traced path -------------------------------------------------------
+    def _call_traced(self, *args):
+        ctx = _trace_ctx()
+        params = {}
+        for k, p in self._reg_params.items():
+            tracer = ctx.param_tracers.get(p.name)
+            if tracer is None:
+                raise MXNetError("parameter %s missing from trace" % p.name)
+            params[k] = tracer
+        return self.hybrid_forward(_F_JNP, *args, **params)
+
+    def _build_cached_op(self, nd_args):
+        plist = list(self.collect_params().values())
+        diff_params = [p for p in plist if p.grad_req != "null"]
+        aux_params = [p for p in plist if p.grad_req == "null"]
+        ordered = diff_params + aux_params
+        n_in = len(nd_args)
+        n_aux = len(aux_params)
+        outer = self
+
+        def cached_fn(*flat, _train=False):
+            # flat = inputs, diff params, aux params, rng
+            rng = flat[-1]
+            inputs = flat[:n_in]
+            param_vals = flat[n_in:-1]
+            tracers = {p.name: v for p, v in zip(ordered, param_vals)}
+            prev = _trace_ctx()
+            ctx = _TraceCtx(tracers, rng, _train)
+            _TRACE_STATE.ctx = ctx
+            try:
+                out = outer._call_traced(*inputs)
+            finally:
+                _TRACE_STATE.ctx = prev
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            new_aux = []
+            for p in aux_params:
+                tr = tracers[p.name]
+                upd = next((v for i_, v in ctx.aux_updates
+                            if i_ == id(tr)), tr)
+                new_aux.append(upd)
+            return tuple(outs) + tuple(new_aux)
+
+        # probe output count with an abstract eval
+        probe_args = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in nd_args]
+        probe_params = [jax.ShapeDtypeStruct(p.data().shape,
+                                             p.data().dtype)
+                        for p in ordered]
+        probe_rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        probe = jax.eval_shape(
+            lambda *f: cached_fn(*f, _train=True),
+            *probe_args, *probe_params, probe_rng)
+        n_out = len(probe) - n_aux
+
+        op = OpDef("_cachedop_%s" % self.name, cached_fn,
+                   arg_names=tuple("in%d" % i for i in range(n_in)) +
+                   tuple(p.name for p in diff_params),
+                   aux_names=tuple(p.name for p in aux_params),
+                   num_outputs=n_out, mutate_aux=True,
+                   needs_rng=True, takes_train=True)
+        self._cached_op = op
+        self._cached_param_list = ordered
+        return op
+
+    def _call_cached(self, *args):
+        try:
+            for p in self.collect_params().values():
+                p._check_initialized()
+        except DeferredInitializationError:
+            self._finish_deferred_recursive(*args)
+        if self._cached_op is None:
+            op = self._build_cached_op(args)
+        else:
+            op = self._cached_op
+        inputs = list(args) + [p.data() for p in self._cached_param_list]
+        return imperative_invoke(op, inputs, {})
+
+    def _finish_deferred_recursive(self, *args):
+        # one eager pass resolves all nested deferred shapes
+        with autograd.pause():
+            self.forward_eager_once(*args)
+
+    def forward_eager_once(self, *args):
+        self._active, saved = False, self._active
+        try:
+            self(*args)
+        finally:
+            self._active = saved
+
+    # -- dispatch ----------------------------------------------------------
+    def forward(self, *args):
+        first = args[0] if args else None
+        if isinstance(first, NDArray):
+            if self._active:
+                return self._call_cached(*args)
+            return self._call_eager(*args)
+        if _trace_ctx() is not None:
+            return self._call_traced(*args)
+        # raw jnp arrays outside a trace: run functionally (inference)
+        prev = _trace_ctx()
+        from .. import random as _random
+        ctx = _TraceCtx({p.name: p.data()._data
+                         for p in self.collect_params().values()},
+                        _random.next_key(), autograd.is_training())
+        _TRACE_STATE.ctx = ctx
+        try:
+            return self._call_traced(*args)
+        finally:
+            _TRACE_STATE.ctx = prev
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol + params as a Block (reference block.py:SymbolBlock)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from .. import symbol as sym_mod
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs)
+        self._symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in arg_names:
+            if name not in self._input_names:
+                self.params.get(name[len(self.params.prefix):]
+                                if name.startswith(self.params.prefix)
+                                else name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            p = self.params.get(name[len(self.params.prefix):]
+                                if name.startswith(self.params.prefix)
+                                else name, grad_req="null",
+                                allow_deferred_init=True)
+        self._aux_names = aux_names
+
+    def forward(self, *args):
+        feed = dict(zip(self._input_names, args))
+        arg_dict = {}
+        aux_dict = {}
+        for name, p in self.params.items():
+            if name in self._aux_names:
+                aux_dict[name] = p.data()
+            else:
+                arg_dict[name] = p.data()
+        arg_dict.update(feed)
+        exe = self._symbol.bind(args=arg_dict, aux_states=aux_dict,
+                                grad_req="null")
+        outs = exe.forward(is_train=autograd.is_training())
+        return outs[0] if len(outs) == 1 else outs

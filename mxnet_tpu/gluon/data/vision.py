@@ -1,0 +1,133 @@
+"""Gluon vision datasets (reference python/mxnet/gluon/data/vision.py).
+
+MNIST/FashionMNIST read idx files, CIFAR10/100 read the python-pickle
+batches — from a local ``root`` directory (this build has no network;
+``download`` raises with instructions).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ... import ndarray as nd
+from .dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        if not os.path.isdir(self._root):
+            raise IOError(
+                "Dataset directory %s does not exist. This build is "
+                "offline: place the dataset files there manually."
+                % self._root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx files (reference data/vision.py:MNIST)."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _open(self, name):
+        path = os.path.join(self._root, name)
+        if os.path.exists(path):
+            return open(path, "rb")
+        if os.path.exists(path + ".gz"):
+            return gzip.open(path + ".gz", "rb")
+        raise IOError("MNIST file %s not found" % path)
+
+    def _get_data(self):
+        img_name, lbl_name = self._files[self._train]
+        with self._open(lbl_name) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8) \
+                .astype(np.int32)
+        with self._open(img_name) as fin:
+            _, num, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._data = data  # numpy; DataLoader batchify converts once
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle batches (reference CIFAR10)."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _batches(self):
+        if self._train:
+            return ["data_batch_%d" % i for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        data = []
+        labels = []
+        base = self._root
+        sub = os.path.join(base, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            base = sub
+        for name in self._batches():
+            with open(os.path.join(base, name), "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            data.append(batch[b"data"].reshape(-1, 3, 32, 32))
+            labels.extend(batch[b"labels"])
+        self._data = np.concatenate(data).transpose(0, 2, 3, 1)
+        self._label = np.asarray(labels, dtype=np.int32)
+
+
+class CIFAR100(_DownloadedDataset):
+    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+                 fine_label=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        base = self._root
+        sub = os.path.join(base, "cifar-100-python")
+        if os.path.isdir(sub):
+            base = sub
+        name = "train" if self._train else "test"
+        with open(os.path.join(base, name), "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        self._data = batch[b"data"].reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._label = np.asarray(batch[key], dtype=np.int32)

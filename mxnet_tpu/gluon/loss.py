@@ -1,0 +1,246 @@
+"""Gluon losses.
+
+Port of /root/reference/python/mxnet/gluon/loss.py: Loss base with
+weight/sample_weight semantics, L1/L2, SigmoidBinaryCrossEntropy (from
+logits or probabilities), SoftmaxCrossEntropy (sparse or dense labels),
+KLDivLoss, plus CTCLoss lowered to a log-semiring lax.scan (the reference
+bundled warp-ctc CUDA kernels, src/operator/contrib/ctc_include/).
+"""
+from __future__ import annotations
+
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "HuberLoss", "HingeLoss", "CTCLoss"]
+
+
+def _apply_weighting(F, loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = F.broadcast_mul(loss, sample_weight)
+    if weight is not None:
+        assert isinstance(weight, (float, int)), "weight must be a number"
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(F, x, y):
+    return F.Reshape(x, shape=y.shape) if hasattr(y, "shape") else x
+
+
+class Loss(HybridBlock):
+    """Base loss (reference loss.py:Loss)."""
+
+    def __init__(self, weight, batch_axis, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return "{}(batch_axis={}, w={})".format(
+            self.__class__.__name__, self._batch_axis, self._weight)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(pred - label)
+        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(pred - label)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        if not self._from_sigmoid:
+            # log(1+exp(x)) - x*z, numerically stable
+            max_val = F.maximum(-pred, F.zeros_like(pred))
+            loss = pred - pred * label + max_val + \
+                F.log(F.exp(-max_val) + F.exp(-pred - max_val))
+        else:
+            eps = 1e-12
+            loss = -(F.log(pred + eps) * label +
+                     F.log(1. - pred + eps) * (1. - label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+        else:
+            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred)
+        loss = label * (F.log(label + 1e-12) - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(pred - label)
+        loss = F.where(loss > self._rho,
+                       loss - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(loss))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.maximum(self._margin - pred * label,
+                         F.zeros_like(pred))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification loss.
+
+    The reference bundles warp-ctc CUDA (src/operator/contrib/ctc_include/);
+    here the forward algorithm runs in log space as a ``lax.scan`` over
+    time — TPU-friendly static-shape dynamic programming.
+
+    Layout 'NTC': pred (N, T, C); label (N, L) padded with -1.
+    Blank label is C-1 (reference default blank_label='last'... 0.11 used
+    first; we follow the gluon default `blank_label='last'`? The 0.11
+    contrib op used blank=0 — configurable here).
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", blank_label="last",
+                 weight=None, **kwargs):
+        batch_axis = label_layout.find("N")
+        super().__init__(weight, batch_axis, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+        self._blank = blank_label
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        import jax
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import NDArray
+
+        is_nd = isinstance(pred, NDArray)
+        p = pred._data if is_nd else pred
+        l = label._data if isinstance(label, NDArray) else label
+        if self._layout == "TNC":
+            p = jnp.swapaxes(p, 0, 1)
+        loss = _ctc_loss_jax(p, l.astype(jnp.int32),
+                             blank_last=(self._blank == "last"))
+        out = NDArray(loss) if is_nd else loss
+        out = _apply_weighting(F, out, self._weight, sample_weight)
+        return out
+
+
+def _ctc_loss_jax(logits, labels, blank_last=True):
+    """log-semiring CTC forward over lax.scan. logits (N,T,C), labels (N,L)
+    padded with -1."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    N, T, C = logits.shape
+    L = labels.shape[1]
+    blank = C - 1 if blank_last else 0
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended label seq: blank l1 blank l2 ... blank  (length 2L+1)
+    ext = jnp.full((N, 2 * L + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(labels >= 0, labels, blank))
+    valid = jnp.concatenate(
+        [jnp.ones((N, 1), bool),
+         jnp.repeat(labels >= 0, 2, axis=1)], axis=1)
+    label_len = jnp.sum(labels >= 0, axis=1)
+
+    neg_inf = -1e30
+    S = 2 * L + 1
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_len > 0,
+                  logp[jnp.arange(N), 0, ext[:, 1]], neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.zeros((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, logp_t):
+        shift1 = jnp.concatenate(
+            [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(same_as_prev2, neg_inf, shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        new_alpha = jnp.where(valid, merged + emit, neg_inf)
+        return new_alpha, None
+
+    alpha, _ = lax.scan(step, alpha0,
+                        jnp.swapaxes(logp, 0, 1)[1:])
+    end1 = 2 * label_len
+    end2 = 2 * label_len - 1
+    a1 = jnp.take_along_axis(alpha, end1[:, None], axis=1)[:, 0]
+    a2 = jnp.where(label_len > 0,
+                   jnp.take_along_axis(alpha, jnp.maximum(end2, 0)[:, None],
+                                       axis=1)[:, 0], neg_inf)
+    return -jnp.logaddexp(a1, a2)

@@ -1,0 +1,2 @@
+"""Model zoo (reference python/mxnet/gluon/model_zoo/__init__.py)."""
+from . import vision

@@ -1,0 +1,288 @@
+"""Gluon Parameter / ParameterDict.
+
+Port of /root/reference/python/mxnet/gluon/parameter.py (606 L): Parameter
+with grad_req, lazy shape (zeros in shape → deferred init at first
+forward), initialize/reset_ctx/save/load; ParameterDict with prefix
+scoping and sharing.  Device placement is XLA's concern — ``ctx`` is kept
+for API parity, with ``list_ctx`` reporting the context the data lives on.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import autograd
+from .. import initializer as init_mod
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DeferredInitializationError", "Parameter", "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter is not initialized yet because shape is unknown."""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self.grad_req = grad_req if differentiable else "null"
+        self._data = None
+        self._grad = None
+        self._deferred_init = None
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape,
+                                                      self.dtype)
+
+    # -- initialization ----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter %s because it has invalid "
+                "shape: %s." % (self.name, str(self.shape)))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx)
+        initializer = init or self.init or default_init
+        initializer(init_mod.InitDesc(self.name), data)
+        self._data = data
+        self._init_grad()
+        self._deferred_init = None
+
+    def _finish_deferred_init(self, in_shape_fill=None):
+        """Complete deferred init once the shape is known."""
+        if self._deferred_init is None:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized" % self.name)
+        if in_shape_fill is not None:
+            self.shape = tuple(in_shape_fill)
+        if self.shape is None or any(s == 0 for s in self.shape):
+            raise DeferredInitializationError(
+                "Parameter %s still has unknown shape %s" %
+                (self.name, self.shape))
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._data.attach_grad(grad_req=self.grad_req)
+        self._grad = self._data._grad
+
+    # -- access ------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    "Parameter %s has not been initialized yet because "
+                    "initialization was deferred. Actual initialization "
+                    "happens during the first forward pass." % self.name)
+            raise RuntimeError(
+                "Parameter %s has not been initialized. Note that you "
+                "should initialize parameters and create Trainer with "
+                "Block.collect_params() instead of Block.params because "
+                "the later does not include Parameters of nested child "
+                "Blocks" % self.name)
+
+    def data(self, ctx=None):
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because "
+                "grad_req='null'" % self.name)
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    def set_data(self, data):
+        if self._data is None:
+            # setting data before init resolves deferred init
+            self.shape = tuple(data.shape)
+            if self._deferred_init is not None:
+                self._finish_deferred_init()
+            else:
+                self._data = data if isinstance(data, NDArray) \
+                    else nd.array(data)
+                self._init_grad()
+                return
+        self._data._set_data(
+            data._data if isinstance(data, NDArray)
+            else nd.array(data)._data)
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def reset_ctx(self, ctx):
+        pass  # placement is XLA-managed; kept for API parity
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._set_data(self._data.astype(dtype)._data)
+
+    # reattach to the autograd graph each forward when recording
+    def _maybe_mark(self):
+        if self._grad is not None and autograd.is_recording():
+            autograd.mark_variable(self._data)
+
+    def var(self):
+        from .. import symbol
+        return symbol.Variable(self.name, shape=self.shape,
+                               lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+
+
+class ParameterDict:
+    """Prefix-scoped dict of Parameters (reference parameter.py:380)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    def __repr__(self):
+        name = self._prefix + " " if self._prefix else ""
+        return "%sParameterDict containing %d parameters" % (
+            name, len(self._params))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get or create a parameter named prefix+name."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and \
+                            existing is not None:
+                        if len(v) == len(existing) and all(
+                                a == b or a == 0 or b == 0
+                                for a, b in zip(v, existing)):
+                            setattr(param, k, tuple(
+                                max(a, b) for a, b in zip(v, existing)))
+                            continue
+                    assert str(v) == str(existing) or v is None, \
+                        "Parameter %s attribute %s mismatch: %s vs %s" % \
+                        (name, k, str(v), str(existing))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have " \
+                    "different Parameters with the same name %s" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            block = param.data()
+            name = param.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = block
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        arg_dict = nd.load(filename)
+        if restore_prefix:
+            arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter %s is missing in file %s" % (name, filename)
+        for name, val in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise ValueError(
+                        "Parameter %s loaded from file %s is not present "
+                        "in ParameterDict" % (name, filename))
+                continue
+            self._params[name].set_data(val)

@@ -1,0 +1,124 @@
+"""Gluon fused RNN layers.
+
+Port of /root/reference/python/mxnet/gluon/rnn/rnn_layer.py: RNN, LSTM, GRU
+backed by the fused ``RNN`` op — on the reference that meant cuDNN
+(GPU-only); here it's the lax.scan lowering (ops/rnn.py) with the input
+projection batched onto the MXU, so the same layer runs everywhere.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ops.rnn import rnn_param_size
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        psize = rnn_param_size(num_layers, input_size, hidden_size,
+                               bidirectional, mode) if input_size else 0
+        self.parameters = self.params.get(
+            "parameters", shape=(psize,), allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        if self._mode == "lstm":
+            return [{"shape": (self._num_layers * self._dir, batch_size,
+                               self._hidden_size)},
+                    {"shape": (self._num_layers * self._dir, batch_size,
+                               self._hidden_size)}]
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            if func is None:
+                states.append(nd.zeros(**info))
+            else:
+                info.update(kwargs)
+                states.append(func(**info))
+        return states
+
+    def infer_shape(self, x, *states):
+        in_size = x.shape[-1]
+        self._input_size = in_size
+        self.parameters.shape = (rnn_param_size(
+            self._num_layers, in_size, self._hidden_size, self._dir == 2,
+            self._mode),)
+
+    def __call__(self, inputs, states=None):
+        skip_states = states is None
+        if skip_states:
+            batch = inputs.shape[self._layout.find("N")]
+            states = self.begin_state(batch)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        out = super().__call__(inputs, *states)
+        outputs, out_states = out[0], list(out[1:])
+        if skip_states:
+            return outputs
+        return outputs, out_states
+
+    def hybrid_forward(self, F, inputs, *states, **params):
+        parameters = params["parameters"]
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        rnn_args = [inputs, parameters] + list(states)
+        out = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=True)
+        outputs = out[0]
+        out_states = list(out[1:])
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        return tuple([outputs] + out_states)
+
+    def __repr__(self):
+        return "{}({}, {}, num_layers={}, dropout={}, bidirectional={})" \
+            .format(self.__class__.__name__, self._input_size or "None",
+                    self._hidden_size, self._num_layers, self._dropout,
+                    self._dir == 2)
+
+
+class RNN(_RNNLayer):
+    """Elman RNN layer (reference rnn_layer.py:RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, mode, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """LSTM layer (reference rnn_layer.py:LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    """GRU layer (reference rnn_layer.py:GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)

@@ -1,0 +1,123 @@
+"""Gluon Trainer.
+
+Port of /root/reference/python/mxnet/gluon/trainer.py (:26-121): applies an
+Optimizer to a ParameterDict, optionally aggregating gradients through a
+KVStore.  On TPU a single process sees the whole mesh, so the kvstore path
+only matters for the dist facade; the common path is a direct optimizer
+step per parameter — each update op is a jitted XLA kernel.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..model import _create_kvstore
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore = kvstore
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt.create(optimizer,
+                                         param_idx2name={
+                                             i: p.name for i, p in
+                                             param_dict.items()},
+                                         **optimizer_params)
+        lr_mult = {}
+        wd_mult = {}
+        for i, param in enumerate(self._params):
+            lr_mult[i] = param.lr_mult
+            wd_mult[i] = param.wd_mult
+        self._optimizer.set_lr_mult(lr_mult)
+        self._optimizer.set_wd_mult(wd_mult)
+        self._updaters = opt.get_updater(self._optimizer)
+
+    def _init_kvstore(self):
+        arg_arrays = {param.name: param.data() for param in self._params
+                      if param.grad_req != "null"}
+        kvstore, update_on_kvstore = _create_kvstore(self._kvstore, 1,
+                                                     arg_arrays)
+        self._kv = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        if kvstore:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null":
+                    continue
+                kvstore.init(i, param.data())
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.lr = lr
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimizer step, scaling grads by 1/batch_size."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._kv is not None:
+                self._kv.push(i, param.list_grad())
+                if self._update_on_kvstore:
+                    self._kv.pull(i, param.list_data())
+                    continue
+                self._kv.pull(i, param.list_grad())
+            self._updaters(i, param.grad(), param.data())
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kv.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters.get_states())
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kv.load_optimizer_states(fname)
+            self._optimizer = self._kv._optimizer
+        else:
+            with open(fname, "rb") as f:
+                self._updaters.set_states(f.read())

@@ -1,0 +1,84 @@
+"""Gluon utilities (reference python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+from .. import ndarray as nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch_axis into num_slice chunks (reference :28)."""
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(
+            "Too many slices for data with shape %s. Arguments are "
+            "num_slice=%d and batch_axis=%d." %
+            (str(data.shape), num_slice, batch_axis))
+    if size % num_slice != 0:
+        if even_split:
+            raise ValueError(
+                "data with shape %s cannot be evenly split into %d "
+                "slices along axis %d. Use a batch size that's a multiple "
+                "of %d or set even_split=False to allow uneven partial "
+                "slices." % (str(data.shape), num_slice, batch_axis,
+                             num_slice))
+        step = int(math.ceil(size / num_slice))
+        slices = [
+            nd.slice_axis(data, axis=batch_axis, begin=i * step,
+                          end=min((i + 1) * step, size))
+            for i in range(num_slice)]
+    else:
+        step = size // num_slice
+        slices = [
+            nd.slice_axis(data, axis=batch_axis, begin=i * step,
+                          end=(i + 1) * step)
+            for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data and load each slice to a context (reference :66)."""
+    if not isinstance(data, nd.NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale arrays so their joint 2-norm ≤ max_norm (reference :89)."""
+    assert len(arrays) > 0
+    total_norm = 0.0
+    for arr in arrays:
+        total_norm += float(nd.sum(nd.square(arr)).asscalar())
+    total_norm = math.sqrt(total_norm)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._set_data((arr * scale)._data)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Download a file (reference :121). Disabled in air-gapped builds —
+    raises with instructions rather than hanging on zero egress."""
+    raise RuntimeError(
+        "download() is unavailable in this offline build; place the file "
+        "locally and pass its path instead (url was %s)" % url)

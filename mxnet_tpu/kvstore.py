@@ -1,0 +1,187 @@
+"""KVStore: the parameter synchronization facade.
+
+The reference implements this as C++ Comm trees + ps-lite parameter servers
+(/root/reference/src/kvstore/, python/mxnet/kvstore.py).  TPU-native, the
+*fast* data-parallel path is an in-program ``jax.lax.psum`` over a mesh axis
+(see parallel/) — XLA rides ICI directly and there is nothing to copy
+through a server.  This module keeps the reference's API so existing
+training scripts work unmodified:
+
+- ``create('local'|'device')``  → in-process store; push merges (sums) the
+  per-device gradient list, the optimizer runs once on the merged gradient
+  (exactly `update_on_kvstore` semantics, kvstore_local.h), pull broadcasts.
+- ``create('dist_sync'|'dist_async'|'dist_device_sync')`` → same store with
+  rank/num_workers/barrier wired to ``jax.distributed`` process info; the
+  gradient merge runs a cross-process psum when more than one process is
+  attached (the all-reduce replacement for ps-lite's ZPush/ZPull,
+  kvstore_dist.h:52-209).
+
+Keys may be str or int. Values are NDArray or lists of NDArray
+(one per device) as in the reference.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _flatten_pairs(key, value):
+    """Normalize (key, value) to ([key...], [value...]) like the reference's
+    _ctype_key_value (python/mxnet/kvstore.py)."""
+    if isinstance(key, (str, int)):
+        if isinstance(value, (list, tuple)) and \
+                all(isinstance(v, NDArray) for v in value):
+            return [key], [list(value)]
+        return [key], [[value]]
+    assert isinstance(key, (list, tuple))
+    keys, vals = [], []
+    for k, v in zip(key, value):
+        sk, sv = _flatten_pairs(k, v)
+        keys.extend(sk)
+        vals.extend(sv)
+    return keys, vals
+
+
+class KVStore:
+    """In-process parameter store with the reference's surface."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compress_params = {"type": "none"}
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        if self._kind.startswith("dist"):
+            import jax
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._kind.startswith("dist"):
+            import jax
+            return jax.process_count()
+        return 1
+
+    # -- core ops ----------------------------------------------------------
+    def init(self, key, value):
+        keys, vals = _flatten_pairs(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                continue
+            self._store[k] = vlist[0].copy()
+
+    def _merge(self, vlist):
+        merged = vlist[0]
+        for v in vlist[1:]:
+            merged = merged + v
+        if self._kind.startswith("dist") and self.num_workers > 1:
+            # all-reduce across processes over ICI/DCN — the ps-lite
+            # ZPush/merge/ZPull cycle becomes one XLA collective
+            from jax.experimental import multihost_utils
+            import jax.numpy as jnp
+            summed = multihost_utils.process_allgather(merged._data)
+            merged = NDArray(jnp.sum(summed, axis=0), merged._ctx)
+        return merged
+
+    def push(self, key, value, priority=0):
+        keys, vals = _flatten_pairs(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %s was not initialized" % str(k))
+            merged = self._merge(vlist)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k]._set_data(merged._data)
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = _flatten_pairs(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s was not initialized" % str(k))
+            src = self._store[k]
+            for o in olist:
+                o._set_data(src._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference kvstore.py:row_sparse_pull).
+
+        Masked-dense: pulls the full buffer then retains rows — the sparse
+        win on TPU comes from the lazy-update optimizer path instead.
+        """
+        from .ndarray.sparse import sparse_retain
+        assert out is not None and row_ids is not None
+        keys, outs = _flatten_pairs(key, out)
+        ids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, olist in zip(keys, outs):
+            src = self._store[k]
+            for o, rid in zip(olist, ids * len(olist)):
+                kept = sparse_retain(src, rid)
+                o._set_data(kept._data)
+
+    # -- optimizer wiring --------------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        self._compress_params = dict(compression_params)
+
+    # -- distributed control -----------------------------------------------
+    def barrier(self):
+        if self._kind.startswith("dist") and self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def _barrier_before_exit(self):
+        self.barrier()
+
+    def _send_command_to_servers(self, head, body):
+        """No server processes exist in the TPU design; commands are local."""
+
+    # -- optimizer state checkpointing -------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("there is no updater")
+        with open(fname, "wb") as f:
+            if dump_optimizer:
+                f.write(pickle.dumps((self._updater.get_states(),
+                                      pickle.dumps(self._optimizer))))
+            else:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("there is no updater")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def create(name="local"):
+    """Create a KVStore (reference kvstore.py:create)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "local_allreduce_device",
+             "local_allreduce_cpu", "dist_sync", "dist_async",
+             "dist_device_sync", "dist_sync_device")
+    if name not in valid:
+        raise MXNetError("unknown KVStore type %s" % name)
+    return KVStore(name)

@@ -1,0 +1,425 @@
+"""Module: the symbolic training Model API.
+
+Port of /root/reference/python/mxnet/module/module.py (246-631).  The
+reference bound one executor per GPU and layered gradient reduction over
+KVStore (DataParallelExecutorGroup, module/executor_group.py:99).  The
+TPU-native design binds ONE executor — XLA SPMD over a device mesh replaces
+the per-device executor group, and the fused forward_backward is a single
+compiled program.  Multi-context calls (context=[tpu(0), tpu(1), ...]) keep
+working: the batch stays whole and the step is sharded across the mesh by
+the parallel layer rather than split by Python.
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..initializer import Uniform, InitDesc
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
+from .base_module import BaseModule, _check_input_names
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = ctx_mod.current_context()
+        if isinstance(context, ctx_mod.Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = list(fixed_param_names) \
+            if fixed_param_names is not None else []
+
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create a Module from a saved checkpoint (reference :146)."""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Save symbol+params(+optimizer states) (reference :173)."""
+        self._symbol.save("%s-symbol.json" % prefix)
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(param_name)
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        _, out_shapes, _ = self._symbol.infer_shape(
+            **dict([(d[0], d[1]) for d in
+                    (self._data_shapes + (self._label_shapes or []))]))
+        return list(zip(self._output_names, out_shapes))
+
+    # -- parameters --------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """Initialize parameters (reference module.py:246)."""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        if initializer is None:
+            initializer = Uniform(0.01)
+
+        if self._arg_params is None:
+            self._arg_params = {
+                name: nd.zeros(self._exec.arg_dict[name].shape,
+                               dtype=self._exec.arg_dict[name].dtype)
+                for name in self._param_names}
+        if self._aux_params is None:
+            self._aux_params = {
+                name: nd.zeros(self._exec.aux_dict[name].shape,
+                               dtype=self._exec.aux_dict[name].dtype)
+                for name in self._aux_names}
+
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None:
+                if name in cache:
+                    cache_arr = cache[name]
+                    if cache_arr is not arr:
+                        if cache_arr.shape != arr.shape:
+                            raise MXNetError(
+                                "Shape mismatch for %s: %s vs %s" %
+                                (name, str(cache_arr.shape),
+                                 str(arr.shape)))
+                        cache_arr.copyto(arr)
+                else:
+                    if not allow_missing:
+                        raise RuntimeError("%s is not presented" % name)
+                    if initializer is not None:
+                        initializer(name, arr)
+            else:
+                initializer(name, arr)
+
+        for name, arr in sorted(self._arg_params.items()):
+            desc = InitDesc(name, attrs.get(name))
+            _impl(desc, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            desc = InitDesc(name, attrs.get(name))
+            _impl(desc, arr, aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        self._push_params_to_exec()
+
+    def _push_params_to_exec(self):
+        for name, arr in self._arg_params.items():
+            if name in self._exec.arg_dict:
+                self._exec.arg_dict[name]._set_data(arr._data)
+        for name, arr in self._aux_params.items():
+            if name in self._exec.aux_dict:
+                self._exec.aux_dict[name]._set_data(arr._data)
+
+    def _sync_params_from_devices(self):
+        for name in self._param_names:
+            self._arg_params[name]._set_data(self._exec.arg_dict[name]._data)
+        for name in self._aux_names:
+            self._aux_params[name]._set_data(self._exec.aux_dict[name]._data)
+        self._params_dirty = False
+
+    # -- binding -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Compile the symbol for the given shapes (reference module.py:351).
+
+        simple_bind → trace → XLA; PlanMemory/bulking are XLA's problem now.
+        """
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        def _norm(shapes):
+            if shapes is None:
+                return None
+            out = []
+            for s in shapes:
+                if hasattr(s, "name"):
+                    out.append((s.name, tuple(s.shape)))
+                else:
+                    out.append((s[0], tuple(s[1])))
+            return out
+
+        self._data_shapes = _norm(data_shapes)
+        self._label_shapes = _norm(label_shapes)
+
+        shape_kwargs = dict(self._data_shapes)
+        if self._label_shapes:
+            shape_kwargs.update(dict(self._label_shapes))
+
+        req = {}
+        for name in self._symbol.list_arguments():
+            if name in self._data_names:
+                req[name] = "write" if inputs_need_grad else "null"
+            elif name in self._label_names or name in self._state_names:
+                req[name] = "null"
+            elif name in self._fixed_param_names:
+                req[name] = "null"
+            elif not for_training:
+                req[name] = "null"
+            else:
+                req[name] = grad_req if isinstance(grad_req, str) \
+                    else grad_req.get(name, "write")
+
+        ctx = self._context[0]
+        self._exec = self._symbol.simple_bind(ctx, grad_req=req,
+                                              **shape_kwargs)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self._arg_params = shared_module._arg_params
+            self._aux_params = shared_module._aux_params
+            self.params_initialized = True
+            self._push_params_to_exec()
+        elif self.params_initialized:
+            self._push_params_to_exec()
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Set up optimizer + kvstore (reference module.py:460)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        if self._params_dirty:
+            self._sync_params_from_devices()
+
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+        batch_size = self._data_shapes[0][1][0]
+        if kvstore and "dist" in kvstore.type and \
+                "_sync" in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name,
+                                   **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        optimizer.set_lr_mult({})
+        optimizer.set_wd_mult({})
+
+        if kvstore:
+            param_arrays = [[self._exec.arg_dict[n]]
+                            for n in self._param_names]
+            _initialize_kvstore(kvstore=kvstore, param_arrays=param_arrays,
+                                arg_params=self._arg_params,
+                                param_names=self._param_names,
+                                update_on_kvstore=update_on_kvstore)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # -- computation -------------------------------------------------------
+    def _feed_batch(self, data_batch):
+        feeds = {}
+        data = data_batch.data
+        for name, arr in zip(self._data_names, data):
+            feeds[name] = arr
+        if self._label_names and data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        return feeds
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = self._feed_batch(data_batch)
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def forward_backward(self, data_batch):
+        """One fused jitted program for fwd+bwd (the per-batch hot path)."""
+        assert self.binded and self.params_initialized
+        feeds = self._feed_batch(data_batch)
+        self._exec.forward_backward(**feeds)
+
+    def update(self):
+        """Apply optimizer using accumulated grads (reference module.py:615)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        param_arrays = [[self._exec.arg_dict[n]] for n in self._param_names]
+        grad_arrays = [[self._exec.grad_dict.get(n)]
+                       for n in self._param_names]
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(param_arrays, grad_arrays,
+                                      self._kvstore, self._param_names)
+        else:
+            _update_params(param_arrays, grad_arrays,
+                           updater=self._updater,
+                           num_device=len(self._context),
+                           kvstore=self._kvstore,
+                           param_names=self._param_names)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self._exec.outputs)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        if states is not None:
+            for name, arr in zip(self._state_names, states):
+                self._exec.arg_dict[name]._set_data(
+                    arr._data if isinstance(arr, nd.NDArray) else arr)
+        else:
+            for name in self._state_names:
+                self._exec.arg_dict[name][:] = value
+
+    # -- optimizer state io -------------------------------------------------
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind for new shapes (XLA re-jits; params carry over)."""
+        assert self.binded
+        self._sync_params_from_devices() if self._params_dirty else None
+        self.binded = False
+        self._exec = None
+        self.bind(data_shapes, label_shapes,
+                  for_training=self.for_training,
+                  inputs_need_grad=self.inputs_need_grad,
+                  force_rebind=True)

@@ -170,12 +170,13 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
     strides = (1, 1) + stride
     padding = [(0, 0), (0, 0)] + pads
     if pool_type == "max":
+        # literal init value keeps the reduce_window_max pattern (and its
+        # VJP) recognizable to JAX
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
             jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype),
-                                 lax.max, window, strides, padding)
-    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype),
-                               lax.add, window, strides, padding)
+        return lax.reduce_window(data, init, lax.max, window, strides,
+                                 padding)
+    summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
     if pool_type == "sum":
         return summed
     # avg: count includes padding, matching the reference default
@@ -460,9 +461,8 @@ def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
     half = nsize // 2
     pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
     window = (1, nsize) + (1,) * (data.ndim - 2)
-    ssum = lax.reduce_window(jnp.pad(sq, pad), jnp.asarray(0, data.dtype),
-                             lax.add, window, (1,) * data.ndim,
-                             [(0, 0)] * data.ndim)
+    ssum = lax.reduce_window(jnp.pad(sq, pad), 0.0, lax.add, window,
+                             (1,) * data.ndim, [(0, 0)] * data.ndim)
     return data / jnp.power(knorm + (alpha / nsize) * ssum, beta)
 
 

@@ -56,6 +56,10 @@ alias("elemwise_maximum", "_maximum")
 alias("elemwise_minimum", "_minimum")
 alias("broadcast_add", "broadcast_plus")
 alias("broadcast_sub", "broadcast_minus")
+alias("broadcast_maximum", "maximum")
+alias("broadcast_minimum", "minimum")
+alias("broadcast_power", "power")
+alias("broadcast_hypot", "hypot")
 
 _BINARY_LOGIC = {
     "equal": jnp.equal,

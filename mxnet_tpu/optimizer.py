@@ -1,0 +1,522 @@
+"""Optimizers.
+
+TPU-native port of /root/reference/python/mxnet/optimizer.py (999 L): the
+same registry (``Optimizer.register`` / ``create``), per-weight lr/wd
+multipliers driven by symbol attrs and name conventions, ``num_update``
+bookkeeping for schedulers/warmup, and the ``Updater`` closure consumed by
+KVStore (``set_optimizer`` → server-side updates in the reference,
+kvstore_dist_server.h:109-180).
+
+The arithmetic delegates to the registered optimizer update *ops*
+(ops/optimizer_ops.py) exactly as the reference runs sgd_update/adam_update
+as graph ops — so the same update runs imperatively here, inside a jitted
+Module step, or fused into a pjit'd data-parallel step.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy
+
+from .ndarray import (NDArray, zeros, clip as nd_clip, sqrt as nd_sqrt,
+                      square as nd_square, sign as nd_sign,
+                      maximum as nd_maximum, abs as nd_abs)
+from .ndarray import (sgd_update, sgd_mom_update, mp_sgd_update,
+                      mp_sgd_mom_update, adam_update, rmsprop_update,
+                      rmspropalex_update, ftrl_update)
+from . import random as _random
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Test",
+           "create", "get_updater", "Updater", "register"]
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:31-334)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym \
+            else ((), ())
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info and self.sym_info[0]:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info and self.sym_info[0]:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _clip(x, bound):
+    if bound is not None and bound > 0:
+        return nd_clip(x, a_min=-bound, a_max=bound)
+    return x
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + optional fp16 master weights
+    (reference optimizer.py:335)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.multi_precision and weight.dtype == numpy.float16:
+            weight32 = weight.astype(numpy.float32)
+            mom = zeros(weight.shape, dtype=numpy.float32) \
+                if self.momentum != 0.0 else None
+            return (mom, weight32)
+        if self.momentum != 0.0:
+            return zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=(self.clip_gradient
+                                     if self.clip_gradient else -1.0))
+        if isinstance(state, tuple):  # multi-precision
+            mom, weight32 = state
+            if mom is not None:
+                out = mp_sgd_mom_update(weight, grad, mom, weight32,
+                                        momentum=self.momentum, **kwargs)
+                weight._set_data(out[0]._data)
+                mom._set_data(out[1]._data)
+                weight32._set_data(out[2]._data)
+            else:
+                out = mp_sgd_update(weight, grad, weight32, **kwargs)
+                weight._set_data(out[0]._data)
+                weight32._set_data(out[1]._data)
+        elif state is not None:
+            out = sgd_mom_update(weight, grad, state,
+                                 momentum=self.momentum, **kwargs)
+            weight._set_data(out[0]._data)
+            state._set_data(out[1]._data)
+        else:
+            out = sgd_update(weight, grad, **kwargs)
+            weight._set_data(out._data)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py:469)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        grad = grad + wd * weight
+        if state is not None:
+            mom = state
+            new_mom = self.momentum * mom + grad
+            step = grad + self.momentum * new_mom
+            mom._set_data(new_mom._data)
+            weight._set_data((weight - lr * step)._data)
+        else:
+            weight._set_data((weight - lr * grad)._data)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:505)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        from .ndarray import normal
+        noise = normal(loc=0.0, scale=math.sqrt(lr), shape=weight.shape)
+        weight._set_data(
+            (weight - lr / 2 * (grad + wd * weight) + noise)._data)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py:540)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, dtype=weight.dtype), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        mon, previous_weight = state
+        comp = grad + wd * weight + \
+            self.lamda * grad * grad * (weight - previous_weight)
+        if mon is not None:
+            new_mon = self.momentum * mon - lr * comp
+            mon._set_data(new_mon._data)
+            step = new_mon
+        else:
+            step = -lr * comp
+        previous_weight._set_data(weight._data)
+        weight._set_data((weight + step)._data)
+
+
+@register
+class Adam(Optimizer):
+    """Adam with the reference's bias-corrected lr (optimizer.py:595)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        out = adam_update(weight, grad, mean, var, lr=lr, beta1=self.beta1,
+                          beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=(self.clip_gradient
+                                         if self.clip_gradient else -1.0))
+        weight._set_data(out[0]._data)
+        mean._set_data(out[1]._data)
+        var._set_data(out[2]._data)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:708)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        history = state
+        new_hist = history + nd_square(grad)
+        history._set_data(new_hist._data)
+        weight._set_data(
+            (weight - lr * (grad / nd_sqrt(new_hist + self.float_stable_eps)
+                            + wd * weight))._data)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, Hinton + centered (Alex Graves) variants
+    (reference optimizer.py:757)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, dtype=weight.dtype),
+                    zeros(weight.shape, dtype=weight.dtype),
+                    zeros(weight.shape, dtype=weight.dtype))
+        return (zeros(weight.shape, dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      gamma1=self.gamma1, epsilon=self.epsilon,
+                      clip_gradient=(self.clip_gradient
+                                     if self.clip_gradient else -1.0),
+                      clip_weights=(self.clip_weights
+                                    if self.clip_weights else -1.0))
+        if not self.centered:
+            (n,) = state
+            out = rmsprop_update(weight, grad, n, **kwargs)
+            weight._set_data(out[0]._data)
+            n._set_data(out[1]._data)
+        else:
+            n, g, delta = state
+            out = rmspropalex_update(weight, grad, n, g, delta,
+                                     gamma2=self.gamma2, **kwargs)
+            weight._set_data(out[0]._data)
+            n._set_data(out[1]._data)
+            g._set_data(out[2]._data)
+            delta._set_data(out[3]._data)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:810)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g + (1.0 - self.rho) * nd_square(grad)
+        delta = nd_sqrt(acc_delta + self.epsilon) / \
+            nd_sqrt(new_acc_g + self.epsilon) * grad
+        new_acc_delta = self.rho * acc_delta + \
+            (1.0 - self.rho) * nd_square(delta)
+        acc_g._set_data(new_acc_g._data)
+        acc_delta._set_data(new_acc_delta._data)
+        weight._set_data((weight - delta - wd * weight)._data)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference optimizer.py:859)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        z, n = state
+        out = ftrl_update(weight, grad, z, n, lr=lr, lamda1=self.lamda1,
+                          beta=self.beta, wd=wd,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=(self.clip_gradient
+                                         if self.clip_gradient else -1.0))
+        weight._set_data(out[0]._data)
+        z._set_data(out[1]._data)
+        n._set_data(out[2]._data)
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference optimizer.py:927)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient) + \
+            wd * weight
+        m_t, u_t = state
+        new_m = self.beta1 * m_t + (1.0 - self.beta1) * grad
+        new_u = nd_maximum(self.beta2 * u_t, nd_abs(grad))
+        m_t._set_data(new_m._data)
+        u_t._set_data(new_u._data)
+        weight._set_data((weight - lr * new_m / new_u)._data)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference optimizer.py:975)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient) + \
+            wd * weight
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                   (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        new_m = self.beta1 * m_t + (1.0 - self.beta1) * grad
+        new_v = self.beta2 * v_t + (1.0 - self.beta2) * nd_square(grad)
+        grad_prime = grad / (1.0 - self.m_schedule)
+        m_t_prime = new_m / (1.0 - m_schedule_next)
+        v_t_prime = new_v / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * grad_prime + \
+            momentum_t_1 * m_t_prime
+        m_t._set_data(new_m._data)
+        v_t._set_data(new_v._data)
+        weight._set_data(
+            (weight - lr * m_t_bar / (nd_sqrt(v_t_prime) +
+                                      self.epsilon))._data)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: weight -= lr * grad (reference optimizer.py:1021)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(
+            (weight - self.lr * grad * self.rescale_grad)._data)
+
+
+class Updater:
+    """KVStore-facing update closure (reference optimizer.py:1034)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

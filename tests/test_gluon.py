@@ -1,0 +1,280 @@
+"""Gluon API tests — mirrors tests/python/unittest/test_gluon*.py in the
+reference: parameter management, layers, hybridize consistency, trainer,
+losses, rnn cells/layers, data pipeline, model zoo."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, autograd
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init=mx.init.Xavier())
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    p.zero_grad()
+    assert (p.grad().asnumpy() == 0).all()
+
+
+def test_paramdict_save_load(tmp_path):
+    params = gluon.ParameterDict("net_")
+    w = params.get("weight", shape=(4, 4))
+    params.initialize()
+    fname = str(tmp_path / "p.params")
+    params.save(fname)
+    params2 = gluon.ParameterDict("net_")
+    params2.get("weight", shape=(4, 4))
+    params2.load(fname)
+    np.testing.assert_array_equal(w.data().asnumpy(),
+                                  params2["net_weight"].data().asnumpy())
+
+
+def test_dense_deferred_shape():
+    net = gluon.nn.Dense(5)
+    net.initialize()
+    out = net(nd.ones((3, 7)))
+    assert out.shape == (3, 5)
+    assert net.weight.shape == (5, 7)
+
+
+def test_sequential_and_hybrid_consistency():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(5, 8).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_gluon_training_eager_and_hybrid():
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(128, 10).astype(np.float32)
+    W = np.random.randn(10, 2).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    for hybridize in (False, True):
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(32, activation="relu"))
+            net.add(gluon.nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        if hybridize:
+            net.hybridize()
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.5})
+        for _ in range(15):
+            with autograd.record():
+                loss = loss_fn(net(nd.array(X)), nd.array(Y))
+            loss.backward()
+            trainer.step(128)
+        acc = (net(nd.array(X)).asnumpy().argmax(1) == Y).mean()
+        assert acc > 0.95, "hybridize=%s acc=%f" % (hybridize, acc)
+
+
+def test_conv_batchnorm_block():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Activation("relu"))
+        net.add(gluon.nn.MaxPool2D())
+        net.add(gluon.nn.Flatten())
+        net.add(gluon.nn.Dense(3))
+    net.initialize()
+    x = nd.ones((2, 3, 8, 8))
+    with autograd.record():
+        out = net(x)
+    assert out.shape == (2, 3)
+    # running stats updated in train mode
+    rm = [v for k, v in net.collect_params().items()
+          if "running_mean" in k][0]
+    assert float(np.abs(rm.data().asnumpy()).sum()) > 0
+
+
+def test_hybrid_batchnorm_aux_update():
+    net = gluon.nn.BatchNorm(in_channels=3)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.randn(4, 3, 2, 2).astype(np.float32) + 5.0)
+    with autograd.record():
+        net(x)
+    rm = net.running_mean.data().asnumpy()
+    assert (rm > 0).all(), rm  # moved toward batch mean (~5)
+    # inference mode does not move stats
+    before = net.running_mean.data().asnumpy().copy()
+    net(x)
+    np.testing.assert_array_equal(before,
+                                  net.running_mean.data().asnumpy())
+
+
+def test_losses():
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([[1.5, 1.5], [3.5, 3.5]])
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l2, [0.125, 0.125], rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l1, [0.5, 0.5], rtol=1e-5)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = sce(nd.array([[10.0, 0.0]]), nd.array([0])).asnumpy()
+    assert out[0] < 0.01
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    out = bce(nd.array([[10.0]]), nd.array([[1.0]])).asnumpy()
+    assert out[0] < 0.01
+    kl = gluon.loss.KLDivLoss()
+    p = nd.array([[0.5, 0.5]])
+    out = kl(nd.log(p), p).asnumpy()
+    assert abs(out[0]) < 1e-5
+
+
+def test_ctc_loss():
+    # perfect prediction → near-zero loss
+    T, N, C = 4, 1, 3
+    logits = np.full((N, T, C), -10.0, np.float32)
+    # blank = C-1 = 2; label seq [0, 1] over 4 steps: 0 0 1 1 works
+    logits[0, 0, 0] = 10
+    logits[0, 1, 0] = 10
+    logits[0, 2, 1] = 10
+    logits[0, 3, 1] = 10
+    loss = gluon.loss.CTCLoss(layout="NTC")(
+        nd.array(logits), nd.array([[0, 1]]))
+    assert float(loss.asnumpy()[0]) < 0.1
+    # impossible label (longer than T) → large loss
+    loss2 = gluon.loss.CTCLoss(layout="NTC")(
+        nd.array(logits), nd.array([[0, 1, 0, 1, 0]]))
+    assert float(loss2.asnumpy()[0]) > 10
+
+
+def test_rnn_cells_and_unroll():
+    for cell_cls, n_states in [(gluon.rnn.RNNCell, 1),
+                               (gluon.rnn.LSTMCell, 2),
+                               (gluon.rnn.GRUCell, 1)]:
+        cell = cell_cls(8)
+        cell.initialize()
+        outs, states = cell.unroll(
+            3, nd.array(np.random.randn(2, 3, 4).astype(np.float32)),
+            merge_outputs=True)
+        assert outs.shape == (2, 3, 8)
+        assert len(states) == n_states
+
+
+def test_stacked_bidirectional_cells():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(6))
+    stack.add(gluon.rnn.LSTMCell(6))
+    stack.initialize()
+    outs, states = stack.unroll(
+        4, nd.array(np.random.randn(2, 4, 3).astype(np.float32)),
+        merge_outputs=True)
+    assert outs.shape == (2, 4, 6)
+    assert len(states) == 4
+
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.GRUCell(5, prefix="l_"),
+                                     gluon.rnn.GRUCell(5, prefix="r_"))
+    bi.initialize()
+    outs, states = bi.unroll(
+        4, nd.array(np.random.randn(2, 4, 3).astype(np.float32)),
+        merge_outputs=True)
+    assert outs.shape == (2, 4, 10)
+
+
+def test_rnn_layers():
+    for layer, n_state in [(gluon.rnn.RNN(8, 2), 1),
+                           (gluon.rnn.LSTM(8, 2), 2),
+                           (gluon.rnn.GRU(8, 2), 1)]:
+        layer.initialize()
+        x = nd.array(np.random.randn(5, 3, 4).astype(np.float32))
+        out = layer(x)
+        assert out.shape == (5, 3, 8)
+        states = layer.begin_state(3)
+        out, new_states = layer(x, states)
+        assert len(new_states) == n_state
+        assert new_states[0].shape == (2, 3, 8)
+    # NTC layout
+    l = gluon.rnn.LSTM(8, 1, layout="NTC")
+    l.initialize()
+    out = l(nd.array(np.random.randn(3, 5, 4).astype(np.float32)))
+    assert out.shape == (3, 5, 8)
+
+
+def test_lstm_layer_gradient_flows():
+    layer = gluon.rnn.LSTM(8, 1)
+    layer.initialize()
+    x = nd.array(np.random.randn(5, 3, 4).astype(np.float32))
+    with autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    g = layer.parameters.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_data_pipeline():
+    X = np.random.randn(10, 3).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    dataset = gluon.data.ArrayDataset(X, Y)
+    assert len(dataset) == 10
+    loader = gluon.data.DataLoader(dataset, batch_size=3, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (3, 3)
+    loader2 = gluon.data.DataLoader(dataset, batch_size=3,
+                                    last_batch="discard")
+    assert len(list(loader2)) == 3
+    ds2 = dataset.transform_first(lambda x: x * 2)
+    item = ds2[0]
+    np.testing.assert_allclose(item[0], X[0] * 2, rtol=1e-6)
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(12).reshape(6, 2))
+    slices = gluon.split_data(data, 3)
+    assert len(slices) == 3 and slices[0].shape == (2, 2)
+    loaded = gluon.split_and_load(data, [mx.cpu(0)])
+    assert loaded[0].shape == (6, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.array([3.0]), nd.array([4.0])]
+    norm = gluon.clip_global_norm(arrays, 2.5)
+    assert norm == pytest.approx(5.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert total == pytest.approx(2.5, rel=1e-4)
+
+
+def test_model_zoo_smoke():
+    np.random.seed(0)
+    x32 = nd.array(np.random.randn(1, 3, 32, 32).astype(np.float32))
+    net = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    net.initialize()
+    assert net(x32).shape == (1, 10)
+    net2 = gluon.model_zoo.vision.resnet50_v2(classes=10)
+    net2.initialize()
+    assert net2(x32).shape == (1, 10)
+    zoo = gluon.model_zoo.vision.get_model("squeezenet1.1", classes=4)
+    zoo.initialize()
+    x64 = nd.array(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    assert zoo(x64).shape == (1, 4)
+
+
+def test_block_save_load_params(tmp_path):
+    net = gluon.nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.ones((1, 3)))
+    fname = str(tmp_path / "net.params")
+    net.save_params(fname)
+    net2 = gluon.nn.HybridSequential(prefix="model_")
+    with net2.name_scope():
+        net2.add(gluon.nn.Dense(4))
+    net2.load_params(fname)
+    np.testing.assert_array_equal(net(nd.ones((1, 3))).asnumpy(),
+                                  net2(nd.ones((1, 3))).asnumpy())

@@ -1,0 +1,213 @@
+"""Metric + IO tests, mirroring tests/python/unittest/test_metric.py and
+test_io.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ---------------------------------------------------------------- metrics
+
+def test_accuracy():
+    m = mx.metric.create("acc")
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(2.0 / 3.0)
+
+
+def test_topk():
+    m = mx.metric.create("top_k_accuracy", top_k=2)
+    pred = nd.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+    label = nd.array([1, 0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1():
+    m = mx.metric.create("f1")
+    pred = nd.array([[0.1, 0.9], [0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+    label = nd.array([1, 0, 0, 1])
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=1 → p=r=0.5 → f1=0.5
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_regression_metrics():
+    pred = nd.array([[1.0], [2.0], [3.0]])
+    label = nd.array([2.0, 2.0, 2.0])
+    mae = mx.metric.create("mae")
+    mae.update([label], [pred])
+    assert mae.get()[1] == pytest.approx(2.0 / 3.0)
+    mse = mx.metric.create("mse")
+    mse.update([label], [pred])
+    assert mse.get()[1] == pytest.approx(2.0 / 3.0)
+    rmse = mx.metric.create("rmse")
+    rmse.update([label], [pred])
+    assert rmse.get()[1] == pytest.approx(np.sqrt(2.0 / 3.0))
+
+
+def test_perplexity_and_ce():
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0, 0])
+    ce = mx.metric.create("ce")
+    ce.update([label], [pred])
+    expect = -(np.log(0.5) + np.log(0.9)) / 2
+    assert ce.get()[1] == pytest.approx(expect, rel=1e-5)
+    ppl = mx.metric.create("perplexity", ignore_label=None)
+    ppl.update([label], [pred])
+    assert ppl.get()[1] == pytest.approx(np.exp(expect), rel=1e-5)
+
+
+def test_composite_and_custom():
+    comp = mx.metric.create(["acc", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+    def feval(label, pred):
+        return float(np.abs(label - pred.argmax(1)).sum())
+    m = mx.metric.np(feval, name="custom_abs")
+    pred = nd.array([[0.9, 0.1]])
+    label = nd.array([1])
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+
+# -------------------------------------------------------------------- io
+
+def test_ndarrayiter_basic():
+    X = np.arange(40).reshape(10, 4).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[2].pad == 2
+    # pad wraps around
+    np.testing.assert_array_equal(batches[2].data[0].asnumpy()[2:],
+                                  X[:2])
+    it.reset()
+    assert len(list(it)) == 3
+
+    it2 = mx.io.NDArrayIter(X, Y, batch_size=4,
+                            last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_ndarrayiter_shuffle_and_dict():
+    X = np.arange(12).reshape(6, 2).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": X}, {"softmax_label": np.zeros(6)},
+                           batch_size=2, shuffle=True)
+    names = [d.name for d in it.provide_data]
+    assert names == ["data"]
+    got = np.concatenate([b.data[0].asnumpy() for b in it])
+    assert sorted(got[:, 0].tolist()) == sorted(X[:, 0].tolist())
+
+
+def test_resize_iter():
+    X = np.zeros((8, 2), np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(8), batch_size=2)
+    r = mx.io.ResizeIter(base, 7)
+    assert len(list(r)) == 7
+
+
+def test_prefetching_iter():
+    X = np.arange(16).reshape(8, 2).astype(np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(8), batch_size=2)
+    pf = mx.io.PrefetchingIter(base)
+    batches = list(pf)
+    assert len(batches) == 4
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(), X[:2])
+    pf.reset()
+    assert len(list(pf)) == 4
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "d.csv")
+    label_path = str(tmp_path / "l.csv")
+    X = np.random.rand(6, 3).astype(np.float32)
+    Y = np.arange(6).astype(np.float32)
+    np.savetxt(data_path, X, delimiter=",")
+    np.savetxt(label_path, Y, delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_path, data_shape=(3,),
+                       label_csv=label_path, batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), X[:2],
+                               rtol=1e-5)
+
+
+def test_mnist_iter(tmp_path):
+    # synthesize an idx-format file pair (the on-disk format the reference's
+    # iter_mnist.cc parses)
+    import struct
+    imgs = (np.random.rand(10, 28, 28) * 255).astype(np.uint8)
+    lbls = np.arange(10).astype(np.uint8)
+    img_path = str(tmp_path / "train-images-idx3-ubyte")
+    lbl_path = str(tmp_path / "train-labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 10, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 10))
+        f.write(lbls.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                         shuffle=False)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy()[0, 0],
+                               imgs[0] / 255.0, rtol=1e-5)
+    np.testing.assert_array_equal(batches[0].label[0].asnumpy(),
+                                  lbls[:5])
+    flat = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                           shuffle=False, flat=True)
+    assert next(iter(flat)).data[0].shape == (5, 784)
+
+
+# --------------------------------------------------------------- kvstore
+
+def test_kvstore_local_aggregation():
+    kv = mx.kv.create("local")
+    shape = (3, 3)
+    kv.init(3, nd.ones(shape))
+    # push from 4 "devices" then pull: values sum (reference
+    # tests/python/unittest/test_kvstore.py:305 pattern)
+    vals = [nd.ones(shape)] * 4
+    kv.push(3, vals)
+    out = nd.zeros(shape)
+    kv.pull(3, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), 4 * np.ones(shape))
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    shape = (2,)
+    kv.init("w", nd.zeros(shape))
+
+    def updater(key, grad, stored):
+        stored._set_data((stored + 2 * grad)._data)
+    kv.set_updater(updater)
+    kv.push("w", nd.ones(shape))
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    np.testing.assert_array_equal(out.asnumpy(), [2, 2])
+
+
+def test_kvstore_optimizer():
+    kv = mx.kv.create("device")
+    kv.init("w", nd.ones((2,)))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    kv.push("w", nd.ones((2,)))
+    out = nd.zeros((2,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.5])
+    assert kv.rank == 0 and kv.num_workers == 1
+
+
+def test_kvstore_str_and_list_keys():
+    kv = mx.kv.create("local")
+    kv.init(["a", "b"], [nd.ones((2,)), nd.zeros((2,))])
+    outs = [nd.zeros((2,)), nd.zeros((2,))]
+    kv.pull(["a", "b"], out=outs)
+    np.testing.assert_array_equal(outs[0].asnumpy(), [1, 1])

@@ -1,0 +1,238 @@
+"""Module API tests — small real trainings asserting accuracy, mirroring
+the reference tests/python/train/test_mlp.py + unittest/test_module.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _linear_problem(n=256, d=10, k=2, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    return X, Y
+
+
+def _mlp_symbol(num_hidden=32, num_classes=2):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_mlp():
+    X, Y = _linear_problem()
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(X, Y, batch_size=64)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric="acc", num_epoch=8)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, "MLP did not learn: %s" % score
+
+
+def test_module_fit_conv_pattern():
+    # two classes: bright square top-left vs bottom-right — conv+maxpool
+    # learnable by construction
+    rng = np.random.RandomState(0)
+    n = 256
+    X = rng.randn(n, 1, 16, 16).astype(np.float32) * 0.1
+    Y = (rng.rand(n) > 0.5).astype(np.float32)
+    for i in range(n):
+        if Y[i] > 0:
+            X[i, 0, 2:6, 2:6] += 2.0
+        else:
+            X[i, 0, 10:14, 10:14] += 2.0
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(4, 4),
+                         stride=(4, 4))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    train = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=6)
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=32), "acc")
+    assert score[0][1] > 0.95, "conv net did not learn: %s" % score
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, Y = _linear_problem()
+    train = mx.io.NDArrayIter(X, Y, batch_size=64)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=3,
+            initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 3)
+    s1 = mod.score(mx.io.NDArrayIter(X, Y, batch_size=64), "acc")
+
+    mod2 = mx.mod.Module.load(prefix, 3)
+    val = mx.io.NDArrayIter(X, Y, batch_size=64)
+    mod2.bind(data_shapes=val.provide_data,
+              label_shapes=val.provide_label, for_training=False)
+    s2 = mod2.score(val, "acc")
+    assert abs(s1[0][1] - s2[0][1]) < 1e-9
+
+    # epoch-callback style checkpoint via mx.callback.do_checkpoint
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    assert sym.list_arguments() == mod.symbol.list_arguments()
+    assert set(args) == set(mod.get_params()[0])
+
+
+def test_module_predict_and_outputs():
+    X, Y = _linear_problem(n=128)
+    train = mx.io.NDArrayIter(X, Y, batch_size=32)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=2,
+            initializer=mx.init.Xavier())
+    out = mod.predict(mx.io.NDArrayIter(X, Y, batch_size=32))
+    assert out.shape == (128, 2)
+    np.testing.assert_allclose(out.asnumpy().sum(1), np.ones(128),
+                               rtol=1e-4)
+    # iter_predict yields per batch
+    n = 0
+    for outs, i_batch, batch in mod.iter_predict(
+            mx.io.NDArrayIter(X, Y, batch_size=32)):
+        assert outs[0].shape == (32, 2)
+        n += 1
+    assert n == 4
+
+
+def test_module_input_grads():
+    X, Y = _linear_problem(n=64)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True, inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    (dgrad,) = mod.get_input_grads()
+    assert dgrad.shape == (32, 10)
+    assert float(np.abs(dgrad.asnumpy()).sum()) > 0
+
+
+def test_module_fixed_params():
+    X, Y = _linear_problem(n=64)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    w_before = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    w_after = mod._exec.arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_array_equal(w_before, w_after)
+
+
+def test_module_kvstore_local():
+    # update_on_kvstore path: optimizer runs inside the kvstore
+    X, Y = _linear_problem()
+    train = mx.io.NDArrayIter(X, Y, batch_size=64)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+    kv = mx.kv.create("local")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    assert mod._update_on_kvstore
+    for _ in range(3):
+        train.reset()
+        for batch in train:
+            mod.forward_backward(batch)
+            mod.update()
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=64), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_bucketing_module():
+    # same network, two sequence-length "buckets" sharing parameters
+    # buckets differ in sequence length; params (which act on the feature
+    # dim) are shared — the RNN bucketing pattern
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")  # (N, seq_len, 4)
+        pooled = mx.sym.mean(data, axis=1)
+        net = mx.sym.FullyConnected(pooled, num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    rng = np.random.RandomState(0)
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataBatch, DataDesc
+    mod.bind(data_shapes=[DataDesc("data", (16, 10, 4))],
+             label_shapes=[DataDesc("softmax_label", (16,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for step in range(8):
+        for key in (10, 6):
+            X = rng.randn(16, key, 4).astype(np.float32)
+            Y = (X.mean(axis=(1, 2)) > 0).astype(np.float32)
+            batch = DataBatch(
+                data=[nd.array(X)], label=[nd.array(Y)], bucket_key=key,
+                provide_data=[DataDesc("data", (16, key, 4))],
+                provide_label=[DataDesc("softmax_label", (16,))],
+                pad=0)
+            mod.forward_backward(batch)
+            mod.update()
+    assert set(mod._buckets) == {10, 6}
+    # params really are shared: the shared dict matches every bucket's
+    # executor after a switch
+    shared = mod._buckets[10]._arg_params["fc1_weight"].asnumpy()
+    for key in (10, 6):
+        mod.switch_bucket(key, None)
+        mod._share_params_with_current()
+        w = mod._curr_module._exec.arg_dict["fc1_weight"].asnumpy()
+        np.testing.assert_array_equal(shared, w)
+
+
+def test_sequential_module():
+    X, Y = _linear_problem(n=64)
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                 name="s1fc")
+    net1 = mx.sym.Activation(net1, act_type="relu")
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("s1fc_act"), num_hidden=2,
+                                 name="s2fc")
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32)
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=None, context=mx.cpu()),
+            auto_wiring=True)
+    seq.add(mx.mod.Module(net2, data_names=("s1fc_act",),
+                          context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for _ in range(8):
+        it.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    m = mx.metric.create("acc")
+    it.reset()
+    for batch in it:
+        seq.forward(batch, is_train=False)
+        seq.update_metric(m, batch.label)
+    assert m.get()[1] > 0.9, m.get()

@@ -1,0 +1,118 @@
+"""Optimizer tests — step-exactness vs hand-computed reference updates and
+convergence on a quadratic, mirroring tests/python/unittest/test_optimizer.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _quadratic_converges(opt_name, tol=1e-2, steps=300, **kwargs):
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = nd.array(np.zeros(3, np.float32))
+    optimizer = mx.optimizer.create(opt_name, **kwargs)
+    state = optimizer.create_state(0, w)
+    for _ in range(steps):
+        grad = nd.array(2.0 * (w.asnumpy() - target))
+        optimizer.update(0, w, grad, state)
+    return np.abs(w.asnumpy() - target).max() < tol
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.3}),
+    ("rmsprop", {"learning_rate": 0.1}),
+    ("rmsprop", {"learning_rate": 0.05, "centered": True, "tol": 0.05}),
+    ("adagrad", {"learning_rate": 1.0}),
+    ("adadelta", {"rho": 0.9, "epsilon": 1e-4}),
+    ("adamax", {"learning_rate": 0.5}),
+    ("nadam", {"learning_rate": 0.3}),
+    ("ftrl", {"learning_rate": 2.0}),
+])
+def test_optimizer_converges(name, kwargs):
+    kwargs = dict(kwargs)
+    tol = kwargs.pop("tol", 1e-2)
+    assert _quadratic_converges(name, tol=tol, steps=500, **kwargs), \
+        "%s failed to converge" % name
+
+
+def test_sgd_exact_step():
+    w0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.5, -0.5], np.float32)
+    w = nd.array(w0)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, wd=0.01,
+                              rescale_grad=2.0)
+    opt.update(0, w, nd.array(g), opt.create_state(0, w))
+    expected = w0 - 0.1 * (2.0 * g + 0.01 * w0)
+    np.testing.assert_allclose(w.asnumpy(), expected, rtol=1e-6)
+
+
+def test_sgd_momentum_exact_two_steps():
+    w0 = np.array([1.0], np.float32)
+    g = np.array([1.0], np.float32)
+    w = nd.array(w0)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    state = opt.create_state(0, w)
+    opt.update(0, w, nd.array(g), state)
+    opt.update(0, w, nd.array(g), state)
+    # step1: mom=-0.1, w=0.9 ; step2: mom=0.9*-0.1-0.1=-0.19, w=0.71
+    np.testing.assert_allclose(w.asnumpy(), [0.71], rtol=1e-6)
+
+
+def test_adam_bias_correction():
+    w = nd.array(np.array([1.0], np.float32))
+    g = nd.array(np.array([0.1], np.float32))
+    opt = mx.optimizer.create("adam", learning_rate=0.001)
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    # first step of adam moves weight by ~lr*sign(g)
+    assert abs(float(w.asnumpy()[0]) - (1.0 - 0.001)) < 1e-4
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+    multi = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    multi.base_lr = 1.0
+    assert multi(3) == 1.0
+    assert multi(6) == pytest.approx(0.1)
+    assert multi(16) == pytest.approx(0.01)
+    poly = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0)
+    assert poly(0) == 1.0
+    assert poly(100) == 0
+    assert 0 < poly(50) < 1
+
+
+def test_lr_wd_mult():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w", lr_mult=0.0)
+    net = mx.sym.FullyConnected(data, weight=w, num_hidden=2, no_bias=True,
+                                name="fc")
+    opt = mx.optimizer.create("sgd", learning_rate=1.0, sym=net,
+                              param_idx2name={0: "w"})
+    opt.set_lr_mult({})
+    w_nd = nd.array(np.ones((2, 3), np.float32))
+    g_nd = nd.array(np.ones((2, 3), np.float32))
+    opt.update(0, w_nd, g_nd, opt.create_state(0, w_nd))
+    np.testing.assert_array_equal(w_nd.asnumpy(), np.ones((2, 3)))
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.ones(4, np.float32))
+    updater(0, g, w)
+    states = updater.get_states()
+    updater2 = mx.optimizer.get_updater(
+        mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9))
+    updater2.set_states(states)
+    w2 = nd.array(w.asnumpy())
+    updater(0, g, w)
+    updater2(0, g, w2)
+    np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)
