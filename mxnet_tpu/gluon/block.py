@@ -248,10 +248,10 @@ class Block:
         for _, param in self.params.items():
             param.cast(dtype)
 
-    def __call__(self, *args):
-        return self.forward(*args)
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
 
-    def forward(self, *args):
+    def forward(self, *args, **kwargs):
         raise NotImplementedError
 
 
@@ -293,13 +293,13 @@ class HybridBlock(Block):
         self._cached_op = None
 
     # -- eager path --------------------------------------------------------
-    def _call_eager(self, *args):
+    def _call_eager(self, *args, **kwargs):
         try:
             params = {k: p.data() for k, p in self._reg_params.items()}
         except DeferredInitializationError:
             self._finish_deferred(*args)
             params = {k: p.data() for k, p in self._reg_params.items()}
-        return self.hybrid_forward(nd, *args, **params)
+        return self.hybrid_forward(nd, *args, **kwargs, **params)
 
     def _finish_deferred(self, *args):
         self.infer_shape(*args)
@@ -314,7 +314,7 @@ class HybridBlock(Block):
             "inferred for %s. Override infer_shape." % self.name)
 
     # -- traced path -------------------------------------------------------
-    def _call_traced(self, *args):
+    def _call_traced(self, *args, **kwargs):
         ctx = _trace_ctx()
         params = {}
         for k, p in self._reg_params.items():
@@ -322,7 +322,7 @@ class HybridBlock(Block):
             if tracer is None:
                 raise MXNetError("parameter %s missing from trace" % p.name)
             params[k] = tracer
-        return self.hybrid_forward(_F_JNP, *args, **params)
+        return self.hybrid_forward(_F_JNP, *args, **kwargs, **params)
 
     def _build_cached_op(self, nd_args):
         plist = list(self.collect_params().values())
@@ -403,14 +403,16 @@ class HybridBlock(Block):
             self._active = saved
 
     # -- dispatch ----------------------------------------------------------
-    def forward(self, *args):
+    def forward(self, *args, **kwargs):
         first = args[0] if args else None
         if isinstance(first, NDArray):
-            if self._active:
+            # kwargs (e.g. loss pred_lengths) bypass the cached-op path —
+            # the op registry is positional-only
+            if self._active and not kwargs:
                 return self._call_cached(*args)
-            return self._call_eager(*args)
+            return self._call_eager(*args, **kwargs)
         if _trace_ctx() is not None:
-            return self._call_traced(*args)
+            return self._call_traced(*args, **kwargs)
         # raw jnp arrays outside a trace: run functionally (inference)
         prev = _trace_ctx()
         from .. import random as _random

@@ -181,21 +181,32 @@ class CTCLoss(Loss):
         import jax.numpy as jnp
         from ..ndarray.ndarray import NDArray
 
+        def unwrap(a):
+            return a._data if isinstance(a, NDArray) else a
+
         is_nd = isinstance(pred, NDArray)
-        p = pred._data if is_nd else pred
-        l = label._data if isinstance(label, NDArray) else label
+        p = unwrap(pred)
+        l = unwrap(label)
         if self._layout == "TNC":
             p = jnp.swapaxes(p, 0, 1)
+        plen = unwrap(pred_lengths)
+        llen = unwrap(label_lengths)
         loss = _ctc_loss_jax(p, l.astype(jnp.int32),
-                             blank_last=(self._blank == "last"))
+                             blank_last=(self._blank == "last"),
+                             pred_lengths=None if plen is None
+                             else plen.astype(jnp.int32),
+                             label_lengths=None if llen is None
+                             else llen.astype(jnp.int32))
         out = NDArray(loss) if is_nd else loss
         out = _apply_weighting(F, out, self._weight, sample_weight)
         return out
 
 
-def _ctc_loss_jax(logits, labels, blank_last=True):
+def _ctc_loss_jax(logits, labels, blank_last=True, pred_lengths=None,
+                  label_lengths=None):
     """log-semiring CTC forward over lax.scan. logits (N,T,C), labels (N,L)
-    padded with -1."""
+    padded with -1 (or bounded by ``label_lengths``); ``pred_lengths``
+    limits the per-sample number of frames entering the forward pass."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -203,6 +214,9 @@ def _ctc_loss_jax(logits, labels, blank_last=True):
     N, T, C = logits.shape
     L = labels.shape[1]
     blank = C - 1 if blank_last else 0
+    if label_lengths is not None:
+        pos = jnp.arange(L)[None, :]
+        labels = jnp.where(pos < label_lengths[:, None], labels, -1)
     logp = jax.nn.log_softmax(logits, axis=-1)
 
     # extended label seq: blank l1 blank l2 ... blank  (length 2L+1)
@@ -233,10 +247,16 @@ def _ctc_loss_jax(logits, labels, blank_last=True):
         merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
         emit = jnp.take_along_axis(logp_t, ext, axis=1)
         new_alpha = jnp.where(valid, merged + emit, neg_inf)
-        return new_alpha, None
+        return new_alpha, new_alpha
 
-    alpha, _ = lax.scan(step, alpha0,
-                        jnp.swapaxes(logp, 0, 1)[1:])
+    _, stacked = lax.scan(step, alpha0,
+                          jnp.swapaxes(logp, 0, 1)[1:])
+    all_alpha = jnp.concatenate([alpha0[None], stacked])   # [T, N, S]
+    if pred_lengths is None:
+        alpha = all_alpha[-1]
+    else:
+        t_idx = jnp.clip(pred_lengths - 1, 0, T - 1)
+        alpha = all_alpha[t_idx, jnp.arange(N)]
     end1 = 2 * label_len
     end2 = 2 * label_len - 1
     a1 = jnp.take_along_axis(alpha, end1[:, None], axis=1)[:, 0]

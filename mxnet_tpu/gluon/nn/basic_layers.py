@@ -276,5 +276,4 @@ def _init_from_name(name):
     if name is None or not isinstance(name, str):
         return name
     from ... import initializer as init_mod
-    table = {"zeros": init_mod.Zero(), "ones": init_mod.One()}
-    return table.get(name, None)
+    return init_mod.create(name)

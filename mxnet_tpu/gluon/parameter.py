@@ -64,6 +64,8 @@ class Parameter:
     def _finish_init(self, init, ctx, default_init):
         data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx)
         initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
         initializer(init_mod.InitDesc(self.name), data)
         self._data = data
         self._init_grad()

@@ -19,7 +19,7 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Orthogonal",
            "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "One", "Zero",
-           "Constant", "Mixed", "Load", "register"]
+           "Constant", "Mixed", "Load", "register", "create"]
 
 _INIT_REGISTRY = {}
 
@@ -27,6 +27,20 @@ _INIT_REGISTRY = {}
 def register(klass):
     _INIT_REGISTRY[klass.__name__.lower()] = klass
     return klass
+
+
+def create(name, **kwargs):
+    """Resolve an initializer by registered name (reference registry.py)."""
+    if isinstance(name, Initializer):
+        return name
+    key = str(name).lower()
+    # reference registry aliases (initializer.py @init.register aliases)
+    key = {"zeros": "zero", "ones": "one"}.get(key, key)
+    if key not in _INIT_REGISTRY:
+        raise ValueError(
+            "Unknown initializer %r. Registered: %s"
+            % (name, sorted(_INIT_REGISTRY)))
+    return _INIT_REGISTRY[key](**kwargs)
 
 
 class InitDesc(str):

@@ -162,11 +162,7 @@ class KVStore:
         if self._updater is None:
             raise MXNetError("there is no updater")
         with open(fname, "wb") as f:
-            if dump_optimizer:
-                f.write(pickle.dumps((self._updater.get_states(),
-                                      pickle.dumps(self._optimizer))))
-            else:
-                f.write(self._updater.get_states())
+            f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:

@@ -179,6 +179,8 @@ class TopKAccuracy(EvalMetric):
             label_np = _as_np(label).astype("int32")
             num_samples = pred_np.shape[0]
             num_dims = len(pred_np.shape)
+            assert num_dims <= 2, \
+                "Predictions should be no more than 2 dims"
             if num_dims == 1:
                 self.sum_metric += (pred_np.ravel() ==
                                     label_np.ravel()).sum()
@@ -354,8 +356,9 @@ class Loss(EvalMetric):
 
     def update(self, _, preds):
         for pred in preds:
-            self.sum_metric += _as_np(pred).sum()
-            self.num_inst += _as_np(pred).size
+            pred_np = _as_np(pred)
+            self.sum_metric += pred_np.sum()
+            self.num_inst += pred_np.size
 
 
 @_register

@@ -172,11 +172,13 @@ class BucketingModule(BaseModule):
 
     def prepare(self, data_batch):
         assert self.binded and self.params_initialized
-        bucket_key = data_batch.bucket_key
         original_bucket_key = self._curr_bucket_key
-        self.switch_bucket(bucket_key, data_batch.provide_data,
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
                            data_batch.provide_label)
-        self._curr_bucket_key = original_bucket_key
+        # restore the active module too, not just the key — update_metric
+        # after prepare() must read the executor that actually ran
+        # (reference bucketing_module.py prepare)
+        self.switch_bucket(original_bucket_key, None, None)
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

@@ -512,9 +512,17 @@ class Updater:
         self.optimizer.update(index, weight, grad, self.states[index])
 
     def set_states(self, states):
-        self.states = pickle.loads(states)
+        """Restore states; a (states, optimizer) pair also restores the
+        optimizer (reference optimizer.py set_states)."""
+        obj = pickle.loads(states)
+        if isinstance(obj, tuple) and len(obj) == 2:
+            self.states, self.optimizer = obj
+        else:
+            self.states = obj
 
-    def get_states(self):
+    def get_states(self, dump_optimizer=False):
+        if dump_optimizer:
+            return pickle.dumps((self.states, self.optimizer))
         return pickle.dumps(self.states)
 
 

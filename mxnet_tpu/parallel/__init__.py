@@ -1,0 +1,47 @@
+"""First-class parallelism for the TPU-native framework.
+
+The reference (MXNet v0.11) scales via a ZMQ parameter server
+(/root/reference/src/kvstore/kvstore_dist.h) plus per-device executor
+replicas (/root/reference/python/mxnet/module/executor_group.py:99).  On
+TPU the idiomatic design is the opposite: ONE SPMD program laid out over a
+``jax.sharding.Mesh`` whose axes name the parallelism strategies, with XLA
+inserting ICI/DCN collectives from sharding annotations.
+
+Axes (any subset may be size 1):
+
+- ``dp`` — data parallel: batch sharded, gradients all-reduced (psum).
+- ``tp`` — tensor parallel: weight matrices sharded row/col-wise.
+- ``pp`` — pipeline parallel: layer stages on mesh slices, microbatched.
+- ``sp`` — sequence/context parallel: ring attention / Ulysses all-to-all.
+- ``ep`` — expert parallel: MoE experts sharded, all_to_all routing.
+
+Modules:
+
+- :mod:`.mesh` — mesh construction (`make_mesh`) and axis conventions.
+- :mod:`.collectives` — named-axis collective wrappers (psum etc.).
+- :mod:`.sharding` — parameter partition rules → `NamedSharding`.
+- :mod:`.data_parallel` — jitted DP/FSDP train-step builder.
+- :mod:`.ring_attention` — blockwise ring attention over ``sp``.
+- :mod:`.ulysses` — all-to-all sequence parallelism over ``sp``.
+- :mod:`.moe` — mixture-of-experts layer with ``ep`` routing.
+- :mod:`.pipeline` — GPipe-style microbatch pipeline over ``pp``.
+"""
+from . import mesh
+from .mesh import (MeshSpec, make_mesh, device_mesh_shape, AXIS_DP, AXIS_TP,
+                   AXIS_PP, AXIS_SP, AXIS_EP)
+from . import collectives
+from .collectives import (allreduce, allgather, reduce_scatter, alltoall,
+                          ring_permute, axis_index, axis_size)
+from . import sharding
+from .sharding import (PartitionRule, make_sharding_rules, shard_params,
+                       named_sharding, replicated, logical_to_mesh)
+from . import data_parallel
+from .data_parallel import make_train_step, DataParallelTrainer
+from . import ring_attention
+from .ring_attention import ring_attention as ring_attention_fn
+from . import ulysses
+from .ulysses import ulysses_attention
+from . import moe
+from .moe import MoELayer, moe_apply
+from . import pipeline
+from .pipeline import pipeline_apply

@@ -1,0 +1,23 @@
+"""shard_map compat shim (jax.shard_map in >=0.8, experimental before)."""
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check_rep=False):
+    kw = {}
+    if "check_vma" in _PARAMS:
+        kw["check_vma"] = check_rep
+    elif "check_rep" in _PARAMS:
+        kw["check_rep"] = check_rep
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)

@@ -1,0 +1,99 @@
+"""Device-mesh construction.
+
+Replaces the reference's device-assignment machinery — context lists in
+``Module(context=[gpu(0), gpu(1), ...])`` and the kvstore node roles
+(/root/reference/src/kvstore/kvstore_dist.h:52-81) — with one logical mesh
+over which the whole training step is laid out.  Collectives then ride ICI
+inside a slice and DCN across slices automatically, because mesh axes are
+created innermost-first over the physical device order JAX reports.
+"""
+from __future__ import annotations
+
+import collections
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_PP = "pp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+
+#: canonical ordering, outermost (slowest / DCN-friendly) first.  ``tp``/``sp``
+#: are innermost so their (frequent, latency-bound) collectives map to
+#: nearest-neighbour ICI links.
+CANONICAL_ORDER = (AXIS_PP, AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP)
+
+
+class MeshSpec(collections.OrderedDict):
+    """Ordered {axis_name: size} spec; -1 means "all remaining devices"."""
+
+    def resolved(self, n_devices):
+        out = collections.OrderedDict(self)
+        known = 1
+        wild = None
+        for k, v in out.items():
+            if v == -1:
+                if wild is not None:
+                    raise ValueError("only one axis may be -1")
+                wild = k
+            else:
+                known *= v
+        if wild is not None:
+            if n_devices % known:
+                raise ValueError(
+                    "cannot infer axis %r: %d devices not divisible by %d"
+                    % (wild, n_devices, known))
+            out[wild] = n_devices // known
+            known *= out[wild]
+        if known != n_devices:
+            raise ValueError("mesh %s needs %d devices, have %d"
+                             % (dict(out), known, n_devices))
+        return out
+
+
+def device_mesh_shape(n_devices, dp=1, tp=1, pp=1, sp=1, ep=1):
+    """Fill dp with leftover devices; validates the product."""
+    fixed = tp * pp * sp * ep
+    if dp == -1:
+        if n_devices % fixed:
+            raise ValueError("devices %d not divisible by %d"
+                             % (n_devices, fixed))
+        dp = n_devices // fixed
+    if dp * fixed != n_devices:
+        raise ValueError("dp*tp*pp*sp*ep=%d != %d devices"
+                         % (dp * fixed, n_devices))
+    return collections.OrderedDict(
+        [(AXIS_PP, pp), (AXIS_DP, dp), (AXIS_EP, ep), (AXIS_SP, sp),
+         (AXIS_TP, tp)])
+
+
+def make_mesh(axes=None, devices=None, **axis_sizes):
+    """Create a `jax.sharding.Mesh`.
+
+    ``axes`` may be a dict {name: size} (ordered; -1 once for "rest"), or
+    pass sizes as kwargs (``make_mesh(dp=4, tp=2)``).  Axes of size 1 are
+    kept so shardings can always name them.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = axis_sizes or {AXIS_DP: n}
+    spec = MeshSpec(axes).resolved(n)
+    shape = tuple(spec.values())
+    if math.prod(shape) != n:
+        raise ValueError("mesh shape %s != %d devices" % (shape, n))
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(spec.keys()))
+
+
+def full_mesh(devices=None, dp=-1, tp=1, pp=1, sp=1, ep=1):
+    """A mesh naming all five canonical axes (unused ones size 1)."""
+    if devices is None:
+        devices = jax.devices()
+    spec = device_mesh_shape(len(devices), dp=dp, tp=tp, pp=pp, sp=sp, ep=ep)
+    return make_mesh(spec, devices=devices)

@@ -1,0 +1,125 @@
+"""Mixture-of-experts with expert parallelism (``ep`` mesh axis).
+
+No MoE exists in the reference; this is the TPU-native capability the task
+brief requires (EP via ``lax.all_to_all`` routing).  GShard-style dense
+dispatch: top-k gating with a capacity bound produces a dispatch tensor,
+one all_to_all moves token slots to their expert's device, each device runs
+its local experts as one batched matmul (MXU-friendly — no gather loops),
+and a second all_to_all brings results home for the weighted combine.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from ._shard_map import shard_map
+
+from .mesh import AXIS_EP
+
+
+def top1_gating(logits, capacity):
+    """Top-1 gating with capacity. logits [T, E] → (combine, dispatch).
+
+    combine: [T, E, C] float weights; dispatch: [T, E, C] bool mask.
+    Tokens overflowing an expert's capacity are dropped (GShard semantics).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                     # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)   # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) - onehot               # position in queue
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1)          # [T]
+    keep = pos_in_expert < capacity
+    gate = gate * keep
+    cap_onehot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)          # [T, C]
+    dispatch = onehot[:, :, None] * cap_onehot[:, None, :] * keep[:, None, None]
+    combine = dispatch * gate[:, None, None]
+    return combine, dispatch
+
+
+def _moe_local(x, gate_w, w1, b1, w2, b2, axis, capacity_factor, act):
+    """Inside shard_map.  x: [T_local, D]; experts sharded: w1 [E_local,...]."""
+    n = lax.axis_size(axis)
+    t, d = x.shape
+    e_local = w1.shape[0]
+    e = e_local * n
+    capacity = max(1, int(capacity_factor * t / e))
+
+    logits = jnp.dot(x, gate_w, preferred_element_type=jnp.float32)  # [T, E]
+    combine, dispatch = top1_gating(logits, capacity)
+
+    # [T, E, C] x [T, D] → [E, C, D]: expert-major slots for this shard
+    slots = jnp.einsum("tec,td->ecd", dispatch, x)
+    # all_to_all: split expert dim across devices, concat their slots —
+    # afterwards each device holds [E_local, C*n, D]: every device's slots
+    # for MY experts.
+    slots = slots.reshape(n, e_local * capacity, d)
+    recv = lax.all_to_all(slots, axis, split_axis=0, concat_axis=0,
+                          tiled=True)                    # [n*E_local*C, D]
+    recv = recv.reshape(n, e_local, capacity, d)
+    recv = recv.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, d)
+
+    # batched expert FFN — one big MXU matmul per projection
+    h = jnp.einsum("egd,edf->egf", recv, w1,
+                   preferred_element_type=jnp.float32) + b1[:, None, :]
+    h = act(h)
+    y = jnp.einsum("egf,efd->egd", h, w2,
+                   preferred_element_type=jnp.float32) + b2[:, None, :]
+
+    # route back: inverse of the dispatch all_to_all
+    y = y.reshape(e_local, n, capacity, d).transpose(1, 0, 2, 3)
+    y = y.reshape(n * e_local * capacity, d)
+    back = lax.all_to_all(y.reshape(n, e_local * capacity, d), axis,
+                          split_axis=0, concat_axis=0, tiled=True)
+    back = back.reshape(e, capacity, d)
+    return jnp.einsum("tec,ecd->td", combine, back).astype(x.dtype)
+
+
+def moe_apply(x, gate_w, w1, b1, w2, b2, mesh=None, axis=AXIS_EP,
+              capacity_factor=2.0, act=jax.nn.relu):
+    """MoE FFN. Global shapes: x [T, D]; gate_w [D, E]; w1 [E, D, F];
+    b1 [E, F]; w2 [E, F, D]; b2 [E, D].  Tokens sharded over ``axis``,
+    experts sharded over ``axis``."""
+    if mesh is None:
+        return _moe_local(x, gate_w, w1, b1, w2, b2, axis, capacity_factor,
+                          act)
+    fn = functools.partial(_moe_local, axis=axis,
+                           capacity_factor=capacity_factor, act=act)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(axis, None), P(None, None), P(axis, None, None),
+                  P(axis, None), P(axis, None, None), P(axis, None)),
+        out_specs=P(axis, None), check_rep=False)(
+            x, gate_w, w1, b1, w2, b2)
+
+
+class MoELayer:
+    """Parameter container + init for `moe_apply` (functional style)."""
+
+    def __init__(self, dim, hidden, num_experts, capacity_factor=2.0):
+        self.dim, self.hidden = dim, hidden
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+
+    def init(self, key):
+        kg, k1, k2 = jax.random.split(key, 3)
+        scale = self.dim ** -0.5
+        return {
+            "gate_w": jax.random.normal(kg, (self.dim, self.num_experts)) * scale,
+            "w1": jax.random.normal(k1, (self.num_experts, self.dim,
+                                         self.hidden)) * scale,
+            "b1": jnp.zeros((self.num_experts, self.hidden)),
+            "w2": jax.random.normal(k2, (self.num_experts, self.hidden,
+                                         self.dim)) * (self.hidden ** -0.5),
+            "b2": jnp.zeros((self.num_experts, self.dim)),
+        }
+
+    def __call__(self, params, x, mesh=None, axis=AXIS_EP):
+        return moe_apply(x, params["gate_w"], params["w1"], params["b1"],
+                         params["w2"], params["b2"], mesh=mesh, axis=axis,
+                         capacity_factor=self.capacity_factor)

@@ -1,0 +1,89 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+The reference's "pipeline ancestor" is layer placement: ``ctx_group``
+attributes + ``group2ctx`` at bind time insert ``_CrossDeviceCopy`` nodes
+(/root/reference/src/executor/graph_executor.cc:309-395, example
+/root/reference/example/model-parallel-lstm/lstm.py:65-116) — layers live
+on different devices but run sequentially.  The TPU-native design adds the
+missing microbatching: stage s's parameters live on mesh slice s, a shift
+register of activations advances one ``ppermute`` hop per tick, and after
+the n_micro + n_stages - 1 tick ramp all stages compute concurrently.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from ._shard_map import shard_map
+
+from . import collectives
+from .mesh import AXIS_PP
+
+
+def _pipeline_local(stage_params, microbatches, stage_fn, axis):
+    """Inside shard_map.  stage_params: this stage's param pytree (leading
+    stage dim already sliced away by shard_map when specs shard dim 0).
+    microbatches: [n_micro, ...] — real data on stage 0 (same array is fed
+    on every stage; only stage 0 reads it).  Output collected on the last
+    stage and broadcast.
+    """
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    n_micro = microbatches.shape[0]
+
+    probe = jax.eval_shape(stage_fn, stage_params, microbatches[0])
+    state = jnp.zeros(probe.shape, probe.dtype)       # activation in flight
+    outputs = jnp.zeros((n_micro,) + probe.shape, probe.dtype)
+
+    def tick(i, carry):
+        state, outputs = carry
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(i, 0, n_micro - 1), 0, keepdims=False)
+        x = jnp.where(stage == 0, feed.astype(probe.dtype), state)
+        y = stage_fn(stage_params, x)
+        out_idx = i - (n_stages - 1)
+        is_tail = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(is_tail, y,
+                      lax.dynamic_index_in_dim(
+                          outputs, jnp.clip(out_idx, 0, n_micro - 1), 0,
+                          keepdims=False)),
+            jnp.clip(out_idx, 0, n_micro - 1), 0)
+        state = collectives.ring_permute(y, axis, 1)
+        return state, outputs
+
+    _, outputs = lax.fori_loop(0, n_micro + n_stages - 1, tick,
+                               (state, outputs))
+    # result lives on the last stage; broadcast so every stage returns it
+    return collectives.broadcast_from(outputs, axis, root=n_stages - 1)
+
+
+def pipeline_apply(stage_params, microbatches, stage_fn, mesh=None,
+                   axis=AXIS_PP):
+    """Run ``stage_fn`` as an n-stage pipeline.
+
+    ``stage_params``: pytree whose leaves have a leading stage dim of size
+    n_stages (sharded over ``axis``).  ``microbatches``: [n_micro, mb, ...]
+    replicated input.  Every stage must map activations to the same
+    shape/dtype (classic GPipe restriction; heterogeneous stages wrap
+    `stage_fn` with padding).  Differentiable — ppermute/where have exact
+    transposes, so `jax.grad` yields 1F1B-equivalent schedules from XLA.
+    """
+    if mesh is None:
+        return _pipeline_local(stage_params, microbatches, stage_fn, axis)
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params)
+    fn = functools.partial(_strip_stage_dim, stage_fn=stage_fn, axis=axis)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, P()), out_specs=P(),
+        check_rep=False)(stage_params, microbatches)
+
+
+def _strip_stage_dim(stage_params, microbatches, stage_fn, axis):
+    local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    return _pipeline_local(local, microbatches, stage_fn, axis)

@@ -1,0 +1,107 @@
+"""Parameter partition rules → `NamedSharding`.
+
+The reference assigns whole arrays to devices (`Context` on every NDArray;
+`nnvm::pass::PlaceDevice` for model parallelism,
+/root/reference/src/executor/graph_executor.cc:309-395).  TPU-native
+placement is finer: each array gets a `PartitionSpec` over mesh axes and
+XLA materialises the layout.  Rules are regex patterns over parameter
+names — the same name-driven dispatch the reference's initializer registry
+uses (/root/reference/python/mxnet/initializer.py:53-160) — so model code
+stays sharding-agnostic.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DP, AXIS_TP
+
+
+class PartitionRule:
+    """(name_regex, ndim or None, PartitionSpec)."""
+
+    def __init__(self, pattern, spec, ndim=None):
+        self.pattern = re.compile(pattern)
+        self.spec = spec if isinstance(spec, P) else P(*spec)
+        self.ndim = ndim
+
+    def matches(self, name, val):
+        if self.ndim is not None and getattr(val, "ndim", None) != self.ndim:
+            return False
+        return self.pattern.search(name) is not None
+
+
+def make_sharding_rules(*rules):
+    return [r if isinstance(r, PartitionRule) else PartitionRule(*r)
+            for r in rules]
+
+
+#: default tensor-parallel rules for the framework's layer naming
+#: (gluon Dense kernels are (units, in_units); conv kernels (O, I, kh, kw)).
+#: Megatron-style: alternate column/row splits would need per-layer pairing,
+#: so the generic default shards every big matmul's output dim and
+#: all-reduces activations — correct for any graph.
+DEFAULT_TP_RULES = make_sharding_rules(
+    (r"(dense|fc|proj|embedding).*weight$", P(AXIS_TP, None), 2),
+    (r"conv.*weight$", P(AXIS_TP, None, None, None), 4),
+    (r"(dense|fc|proj).*bias$", P(AXIS_TP), 1),
+)
+
+
+def spec_for(name, val, rules):
+    for r in rules:
+        if r.matches(name, val):
+            return r.spec
+    return P()  # replicated
+
+
+def named_sharding(mesh, spec):
+    return NamedSharding(mesh, spec if isinstance(spec, P) else P(*spec))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def logical_to_mesh(mesh, tree_of_specs):
+    """Map a pytree of PartitionSpec to NamedSharding on ``mesh``."""
+    return jax.tree_util.tree_map(
+        lambda s: named_sharding(mesh, s), tree_of_specs,
+        is_leaf=lambda s: isinstance(s, P))
+
+
+def shard_params(params, mesh, rules=None, donate=False):
+    """Place a {name: array} pytree onto the mesh per the rules.
+
+    Arrays whose sharded dim is not divisible by the axis size fall back
+    to replication (the reference similarly falls back to copying small
+    arrays whole, kvstore_dist.h big-array bound).
+    """
+    rules = rules or []
+    out = {}
+    for name, val in params.items():
+        spec = spec_for(name, val, rules)
+        spec = _validate_spec(spec, getattr(val, "shape", ()), mesh)
+        out[name] = jax.device_put(val, named_sharding(mesh, spec))
+    return out
+
+
+def _validate_spec(spec, shape, mesh):
+    fixed = []
+    for d, axis in enumerate(tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axis is None:
+            fixed.append(None)
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        fixed.append(axis if shape[d] % size == 0 else None)
+    return P(*fixed)
+
+
+def batch_spec(ndim, axis=AXIS_DP):
+    """PartitionSpec sharding dim 0 (the batch) over ``axis``."""
+    return P(axis, *([None] * (ndim - 1)))

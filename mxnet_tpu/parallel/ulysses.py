@@ -1,0 +1,48 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-shard.
+
+Alternative to ring attention for long context: instead of rotating K/V
+blocks, one ``all_to_all`` turns sequence sharding into head sharding, each
+device runs *full-sequence* attention for its head subset, and a second
+``all_to_all`` restores sequence sharding.  Two collectives total (vs. sp-1
+ppermute hops), at the cost of requiring heads % sp == 0.  Rides ICI as a
+single fused all-to-all.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from ._shard_map import shard_map
+
+from . import collectives
+from .mesh import AXIS_SP
+from .ring_attention import attention_reference
+
+
+def _ulysses_local(q, k, v, axis, causal, scale):
+    """Inside shard_map: [B, H, T_local, D] → [B, H, T_local, D]."""
+    # seq-sharded → head-sharded: split heads (dim 1), gather seq (dim 2)
+    qh = collectives.alltoall(q, axis, split_axis=1, concat_axis=2)
+    kh = collectives.alltoall(k, axis, split_axis=1, concat_axis=2)
+    vh = collectives.alltoall(v, axis, split_axis=1, concat_axis=2)
+    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    # head-sharded → seq-sharded
+    return collectives.alltoall(out, axis, split_axis=2, concat_axis=1)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis=AXIS_SP, causal=False,
+                      scale=None):
+    """[B,H,T,D] attention with T sharded over ``axis``; needs H % sp == 0."""
+    if mesh is None:
+        return _ulysses_local(q, k, v, axis, causal, scale)
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError("Ulysses needs heads (%d) divisible by sp=%d"
+                         % (q.shape[1], n))
+    spec = P(None, None, axis, None)
+    fn = functools.partial(_ulysses_local, axis=axis, causal=causal,
+                           scale=scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)

@@ -278,3 +278,26 @@ def test_block_save_load_params(tmp_path):
     net2.load_params(fname)
     np.testing.assert_array_equal(net(nd.ones((1, 3))).asnumpy(),
                                   net2(nd.ones((1, 3))).asnumpy())
+
+
+def test_ctc_loss_lengths():
+    # pred_lengths truncates trailing frames; label_lengths bounds labels
+    T, N, C = 6, 2, 3
+    logits = np.full((N, T, C), -10.0, np.float32)
+    # sample 0: frames 0..3 spell [0, 1]; frames 4-5 are garbage (all C-1
+    # low) that must be ignored via pred_lengths=4
+    logits[0, 0, 0] = 10; logits[0, 1, 0] = 10
+    logits[0, 2, 1] = 10; logits[0, 3, 1] = 10
+    logits[0, 4, 0] = 10; logits[0, 5, 0] = 10   # would corrupt if counted
+    logits[1, :, 2] = 10  # sample 1: all blanks, empty label
+    labels = np.array([[0, 1, 7], [0, 0, 0]], np.float32)  # padded junk
+    loss = gluon.loss.CTCLoss(layout="NTC")(
+        nd.array(logits), nd.array(labels),
+        pred_lengths=nd.array([4, 6]), label_lengths=nd.array([2, 0]))
+    out = loss.asnumpy()
+    assert out[0] < 0.1, out
+    assert out[1] < 0.1, out
+    # without pred_lengths the garbage frames make the loss large
+    loss_full = gluon.loss.CTCLoss(layout="NTC")(
+        nd.array(logits), nd.array(labels), label_lengths=nd.array([2, 0]))
+    assert loss_full.asnumpy()[0] > 5

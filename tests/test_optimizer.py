@@ -116,3 +116,21 @@ def test_updater_states_roundtrip():
     updater(0, g, w)
     updater2(0, g, w2)
     np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_updater_states_with_optimizer_dump():
+    # dump_optimizer=True roundtrip (the Trainer.save_states dist path)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.ones(4, np.float32))
+    updater(0, g, w)
+    blob = updater.get_states(dump_optimizer=True)
+    updater2 = mx.optimizer.get_updater(
+        mx.optimizer.create("sgd", learning_rate=0.5))  # wrong hyperparams
+    updater2.set_states(blob)
+    assert updater2.optimizer.lr == 0.1  # optimizer restored from blob
+    w2 = nd.array(w.asnumpy())
+    updater(0, g, w)
+    updater2(0, g, w2)
+    np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)
