@@ -1,0 +1,86 @@
+"""Headline benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Baseline (BASELINE.md): MXNet v0.11 ResNet-50 ImageNet at batch 32 on one
+K80 = 109 img/s (/root/reference/example/image-classification/README.md:147-157).
+Here: the same model family (gluon model_zoo ResNet-50 v1) compiled to one
+XLA program — forward, softmax-CE loss, backward, SGD+momentum update —
+per step, images 224x224x3.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 109.0  # 1x K80, bs 32, reference README
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and "BENCH_BATCH" not in os.environ:
+        batch, steps = 16, 4  # keep the CPU smoke test fast
+
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.block import functionalize
+
+    net = vision.resnet50_v1()
+    net.initialize()
+    x0 = jnp.zeros((batch, 3, image, image), jnp.float32)
+    fn, params = functionalize(net, x0, train=True)
+    n_aux = fn.num_aux
+    n_diff = len(params) - n_aux
+    diff_params = params[:n_diff]
+    aux_params = params[n_diff:]
+    mom = [jnp.zeros_like(p) for p in diff_params]
+
+    def loss_fn(diff, aux, x, y, rng):
+        (logits,), new_aux = fn(list(diff) + list(aux), x, rng=rng)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+        return loss, new_aux
+
+    @jax.jit
+    def train_step(diff, aux, mom, x, y, rng):
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(diff, aux, x, y, rng)
+        new_mom = [0.9 * m - 0.05 * g for m, g in zip(mom, grads)]
+        new_diff = [p + m for p, m in zip(diff, new_mom)]
+        return new_diff, list(new_aux), new_mom, loss
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (batch, 3, image, image), jnp.float32)
+    y = jax.random.randint(key, (batch,), 0, 1000)
+
+    for i in range(warmup):
+        diff_params, aux_params, mom, loss = train_step(
+            diff_params, aux_params, mom, x, y, jax.random.fold_in(key, i))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        diff_params, aux_params, mom, loss = train_step(
+            diff_params, aux_params, mom, x, y, jax.random.fold_in(key, i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s (bs %d, %dx%d, 1 %s device)" % (
+            batch, image, image, platform),
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -469,3 +469,40 @@ class SymbolBlock(HybridBlock):
                                 grad_req="null")
         outs = exe.forward(is_train=autograd.is_training())
         return outs[0] if len(outs) == 1 else outs
+
+
+def functionalize(net, *example_args, train=False):
+    """Extract a pure, jittable function from a HybridBlock.
+
+    The TPU-native analogue of exporting a CachedOp
+    (/root/reference/src/c_api/c_api_ndarray.cc:616): returns
+    ``(apply_fn, params)`` where ``apply_fn(params, *inputs, rng=None)``
+    is a pure JAX function (safe under jit/grad/pjit) and ``params`` is the
+    list of current parameter values (jax arrays) in the order apply_fn
+    expects.  Differentiable parameters come first, then auxiliary states
+    (BatchNorm moving stats); ``apply_fn`` returns (outputs_tuple,
+    new_aux_tuple) so training loops can carry the aux updates.
+    """
+    nd_args = tuple(a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+                    for a in example_args)
+    try:
+        for p in net.collect_params().values():
+            p._check_initialized()
+    except DeferredInitializationError:
+        net._finish_deferred_recursive(*nd_args)
+    op = net._build_cached_op(nd_args)
+    plist = net._cached_param_list
+    n_aux = sum(1 for p in plist if p.grad_req == "null")
+    n_out = op.num_outputs({})
+
+    def apply_fn(params, *inputs, rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        flat = op.fn(*inputs, *params, rng, _train=train)
+        outs = flat[:n_out]
+        new_aux = flat[n_out:]
+        return outs, new_aux
+
+    params = [p.data()._data for p in plist]
+    apply_fn.param_names = [p.name for p in plist]
+    apply_fn.num_aux = n_aux
+    return apply_fn, params

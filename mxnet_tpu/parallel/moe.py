@@ -81,20 +81,21 @@ def _moe_local(x, gate_w, w1, b1, w2, b2, axis, capacity_factor, act):
 
 
 def moe_apply(x, gate_w, w1, b1, w2, b2, mesh=None, axis=AXIS_EP,
-              capacity_factor=2.0, act=jax.nn.relu):
+              capacity_factor=2.0, act=jax.nn.relu, batch_axis=None):
     """MoE FFN. Global shapes: x [T, D]; gate_w [D, E]; w1 [E, D, F];
-    b1 [E, F]; w2 [E, F, D]; b2 [E, D].  Tokens sharded over ``axis``,
-    experts sharded over ``axis``."""
+    b1 [E, F]; w2 [E, F, D]; b2 [E, D].  Tokens sharded over ``axis``
+    (and ``batch_axis`` when composing with dp), experts over ``axis``."""
     if mesh is None:
         return _moe_local(x, gate_w, w1, b1, w2, b2, axis, capacity_factor,
                           act)
     fn = functools.partial(_moe_local, axis=axis,
                            capacity_factor=capacity_factor, act=act)
+    tok = (batch_axis, axis) if batch_axis else axis
     return shard_map(
         fn, mesh=mesh,
-        in_specs=(P(axis, None), P(None, None), P(axis, None, None),
+        in_specs=(P(tok, None), P(None, None), P(axis, None, None),
                   P(axis, None), P(axis, None, None), P(axis, None)),
-        out_specs=P(axis, None), check_rep=False)(
+        out_specs=P(tok, None), check_rep=False)(
             x, gate_w, w1, b1, w2, b2)
 
 

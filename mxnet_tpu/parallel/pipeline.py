@@ -63,7 +63,7 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis):
 
 
 def pipeline_apply(stage_params, microbatches, stage_fn, mesh=None,
-                   axis=AXIS_PP):
+                   axis=AXIS_PP, batch_axis=None):
     """Run ``stage_fn`` as an n-stage pipeline.
 
     ``stage_params``: pytree whose leaves have a leading stage dim of size
@@ -77,10 +77,11 @@ def pipeline_apply(stage_params, microbatches, stage_fn, mesh=None,
         return _pipeline_local(stage_params, microbatches, stage_fn, axis)
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params)
+    data_spec = (P(None, batch_axis) if batch_axis else P())
     fn = functools.partial(_strip_stage_dim, stage_fn=stage_fn, axis=axis)
     return shard_map(
         fn, mesh=mesh,
-        in_specs=(param_specs, P()), out_specs=P(),
+        in_specs=(param_specs, data_spec), out_specs=data_spec,
         check_rep=False)(stage_params, microbatches)
 
 

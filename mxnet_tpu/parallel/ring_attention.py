@@ -89,16 +89,18 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
 
 
 def ring_attention(q, k, v, mesh=None, axis=AXIS_SP, causal=False,
-                   scale=None):
+                   scale=None, batch_axis=None):
     """Sequence-parallel attention.
 
     With ``mesh`` given, q/k/v are global [B,H,T,D] arrays and the call is
     wrapped in shard_map with T sharded over ``axis``.  With ``mesh=None``
     the caller is already inside shard_map/pjit and q/k/v are local blocks.
+    ``batch_axis`` names an additional mesh axis sharding dim 0 (compose
+    with dp in one program).
     """
     if mesh is None:
         return _ring_attention_local(q, k, v, axis, causal, scale)
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
     fn = functools.partial(_ring_attention_local, axis=axis, causal=causal,
                            scale=scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

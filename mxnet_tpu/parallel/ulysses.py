@@ -33,7 +33,7 @@ def _ulysses_local(q, k, v, axis, causal, scale):
 
 
 def ulysses_attention(q, k, v, mesh=None, axis=AXIS_SP, causal=False,
-                      scale=None):
+                      scale=None, batch_axis=None):
     """[B,H,T,D] attention with T sharded over ``axis``; needs H % sp == 0."""
     if mesh is None:
         return _ulysses_local(q, k, v, axis, causal, scale)
@@ -41,7 +41,7 @@ def ulysses_attention(q, k, v, mesh=None, axis=AXIS_SP, causal=False,
     if q.shape[1] % n:
         raise ValueError("Ulysses needs heads (%d) divisible by sp=%d"
                          % (q.shape[1], n))
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
     fn = functools.partial(_ulysses_local, axis=axis, causal=causal,
                            scale=scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
