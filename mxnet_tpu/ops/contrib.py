@@ -1,0 +1,425 @@
+"""Contrib operators: SSD multibox trio, FFT, quantization, count_sketch.
+
+TPU-native lowerings of /root/reference/src/operator/contrib/*.  The
+reference implements these as hand-rolled CPU/CUDA kernels with dynamic
+counts (std::vector matching loops, valid_count compaction); here every op
+is a static-shape jnp/lax program — matching via masked argmax iterations,
+compaction via stable argsort on validity, NMS as a fori_loop over a keep
+mask — so the whole SSD head jits onto TPU.
+
+Ops registered under both their ``_contrib_*`` and plain names, matching
+the reference's dual registration.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, alias
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior (reference src/operator/contrib/multibox_prior.cc:40-71)
+# ---------------------------------------------------------------------------
+
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    sizes = [float(s) for s in sizes]
+    ratios = [float(r) for r in ratios]
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    # anchors per location: all sizes at ratio 1, then ratios[1:] at sizes[0]
+    half_wh = []
+    for s in sizes:
+        half_wh.append((s / 2.0, s / 2.0))
+    for r in ratios[1:]:
+        sq = math.sqrt(r)
+        half_wh.append((sizes[0] * sq / 2.0, sizes[0] / sq / 2.0))
+    hw = jnp.asarray(half_wh, jnp.float32)              # [K, 2] (w, h)
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # [H, W, 2]
+    cxy = cyx[..., ::-1]                                 # (cx, cy)
+    mins = cxy[:, :, None, :] - hw[None, None, :, :]     # [H, W, K, 2]
+    maxs = cxy[:, :, None, :] + hw[None, None, :, :]
+    out = jnp.concatenate([mins, maxs], -1).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(data.dtype)
+
+
+register_op("_contrib_MultiBoxPrior",
+            arg_names=("data",),
+            param_defaults=dict(sizes=(1.0,), ratios=(1.0,), clip=False,
+                                steps=(-1.0, -1.0), offsets=(0.5, 0.5)))(_multibox_prior)
+alias("_contrib_MultiBoxPrior", "MultiBoxPrior")
+
+
+# ---------------------------------------------------------------------------
+# IoU helpers
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(a, b):
+    """a [A,4], b [L,4] corner boxes → IoU [A,L]."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union <= 0, 0.0, inter / jnp.maximum(union, 1e-12))
+
+
+def _encode_loc(anchor, gt, variances):
+    """Center-parameterised regression target (multibox_target.cc:36-54)."""
+    aw = anchor[2] - anchor[0]
+    ah = anchor[3] - anchor[1]
+    ax = (anchor[0] + anchor[2]) * 0.5
+    ay = (anchor[1] + anchor[3]) * 0.5
+    gw = gt[2] - gt[0]
+    gh = gt[3] - gt[1]
+    gx = (gt[0] + gt[2]) * 0.5
+    gy = (gt[1] + gt[3]) * 0.5
+    vx, vy, vw, vh = variances
+    return jnp.stack([
+        (gx - ax) / aw / vx, (gy - ay) / ah / vy,
+        jnp.log(jnp.maximum(gw / aw, 1e-12)) / vw,
+        jnp.log(jnp.maximum(gh / ah, 1e-12)) / vh])
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (reference multibox_target.cc:70-280)
+# ---------------------------------------------------------------------------
+
+def _multibox_target_one(anchors, labels, cls_preds, overlap_threshold,
+                         ignore_label, negative_mining_ratio,
+                         negative_mining_thresh, variances):
+    """Single-sample matching. anchors [A,4]; labels [L,W]; cls_preds [C,A]."""
+    num_anchors = anchors.shape[0]
+    num_labels = labels.shape[0]
+
+    # valid gt prefix: stops at the first class == -1 row (reference :94-103)
+    valid_gt = jnp.cumprod(labels[:, 0] != -1).astype(bool)
+    num_valid = valid_gt.sum()
+    gt_boxes = labels[:, 1:5]
+    iou = _iou_matrix(anchors, gt_boxes)                 # [A, L]
+    iou_valid = jnp.where(valid_gt[None, :], iou, -1.0)
+
+    # stage 1: greedy bipartite matching — each iteration matches the
+    # globally best (anchor, gt) pair, stops when best IoU <= 1e-6
+    def bip_step(_, state):
+        anchor_gt, anchor_flag, gt_used = state
+        masked = jnp.where(anchor_flag[:, None] == 1, _NEG, iou_valid)
+        masked = jnp.where(gt_used[None, :], _NEG, masked)
+        flat = masked.reshape(-1)
+        best = jnp.argmax(flat)
+        best_iou = flat[best]
+        ba = (best // num_labels).astype(jnp.int32)
+        bg = (best % num_labels).astype(jnp.int32)
+        ok = best_iou > 1e-6
+        anchor_gt = anchor_gt.at[ba].set(jnp.where(ok, bg, anchor_gt[ba]))
+        anchor_flag = anchor_flag.at[ba].set(
+            jnp.where(ok, 1, anchor_flag[ba]))
+        gt_used = gt_used.at[bg].set(jnp.where(ok, True, gt_used[bg]))
+        return anchor_gt, anchor_flag, gt_used
+
+    anchor_gt = jnp.full((num_anchors,), -1, jnp.int32)
+    anchor_flag = jnp.full((num_anchors,), -1, jnp.int32)  # -1 ignore, 0 neg, 1 pos
+    gt_used = jnp.zeros((num_labels,), bool)
+    anchor_gt, anchor_flag, gt_used = lax.fori_loop(
+        0, num_labels, bip_step, (anchor_gt, anchor_flag, gt_used))
+
+    # stage 2: threshold matching for remaining anchors (:150-178)
+    best_gt = jnp.argmax(iou_valid, axis=1).astype(jnp.int32)
+    best_iou = jnp.max(iou_valid, axis=1)
+    has_gt = num_valid > 0
+    thr_pos = (anchor_flag != 1) & (best_iou > overlap_threshold) & has_gt \
+        if overlap_threshold > 0 else jnp.zeros((num_anchors,), bool)
+    anchor_gt = jnp.where(thr_pos, best_gt, anchor_gt)
+    anchor_flag = jnp.where(thr_pos, 1, anchor_flag)
+    n_pos = (anchor_flag == 1).sum()
+
+    if negative_mining_ratio > 0:
+        # hard negative mining (:181-240): among still-unmatched anchors
+        # with max IoU < mining_thresh, pick the ones with the LOWEST
+        # background probability (hardest), n_neg = ratio * n_pos
+        cls_t = cls_preds.T                              # [A, C]
+        bg_prob = jax.nn.softmax(cls_t, axis=-1)[:, 0]
+        eligible = (anchor_flag == -1) & (best_iou < negative_mining_thresh)
+        n_neg = jnp.minimum(
+            (n_pos * negative_mining_ratio).astype(jnp.int32),
+            num_anchors - n_pos)
+        score = jnp.where(eligible, -bg_prob, _NEG)      # harder = higher
+        order = jnp.argsort(-score)                      # descending
+        rank = jnp.zeros((num_anchors,), jnp.int32).at[order].set(
+            jnp.arange(num_anchors, dtype=jnp.int32))
+        make_neg = eligible & (rank < n_neg)
+        anchor_flag = jnp.where(make_neg, 0, anchor_flag)
+    else:
+        anchor_flag = jnp.where(anchor_flag != 1, 0, anchor_flag)
+    anchor_flag = jnp.where(has_gt, anchor_flag, -1)
+
+    # targets (:249-278)
+    matched_gt = jnp.clip(anchor_gt, 0, num_labels - 1)
+    cls_target = jnp.where(
+        anchor_flag == 1, labels[matched_gt, 0] + 1.0,
+        jnp.where(anchor_flag == 0, 0.0, float(ignore_label)))
+    loc = jax.vmap(_encode_loc, in_axes=(0, 0, None))(
+        anchors, gt_boxes[matched_gt], tuple(variances))
+    loc_mask = (anchor_flag == 1).astype(anchors.dtype)
+    loc_target = jnp.where(loc_mask[:, None].astype(bool), loc, 0.0)
+    loc_mask4 = jnp.repeat(loc_mask[:, None], 4, axis=1)
+    return (loc_target.reshape(-1), loc_mask4.reshape(-1), cls_target)
+
+
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """anchor (1,A,4); label (B,L,W>=5); cls_pred (B,C,A) →
+    loc_target (B,4A), loc_mask (B,4A), cls_target (B,A)."""
+    anchors = anchor.reshape(-1, 4)
+    f = jax.vmap(lambda lb, cp: _multibox_target_one(
+        anchors, lb, cp, overlap_threshold, ignore_label,
+        negative_mining_ratio, negative_mining_thresh, variances))
+    loc_t, loc_m, cls_t = f(label, cls_pred)
+    return loc_t.astype(anchor.dtype), loc_m.astype(anchor.dtype), \
+        cls_t.astype(anchor.dtype)
+
+
+register_op("_contrib_MultiBoxTarget",
+            arg_names=("anchor", "label", "cls_pred"), num_outputs=3,
+            param_defaults=dict(overlap_threshold=0.5, ignore_label=-1.0,
+                                negative_mining_ratio=-1.0,
+                                negative_mining_thresh=0.5,
+                                minimum_negative_samples=0,
+                                variances=(0.1, 0.1, 0.2, 0.2)),
+            backward_ignore=("anchor", "label", "cls_pred"))(_multibox_target)
+alias("_contrib_MultiBoxTarget", "MultiBoxTarget")
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (reference multibox_detection.cc:44-180)
+# ---------------------------------------------------------------------------
+
+def _decode_loc(anchors, loc_pred, variances, clip):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    vx, vy, vw, vh = variances
+    ox = loc_pred[:, 0] * vx * aw + ax
+    oy = loc_pred[:, 1] * vy * ah + ay
+    ow = jnp.exp(loc_pred[:, 2] * vw) * aw * 0.5
+    oh = jnp.exp(loc_pred[:, 3] * vh) * ah * 0.5
+    box = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], -1)
+    if clip:
+        box = jnp.clip(box, 0.0, 1.0)
+    return box
+
+
+def _multibox_detection_one(cls_prob, loc_pred, anchors, threshold, clip,
+                            variances, nms_threshold, force_suppress,
+                            nms_topk):
+    """cls_prob [C,A]; loc_pred [A*4]; anchors [A,4] → [A,6]."""
+    num_classes, num_anchors = cls_prob.shape
+    scores = cls_prob[1:, :]                             # skip background
+    best = jnp.argmax(scores, axis=0)
+    score = scores[best, jnp.arange(num_anchors)]
+    cid = jnp.where(score >= threshold, best.astype(jnp.float32), -1.0)
+    boxes = _decode_loc(anchors, loc_pred.reshape(-1, 4), variances, clip)
+
+    valid = cid >= 0
+    # compact valid rows to the front preserving anchor order (stable)
+    order = jnp.argsort(~valid, stable=True)
+    cid_c, score_c, boxes_c = cid[order], score[order], boxes[order]
+    valid_c = valid[order]
+
+    # sort by confidence desc among valid (reference sorts all valid;
+    # nms_topk>0 keeps only the top-k in sorted positions)
+    conf_order = jnp.argsort(jnp.where(valid_c, -score_c, jnp.inf),
+                             stable=True)
+    nkeep = num_anchors if nms_topk <= 0 else min(nms_topk, num_anchors)
+    rank = jnp.arange(num_anchors)
+    take = jnp.where(rank < nkeep, conf_order[jnp.minimum(rank, num_anchors - 1)],
+                     rank)
+    cid_s, score_s, boxes_s = cid_c[take], score_c[take], boxes_c[take]
+    valid_s = valid_c[take]
+
+    if 0 < nms_threshold <= 1:
+        def body(i, keep):
+            active = keep[i] & valid_s[i]
+            iou = _iou_matrix(boxes_s[i][None], boxes_s)[0]
+            same = force_suppress | (cid_s == cid_s[i])
+            sup = active & (iou > nms_threshold) & same & \
+                (jnp.arange(num_anchors) > i) & valid_s
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, num_anchors, body,
+                             jnp.ones((num_anchors,), bool))
+    else:
+        keep = jnp.ones((num_anchors,), bool)
+
+    cid_f = jnp.where(keep & valid_s, cid_s, -1.0)
+    out = jnp.concatenate(
+        [cid_f[:, None],
+         jnp.where(valid_s, score_s, -1.0)[:, None],
+         jnp.where(valid_s[:, None], boxes_s, -1.0)], -1)
+    return out
+
+
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """cls_prob (B,C,A); loc_pred (B,A*4); anchor (1,A,4) → (B,A,6)
+    rows are [class_id, score, xmin, ymin, xmax, ymax], -1 = invalid."""
+    anchors = anchor.reshape(-1, 4)
+    f = jax.vmap(lambda cp, lp: _multibox_detection_one(
+        cp, lp, anchors, threshold, clip, tuple(variances), nms_threshold,
+        force_suppress, int(nms_topk)))
+    return f(cls_prob, loc_pred).astype(cls_prob.dtype)
+
+
+register_op("_contrib_MultiBoxDetection",
+            arg_names=("cls_prob", "loc_pred", "anchor"),
+            param_defaults=dict(clip=True, threshold=0.01, background_id=0,
+                                nms_threshold=0.5, force_suppress=False,
+                                variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1),
+            backward_ignore=("cls_prob", "loc_pred", "anchor"))(_multibox_detection)
+alias("_contrib_MultiBoxDetection", "MultiBoxDetection")
+
+
+# ---------------------------------------------------------------------------
+# smooth_l1 (reference src/operator/mshadow_op.h smooth_l1_loss; used by SSD)
+# ---------------------------------------------------------------------------
+
+def _smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data,
+                     absd - 0.5 / s2)
+
+
+register_op("smooth_l1",
+ arg_names=("data",),
+            param_defaults=dict(scalar=1.0))(_smooth_l1)
+
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT (reference contrib/fft-inl.h: real input → interleaved
+# re/im output of length 2*n on the last dim; ifft inverse, scaled by 1/n)
+# ---------------------------------------------------------------------------
+
+def _fft(data, compute_size=128):
+    c = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([c.real, c.imag], axis=-1)
+    return out.reshape(*data.shape[:-1], data.shape[-1] * 2) \
+        .astype(data.dtype)
+
+
+def _ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    pairs = data.reshape(*data.shape[:-1], n, 2).astype(jnp.float32)
+    c = lax.complex(pairs[..., 0], pairs[..., 1])
+    # reference ifft does NOT normalise (cuFFT inverse is unscaled)
+    out = jnp.fft.ifft(c, axis=-1).real * n
+    return out.astype(data.dtype)
+
+
+register_op("_contrib_fft",
+ arg_names=("data",),
+            param_defaults=dict(compute_size=128))(_fft)
+alias("_contrib_fft", "fft")
+register_op("_contrib_ifft",
+ arg_names=("data",),
+            param_defaults=dict(compute_size=128))(_ifft)
+alias("_contrib_ifft", "ifft")
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize (reference contrib/quantize-inl.h: affine uint8)
+# ---------------------------------------------------------------------------
+
+def _quantize(data, min_range, max_range, out_type="uint8"):
+    if out_type != "uint8":
+        raise ValueError("only uint8 supported (reference quantize-inl.h)")
+    qmin, qmax = 0.0, 255.0
+    scale = (qmax - qmin) / (max_range - min_range)
+    q = jnp.round((data - min_range) * scale + qmin)
+    return (jnp.clip(q, qmin, qmax).astype(jnp.uint8), min_range, max_range)
+
+
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    scale = (max_range - min_range) / 255.0
+    return data.astype(jnp.float32) * scale + min_range
+
+
+register_op("_contrib_quantize",
+            arg_names=("data", "min_range", "max_range"), num_outputs=3,
+            param_defaults=dict(out_type="uint8"),
+            backward_ignore=("data", "min_range", "max_range"))(_quantize)
+alias("_contrib_quantize", "quantize")
+register_op("_contrib_dequantize",
+            arg_names=("data", "min_range", "max_range"),
+            param_defaults=dict(out_type="float32"),
+            backward_ignore=("data", "min_range", "max_range"))(_dequantize)
+alias("_contrib_dequantize", "dequantize")
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (reference contrib/count_sketch-inl.h: random feature
+# hashing h: [in_dim]→[out_dim] indices, s: ±1 signs)
+# ---------------------------------------------------------------------------
+
+def _count_sketch(data, h, s, out_dim, processing_batch_size=32):
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    signed = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], int(out_dim)), data.dtype)
+    return out.at[:, idx].add(signed)
+
+
+register_op("_contrib_count_sketch",
+            arg_names=("data", "h", "s"),
+            param_defaults=dict(out_dim=0, processing_batch_size=32),
+            backward_ignore=("h", "s"))(_count_sketch)
+alias("_contrib_count_sketch", "count_sketch")
+
+
+# ---------------------------------------------------------------------------
+# ctc_loss op (reference contrib/ctc_loss-inl.h, warp-ctc semantics:
+# data (T,N,C) softmax applied internally, labels (N,L) 0-padded,
+# blank = 0)
+# ---------------------------------------------------------------------------
+
+def _ctc_loss(data, label, use_data_lengths=False, use_label_lengths=False,
+              blank_label="first"):
+    from ..gluon.loss import _ctc_loss_jax
+    logits = jnp.swapaxes(data, 0, 1)        # (T,N,C) → (N,T,C)
+    lbl = label.astype(jnp.int32)
+    if blank_label == "first":
+        # reference contrib op: blank=0, labels are 1-based with 0 padding;
+        # shift to the blank-last convention of the shared kernel
+        C = data.shape[-1]
+        lbl = jnp.where(lbl > 0, lbl - 1, -1)
+        return _ctc_loss_jax(jnp.roll(logits, -1, axis=-1), lbl,
+                             blank_last=True)
+    lbl = jnp.where(lbl >= 0, lbl, -1)
+    return _ctc_loss_jax(logits, lbl, blank_last=True)
+
+
+register_op("_contrib_ctc_loss",
+ arg_names=("data", "label"),
+            param_defaults=dict(use_data_lengths=False,
+                                use_label_lengths=False,
+                                blank_label="first"),
+            backward_ignore=("label",))(_ctc_loss)
+alias("_contrib_ctc_loss", "ctc_loss")

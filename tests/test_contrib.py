@@ -1,0 +1,206 @@
+"""Contrib op tests: SSD multibox trio vs numpy oracles of the reference
+algorithms (multibox_{prior,target,detection}.cc), fft/quantize/count_sketch.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np_prior(h, w, sizes, ratios, offsets=(0.5, 0.5), steps=None):
+    """Literal transcription of multibox_prior.cc:40-71."""
+    step_y = steps[0] if steps else 1.0 / h
+    step_x = steps[1] if steps else 1.0 / w
+    out = []
+    for r in range(h):
+        cy = (r + offsets[0]) * step_y
+        for c in range(w):
+            cx = (c + offsets[1]) * step_x
+            for s in sizes:
+                out.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
+            for ratio in ratios[1:]:
+                sq = np.sqrt(ratio)
+                ww = sizes[0] * sq / 2
+                hh = sizes[0] / sq / 2
+                out.append([cx - ww, cy - hh, cx + ww, cy + hh])
+    return np.array(out, np.float32)
+
+
+def test_multibox_prior_matches_reference():
+    sizes, ratios = [0.4, 0.2], [1.0, 2.0, 0.5]
+    data = nd.zeros((1, 3, 4, 6))
+    out = nd.MultiBoxPrior(data, sizes=sizes, ratios=ratios).asnumpy()
+    ref = _np_prior(4, 6, sizes, ratios)
+    assert out.shape == (1, 4 * 6 * 4, 4)
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior_clip():
+    out = nd.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=[1.5],
+                           clip=True).asnumpy()
+    assert out.min() >= 0 and out.max() <= 1
+
+
+def _iou(a, b):
+    w = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    h = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    i = w * h
+    u = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - i
+    return 0.0 if u <= 0 else i / u
+
+
+def test_multibox_target_basic():
+    # anchors: one perfectly on gt0, one overlapping gt1 above threshold,
+    # one far away (negative)
+    anchors = np.array([[0.1, 0.1, 0.3, 0.3],
+                        [0.55, 0.55, 0.8, 0.8],
+                        [0.0, 0.8, 0.1, 0.9]], np.float32)[None]
+    labels = np.array([[[0, 0.1, 0.1, 0.3, 0.3],
+                        [1, 0.5, 0.5, 0.8, 0.8],
+                        [-1, -1, -1, -1, -1]]], np.float32)
+    cls_preds = np.zeros((1, 3, 3), np.float32)  # 3 classes (bg + 2)
+    loc_t, loc_m, cls_t = nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds),
+        overlap_threshold=0.5)
+    cls_t = cls_t.asnumpy()[0]
+    loc_m = loc_m.asnumpy()[0].reshape(3, 4)
+    loc_t = loc_t.asnumpy()[0].reshape(3, 4)
+    assert cls_t[0] == 1.0     # gt class 0 → target 1 (bg reserved)
+    assert cls_t[1] == 2.0
+    assert cls_t[2] == 0.0     # negative
+    assert loc_m[0].all() and loc_m[1].all() and not loc_m[2].any()
+    # anchor 0 matches exactly → zero offsets
+    np.testing.assert_allclose(loc_t[0], np.zeros(4), atol=1e-5)
+    # anchor 1 target encodes gt1 with variances (0.1,0.1,0.2,0.2)
+    a = anchors[0, 1]
+    g = labels[0, 1, 1:5]
+    aw, ah = a[2] - a[0], a[3] - a[1]
+    ax, ay = (a[0] + a[2]) / 2, (a[1] + a[3]) / 2
+    gw, gh = g[2] - g[0], g[3] - g[1]
+    gx, gy = (g[0] + g[2]) / 2, (g[1] + g[3]) / 2
+    expect = [(gx - ax) / aw / 0.1, (gy - ay) / ah / 0.1,
+              np.log(gw / aw) / 0.2, np.log(gh / ah) / 0.2]
+    np.testing.assert_allclose(loc_t[1], expect, rtol=1e-4)
+
+
+def test_multibox_target_no_gt():
+    anchors = np.random.uniform(0, 1, (1, 5, 4)).astype(np.float32)
+    labels = -np.ones((1, 2, 5), np.float32)
+    cls_preds = np.zeros((1, 4, 5), np.float32)
+    loc_t, loc_m, cls_t = nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds))
+    # reference leaves everything at init: cls_target = ignore_label
+    assert (cls_t.asnumpy() == -1).all()
+    assert (loc_m.asnumpy() == 0).all()
+
+
+def test_multibox_target_negative_mining():
+    rng = np.random.RandomState(0)
+    anchors = np.array([[0.1, 0.1, 0.3, 0.3]] +
+                       [[0.6 + 0.02 * i, 0.6, 0.9, 0.9] for i in range(6)],
+                       np.float32)[None]
+    labels = np.array([[[2, 0.1, 0.1, 0.3, 0.3],
+                        [-1, -1, -1, -1, -1]]], np.float32)
+    cls_preds = rng.randn(1, 4, 7).astype(np.float32)
+    _, _, cls_t = nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds),
+        overlap_threshold=0.5, negative_mining_ratio=2.0,
+        negative_mining_thresh=0.5)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 3.0                    # positive: class 2 + 1
+    assert (cls_t == 0).sum() == 2            # 1 pos * ratio 2 negatives
+    assert (cls_t == -1).sum() == 4           # rest ignored
+
+
+def test_multibox_detection_decode_and_nms():
+    # two anchors, same class, heavy overlap → NMS keeps higher score
+    anchors = np.array([[0.1, 0.1, 0.5, 0.5],
+                        [0.12, 0.12, 0.52, 0.52],
+                        [0.6, 0.6, 0.9, 0.9]], np.float32)[None]
+    cls_prob = np.array([[[0.1, 0.2, 0.05],    # background
+                          [0.8, 0.7, 0.01],    # class 0
+                          [0.1, 0.1, 0.94]]],  # class 1
+                        np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                               nd.array(anchors), nms_threshold=0.5,
+                               threshold=0.1).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    # detection 1 of class 0 suppressed; one class-0 + one class-1 survive
+    assert len(kept) == 2
+    byscore = kept[np.argsort(-kept[:, 1])]
+    assert byscore[0][0] == 1.0 and abs(byscore[0][1] - 0.94) < 1e-6
+    assert byscore[1][0] == 0.0 and abs(byscore[1][1] - 0.8) < 1e-6
+    # zero loc_pred → decoded box equals anchor
+    np.testing.assert_allclose(byscore[1][2:], anchors[0, 0], atol=1e-5)
+
+
+def test_multibox_detection_force_suppress_and_threshold():
+    anchors = np.array([[0.1, 0.1, 0.5, 0.5],
+                        [0.12, 0.12, 0.52, 0.52]], np.float32)[None]
+    cls_prob = np.array([[[0.1, 0.2],
+                          [0.8, 0.005],
+                          [0.1, 0.7]]], np.float32)
+    loc_pred = np.zeros((1, 8), np.float32)
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                               nd.array(anchors), nms_threshold=0.5,
+                               force_suppress=True, threshold=0.1
+                               ).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 1 and kept[0][0] == 0.0  # cross-class suppression
+
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    expect = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_fft_ifft_roundtrip():
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    f = nd.fft(nd.array(x))
+    assert f.shape == (2, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f.asnumpy()[:, 0::2], ref.real, atol=1e-4)
+    np.testing.assert_allclose(f.asnumpy()[:, 1::2], ref.imag, atol=1e-4)
+    # reference ifft is unnormalised: ifft(fft(x)) = n * x
+    back = nd.ifft(f).asnumpy()
+    np.testing.assert_allclose(back, x * 8, atol=1e-3)
+
+
+def test_quantize_dequantize():
+    x = np.array([[-1.0, 0.0, 0.5, 1.0]], np.float32)
+    q, mn, mx_ = nd.quantize(nd.array(x), nd.array([-1.0]), nd.array([1.0]))
+    assert q.dtype == np.uint8
+    back = nd.dequantize(q, mn, mx_).asnumpy()
+    np.testing.assert_allclose(back, x, atol=2.0 / 255)
+
+
+def test_count_sketch():
+    rng = np.random.RandomState(1)
+    in_dim, out_dim = 8, 4
+    x = rng.randn(3, in_dim).astype(np.float32)
+    h = rng.randint(0, out_dim, (1, in_dim)).astype(np.float32)
+    s = (rng.randint(0, 2, (1, in_dim)) * 2 - 1).astype(np.float32)
+    out = nd.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                          out_dim=out_dim).asnumpy()
+    expect = np.zeros((3, out_dim), np.float32)
+    for j in range(in_dim):
+        expect[:, int(h[0, j])] += s[0, j] * x[:, j]
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_contrib_ctc_loss():
+    # blank=first convention: perfect prediction of label [1, 2]
+    T, N, C = 4, 1, 3
+    logits = np.full((T, N, C), -10.0, np.float32)
+    logits[0, 0, 1] = 10
+    logits[1, 0, 1] = 10
+    logits[2, 0, 2] = 10
+    logits[3, 0, 2] = 10
+    label = np.array([[1, 2]], np.float32)
+    loss = nd.ctc_loss(nd.array(logits), nd.array(label)).asnumpy()
+    assert loss.shape == (1,)
+    assert loss[0] < 0.1
