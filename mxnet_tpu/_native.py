@@ -1,0 +1,99 @@
+"""ctypes bindings for the native runtime library (libmxtpu.so).
+
+The reference keeps its data pipeline in C++ behind a flat C ABI
+(/root/reference/src/io/, include/mxnet/c_api.h); this module is the
+TPU-native analogue: it loads ``native/libmxtpu.so`` (built from
+``src/mxtpu/``) and exposes the RecordIO + threaded image-pipeline entry
+points. If the library is missing it is built on demand with ``make``;
+if that fails, callers fall back to the pure-Python paths (recordio.py,
+image.py use PIL).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "native", "libmxtpu.so")
+_SRC_DIR = os.path.normpath(os.path.join(_HERE, "..", "src"))
+
+_lib = None
+_lib_lock = threading.Lock()
+_tried = False
+
+
+def _declare(lib):
+    c = ctypes
+    lib.MXTGetLastError.restype = c.c_char_p
+    lib.MXTRecordIOReaderCreate.restype = c.c_void_p
+    lib.MXTRecordIOReaderCreate.argtypes = [c.c_char_p]
+    lib.MXTRecordIOReaderNext.restype = c.c_int
+    lib.MXTRecordIOReaderNext.argtypes = [
+        c.c_void_p, c.POINTER(c.c_char_p), c.POINTER(c.c_uint64)]
+    lib.MXTRecordIOReaderSeek.argtypes = [c.c_void_p, c.c_uint64]
+    lib.MXTRecordIOReaderReset.argtypes = [c.c_void_p]
+    lib.MXTRecordIOReaderFree.argtypes = [c.c_void_p]
+    lib.MXTRecordIOWriterCreate.restype = c.c_void_p
+    lib.MXTRecordIOWriterCreate.argtypes = [c.c_char_p]
+    lib.MXTRecordIOWriterWrite.restype = c.c_int64
+    lib.MXTRecordIOWriterWrite.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.MXTRecordIOWriterFree.argtypes = [c.c_void_p]
+    lib.MXTImageIterCreate.restype = c.c_void_p
+    lib.MXTImageIterCreate.argtypes = [
+        c.c_char_p, c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_int, c.c_uint64, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_float, c.c_float, c.c_float, c.POINTER(c.c_float),
+        c.POINTER(c.c_float), c.c_int]
+    lib.MXTImageIterNext.restype = c.c_int
+    lib.MXTImageIterNext.argtypes = [
+        c.c_void_p, c.POINTER(c.c_float), c.POINTER(c.c_float)]
+    lib.MXTImageIterNumSamples.restype = c.c_int
+    lib.MXTImageIterNumSamples.argtypes = [c.c_void_p]
+    lib.MXTImageIterNumErrors.restype = c.c_uint64
+    lib.MXTImageIterNumErrors.argtypes = [c.c_void_p]
+    lib.MXTImageIterReset.restype = c.c_int
+    lib.MXTImageIterReset.argtypes = [c.c_void_p]
+    lib.MXTImageIterFree.argtypes = [c.c_void_p]
+    lib.MXTDecodeJPEG.restype = c.c_int
+    lib.MXTDecodeJPEG.argtypes = [
+        c.c_char_p, c.c_uint64, c.c_void_p,
+        c.POINTER(c.c_int), c.POINTER(c.c_int)]
+    lib.MXTResizeBilinear.restype = c.c_int
+    lib.MXTResizeBilinear.argtypes = [
+        c.c_void_p, c.c_int, c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int]
+    return lib
+
+
+def get_lib():
+    """Returns the loaded native library, building it if necessary, or
+    None when the native toolchain is unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and os.path.isdir(_SRC_DIR):
+            try:
+                subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                               capture_output=True, timeout=300)
+            except Exception:
+                return None
+        if os.path.exists(_LIB_PATH):
+            try:
+                _lib = _declare(ctypes.CDLL(_LIB_PATH))
+            except OSError:
+                _lib = None
+        return _lib
+
+
+def available():
+    return get_lib() is not None
+
+
+def last_error():
+    lib = get_lib()
+    return lib.MXTGetLastError().decode() if lib is not None else ""

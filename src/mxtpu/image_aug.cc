@@ -1,0 +1,189 @@
+#include "image_aug.h"
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <cmath>
+#include <csetjmp>
+#include <cstring>
+
+namespace mxtpu {
+
+namespace {
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  std::jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  std::longjmp(err->jb, 1);
+}
+}  // namespace
+
+bool DecodeJPEG(const uint8_t* buf, uint64_t len, Image* out) {
+  if (len < 3 || buf[0] != 0xFF || buf[1] != 0xD8) return false;
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  out->h = static_cast<int>(cinfo.output_height);
+  out->w = static_cast<int>(cinfo.output_width);
+  out->c = 3;
+  out->data.resize(static_cast<size_t>(out->h) * out->w * 3);
+  size_t stride = static_cast<size_t>(out->w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data.data() + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+void ResizeBilinear(const Image& src, int oh, int ow, Image* dst) {
+  dst->h = oh;
+  dst->w = ow;
+  dst->c = src.c;
+  dst->data.resize(static_cast<size_t>(oh) * ow * src.c);
+  const float sy = static_cast<float>(src.h) / oh;
+  const float sx = static_cast<float>(src.w) / ow;
+  const int c = src.c;
+  for (int y = 0; y < oh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = static_cast<int>(std::floor(fy));
+    float wy = fy - y0;
+    int y1 = std::min(y0 + 1, src.h - 1);
+    y0 = std::max(y0, 0);
+    for (int x = 0; x < ow; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = static_cast<int>(std::floor(fx));
+      float wx = fx - x0;
+      int x1 = std::min(x0 + 1, src.w - 1);
+      x0 = std::max(x0, 0);
+      const uint8_t* p00 = &src.data[(static_cast<size_t>(y0) * src.w + x0) * c];
+      const uint8_t* p01 = &src.data[(static_cast<size_t>(y0) * src.w + x1) * c];
+      const uint8_t* p10 = &src.data[(static_cast<size_t>(y1) * src.w + x0) * c];
+      const uint8_t* p11 = &src.data[(static_cast<size_t>(y1) * src.w + x1) * c];
+      uint8_t* q = &dst->data[(static_cast<size_t>(y) * ow + x) * c];
+      for (int k = 0; k < c; ++k) {
+        float v = (1 - wy) * ((1 - wx) * p00[k] + wx * p01[k]) +
+                  wy * ((1 - wx) * p10[k] + wx * p11[k]);
+        q[k] = static_cast<uint8_t>(std::lround(std::clamp(v, 0.f, 255.f)));
+      }
+    }
+  }
+}
+
+void AugmentToFloat(const Image& img_in, int out_c, int out_h, int out_w,
+                    const AugmentParams& p, std::mt19937* rng, float* out) {
+  Image resized;
+  const Image* img = &img_in;
+  // 1. resize shorter edge (or force-fit if the image is smaller than crop).
+  // Both edges are clamped to at least the crop size so step 2 never reads
+  // out of bounds even when resize_shorter < out_h/out_w.
+  int target_short = p.resize_shorter;
+  if (target_short == 0 && (img->h < out_h || img->w < out_w))
+    target_short = std::max(out_h, out_w);
+  if (target_short > 0) {
+    int nh, nw;
+    if (img->h < img->w) {
+      nh = target_short;
+      nw = static_cast<int>(
+          std::lround(static_cast<double>(img->w) * target_short / img->h));
+    } else {
+      nw = target_short;
+      nh = static_cast<int>(
+          std::lround(static_cast<double>(img->h) * target_short / img->w));
+    }
+    nh = std::max(nh, out_h);
+    nw = std::max(nw, out_w);
+    if (nh != img->h || nw != img->w) {
+      ResizeBilinear(*img, nh, nw, &resized);
+      img = &resized;
+    }
+  }
+  // 2. crop to (out_h, out_w)
+  int max_y = img->h - out_h, max_x = img->w - out_w;
+  int y0, x0;
+  if (p.rand_crop) {
+    y0 = max_y > 0 ? std::uniform_int_distribution<int>(0, max_y)(*rng) : 0;
+    x0 = max_x > 0 ? std::uniform_int_distribution<int>(0, max_x)(*rng) : 0;
+  } else {
+    y0 = std::max(max_y / 2, 0);
+    x0 = std::max(max_x / 2, 0);
+  }
+  bool mirror =
+      p.rand_mirror && std::uniform_int_distribution<int>(0, 1)(*rng);
+  // 3. color jitter factors
+  float fb = 0.f, fc = 1.f, fs = 1.f;
+  if (p.brightness > 0.f)
+    fb = std::uniform_real_distribution<float>(-p.brightness,
+                                               p.brightness)(*rng) * 255.f;
+  if (p.contrast > 0.f)
+    fc = 1.f + std::uniform_real_distribution<float>(-p.contrast,
+                                                     p.contrast)(*rng);
+  if (p.saturation > 0.f)
+    fs = 1.f + std::uniform_real_distribution<float>(-p.saturation,
+                                                     p.saturation)(*rng);
+  const int c = img->c;
+  const size_t plane = static_cast<size_t>(out_h) * out_w;
+  for (int y = 0; y < out_h; ++y) {
+    const uint8_t* row =
+        &img->data[(static_cast<size_t>(y0 + y) * img->w + x0) * c];
+    for (int x = 0; x < out_w; ++x) {
+      int sx = mirror ? (out_w - 1 - x) : x;
+      const uint8_t* px = row + static_cast<size_t>(sx) * c;
+      float r = px[0], g = c >= 3 ? px[1] : px[0],
+            b = c >= 3 ? px[2] : px[0];
+      if (fs != 1.f) {
+        float gray = 0.299f * r + 0.587f * g + 0.114f * b;
+        r = gray + fs * (r - gray);
+        g = gray + fs * (g - gray);
+        b = gray + fs * (b - gray);
+      }
+      if (fc != 1.f) {
+        r = (r - 128.f) * fc + 128.f;
+        g = (g - 128.f) * fc + 128.f;
+        b = (b - 128.f) * fc + 128.f;
+      }
+      if (fb != 0.f) {
+        r += fb;
+        g += fb;
+        b += fb;
+      }
+      size_t pos = static_cast<size_t>(y) * out_w + x;
+      if (out_c == 1) {
+        float lum = 0.299f * std::clamp(r, 0.f, 255.f) +
+                    0.587f * std::clamp(g, 0.f, 255.f) +
+                    0.114f * std::clamp(b, 0.f, 255.f);
+        out[pos] = (lum - p.mean[0]) / p.std[0];
+        continue;
+      }
+      float v[3] = {(std::clamp(r, 0.f, 255.f) - p.mean[0]) / p.std[0],
+                    (std::clamp(g, 0.f, 255.f) - p.mean[1]) / p.std[1],
+                    (std::clamp(b, 0.f, 255.f) - p.mean[2]) / p.std[2]};
+      if (p.channels_first) {
+        out[pos] = v[0];
+        out[plane + pos] = v[1];
+        out[2 * plane + pos] = v[2];
+      } else {
+        out[pos * 3] = v[0];
+        out[pos * 3 + 1] = v[1];
+        out[pos * 3 + 2] = v[2];
+      }
+    }
+  }
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,49 @@
+// JPEG decode + image augmentation kernels for the native data pipeline.
+//
+// Native equivalent of the reference's OpenCV-based augmenter chain
+// (src/io/image_aug_default.cc) and the OMP JPEG parser
+// (src/io/iter_image_recordio_2.cc:293-340 in /root/reference): decode,
+// resize-shorter-edge, random/center crop, mirror, brightness/contrast/
+// saturation jitter, mean/std normalize, HWC u8 -> CHW f32.
+#ifndef MXTPU_IMAGE_AUG_H_
+#define MXTPU_IMAGE_AUG_H_
+
+#include <cstdint>
+#include <random>
+#include <vector>
+
+namespace mxtpu {
+
+struct Image {
+  int h = 0, w = 0, c = 0;
+  std::vector<uint8_t> data;  // HWC, RGB
+};
+
+// Decodes a JPEG byte buffer into an RGB image. Returns false if the buffer
+// is not a decodable JPEG.
+bool DecodeJPEG(const uint8_t* buf, uint64_t len, Image* out);
+
+// Bilinear resize to (oh, ow).
+void ResizeBilinear(const Image& src, int oh, int ow, Image* dst);
+
+struct AugmentParams {
+  int resize_shorter = 0;   // 0 = off; else resize shorter edge to this
+  bool rand_crop = false;   // random crop position (else center)
+  bool rand_mirror = false; // random horizontal flip
+  float brightness = 0.f;   // jitter ranges, 0 = off
+  float contrast = 0.f;
+  float saturation = 0.f;
+  float mean[3] = {0.f, 0.f, 0.f};
+  float std[3] = {1.f, 1.f, 1.f};
+  bool channels_first = true;  // write CHW (reference layout) vs HWC
+};
+
+// Full augment chain: resize / crop to (out_h, out_w) / mirror / color
+// jitter / normalize; writes float32 into `out` (out_c*H*W floats).
+// out_c must be 1 (luminance) or 3 (RGB).
+void AugmentToFloat(const Image& img, int out_c, int out_h, int out_w,
+                    const AugmentParams& p, std::mt19937* rng, float* out);
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_IMAGE_AUG_H_

@@ -1,0 +1,462 @@
+// Threaded image-record iterator: the native data pipeline.
+//
+// Native equivalent of the reference's ImageRecordIter
+// (src/io/iter_image_recordio_2.cc in /root/reference): a reader thread
+// streams raw records off the .rec file, N worker threads JPEG-decode and
+// augment them into pinned float batch buffers, and completed batches are
+// handed to Python in order through a bounded reorder window — the same
+// parser -> batcher -> prefetcher chain dmlc::ThreadedIter provided, built
+// here on std::thread so the hot decode path never holds the GIL.
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <numeric>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "image_aug.h"
+#include "recordio.h"
+
+namespace mxtpu {
+namespace {
+
+thread_local std::string g_last_error;
+
+// IRHeader ahead of every image payload (python/mxnet/recordio.py pack()):
+// uint32 flag | float label | uint64 id | uint64 id2; flag>0 means `flag`
+// float32 labels follow the header instead of the inline one.
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+struct Batch {
+  std::vector<float> data;
+  std::vector<float> label;
+  int count = 0;  // valid samples (< batch_size on the tail batch)
+};
+
+class ImageRecordIter {
+ public:
+  ImageRecordIter(const std::string& rec_path, const std::string& idx_path,
+                  int batch_size, int channels, int height, int width,
+                  int label_width, bool shuffle, uint64_t seed, int nthreads,
+                  const AugmentParams& aug, int prefetch)
+      : rec_path_(rec_path), batch_size_(batch_size), c_(channels),
+        h_(height), w_(width), label_width_(label_width), shuffle_(shuffle),
+        aug_(aug), nthreads_(std::max(1, nthreads)),
+        prefetch_(std::max(2, prefetch)), rng_(seed), epoch_seed_(seed) {
+    if (channels != 1 && channels != 3)
+      throw std::runtime_error(
+          "image pipeline: data_shape channels must be 1 or 3");
+    if (!idx_path.empty()) {
+      for (auto& kv : LoadIndex(idx_path)) offsets_.push_back(kv.second);
+    }
+    if (offsets_.empty()) {
+      // No index: scan the .rec once to build one (sequential read is cheap).
+      RecordIOReader r(rec_path_);
+      if (!r.ok()) throw std::runtime_error("cannot open " + rec_path_);
+      std::string payload;
+      uint64_t pos = r.Tell();
+      while (r.Next(&payload)) {
+        offsets_.push_back(pos);
+        pos = r.Tell();
+      }
+    }
+    if (offsets_.empty())
+      throw std::runtime_error("empty record file " + rec_path_);
+    Start();
+  }
+
+  ~ImageRecordIter() { Stop(); }
+
+  int num_samples() const { return static_cast<int>(offsets_.size()); }
+
+  uint64_t num_errors() const { return errors_.load(); }
+
+  // Copies the next batch into caller buffers. Returns #valid samples,
+  // 0 at epoch end (call Reset() to start the next epoch). Throws if the
+  // reader thread hit a corrupt stream.
+  int Next(float* data_out, float* label_out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_done_.wait(lk, [&] {
+      return !pipeline_error_.empty() ||
+             (!done_.empty() && done_.begin()->first == next_seq_);
+    });
+    if (!pipeline_error_.empty())
+      throw std::runtime_error(pipeline_error_);
+    Batch b = std::move(done_.begin()->second);
+    done_.erase(done_.begin());
+    ++next_seq_;
+    cv_space_.notify_all();
+    lk.unlock();
+    if (b.count == 0) return 0;  // epoch-end sentinel
+    std::memcpy(data_out, b.data.data(), b.data.size() * sizeof(float));
+    std::memcpy(label_out, b.label.data(), b.label.size() * sizeof(float));
+    return b.count;
+  }
+
+  void Reset() {
+    Stop();
+    epoch_seed_ += 1;
+    Start();
+  }
+
+ private:
+  void Start() {
+    stop_.store(false);
+    next_seq_ = 0;
+    done_.clear();
+    work_.clear();
+    pipeline_error_.clear();
+    // Epoch order: shuffled record offsets (reference shuffles chunk order +
+    // in-chunk; with per-record seeks we shuffle exactly).
+    order_.resize(offsets_.size());
+    std::iota(order_.begin(), order_.end(), size_t{0});
+    if (shuffle_) {
+      std::mt19937_64 erng(epoch_seed_);
+      for (size_t i = order_.size(); i > 1; --i)
+        std::swap(order_[i - 1], order_[erng() % i]);
+    }
+    reader_ = std::thread(&ImageRecordIter::ReaderLoop, this);
+    workers_.clear();
+    for (int i = 0; i < nthreads_; ++i)
+      workers_.emplace_back(&ImageRecordIter::WorkerLoop, this,
+                            static_cast<uint64_t>(epoch_seed_ * 9973 + i));
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_.store(true);
+    }
+    cv_work_.notify_all();
+    cv_space_.notify_all();
+    cv_done_.notify_all();
+    if (reader_.joinable()) reader_.join();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+    workers_.clear();
+  }
+
+  void Fail(const std::string& msg) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (pipeline_error_.empty()) pipeline_error_ = msg;
+    }
+    cv_done_.notify_all();
+    cv_work_.notify_all();
+  }
+
+  void ReaderLoop() {
+    uint64_t seq = 0;
+    try {
+      RecordIOReader r(rec_path_);
+      if (!r.ok()) throw std::runtime_error("cannot open " + rec_path_);
+      size_t n = order_.size();
+      for (size_t i = 0; i < n && !stop_.load();) {
+        auto recs = std::make_shared<std::vector<std::string>>();
+        recs->reserve(batch_size_);
+        for (int j = 0; j < batch_size_ && i < n; ++j, ++i) {
+          r.Seek(offsets_[order_[i]]);
+          std::string payload;
+          if (!r.Next(&payload)) break;
+          recs->push_back(std::move(payload));
+        }
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_space_.wait(lk, [&] {
+          return stop_.load() ||
+                 work_.size() + done_.size() < static_cast<size_t>(prefetch_);
+        });
+        if (stop_.load()) return;
+        work_.emplace_back(seq++, std::move(recs));
+        cv_work_.notify_one();
+      }
+    } catch (const std::exception& e) {
+      Fail(std::string("image pipeline reader: ") + e.what());
+      return;
+    }
+    // Epoch-end sentinel so Next() unblocks with 0.
+    std::lock_guard<std::mutex> lk(mu_);
+    work_.emplace_back(seq, nullptr);
+    cv_work_.notify_all();
+  }
+
+  void WorkerLoop(uint64_t seed) {
+    std::mt19937 rng(static_cast<uint32_t>(seed));
+    const size_t sample_sz = static_cast<size_t>(c_) * h_ * w_;
+    while (true) {
+      uint64_t seq;
+      std::shared_ptr<std::vector<std::string>> recs;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_work_.wait(lk, [&] { return stop_.load() || !work_.empty(); });
+        if (stop_.load()) return;
+        seq = work_.front().first;
+        recs = std::move(work_.front().second);
+        work_.pop_front();
+      }
+      Batch b;
+      if (recs) {
+        b.count = static_cast<int>(recs->size());
+        b.data.assign(static_cast<size_t>(batch_size_) * sample_sz, 0.f);
+        b.label.assign(static_cast<size_t>(batch_size_) * label_width_, 0.f);
+        try {
+          for (int j = 0; j < b.count; ++j) {
+            ParseOne((*recs)[j], &rng, b.data.data() + j * sample_sz,
+                     b.label.data() + j * label_width_);
+          }
+        } catch (const std::exception& e) {
+          Fail(std::string("image pipeline worker: ") + e.what());
+          return;
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        done_.emplace(seq, std::move(b));
+      }
+      cv_done_.notify_all();
+    }
+  }
+
+  void ParseOne(const std::string& rec, std::mt19937* rng, float* data_out,
+                float* label_out) {
+    if (rec.size() < sizeof(IRHeader)) return;
+    IRHeader hdr;
+    std::memcpy(&hdr, rec.data(), sizeof(hdr));
+    const uint8_t* img = reinterpret_cast<const uint8_t*>(rec.data()) +
+                         sizeof(IRHeader);
+    uint64_t img_len = rec.size() - sizeof(IRHeader);
+    if (hdr.flag > 0) {
+      uint64_t lab_bytes = static_cast<uint64_t>(hdr.flag) * 4;
+      if (img_len < lab_bytes) return;
+      uint32_t ncopy = std::min<uint32_t>(hdr.flag, label_width_);
+      std::memcpy(label_out, img, ncopy * 4);
+      img += lab_bytes;
+      img_len -= lab_bytes;
+    } else {
+      label_out[0] = hdr.label;
+    }
+    Image decoded;
+    if (!DecodeJPEG(img, img_len, &decoded)) {
+      errors_.fetch_add(1);
+      return;  // leave the zero-filled slot; Python checks num_errors()
+    }
+    AugmentToFloat(decoded, c_, h_, w_, aug_, rng, data_out);
+  }
+
+  const std::string rec_path_;
+  const int batch_size_, c_, h_, w_, label_width_;
+  const bool shuffle_;
+  const AugmentParams aug_;
+  const int nthreads_, prefetch_;
+  std::mt19937_64 rng_;
+  uint64_t epoch_seed_;
+
+  std::vector<uint64_t> offsets_;
+  std::vector<size_t> order_;
+
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_done_, cv_space_;
+  std::deque<std::pair<uint64_t, std::shared_ptr<std::vector<std::string>>>>
+      work_;
+  std::map<uint64_t, Batch> done_;
+  std::string pipeline_error_;
+  uint64_t next_seq_ = 0;
+  std::atomic<bool> stop_{false};
+  std::atomic<uint64_t> errors_{0};
+  std::thread reader_;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+}  // namespace mxtpu
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface — the TPU-native analogue of the reference's
+// include/mxnet/c_api.h IO + recordio sections).
+// ---------------------------------------------------------------------------
+extern "C" {
+
+const char* MXTGetLastError() { return mxtpu::g_last_error.c_str(); }
+
+#define MXT_GUARD_BEGIN try {
+#define MXT_GUARD_END                         \
+  }                                           \
+  catch (const std::exception& e) {           \
+    mxtpu::g_last_error = e.what();           \
+    return nullptr;                           \
+  }
+#define MXT_GUARD_END_INT                     \
+  }                                           \
+  catch (const std::exception& e) {           \
+    mxtpu::g_last_error = e.what();           \
+    return -1;                                \
+  }
+
+void* MXTRecordIOReaderCreate(const char* path) {
+  MXT_GUARD_BEGIN
+  auto* r = new mxtpu::RecordIOReader(path);
+  if (!r->ok()) {
+    delete r;
+    mxtpu::g_last_error = std::string("cannot open ") + path;
+    return nullptr;
+  }
+  return r;
+  MXT_GUARD_END
+}
+
+// Returns 1 and sets (*out_buf, *out_len) on success, 0 on EOF, -1 on error.
+// The buffer stays valid until the next call on this handle.
+int MXTRecordIOReaderNext(void* h, const char** out_buf, uint64_t* out_len) {
+  MXT_GUARD_BEGIN
+  auto* r = static_cast<mxtpu::RecordIOReader*>(h);
+  thread_local std::string buf;
+  if (!r->Next(&buf)) return 0;
+  *out_buf = buf.data();
+  *out_len = buf.size();
+  return 1;
+  MXT_GUARD_END_INT
+}
+
+int MXTRecordIOReaderSeek(void* h, uint64_t pos) {
+  static_cast<mxtpu::RecordIOReader*>(h)->Seek(pos);
+  return 0;
+}
+
+int MXTRecordIOReaderReset(void* h) {
+  static_cast<mxtpu::RecordIOReader*>(h)->Reset();
+  return 0;
+}
+
+void MXTRecordIOReaderFree(void* h) {
+  delete static_cast<mxtpu::RecordIOReader*>(h);
+}
+
+void* MXTRecordIOWriterCreate(const char* path) {
+  MXT_GUARD_BEGIN
+  auto* w = new mxtpu::RecordIOWriter(path);
+  if (!w->ok()) {
+    delete w;
+    mxtpu::g_last_error = std::string("cannot open ") + path;
+    return nullptr;
+  }
+  return w;
+  MXT_GUARD_END
+}
+
+// Returns the byte offset the record was written at (for .idx), or -1.
+int64_t MXTRecordIOWriterWrite(void* h, const char* buf, uint64_t len) {
+  MXT_GUARD_BEGIN
+  return static_cast<int64_t>(
+      static_cast<mxtpu::RecordIOWriter*>(h)->Write(buf, len));
+  MXT_GUARD_END_INT
+}
+
+void MXTRecordIOWriterFree(void* h) {
+  delete static_cast<mxtpu::RecordIOWriter*>(h);
+}
+
+void* MXTImageIterCreate(const char* rec_path, const char* idx_path,
+                         int batch_size, int channels, int height, int width,
+                         int label_width, int shuffle, uint64_t seed,
+                         int nthreads, int prefetch, int resize_shorter,
+                         int rand_crop, int rand_mirror, float brightness,
+                         float contrast, float saturation, const float* mean,
+                         const float* std_, int channels_first) {
+  MXT_GUARD_BEGIN
+  mxtpu::AugmentParams aug;
+  aug.resize_shorter = resize_shorter;
+  aug.rand_crop = rand_crop != 0;
+  aug.rand_mirror = rand_mirror != 0;
+  aug.brightness = brightness;
+  aug.contrast = contrast;
+  aug.saturation = saturation;
+  aug.channels_first = channels_first != 0;
+  for (int i = 0; i < 3; ++i) {
+    if (mean) aug.mean[i] = mean[i];
+    if (std_) aug.std[i] = std_[i];
+  }
+  return new mxtpu::ImageRecordIter(rec_path, idx_path ? idx_path : "",
+                                    batch_size, channels, height, width,
+                                    label_width, shuffle != 0, seed, nthreads,
+                                    aug, prefetch);
+  MXT_GUARD_END
+}
+
+int MXTImageIterNext(void* h, float* data_out, float* label_out) {
+  MXT_GUARD_BEGIN
+  return static_cast<mxtpu::ImageRecordIter*>(h)->Next(data_out, label_out);
+  MXT_GUARD_END_INT
+}
+
+int MXTImageIterNumSamples(void* h) {
+  return static_cast<mxtpu::ImageRecordIter*>(h)->num_samples();
+}
+
+// Count of records that failed to decode (zero-filled slots) so far.
+uint64_t MXTImageIterNumErrors(void* h) {
+  return static_cast<mxtpu::ImageRecordIter*>(h)->num_errors();
+}
+
+int MXTImageIterReset(void* h) {
+  MXT_GUARD_BEGIN
+  static_cast<mxtpu::ImageRecordIter*>(h)->Reset();
+  return 0;
+  MXT_GUARD_END_INT
+}
+
+void MXTImageIterFree(void* h) {
+  delete static_cast<mxtpu::ImageRecordIter*>(h);
+}
+
+// Standalone decode+augment (used by mxnet_tpu.image.imdecode fast path).
+int MXTDecodeJPEG(const uint8_t* buf, uint64_t len, uint8_t* out,
+                  int* out_h, int* out_w) {
+  MXT_GUARD_BEGIN
+  mxtpu::Image img;
+  if (!mxtpu::DecodeJPEG(buf, len, &img)) {
+    mxtpu::g_last_error = "not a decodable JPEG";
+    return -1;
+  }
+  if (out == nullptr) {  // size query
+    *out_h = img.h;
+    *out_w = img.w;
+    return 0;
+  }
+  if (*out_h != img.h || *out_w != img.w) {
+    mxtpu::g_last_error = "decode buffer shape mismatch";
+    return -1;
+  }
+  std::memcpy(out, img.data.data(), img.data.size());
+  return 0;
+  MXT_GUARD_END_INT
+}
+
+int MXTResizeBilinear(const uint8_t* src, int h, int w, int c, uint8_t* dst,
+                      int oh, int ow) {
+  MXT_GUARD_BEGIN
+  mxtpu::Image s;
+  s.h = h;
+  s.w = w;
+  s.c = c;
+  s.data.assign(src, src + static_cast<size_t>(h) * w * c);
+  mxtpu::Image d;
+  mxtpu::ResizeBilinear(s, oh, ow, &d);
+  std::memcpy(dst, d.data.data(), d.data.size());
+  return 0;
+  MXT_GUARD_END_INT
+}
+
+}  // extern "C"

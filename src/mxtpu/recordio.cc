@@ -1,0 +1,96 @@
+#include "recordio.h"
+
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+
+namespace mxtpu {
+
+RecordIOReader::RecordIOReader(const std::string& path)
+    : fp_(std::fopen(path.c_str(), "rb")) {}
+
+RecordIOReader::~RecordIOReader() {
+  if (fp_) std::fclose(fp_);
+}
+
+bool RecordIOReader::Next(std::string* out) {
+  uint32_t head[2];
+  size_t n = std::fread(head, sizeof(uint32_t), 2, fp_);
+  if (n < 2) return false;  // EOF
+  if (head[0] != kRecMagic)
+    throw std::runtime_error("recordio: bad magic (corrupt .rec?)");
+  uint32_t len = head[1] & kRecLenMask;
+  uint32_t cflag = head[1] >> 29;
+  out->resize(len);
+  if (len && std::fread(&(*out)[0], 1, len, fp_) != len)
+    throw std::runtime_error("recordio: truncated record");
+  uint32_t pad = (4 - (len & 3u)) & 3u;
+  if (pad) std::fseek(fp_, pad, SEEK_CUR);
+  // Multi-part records (continuation flag != 0): stitch parts together the
+  // way dmlc's reader does — flag 1 starts, 2 continues, 3 ends.
+  while (cflag == 1 || cflag == 2) {
+    n = std::fread(head, sizeof(uint32_t), 2, fp_);
+    if (n < 2) throw std::runtime_error("recordio: truncated multipart");
+    if (head[0] != kRecMagic)
+      throw std::runtime_error("recordio: bad magic in multipart");
+    len = head[1] & kRecLenMask;
+    cflag = head[1] >> 29;
+    size_t old = out->size();
+    out->resize(old + len);
+    if (len && std::fread(&(*out)[old], 1, len, fp_) != len)
+      throw std::runtime_error("recordio: truncated record");
+    pad = (4 - (len & 3u)) & 3u;
+    if (pad) std::fseek(fp_, pad, SEEK_CUR);
+    if (cflag == 3) break;
+  }
+  return true;
+}
+
+void RecordIOReader::Reset() { std::fseek(fp_, 0, SEEK_SET); }
+
+void RecordIOReader::Seek(uint64_t pos) {
+  std::fseek(fp_, static_cast<long>(pos), SEEK_SET);
+}
+
+uint64_t RecordIOReader::Tell() const {
+  return static_cast<uint64_t>(std::ftell(fp_));
+}
+
+RecordIOWriter::RecordIOWriter(const std::string& path)
+    : fp_(std::fopen(path.c_str(), "wb")) {}
+
+RecordIOWriter::~RecordIOWriter() {
+  if (fp_) std::fclose(fp_);
+}
+
+uint64_t RecordIOWriter::Write(const void* buf, uint64_t len) {
+  if (len > kRecLenMask)
+    throw std::runtime_error(
+        "recordio: record too large (>512MB); split the payload");
+  uint64_t pos = static_cast<uint64_t>(std::ftell(fp_));
+  uint32_t head[2] = {kRecMagic,
+                      static_cast<uint32_t>(len & kRecLenMask)};
+  std::fwrite(head, sizeof(uint32_t), 2, fp_);
+  if (len) std::fwrite(buf, 1, len, fp_);
+  static const char zeros[4] = {0, 0, 0, 0};
+  uint32_t pad = (4 - (len & 3u)) & 3u;
+  if (pad) std::fwrite(zeros, 1, pad, fp_);
+  return pos;
+}
+
+std::vector<std::pair<int64_t, uint64_t>> LoadIndex(const std::string& path) {
+  std::vector<std::pair<int64_t, uint64_t>> idx;
+  std::ifstream fin(path);
+  std::string line;
+  while (std::getline(fin, line)) {
+    if (line.empty()) continue;
+    std::istringstream ss(line);
+    int64_t key;
+    uint64_t pos;
+    if (ss >> key >> pos) idx.emplace_back(key, pos);
+  }
+  return idx;
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,57 @@
+// RecordIO: dmlc-format packed-record container (native reader/writer).
+//
+// Same on-disk format as the reference's dmlc recordio (consumed via
+// src/io/iter_image_recordio_2.cc and python/mxnet/recordio.py in
+// /root/reference): every record is
+//   uint32 magic (0xced7230a) | uint32 lrec | payload | pad to 4 bytes
+// lrec's top 3 bits are a continuation flag (this writer emits only whole
+// records, flag 0) and the low 29 bits the payload length.
+#ifndef MXTPU_RECORDIO_H_
+#define MXTPU_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+constexpr uint32_t kRecMagic = 0xced7230a;
+constexpr uint32_t kRecLenMask = (1u << 29) - 1;
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string& path);
+  ~RecordIOReader();
+  bool ok() const { return fp_ != nullptr; }
+  // Reads the next record payload into *out. Returns false at EOF.
+  // Throws std::runtime_error on a corrupt stream.
+  bool Next(std::string* out);
+  void Reset();
+  // Random access: seek to a byte offset previously produced by a writer
+  // (the .idx sidecar stores these).
+  void Seek(uint64_t pos);
+  uint64_t Tell() const;
+
+ private:
+  FILE* fp_;
+};
+
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const std::string& path);
+  ~RecordIOWriter();
+  bool ok() const { return fp_ != nullptr; }
+  // Returns the byte offset the record starts at (for the index).
+  uint64_t Write(const void* buf, uint64_t len);
+
+ private:
+  FILE* fp_;
+};
+
+// Loads a tab-separated "<key>\t<offset>" .idx sidecar.
+std::vector<std::pair<int64_t, uint64_t>> LoadIndex(const std::string& path);
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_RECORDIO_H_

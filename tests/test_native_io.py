@@ -1,0 +1,262 @@
+"""Native runtime library tests: recordio interop + threaded image pipeline.
+
+Models the reference's IO coverage (tests/python/unittest/test_recordio.py
+and test_io.py in /root/reference): format roundtrips, native-vs-Python
+reader agreement, and the ImageRecordIter batch contract.
+"""
+import ctypes
+import io as pyio
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _native, recordio
+
+
+requires_native = pytest.mark.skipif(not _native.available(),
+                                     reason="native lib unavailable")
+
+
+def _write_images(tmp_path, n=23, label_width=1, size=(40, 48)):
+    """Packs n random JPEGs into a .rec/.idx pair; returns paths + labels."""
+    from PIL import Image
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    labels = []
+    for i in range(n):
+        arr = rng.randint(0, 255, size=(size[0], size[1], 3), dtype=np.uint8)
+        if label_width == 1:
+            label = float(i % 7)
+        else:
+            label = rng.rand(label_width).astype(np.float32)
+        labels.append(label)
+        img = Image.fromarray(arr)
+        buf = pyio.BytesIO()
+        img.save(buf, format="JPEG", quality=95)
+        payload = recordio.pack(
+            recordio.IRHeader(0 if label_width == 1 else label_width,
+                              label, i, 0), buf.getvalue())
+        writer.write_idx(i, payload)
+    writer.close()
+    return rec_path, idx_path, labels
+
+
+def test_recordio_python_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    blobs = [os.urandom(ln) for ln in (1, 3, 4, 100, 0, 57)]
+    for b in blobs:
+        w.write(b)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for b in blobs:
+        assert r.read() == b
+    assert r.read() is None
+
+
+@requires_native
+def test_recordio_native_reads_python_written(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    blobs = [os.urandom(ln) for ln in (5, 64, 1, 333)]
+    for b in blobs:
+        w.write(b)
+    w.close()
+    lib = _native.get_lib()
+    h = lib.MXTRecordIOReaderCreate(path.encode())
+    assert h
+    out = ctypes.c_char_p()
+    ln = ctypes.c_uint64()
+    for b in blobs:
+        assert lib.MXTRecordIOReaderNext(h, ctypes.byref(out),
+                                         ctypes.byref(ln)) == 1
+        assert ctypes.string_at(out, ln.value) == b
+    assert lib.MXTRecordIOReaderNext(h, ctypes.byref(out),
+                                     ctypes.byref(ln)) == 0
+    lib.MXTRecordIOReaderFree(h)
+
+
+@requires_native
+def test_recordio_python_reads_native_written(tmp_path):
+    path = str(tmp_path / "t.rec")
+    lib = _native.get_lib()
+    h = lib.MXTRecordIOWriterCreate(path.encode())
+    blobs = [os.urandom(ln) for ln in (7, 128, 2)]
+    offsets = []
+    for b in blobs:
+        off = lib.MXTRecordIOWriterWrite(h, b, len(b))
+        assert off >= 0
+        offsets.append(off)
+    lib.MXTRecordIOWriterFree(h)
+    r = recordio.MXRecordIO(path, "r")
+    for b in blobs:
+        assert r.read() == b
+    # offsets recorded by the native writer are seekable
+    assert offsets[0] == 0 and offsets[1] > 0
+
+
+@requires_native
+def test_native_jpeg_decode_matches_pil(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(3)
+    arr = rng.randint(0, 255, size=(32, 28, 3), dtype=np.uint8)
+    buf = pyio.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    jpg = buf.getvalue()
+    pil = np.asarray(Image.open(pyio.BytesIO(jpg)).convert("RGB"))
+
+    lib = _native.get_lib()
+    h = ctypes.c_int(0)
+    w = ctypes.c_int(0)
+    assert lib.MXTDecodeJPEG(jpg, len(jpg), None,
+                             ctypes.byref(h), ctypes.byref(w)) == 0
+    assert (h.value, w.value) == (32, 28)
+    out = np.zeros((32, 28, 3), dtype=np.uint8)
+    assert lib.MXTDecodeJPEG(jpg, len(jpg), out.ctypes.data_as(
+        ctypes.c_void_p), ctypes.byref(h), ctypes.byref(w)) == 0
+    # libjpeg and PIL (also libjpeg) should agree exactly or within IDCT noise
+    assert np.mean(np.abs(out.astype(int) - pil.astype(int))) < 2.0
+
+
+def _iter_labels(it):
+    seen = []
+    for batch in it:
+        lab = batch.label[0].asnumpy()
+        n = it.batch_size - batch.pad
+        seen.extend(lab[:n].tolist())
+    return seen
+
+
+@requires_native
+def test_image_record_iter_native(tmp_path):
+    rec, idx, labels = _write_images(tmp_path, n=23)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 24, 24), batch_size=8,
+                               shuffle=False, preprocess_threads=3)
+    assert it.num_samples == 23
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (8, 3, 24, 24)
+    assert batches[-1].pad == 1
+    seen = _iter_labels(mx.io.ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=8, shuffle=False))
+    assert sorted(seen) == sorted(float(i % 7) for i in range(23))
+    # reset → same number of batches again
+    it.reset()
+    assert len(list(it)) == 3
+
+
+@requires_native
+def test_image_record_iter_shuffle_and_values(tmp_path):
+    rec, idx, _ = _write_images(tmp_path, n=16, size=(24, 24))
+    kw = dict(path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+              batch_size=16, preprocess_threads=2)
+    plain = next(iter(mx.io.ImageRecordIter(shuffle=False, **kw)))
+    labels = plain.label[0].asnumpy()
+    shuf = next(iter(mx.io.ImageRecordIter(shuffle=True, seed=5, **kw)))
+    labels_s = shuf.label[0].asnumpy()
+    assert sorted(labels.tolist()) == sorted(labels_s.tolist())
+    assert not np.array_equal(labels, labels_s)
+    # data is real decoded pixels (not all zeros), normalized range
+    assert float(np.abs(plain.data[0].asnumpy()).max()) > 1.0
+
+
+@requires_native
+def test_image_record_iter_native_matches_fallback(tmp_path, monkeypatch):
+    rec, idx, _ = _write_images(tmp_path, n=6, size=(24, 24))
+    kw = dict(path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+              batch_size=6, shuffle=False, mean_r=123.0, mean_g=117.0,
+              mean_b=104.0, std_r=58.0, std_g=57.0, std_b=57.0)
+    native_batch = next(iter(mx.io.ImageRecordIter(**kw)))
+    monkeypatch.setattr(_native, "get_lib", lambda: None)
+    py_batch = next(iter(mx.io.ImageRecordIter(**kw)))
+    nd = native_batch.data[0].asnumpy()
+    pd = py_batch.data[0].asnumpy()
+    assert nd.shape == pd.shape
+    np.testing.assert_allclose(nd, pd, atol=0.1)
+    np.testing.assert_array_equal(native_batch.label[0].asnumpy(),
+                                  py_batch.label[0].asnumpy())
+
+
+@requires_native
+def test_image_record_iter_multilabel(tmp_path):
+    rec, idx, labels = _write_images(tmp_path, n=5, label_width=4,
+                                     size=(24, 24))
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 24, 24), batch_size=5,
+                               label_width=4, shuffle=False)
+    batch = next(iter(it))
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (5, 4)
+    np.testing.assert_allclose(lab, np.stack(labels), rtol=1e-6)
+
+
+@requires_native
+def test_image_record_iter_grayscale(tmp_path):
+    rec, idx, _ = _write_images(tmp_path, n=4, size=(24, 24))
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(1, 16, 16), batch_size=4,
+                               shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 1, 16, 16)
+    assert float(np.abs(batch.data[0].asnumpy()).max()) > 1.0
+    with pytest.raises(Exception):
+        mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                              data_shape=(4, 16, 16), batch_size=4)
+
+
+@requires_native
+def test_image_record_iter_small_resize_clamped(tmp_path):
+    # resize shorter edge BELOW the crop size must not crash (clamped up)
+    rec, idx, _ = _write_images(tmp_path, n=4, size=(60, 80))
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 48, 48), batch_size=4,
+                               resize=20, shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 48, 48)
+
+
+@requires_native
+def test_image_record_iter_corrupt_rec_raises(tmp_path):
+    rec, idx, _ = _write_images(tmp_path, n=8, size=(24, 24))
+    # corrupt the middle of the file (clobber a record header via its offset)
+    offs = [int(l.split("\t")[1]) for l in open(idx)]
+    with open(rec, "r+b") as f:
+        f.seek(offs[4])
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(Exception):
+        it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                                   data_shape=(3, 24, 24), batch_size=8,
+                                   shuffle=False)
+        list(it)
+
+
+@requires_native
+def test_image_record_iter_undecodable_counted(tmp_path):
+    rec_path = str(tmp_path / "bad.rec")
+    idx_path = str(tmp_path / "bad.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(4):
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                     b"not-a-jpeg-payload"))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                               data_shape=(3, 8, 8), batch_size=4,
+                               shuffle=False)
+    batch = next(iter(it))
+    assert float(np.abs(batch.data[0].asnumpy()).max()) == 0.0
+    assert it.num_decode_errors == 4
+
+
+@requires_native
+def test_recordio_writer_rejects_oversized(tmp_path):
+    lib = _native.get_lib()
+    h = lib.MXTRecordIOWriterCreate(str(tmp_path / "big.rec").encode())
+    # lie about the length (no need to allocate 512MB): writer must reject
+    assert lib.MXTRecordIOWriterWrite(h, b"x", (1 << 29) + 5) == -1
+    lib.MXTRecordIOWriterFree(h)
