@@ -40,6 +40,7 @@ from . import module
 from . import module as mod
 from .module import Module
 from . import recordio
+from . import image
 from . import gluon
 
 __version__ = "0.1.0"

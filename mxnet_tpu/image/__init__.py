@@ -1,0 +1,14 @@
+"""Python-side image processing + iterators (mx.image).
+
+TPU-native port of /root/reference/python/mxnet/image/: decode/resize/crop/
+color-jitter augmenters and the ImageIter / ImageDetIter record+list
+iterators.  The reference backs these with OpenCV `nd` ops; here the host
+side is numpy+PIL (with libmxtpu JPEG decode when built), and batches are
+handed to the device as fixed-shape arrays so the XLA step cache stays hot.
+"""
+from .image import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from . import image
+from . import detection
+
+__all__ = image.__all__ + detection.__all__

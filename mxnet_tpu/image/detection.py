@@ -1,0 +1,465 @@
+"""Detection-oriented augmenters + iterator (mx.image.detection).
+
+Port of /root/reference/python/mxnet/image/detection.py: bbox-aware
+augmenters (crop/pad/flip keep the object labels consistent with the
+pixels) and ImageDetIter whose labels are object lists
+``[id, xmin, ymin, xmax, ymax, ...]`` with normalized corner coords.
+Host-side numpy implementation (the reference drives OpenCV nd ops).
+"""
+from __future__ import annotations
+
+import logging
+import random as _pyrandom
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import io as _mxio
+from .image import (ImageIter, Augmenter, ResizeAug, ForceResizeAug,
+                    ColorJitterAug, HueJitterAug, LightingAug,
+                    ColorNormalizeAug, RandomGrayAug, CastAug,
+                    fixed_crop, _to_np, _wrap)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+class DetAugmenter(object):
+    """Detection augmenter base (reference detection.py:37)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in self._kwargs.items():
+            if isinstance(v, _np.ndarray):
+                self._kwargs[k] = v.tolist()
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self._kwargs]
+
+    def __call__(self, src, label):
+        raise NotImplementedError()
+
+
+class DetBorrowAug(DetAugmenter):
+    """Borrow a plain image Augmenter, passing the label through
+    (reference detection.py:63)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise TypeError("DetBorrowAug takes an image Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self.augmenter.dumps()]
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one augmenter to apply, or skip
+    (reference detection.py:88)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for aug in aug_list:
+            if not isinstance(aug, DetAugmenter):
+                raise ValueError("Allow DetAugmenter in list only")
+        if not aug_list:
+            skip_prob = 1.0  # disabled
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [x.dumps() for x in self.aug_list]]
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.skip_prob:
+            return (src, label)
+        return _pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and x-coordinates of boxes with probability p
+    (reference detection.py:124)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = _wrap(_to_np(src)[:, ::-1].copy(), src)
+            label = label.copy()
+            valid = label[:, 0] > -1
+            tmp = 1.0 - label[valid, 1]
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = tmp
+        return (src, label)
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop with object-coverage constraints (SSD-style)
+    (reference detection.py:150)."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, 1.0)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.enabled = (area_range[1] > area_range[0] or
+                        area_range[1] < 1.0) and \
+            aspect_ratio_range[0] <= aspect_ratio_range[1]
+        if not (area_range[0] > 0 and area_range[1] >= area_range[0]):
+            logging.warning("Skip DetRandomCropAug due to invalid "
+                            "area_range: %s", area_range)
+            self.enabled = False
+
+    def _check_satisfy_constraints(self, label, x0, y0, x1, y1):
+        """Return updated label if the crop keeps enough of the objects,
+        else None."""
+        crop = _np.array([x0, y0, x1, y1], _np.float32)
+        valid = label[:, 0] > -1
+        boxes = label[valid, 1:5]
+        if boxes.shape[0] == 0:
+            return label.copy()
+        # coverage of each object by the crop
+        ix0 = _np.maximum(crop[0], boxes[:, 0])
+        iy0 = _np.maximum(crop[1], boxes[:, 1])
+        ix1 = _np.minimum(crop[2], boxes[:, 2])
+        iy1 = _np.minimum(crop[3], boxes[:, 3])
+        inter = _np.maximum(0.0, ix1 - ix0) * _np.maximum(0.0, iy1 - iy0)
+        area_b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        coverage = _np.where(area_b > 0, inter / _np.maximum(area_b, 1e-12),
+                             0.0)
+        # every object the crop intersects must be covered enough
+        # (reference detection.py:248 — amin over nonzero coverages)
+        touched = coverage[coverage > 0]
+        if touched.size == 0 or touched.min() < self.min_object_covered:
+            return None
+        # rebuild labels in crop coordinates; eject low-coverage objects
+        w = crop[2] - crop[0]
+        h = crop[3] - crop[1]
+        keep_rows = []
+        full = label[valid]
+        for i in range(full.shape[0]):
+            if coverage[i] < self.min_eject_coverage:
+                continue
+            row = full[i].copy()
+            row[1] = (max(crop[0], row[1]) - crop[0]) / w
+            row[2] = (max(crop[1], row[2]) - crop[1]) / h
+            row[3] = (min(crop[2], row[3]) - crop[0]) / w
+            row[4] = (min(crop[3], row[4]) - crop[1]) / h
+            keep_rows.append(row)
+        if not keep_rows:
+            return None
+        out = _np.full_like(label, -1.0)
+        kept = _np.stack(keep_rows)
+        out[:kept.shape[0]] = kept
+        return out
+
+    def __call__(self, src, label):
+        if not self.enabled:
+            return (src, label)
+        npsrc = _to_np(src)
+        h, w = npsrc.shape[:2]
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(*self.area_range)
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            cw = _np.sqrt(area * ratio)
+            ch = _np.sqrt(area / ratio)
+            if cw > 1 or ch > 1:
+                continue
+            x0 = _pyrandom.uniform(0, 1 - cw)
+            y0 = _pyrandom.uniform(0, 1 - ch)
+            new_label = self._check_satisfy_constraints(
+                label, x0, y0, x0 + cw, y0 + ch)
+            if new_label is not None:
+                px0 = int(x0 * w)
+                py0 = int(y0 * h)
+                pw = max(1, int(cw * w))
+                ph = max(1, int(ch * h))
+                out = fixed_crop(src, px0, py0, pw, ph)
+                return (out, new_label)
+        return (src, label)
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding; boxes shrink into the padded canvas
+    (reference detection.py:323)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        if not isinstance(pad_val, (list, tuple)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (1.0, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.max_attempts = max_attempts
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.enabled = area_range[1] > 1.0 and \
+            aspect_ratio_range[0] <= aspect_ratio_range[1]
+        if not self.enabled:
+            logging.warning("Skip DetRandomPadAug due to invalid "
+                            "parameters: %s, %s", area_range,
+                            aspect_ratio_range)
+
+    def __call__(self, src, label):
+        if not self.enabled:
+            return (src, label)
+        npsrc = _to_np(src)
+        h, w, c = npsrc.shape
+        for _ in range(self.max_attempts):
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            area = _pyrandom.uniform(*self.area_range)
+            nh = int(h * _np.sqrt(area / ratio))
+            nw = int(w * _np.sqrt(area * ratio))
+            if nh < h or nw < w:
+                continue
+            y0 = _pyrandom.randint(0, nh - h)
+            x0 = _pyrandom.randint(0, nw - w)
+            fill = _np.asarray(self.pad_val, dtype=npsrc.dtype)
+            canvas = _np.empty((nh, nw, c), dtype=npsrc.dtype)
+            canvas[:] = fill
+            canvas[y0:y0 + h, x0:x0 + w] = npsrc
+            new_label = label.copy()
+            valid = new_label[:, 0] > -1
+            new_label[valid, 1] = (new_label[valid, 1] * w + x0) / nw
+            new_label[valid, 2] = (new_label[valid, 2] * h + y0) / nh
+            new_label[valid, 3] = (new_label[valid, 3] * w + x0) / nw
+            new_label[valid, 4] = (new_label[valid, 4] * h + y0) / nh
+            return (_wrap(canvas, src), new_label)
+        return (src, label)
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """Build a DetRandomSelectAug over per-threshold crop augmenters
+    (reference detection.py:417).  Each argument may be a scalar or a
+    list; lists must share length."""
+    def align(v):
+        return v if isinstance(v, (list,)) else [v]
+    mocs = align(min_object_covered)
+    arrs = aspect_ratio_range if isinstance(aspect_ratio_range[0],
+                                            (list, tuple)) \
+        else [aspect_ratio_range]
+    ars = area_range if isinstance(area_range[0], (list, tuple)) \
+        else [area_range]
+    mecs = align(min_eject_coverage)
+    mas = align(max_attempts)
+    n = max(len(mocs), len(arrs), len(ars), len(mecs), len(mas))
+
+    def get(lst, i):
+        if len(lst) == n:
+            return lst[i]
+        assert len(lst) == 1, "Args must be simple or share length"
+        return lst[0]
+    augs = [DetRandomCropAug(min_object_covered=get(mocs, i),
+                             aspect_ratio_range=get(arrs, i),
+                             area_range=get(ars, i),
+                             min_eject_coverage=get(mecs, i),
+                             max_attempts=get(mas, i))
+            for i in range(n)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmenter list (reference detection.py:482)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_pad > 0:
+        pad_aug = DetRandomPadAug(
+            aspect_ratio_range, (1.0, max(1.0, area_range[1])),
+            max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad_aug], 1 - rand_pad))
+    if rand_crop > 0:
+        crop_augs = CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(1.0, area_range[1])),
+            min_eject_coverage, max_attempts, skip_prob=(1 - rand_crop))
+        auglist.append(crop_augs)
+    if rand_mirror > 0:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # force resize to the network input after pad/crop
+    auglist.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = _np.asarray(mean)
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = _np.asarray(std)
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Image iterator with object-detection labels
+    (reference detection.py:624).
+
+    Record labels are flat: [header_width A, object_width B,
+    extra-header..., obj0(B floats), obj1, ...]; exposed as a padded
+    (batch, max_objects, object_width) tensor, pad rows = -1.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name,
+                         last_batch_handle=last_batch_handle, **kwargs)
+        if aug_list is None:
+            self.auglist = CreateDetAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        # estimate padded label shape by scanning first records
+        self.max_objects, self.label_object_width = self._estimate_label_shape()
+        self.label_shape = (self.max_objects, self.label_object_width)
+        self.provide_label = [_mxio.DataDesc(
+            label_name, (self.batch_size,) + self.label_shape)]
+
+    def _parse_label(self, label):
+        """Flat raw label → (num_obj, width) normalized array."""
+        raw = _np.asarray(label, dtype=_np.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError("Label shape is invalid: " + str(raw.shape))
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if (raw.size - header_width) % obj_width != 0:
+            raise MXNetError("Label shape %s inconsistent with annotation "
+                             "width %d." % (str(raw.shape), obj_width))
+        out = raw[header_width:].reshape(-1, obj_width)
+        valid = _np.where(_np.logical_and(out[:, 3] > out[:, 1],
+                                          out[:, 4] > out[:, 2]))[0]
+        if valid.size < 1:
+            raise MXNetError("Encounter sample with no valid label.")
+        return out[valid]
+
+    def _estimate_label_shape(self):
+        """Scan the dataset once for (max_objects, width)."""
+        max_count = 0
+        obj_width = 6
+        self.hard_reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                label = self._parse_label(label)
+                max_count = max(max_count, label.shape[0])
+                obj_width = label.shape[1]
+        except StopIteration:
+            pass
+        self.hard_reset()
+        return max(1, max_count), obj_width
+
+    def _batchify(self, batch_data, batch_label, start=0):
+        i = start
+        batch_size = self.batch_size
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = self.imdecode(s)
+                self.check_valid_image([data])
+                label = self._parse_label(label)
+                padded = _np.full(self.label_shape, -1.0, dtype=_np.float32)
+                n = min(label.shape[0], self.max_objects)
+                padded[:n, :label.shape[1]] = label[:n]
+                data, padded = self.augmentation_transform(data, padded)
+                npdata = _to_np(data)
+                batch_data[i] = npdata.transpose(2, 0, 1)
+                batch_label[i] = padded
+                i += 1
+        except StopIteration:
+            if not i:
+                raise StopIteration
+        return i
+
+    def _empty_label_array(self):
+        return _np.full((self.batch_size,) + self.label_shape, -1.0,
+                        dtype=_np.float32)
+
+    def augmentation_transform(self, data, label):
+        for aug in self.auglist:
+            data, label = aug(data, label)
+        return (data, label)
+
+    def reshape(self, data_shape=None, label_shape=None):
+        """Change data/label shapes for a bound module (reference
+        detection.py:reshape)."""
+        if data_shape is not None:
+            self.check_data_shape(data_shape)
+            self.provide_data = [_mxio.DataDesc(
+                self.provide_data[0].name,
+                (self.batch_size,) + data_shape)]
+            self.data_shape = data_shape
+        if label_shape is not None:
+            self.check_label_shape(label_shape)
+            self.provide_label = [_mxio.DataDesc(
+                self.provide_label[0].name,
+                (self.batch_size,) + label_shape)]
+            self.label_shape = label_shape
+            self.max_objects = label_shape[0]
+
+    def check_label_shape(self, label_shape):
+        if not len(label_shape) == 2:
+            raise ValueError("label_shape should have length 2")
+        if label_shape[0] < self.max_objects:
+            raise ValueError("label_shape object count smaller than data: "
+                             "%d vs %d" % (label_shape[0], self.max_objects))

@@ -1,0 +1,778 @@
+"""Read, decode, resize, crop and augment images (mx.image core).
+
+Port of /root/reference/python/mxnet/image/image.py.  Same API surface —
+imread/imdecode/resize_short/*_crop/color_normalize, the Augmenter class
+zoo, CreateAugmenter, and ImageIter — but the implementation is host-side
+numpy + PIL (the reference calls into OpenCV via nd ops).  Images are HWC,
+RGB by default, float32 or uint8; augmenters accept and return NDArray
+(numpy accepted too and passed through as numpy for pipeline efficiency).
+"""
+from __future__ import annotations
+
+import io as _pyio
+import logging
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+from .. import io as _mxio
+from .. import recordio as _recordio
+
+__all__ = ["imread", "imdecode", "imresize", "scale_down", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+           "RandomOrderAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+
+
+def _to_np(src):
+    """Accept NDArray or numpy, return numpy (HWC)."""
+    if isinstance(src, NDArray):
+        return src.asnumpy()
+    return _np.asarray(src)
+
+
+def _wrap(arr, like):
+    """Return NDArray when the input was NDArray, else raw numpy."""
+    if isinstance(like, NDArray):
+        return array(arr)
+    return arr
+
+
+def _pil_from_np(arr):
+    from PIL import Image
+    a = arr
+    if a.ndim == 3 and a.shape[2] == 1:
+        a = a[:, :, 0]
+    return Image.fromarray(a)
+
+
+# PIL resample codes for the reference's OpenCV interp numbers
+# (0=nearest 1=bilinear 2=area 3=bicubic 4=lanczos; 9/10 are adaptive)
+def _get_interp_method(interp, sizes=()):
+    from PIL import Image
+    table = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BOX,
+             3: Image.BICUBIC, 4: Image.LANCZOS}
+    if interp == 9:  # area for shrink, bicubic for enlarge
+        if sizes:
+            oh, ow, nh, nw = sizes
+            interp = 3 if nh > oh or nw > ow else 2
+        else:
+            interp = 2
+    elif interp == 10:  # random
+        interp = _pyrandom.randint(0, 4)
+    if interp not in table:
+        raise ValueError("Unknown interp method %s" % interp)
+    return table[interp]
+
+
+def _resize_np(src, w, h, interp=2):
+    src = _np.asarray(src)
+    if src.shape[0] == h and src.shape[1] == w:
+        return src
+    dtype = src.dtype
+    method = _get_interp_method(interp, (src.shape[0], src.shape[1], h, w))
+    if dtype == _np.uint8:
+        out = _np.asarray(_pil_from_np(src).resize((w, h), method),
+                          dtype=_np.float32)
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return _np.clip(_np.rint(out), 0, 255).astype(_np.uint8)
+    # float images: per-channel mode-'F' resize keeps exact float values
+    # (no clip/quantize — normalized data can be negative or fractional)
+    from PIL import Image
+    src_f = src.astype(_np.float32)
+    if src_f.ndim == 2:
+        src_f = src_f[:, :, None]
+    chans = [_np.asarray(Image.fromarray(src_f[:, :, c], mode="F")
+                         .resize((w, h), method), dtype=_np.float32)
+             for c in range(src_f.shape[2])]
+    return _np.stack(chans, axis=2).astype(dtype)
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode an image byte-buffer to an HWC NDArray.
+
+    Reference: image.py:imdecode (cv2.imdecode via the _cvimdecode op).
+    flag=1 color, 0 grayscale; to_rgb returns RGB order (reference's
+    OpenCV default is BGR, flipped when to_rgb).
+    """
+    from .. import _native
+    data = bytes(buf)
+    arr = None
+    lib = _native.get_lib()
+    if lib is not None and flag == 1:
+        import ctypes as _ct
+        w = _ct.c_int()
+        h = _ct.c_int()
+        # two-call contract: size query (out=NULL), then exact-shape decode
+        ret = lib.MXTDecodeJPEG(data, len(data), None,
+                                _ct.byref(h), _ct.byref(w))
+        if ret == 0 and w.value > 0:
+            out = _np.empty((h.value, w.value, 3), dtype=_np.uint8)
+            ret = lib.MXTDecodeJPEG(
+                data, len(data), out.ctypes.data_as(_ct.c_void_p),
+                _ct.byref(h), _ct.byref(w))
+            if ret == 0:
+                arr = out
+    if arr is None:
+        from PIL import Image
+        img = Image.open(_pyio.BytesIO(data))
+        img = img.convert("L" if flag == 0 else "RGB")
+        arr = _np.asarray(img, dtype=_np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+    if not to_rgb and arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]
+    return array(arr)
+
+
+def imread(filename, flag=1, to_rgb=True, **kwargs):
+    """Read an image file into an HWC NDArray (reference image.py:imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to (w, h) (reference nd _cvimresize)."""
+    return _wrap(_resize_np(_to_np(src), w, h, interp), src)
+
+
+def scale_down(src_size, size):
+    """Scale target size down to fit inside src_size, keeping aspect
+    (reference image.py:139)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals `size` (reference image.py:229)."""
+    npsrc = _to_np(src)
+    h, w = npsrc.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return _wrap(_resize_np(npsrc, new_w, new_h, interp), src)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop [y0:y0+h, x0:x0+w], optionally resize to `size` (w,h)
+    (reference image.py:291)."""
+    npsrc = _to_np(src)
+    out = npsrc[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize_np(out, size[0], size[1], interp)
+    return _wrap(out, src)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of target `size` (w,h), scaled down to fit; returns
+    (cropped, (x0, y0, w, h)) (reference image.py:323)."""
+    npsrc = _to_np(src)
+    h, w = npsrc.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop; returns (cropped, roi) (reference image.py:362)."""
+    npsrc = _to_np(src)
+    h, w = npsrc.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std, channelwise (reference image.py:411)."""
+    npsrc = _to_np(src).astype(_np.float32)
+    if mean is not None:
+        npsrc = npsrc - _np.asarray(_to_np(mean), _np.float32)
+    if std is not None:
+        npsrc = npsrc / _np.asarray(_to_np(std), _np.float32)
+    return _wrap(npsrc, src)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect crop (inception-style); returns (cropped, roi)
+    (reference image.py:435)."""
+    npsrc = _to_np(src)
+    h, w = npsrc.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = _pyrandom.uniform(min_area, 1.0) * area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        new_ratio = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * new_ratio)))
+        new_h = int(round(_np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    # fall back to center crop
+    return center_crop(src, size, interp)
+
+
+class Augmenter(object):
+    """Image augmenter base (reference image.py:482)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in self._kwargs.items():
+            if isinstance(v, NDArray):
+                v = v.asnumpy()
+            if isinstance(v, _np.ndarray):
+                v = v.tolist()
+                self._kwargs[k] = v
+
+    def dumps(self):
+        """Serialize to [class-name, kwargs] for logging/repro."""
+        return [self.__class__.__name__.lower(), self._kwargs]
+
+    def __call__(self, src):
+        raise NotImplementedError()
+
+
+class ResizeAug(Augmenter):
+    """resize_short wrapper (reference image.py:508)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Force resize to `size` (w,h), ignoring aspect (reference :528)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return _wrap(_resize_np(_to_np(src), self.size[0], self.size[1],
+                                self.interp), src)
+
+
+class RandomCropAug(Augmenter):
+    """random_crop wrapper (reference image.py:549)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    """random_size_crop wrapper (reference image.py:569)."""
+
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    """center_crop wrapper (reference image.py:596)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    """Apply a list of augmenters in random order (reference :616)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-brightness, brightness) (reference :640)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return _wrap(_to_np(src).astype(_np.float32) * alpha, src)
+
+
+_GRAY_COEF = _np.array([0.299, 0.587, 0.114], dtype=_np.float32)
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the mean gray level (reference :659)."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        npsrc = _to_np(src).astype(_np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (npsrc * _GRAY_COEF).sum(axis=2, keepdims=True)
+        # offset = (1-alpha) * mean gray level (npsrc.size = h*w*3)
+        mean = 3.0 * (1.0 - alpha) / npsrc.size * gray.sum()
+        return _wrap(npsrc * alpha + mean, src)
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with the per-pixel gray image (reference :682)."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        npsrc = _to_np(src).astype(_np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (npsrc * _GRAY_COEF).sum(axis=2, keepdims=True)
+        return _wrap(npsrc * alpha + gray * (1.0 - alpha), src)
+
+
+class HueJitterAug(Augmenter):
+    """Rotate hue in YIQ space (reference :706)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = _np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], dtype=_np.float32)
+        self.ityiq = _np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], dtype=_np.float32)
+
+    def __call__(self, src):
+        npsrc = _to_np(src).astype(_np.float32)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], dtype=_np.float32)
+        t = self.ityiq.dot(bt).dot(self.tyiq).T
+        return _wrap(npsrc.dot(t), src)
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Random-order brightness+contrast+saturation (reference :740)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (AlexNet-style) (reference :763)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        npsrc = _to_np(src).astype(_np.float32)
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = _np.dot(self.eigvec * alpha, self.eigval)
+        return _wrap(npsrc + rgb.astype(_np.float32), src)
+
+
+class ColorNormalizeAug(Augmenter):
+    """color_normalize wrapper (reference :789)."""
+
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = None if mean is None else _np.asarray(_to_np(mean),
+                                                          _np.float32)
+        self.std = None if std is None else _np.asarray(_to_np(std),
+                                                        _np.float32)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to 3-channel gray with probability p (reference :809)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            npsrc = _to_np(src).astype(_np.float32)
+            gray = (npsrc * _GRAY_COEF).sum(axis=2, keepdims=True)
+            return _wrap(_np.broadcast_to(gray, npsrc.shape).copy(), src)
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    """Random horizontal flip with probability p (reference :831)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return _wrap(_to_np(src)[:, ::-1].copy(), src)
+        return src
+
+
+class CastAug(Augmenter):
+    """Cast to float32 (reference :850)."""
+
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _wrap(_to_np(src).astype(self.typ), src)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference image.py:861)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0,
+                                                            4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = _np.asarray(_to_np(mean))
+        assert mean.shape[0] in [1, 3]
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = _np.asarray(_to_np(std))
+        assert std.shape[0] in [1, 3]
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_mxio.DataIter):
+    """Python image iterator over .rec files or image lists
+    (reference image/image.py:975).
+
+    Supports path_imgrec (RecordIO), path_imglist (.lst: index\\tlabel...
+    \\tpath), or an in-memory imglist [[label, path], ...] with path_root.
+    Decodes+augments per image on the host, yields NCHW float batches.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        assert dtype in ["int32", "float32", "int64", "float64"], \
+            dtype + " label not supported"
+        num_threads = os.environ.get("MXNET_CPU_WORKER_NTHREADS", "1")
+        logging.info("Using %s threads for decoding...", num_threads)
+        self.record = None
+        self.imgidx = None
+        if path_imgrec:
+            logging.info("loading recordio %s...", path_imgrec)
+            if path_imgidx:
+                self.record = _recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.record.keys)
+            else:
+                assert not shuffle and num_parts == 1, \
+                    "path_imgidx is required for shuffle or partitioning " \
+                    "over a .rec file"
+                self.record = _recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        if path_imglist:
+            logging.info("loading image list %s...", path_imglist)
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in iter(fin.readline, ""):
+                    line = line.strip().split("\t")
+                    label = _np.array(line[1:-1], dtype=dtype)
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+                self.imglist = imglist
+        elif isinstance(imglist, list):
+            logging.info("loading image list...")
+            result = {}
+            imgkeys = []
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                if len(img) > 2:
+                    label = _np.array(img[:-1], dtype=dtype)
+                elif isinstance(img[0], (list, tuple, _np.ndarray)):
+                    label = _np.array(img[0], dtype=dtype)
+                else:
+                    label = _np.array([img[0]], dtype=dtype)
+                result[key] = (label, img[-1])
+                imgkeys.append(str(key))
+            self.imglist = result
+        else:
+            self.imglist = None
+        self.path_root = path_root
+
+        assert len(data_shape) == 3 and data_shape[0] == 3
+        self.provide_data = [_mxio.DataDesc(data_name,
+                                            (batch_size,) + data_shape)]
+        if label_width > 1:
+            self.provide_label = [_mxio.DataDesc(
+                label_name, (batch_size, label_width))]
+        else:
+            self.provide_label = [_mxio.DataDesc(label_name, (batch_size,))]
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if self.imgidx is None and self.imglist is not None:
+            self.seq = imgkeys
+        elif self.imgidx is not None:
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+        if num_parts > 1 and self.seq is not None:
+            assert part_index < num_parts
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self._allow_read = True
+        self.last_batch_handle = last_batch_handle
+        self.num_image = len(self.seq) if self.seq is not None else None
+        self._cache_data = None
+        self._cache_label = None
+        self._cache_idx = None
+        self.reset()
+
+    def reset(self):
+        if self.seq is not None and self.shuffle:
+            _pyrandom.shuffle(self.seq)
+        if self.last_batch_handle != "roll_over" or self._cache_data is None:
+            if self.record is not None:
+                self.record.reset()
+            self.cur = 0
+        if self._allow_read is False:
+            self._allow_read = True
+
+    def hard_reset(self):
+        """Reset regardless of roll-over cache."""
+        if self.seq is not None and self.shuffle:
+            _pyrandom.shuffle(self.seq)
+        if self.record is not None:
+            self.record.reset()
+        self.cur = 0
+        self._allow_read = True
+        self._cache_data = None
+        self._cache_label = None
+        self._cache_idx = None
+
+    def next_sample(self):
+        """Return (label, decoded-numpy-image) for the next sample."""
+        if not self._allow_read:
+            raise StopIteration
+        if self.seq is not None:
+            if self.cur < self.num_image:
+                idx = self.seq[self.cur]
+            else:
+                if self.last_batch_handle != "discard":
+                    self.cur = 0
+                raise StopIteration
+            self.cur += 1
+            if self.record is not None:
+                s = self.record.read_idx(idx)
+                header, img = _recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.record.read()
+        if s is None:
+            if self.last_batch_handle != "discard":
+                self.record.reset()
+            raise StopIteration
+        header, img = _recordio.unpack(s)
+        return header.label, img
+
+    def _batchify(self, batch_data, batch_label, start=0):
+        i = start
+        batch_size = self.batch_size
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = self.imdecode(s)
+                self.check_valid_image([data])
+                data = self.augmentation_transform(data)
+                npdata = _to_np(data)
+                batch_data[i] = npdata.transpose(2, 0, 1)
+                lab = _np.asarray(label)
+                if batch_label.ndim == 1:
+                    batch_label[i] = float(lab.ravel()[0])
+                else:
+                    batch_label[i] = lab
+                i += 1
+        except StopIteration:
+            if not i:
+                raise StopIteration
+        return i
+
+    def _empty_label_array(self):
+        """Allocate one epoch-batch label buffer (ImageDetIter overrides)."""
+        if self.label_width > 1:
+            return _np.zeros((self.batch_size, self.label_width),
+                             dtype=_np.float32)
+        return _np.zeros((self.batch_size,), dtype=_np.float32)
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        if self._cache_data is not None:
+            # continue filling the partial batch rolled over from last epoch
+            batch_data = self._cache_data
+            batch_label = self._cache_label
+            start = self._cache_idx
+            self._cache_data = None
+            self._cache_label = None
+            self._cache_idx = None
+            i = self._batchify(batch_data, batch_label, start)
+        else:
+            batch_data = _np.zeros((batch_size, c, h, w), dtype=_np.float32)
+            batch_label = self._empty_label_array()
+            i = self._batchify(batch_data, batch_label)
+        pad = batch_size - i
+        if pad != 0:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            if (self.last_batch_handle == "roll_over" and
+                    self._cache_data is None and i > 0):
+                self._cache_data = batch_data
+                self._cache_label = batch_label
+                self._cache_idx = i
+                raise StopIteration
+            self._allow_read = False
+        return _mxio.DataBatch([array(batch_data)], [array(batch_label)],
+                               pad=pad)
+
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError("data_shape should have length 3, with "
+                             "dimensions CxHxW")
+        if not data_shape[0] == 3:
+            raise ValueError("This iterator expects inputs to have 3 "
+                             "channels.")
+
+    def check_valid_image(self, data):
+        if len(data[0].shape) == 0:
+            raise RuntimeError("Data shape is wrong")
+
+    def imdecode(self, s):
+        """Decode a record's image bytes."""
+        if isinstance(s, _np.ndarray):
+            return s
+        return imdecode(s).asnumpy()
+
+    def read_image(self, fname):
+        path = os.path.join(self.path_root, fname) if self.path_root \
+            else fname
+        with open(path, "rb") as f:
+            return f.read()
+
+    def augmentation_transform(self, data):
+        for aug in self.auglist:
+            data = aug(data)
+        return data

@@ -1,0 +1,190 @@
+"""mx.image tests — decode/resize/crop/augmenters/ImageIter/ImageDetIter.
+
+Mirrors tests/python/unittest/test_image.py from the reference at a
+smaller scale (synthetic JPEGs instead of downloaded data).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+
+
+def _make_jpeg_bytes(h=64, w=48, seed=0):
+    """Smooth gradient + low-freq pattern: JPEG-compresses faithfully."""
+    from PIL import Image
+    import io as pyio
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    r = 127 + 100 * np.sin(xx / w * 3 + seed)
+    g = 127 + 100 * np.cos(yy / h * 3 + seed)
+    b = (xx + yy) / (h + w) * 255
+    arr = np.clip(np.stack([r, g, b], axis=2), 0, 255).astype(np.uint8)
+    buf = pyio.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue(), arr
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    """A small .rec/.idx pair of 8 JPEG records with scalar labels."""
+    d = tmp_path_factory.mktemp("imgs")
+    rec = str(d / "data.rec")
+    idx = str(d / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(8):
+        jpg, _ = _make_jpeg_bytes(60 + i, 50 + i, seed=i)
+        hdr = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack(hdr, jpg))
+    w.close()
+    return rec, idx
+
+
+def test_imdecode_roundtrip():
+    jpg, arr = _make_jpeg_bytes()
+    out = image.imdecode(jpg)
+    assert isinstance(out, mx.nd.NDArray)
+    assert out.shape == arr.shape
+    # JPEG is lossy; mean abs error should still be small
+    assert np.abs(out.asnumpy().astype(np.float32) -
+                  arr.astype(np.float32)).mean() < 12.0
+
+
+def test_imread(tmp_path):
+    jpg, arr = _make_jpeg_bytes()
+    p = tmp_path / "x.jpg"
+    p.write_bytes(jpg)
+    out = image.imread(str(p))
+    assert out.shape == arr.shape
+
+
+def test_resize_short_and_crops():
+    jpg, _ = _make_jpeg_bytes(80, 60)
+    img = image.imdecode(jpg)
+    r = image.resize_short(img, 40)
+    assert min(r.shape[:2]) == 40
+    c, roi = image.center_crop(img, (32, 24))
+    assert c.shape == (24, 32, 3)
+    assert roi[2] == 32 and roi[3] == 24
+    rc, _ = image.random_crop(img, (32, 24))
+    assert rc.shape == (24, 32, 3)
+    rsc, _ = image.random_size_crop(img, (32, 24), 0.3, (0.7, 1.4))
+    assert rsc.shape == (24, 32, 3)
+    f = image.fixed_crop(img, 5, 5, 20, 20, (16, 16))
+    assert f.shape == (16, 16, 3)
+
+
+def test_color_normalize():
+    x = np.full((4, 4, 3), 100.0, np.float32)
+    out = image.color_normalize(x, np.array([50.0, 50.0, 50.0]),
+                                np.array([25.0, 25.0, 25.0]))
+    assert np.allclose(out, 2.0)
+
+
+def test_augmenters_run_and_dump():
+    jpg, _ = _make_jpeg_bytes(64, 64)
+    img = image.imdecode(jpg)
+    augs = image.CreateAugmenter((3, 32, 32), resize=40, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1, pca_noise=0.05,
+                                 rand_gray=0.2)
+    out = img
+    for a in augs:
+        out = a(out)
+        assert a.dumps() is not None
+    arr = out.asnumpy() if isinstance(out, mx.nd.NDArray) else out
+    assert arr.shape == (32, 32, 3)
+    assert arr.dtype == np.float32
+
+
+def test_image_iter_rec(rec_file):
+    rec, idx = rec_file
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=rec, path_imgidx=idx, shuffle=True)
+    batches = list(it)
+    assert len(batches) == 2
+    b = batches[0]
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert b.label[0].shape == (4,)
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_image_iter_imglist(tmp_path):
+    paths = []
+    for i in range(5):
+        jpg, _ = _make_jpeg_bytes(seed=i)
+        p = tmp_path / ("img%d.jpg" % i)
+        p.write_bytes(jpg)
+        paths.append([float(i), "img%d.jpg" % i])
+    it = image.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                         imglist=paths, path_root=str(tmp_path))
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 24, 24)
+
+
+def _det_label(n_obj, seed=0):
+    """Flat det label: header A=2+1 extra? use A=3, B=5."""
+    rng = np.random.RandomState(seed)
+    objs = []
+    for _ in range(n_obj):
+        x0, y0 = rng.uniform(0, 0.5, 2)
+        w, h = rng.uniform(0.2, 0.45, 2)
+        cls = float(rng.randint(0, 3))
+        objs.extend([cls, x0, y0, min(1.0, x0 + w), min(1.0, y0 + h)])
+    return np.array([3.0, 5.0, 0.0] + objs, np.float32)
+
+
+@pytest.fixture(scope="module")
+def det_rec_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("det")
+    rec = str(d / "det.rec")
+    idx = str(d / "det.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(6):
+        jpg, _ = _make_jpeg_bytes(60, 60, seed=i)
+        hdr = recordio.IRHeader(0, _det_label(1 + i % 3, seed=i), i, 0)
+        w.write_idx(i, recordio.pack(hdr, jpg))
+    w.close()
+    return rec, idx
+
+
+def test_image_det_iter(det_rec_file):
+    rec, idx = det_rec_file
+    it = image.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                            path_imgrec=rec, path_imgidx=idx)
+    b = next(it)
+    assert b.data[0].shape == (3, 3, 32, 32)
+    lab = b.label[0].asnumpy()
+    assert lab.shape[0] == 3 and lab.shape[2] == 5
+    # at least one valid object per sample; pad rows are -1
+    assert (lab[:, 0, 0] > -1).all()
+
+
+def test_det_augmenters(det_rec_file):
+    jpg, _ = _make_jpeg_bytes(64, 64)
+    img = image.imdecode(jpg)
+    label = np.full((4, 5), -1.0, np.float32)
+    label[0] = [1.0, 0.2, 0.2, 0.8, 0.8]
+    augs = image.CreateDetAugmenter((3, 32, 32), rand_crop=1.0,
+                                    rand_pad=1.0, rand_mirror=True,
+                                    brightness=0.1, mean=True, std=True)
+    out, lab = img, label
+    for a in augs:
+        out, lab = a(out, lab)
+        assert a.dumps() is not None
+    arr = out.asnumpy() if isinstance(out, mx.nd.NDArray) else out
+    assert arr.shape == (32, 32, 3)
+    valid = lab[lab[:, 0] > -1]
+    assert valid.shape[0] >= 1
+    assert (valid[:, 1:5] >= -1e-5).all() and (valid[:, 1:5] <= 1 + 1e-5).all()
+
+
+def test_det_flip_boxes():
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    img = np.zeros((10, 10, 3), np.float32)
+    label = np.array([[0.0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    _, out = aug(img, label.copy())
+    assert np.allclose(out[0], [0.0, 0.6, 0.2, 0.9, 0.6])
