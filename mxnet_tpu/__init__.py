@@ -41,6 +41,7 @@ from . import module as mod
 from .module import Module
 from . import recordio
 from . import image
+from . import rnn
 from . import gluon
 
 __version__ = "0.1.0"

@@ -137,8 +137,12 @@ def _rnn(data, parameters, state, state_cell=None, rng=None, state_size=0,
         outs = []
         for di, (W, R, bW, bR) in enumerate(dirs):
             idx = li * D + di
-            h0 = state[idx]
-            c0 = state_cell[idx] if mode == "lstm" else None
+            # begin states may carry batch dim 1 (symbolic zeros from
+            # rnn_cell.begin_state) — broadcast up so the scan carry shape
+            # is fixed at (N, H)
+            h0 = jnp.broadcast_to(state[idx], (N, H))
+            c0 = (jnp.broadcast_to(state_cell[idx], (N, H))
+                  if mode == "lstm" else None)
             carry, ys = _run_direction(x, W, R, bW, bR, h0, c0, mode, H,
                                        reverse=(di == 1))
             h_states.append(carry[0])

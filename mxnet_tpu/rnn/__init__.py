@@ -1,0 +1,16 @@
+"""Legacy symbolic RNN API (mx.rnn).
+
+Port of /root/reference/python/mxnet/rnn/: symbol-building recurrent cells
+(RNN/LSTM/GRU, fused, stacked, bidirectional, modifier, conv cells), the
+bucketed sentence iterator, and fused-weight checkpoint helpers.  The
+Gluon layer API lives in mxnet_tpu.gluon.rnn; this package serves the
+Symbol/Module path (BucketingModule PTB training, BASELINE config #3).
+"""
+from .rnn_cell import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+from . import rnn_cell
+from . import rnn
+from . import io
+
+__all__ = rnn_cell.__all__ + rnn.__all__ + io.__all__

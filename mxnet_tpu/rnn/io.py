@@ -1,0 +1,159 @@
+"""Bucketed sequence IO (mx.rnn.io).
+
+Port of /root/reference/python/mxnet/rnn/io.py: ``encode_sentences`` and
+``BucketSentenceIter`` — sentences grouped into length buckets, each batch
+drawn from one bucket and padded to that bucket's length.  Pairs with
+BucketingModule: a TPU-natural fit because each bucket is one static-shape
+XLA program in the jit cache.
+"""
+from __future__ import annotations
+
+import bisect
+import random as _pyrandom
+
+import numpy as _np
+
+from ..ndarray.ndarray import array
+from ..io import DataIter, DataBatch, DataDesc
+
+__all__ = ["encode_sentences", "BucketSentenceIter"]
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0):
+    """Map lists of words to lists of int ids, building/extending vocab
+    (reference io.py:30)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                assert new_vocab, "Unknown token %s" % word
+                if idx == invalid_label:
+                    idx += 1
+                vocab[word] = idx
+                idx += 1
+            coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed iterator over encoded sentences (reference io.py:78).
+
+    Each batch comes from one bucket; ``bucket_key`` is the bucket's
+    sequence length so BucketingModule can select the matching jitted
+    executor.  Labels are the data shifted one step left (next-token).
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NT"):
+        super().__init__(batch_size)
+        if not buckets:
+            counts = _np.bincount([len(s) for s in sentences])
+            buckets = [i for i, j in enumerate(counts)
+                       if j >= batch_size]
+            if not buckets:
+                buckets = [max(len(s) for s in sentences)]
+        buckets.sort()
+        ndiscard = 0
+        self.data = [[] for _ in buckets]
+        for sent in sentences:
+            buck = bisect.bisect_left(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = _np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(sent)] = sent
+            self.data[buck].append(buff)
+        # empty buckets become properly-shaped (0, L) arrays so the
+        # label-shift in reset() stays valid
+        self.data = [_np.asarray(rows, dtype=dtype) if rows
+                     else _np.zeros((0, blen), dtype=dtype)
+                     for rows, blen in zip(self.data, buckets)]
+        if ndiscard:
+            import logging
+            logging.warning("discarded %d sentences longer than the largest "
+                            "bucket.", ndiscard)
+
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.invalid_label = invalid_label
+        self.nddata = []
+        self.ndlabel = []
+        self.major_axis = layout.find("N")
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+
+        if self.major_axis == 0:
+            self.provide_data = [DataDesc(
+                name=self.data_name,
+                shape=(batch_size, self.default_bucket_key),
+                layout=layout)]
+            self.provide_label = [DataDesc(
+                name=self.label_name,
+                shape=(batch_size, self.default_bucket_key),
+                layout=layout)]
+        elif self.major_axis == 1:
+            self.provide_data = [DataDesc(
+                name=self.data_name,
+                shape=(self.default_bucket_key, batch_size),
+                layout=layout)]
+            self.provide_label = [DataDesc(
+                name=self.label_name,
+                shape=(self.default_bucket_key, batch_size),
+                layout=layout)]
+        else:
+            raise ValueError("Invalid layout %s: Must by NT (batch major) "
+                             "or TN (time major)" % layout)
+
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend([(i, j) for j in
+                             range(0, len(buck) - batch_size + 1,
+                                   batch_size)])
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        _pyrandom.shuffle(self.idx)
+        for buck in self.data:
+            _np.random.shuffle(buck)
+        self.nddata = []
+        self.ndlabel = []
+        for buck in self.data:
+            label = _np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(buck)
+            self.ndlabel.append(label)
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        if self.major_axis == 1:
+            data = self.nddata[i][j:j + self.batch_size].T
+            label = self.ndlabel[i][j:j + self.batch_size].T
+        else:
+            data = self.nddata[i][j:j + self.batch_size]
+            label = self.ndlabel[i][j:j + self.batch_size]
+        return DataBatch(
+            [array(data)], [array(label)], pad=0,
+            bucket_key=self.buckets[i],
+            provide_data=[DataDesc(name=self.data_name, shape=data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(name=self.label_name, shape=label.shape,
+                                    layout=self.layout)])

@@ -1,0 +1,56 @@
+"""RNN checkpoint helpers + deprecated unroll wrapper (mx.rnn.rnn).
+
+Port of /root/reference/python/mxnet/rnn/rnn.py: checkpoints store
+*unfused* (per-gate) weights so fused and unfused cells interoperate.
+"""
+from __future__ import annotations
+
+from .. import model
+from .rnn_cell import BaseRNNCell
+
+__all__ = ["rnn_unroll", "save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC"):
+    """Deprecated: use cell.unroll (reference rnn.py:26)."""
+    import warnings
+    warnings.warn("rnn_unroll is deprecated. Please call cell.unroll "
+                  "directly.", DeprecationWarning)
+    return cell.unroll(length=length, inputs=inputs,
+                       begin_state=begin_state, layout=layout)
+
+
+def _normalize_cells(cells):
+    if isinstance(cells, BaseRNNCell):
+        return [cells]
+    return cells
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """Save checkpoint with unfused weights (reference rnn.py:32)."""
+    for cell in _normalize_cells(cells):
+        arg_params = cell.unpack_weights(arg_params)
+    model.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load checkpoint and re-pack weights for the given cells
+    (reference rnn.py:62)."""
+    sym, arg, aux = model.load_checkpoint(prefix, epoch)
+    for cell in _normalize_cells(cells):
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback wrapping save_rnn_checkpoint
+    (reference rnn.py:97)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback

@@ -1,0 +1,978 @@
+"""Symbolic RNN cells (mx.rnn.*Cell).
+
+Port of /root/reference/python/mxnet/rnn/rnn_cell.py (1,423 L): cells build
+Symbol graphs step-by-step (``cell(inputs, states)``) or unrolled
+(``cell.unroll``).  The fused path lowers to the TPU-native ``RNN`` op
+(ops/rnn.py: one hoisted input matmul + lax.scan recurrence) instead of
+cuDNN.  Weight naming matches the reference ({prefix}i2h_weight, ...,
+fused '{prefix}parameters') so checkpoints and unpack/pack round-trip.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from .. import symbol
+from ..symbol import Symbol
+from .. import ndarray as nd
+from ..base import MXNetError
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "DropoutCell",
+           "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell", "ConvRNNCell", "ConvLSTMCell",
+           "ConvGRUCell"]
+
+
+class RNNParams(object):
+    """Container for cell weights; ``get`` caches Variables by name
+    (reference rnn_cell.py:78)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell(object):
+    """Abstract cell (reference rnn_cell.py:108).
+
+    Subclasses define state_info, num_gates naming, and __call__.
+    """
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        """Reset the step counter before building a new unrolled graph."""
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        """One step: returns (output_symbol, new_states)."""
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        """List of {'shape': (0, H), '__layout__': 'NC'} dicts."""
+        raise NotImplementedError()
+
+    @property
+    def state_shape(self):
+        return [info["shape"] for info in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        """Initial-state symbols.  Unknown batch dims (0) become 1 and are
+        broadcast at run time — our XLA lowerings broadcast (1, H) states
+        over the batch (the reference relied on nnvm's bidirectional shape
+        inference for the 0 dims)."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called " \
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if info is not None:
+                info = dict(info)
+                shape = tuple(1 if d == 0 else d
+                              for d in info.pop("shape", ()))
+                info.pop("__layout__", None)
+                state = func(name="%sbegin_state_%d" % (self._prefix,
+                                                        self._init_counter),
+                             shape=shape, **info, **kwargs)
+            else:
+                state = func(name="%sbegin_state_%d" % (self._prefix,
+                                                        self._init_counter),
+                             **kwargs)
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Split packed gate weights into per-gate arrays
+        (reference rnn_cell.py:208)."""
+        args = args.copy()
+        if not self._gate_names:
+            return args
+        h = self._num_hidden
+        for group_name in ["i2h", "h2h"]:
+            weight = args.pop("%s%s_weight" % (self._prefix, group_name))
+            bias = args.pop("%s%s_bias" % (self._prefix, group_name))
+            for j, gate in enumerate(self._gate_names):
+                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
+                args[wname] = weight[j * h:(j + 1) * h].copy()
+                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
+                args[bname] = bias[j * h:(j + 1) * h].copy()
+        return args
+
+    def pack_weights(self, args):
+        """Inverse of unpack_weights (reference rnn_cell.py:230)."""
+        args = args.copy()
+        if not self._gate_names:
+            return args
+        for group_name in ["i2h", "h2h"]:
+            weight = []
+            bias = []
+            for gate in self._gate_names:
+                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
+                weight.append(args.pop(wname))
+                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
+                bias.append(args.pop(bname))
+            args["%s%s_weight" % (self._prefix, group_name)] = \
+                nd.concatenate(weight)
+            args["%s%s_bias" % (self._prefix, group_name)] = \
+                nd.concatenate(bias)
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll the cell `length` steps (reference rnn_cell.py:248).
+
+        Returns (outputs, states): outputs is a list of step symbols or,
+        when merge_outputs, a single (N, T, C)/(T, N, C) symbol.
+        """
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """List-of-steps <-> merged tensor conversion
+    (reference rnn_cell.py:51)."""
+    assert inputs is not None, \
+        "unroll(inputs=None) is not supported. Needs input symbols."
+    axis = layout.find("T")
+    in_axis = in_layout.find("T") if in_layout is not None else axis
+    if isinstance(inputs, Symbol):
+        if merge is False:
+            assert len(inputs.list_outputs()) == 1, \
+                "unroll doesn't allow grouped symbol as input."
+            inputs = symbol.SliceChannel(inputs, axis=in_axis,
+                                         num_outputs=length,
+                                         squeeze_axis=1)
+            inputs = [inputs[i] for i in range(length)]
+        elif axis != in_axis:
+            inputs = symbol.swapaxes(inputs, dim1=axis, dim2=in_axis)
+    else:
+        assert length is None or len(inputs) == length
+        if merge is True:
+            inputs = [symbol.expand_dims(i, axis=axis) for i in inputs]
+            inputs = symbol.Concat(*inputs, dim=axis)
+    return inputs, axis
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla Elman cell: h' = act(W x + R h + b)
+    (reference rnn_cell.py:362)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self._params.get("i2h_weight")
+        self._iB = self._params.get("i2h_bias")
+        self._hW = self._params.get("h2h_weight")
+        self._hB = self._params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
+                                    bias=self._iB,
+                                    num_hidden=self._num_hidden,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
+                                    bias=self._hB,
+                                    num_hidden=self._num_hidden,
+                                    name="%sh2h" % name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell, gate order i,f,g,o (reference rnn_cell.py:408)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self._params.get("i2h_weight")
+        self._hW = self._params.get("h2h_weight")
+        self._iB = self._params.get(
+            "i2h_bias",
+            init=LSTMBiasInit(forget_bias) if forget_bias else None)
+        self._hB = self._params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
+                                    bias=self._iB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
+                                    bias=self._hB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%sh2h" % name)
+        gates = i2h + h2h
+        slice_gates = symbol.SliceChannel(gates, num_outputs=4,
+                                          name="%sslice" % name)
+        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",
+                                    name="%si" % name)
+        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid",
+                                        name="%sf" % name)
+        in_transform = symbol.Activation(slice_gates[2], act_type="tanh",
+                                         name="%sc" % name)
+        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid",
+                                     name="%so" % name)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * symbol.Activation(next_c, act_type="tanh",
+                                              name="%sstate" % name)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell, gate order r,z,h (reference rnn_cell.py:469)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self._params.get("i2h_weight")
+        self._iB = self._params.get("i2h_bias")
+        self._hW = self._params.get("h2h_weight")
+        self._hB = self._params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        seq_idx = self._counter
+        name = "%st%d_" % (self._prefix, seq_idx)
+        prev_state_h = states[0]
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
+                                    bias=self._iB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=prev_state_h, weight=self._hW,
+                                    bias=self._hB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%sh2h" % name)
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(
+            i2h, num_outputs=3, name="%si2h_slice" % name)
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(
+            h2h, num_outputs=3, name="%sh2h_slice" % name)
+        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
+                                       name="%sr_act" % name)
+        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
+                                        name="%sz_act" % name)
+        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h,
+                                       act_type="tanh",
+                                       name="%sh_act" % name)
+        next_h = next_h_tmp + update_gate * (prev_state_h - next_h_tmp)
+        return next_h, [next_h]
+
+
+def LSTMBiasInit(forget_bias):
+    """Initializer descriptor for LSTM i2h bias (forget gate = forget_bias).
+    Resolved by mxnet_tpu.initializer at init_params time."""
+    from ..initializer import LSTMBias
+    return LSTMBias(forget_bias)
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN over the native ``RNN`` op
+    (reference rnn_cell.py:536 — there cuDNN, here lax.scan, ops/rnn.py)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._forget_bias = forget_bias
+        self._directions = ["l", "r"] if bidirectional else ["l"]
+        self._parameter = self._params.get("parameters")
+
+    @property
+    def state_info(self):
+        b = self._bidirectional + 1
+        n = (self._mode == "lstm") + 1
+        return [{"shape": (b * self._num_layers, 0, self._num_hidden),
+                 "__layout__": "LNC"} for _ in range(n)]
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": [""], "rnn_tanh": [""],
+                "lstm": ["_i", "_f", "_c", "_o"],
+                "gru": ["_r", "_z", "_o"]}[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def _slice_weights(self, arr, li, lh):
+        """Slice the packed blob into the per-layer/direction/gate dict.
+        Layout matches ops/rnn.py:_unpack: per layer, per direction:
+        W(G*H, in), R(G*H, H), bW(G*H), bR(G*H)."""
+        args = {}
+        gate_names = self._gate_names
+        directions = self._directions
+        b = len(directions)
+        g = len(gate_names)
+        h = self._num_hidden
+        arr = arr.asnumpy() if isinstance(arr, nd.NDArray) else _np.asarray(arr)
+        p = 0
+        for layer in range(self._num_layers):
+            ni = li if layer == 0 else lh * b
+            for direction in directions:
+                pf = "%s%s%d_" % (self._prefix, direction, layer)
+                W = arr[p:p + g * h * ni].reshape((g * h, ni))
+                p += g * h * ni
+                R = arr[p:p + g * h * h].reshape((g * h, h))
+                p += g * h * h
+                bW = arr[p:p + g * h]
+                p += g * h
+                bR = arr[p:p + g * h]
+                p += g * h
+                for j, gate in enumerate(gate_names):
+                    args["%si2h%s_weight" % (pf, gate)] = \
+                        nd.array(W[j * h:(j + 1) * h].copy())
+                    args["%sh2h%s_weight" % (pf, gate)] = \
+                        nd.array(R[j * h:(j + 1) * h].copy())
+                    args["%si2h%s_bias" % (pf, gate)] = \
+                        nd.array(bW[j * h:(j + 1) * h].copy())
+                    args["%sh2h%s_bias" % (pf, gate)] = \
+                        nd.array(bR[j * h:(j + 1) * h].copy())
+        assert p == arr.size, "Invalid parameters size for FusedRNNCell"
+        return args
+
+    def unpack_weights(self, args):
+        args = args.copy()
+        arr = args.pop(self._parameter.name)
+        b = len(self._directions)
+        m = self._num_gates
+        h = self._num_hidden
+        num_input = int(arr.size // b // h // m -
+                        (self._num_layers - 1) * (h + b * h + 2) - h - 2)
+        args.update(self._slice_weights(arr, num_input, h))
+        return args
+
+    def pack_weights(self, args):
+        args = args.copy()
+        b = len(self._directions)
+        g = self._gate_names
+        h = self._num_hidden
+        pieces = []
+        for layer in range(self._num_layers):
+            for direction in self._directions:
+                pf = "%s%s%d_" % (self._prefix, direction, layer)
+                for group in ["i2h", "h2h"]:
+                    ws = [args.pop("%s%s%s_weight" % (pf, group, gate))
+                          for gate in g]
+                    pieces.append(_np.concatenate(
+                        [w.asnumpy() if isinstance(w, nd.NDArray)
+                         else _np.asarray(w) for w in ws]).ravel())
+                for group in ["i2h", "h2h"]:
+                    bs = [args.pop("%s%s%s_bias" % (pf, group, gate))
+                          for gate in g]
+                    pieces.append(_np.concatenate(
+                        [x.asnumpy() if isinstance(x, nd.NDArray)
+                         else _np.asarray(x) for x in bs]).ravel())
+        args[self._parameter.name] = nd.array(_np.concatenate(pieces))
+        return args
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "FusedRNNCell cannot be stepped. Please use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, True)
+        if axis == 1:  # NTC -> TNC for the RNN op
+            inputs = symbol.swapaxes(inputs, dim1=0, dim2=1)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        if self._mode == "lstm":
+            states = {"state": states[0], "state_cell": states[1]}
+        else:
+            states = {"state": states[0]}
+        rnn = symbol.RNN(data=inputs, parameters=self._parameter,
+                         state_size=self._num_hidden,
+                         num_layers=self._num_layers,
+                         bidirectional=self._bidirectional,
+                         p=self._dropout,
+                         state_outputs=self._get_next_state,
+                         mode=self._mode, name=self._prefix + "rnn",
+                         **states)
+        if not self._get_next_state:
+            outputs, states = rnn, []
+        elif self._mode == "lstm":
+            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            outputs, states = rnn[0], [rnn[1]]
+        if axis == 1:
+            outputs = symbol.swapaxes(outputs, dim1=0, dim2=1)
+            out_layout = "NTC"
+        else:
+            out_layout = "TNC"
+        if merge_outputs is False:
+            outputs, _ = _normalize_sequence(length, outputs, layout, False,
+                                             in_layout=out_layout)
+        return outputs, states
+
+    def unfuse(self):
+        """Equivalent SequentialRNNCell of unfused cells
+        (reference rnn_cell.py:703)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda cell_prefix: RNNCell(
+                self._num_hidden, activation="relu", prefix=cell_prefix),
+            "rnn_tanh": lambda cell_prefix: RNNCell(
+                self._num_hidden, activation="tanh", prefix=cell_prefix),
+            "lstm": lambda cell_prefix: LSTMCell(
+                self._num_hidden, prefix=cell_prefix),
+            "gru": lambda cell_prefix: GRUCell(
+                self._num_hidden, prefix=cell_prefix)}[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell("%sl%d_" % (self._prefix, i)),
+                    get_cell("%sr%d_" % (self._prefix, i)),
+                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+            else:
+                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix="%s_dropout%d_" % (self._prefix,
+                                                                i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells run in sequence per step (reference rnn_cell.py:748)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, \
+                "Either specify params for SequentialRNNCell or child cells," \
+                " not both."
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return _cells_state_info(self._cells)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def unpack_weights(self, args):
+        return _cells_unpack_weights(self._cells, args)
+
+    def pack_weights(self, args):
+        return _cells_pack_weights(self._cells, args)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info)
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        num_cells = len(self._cells)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            next_states.extend(states)
+        return inputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout on the step outputs (reference rnn_cell.py:827)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert isinstance(dropout, (int, float)), \
+            "dropout probability must be a number"
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout,
+                                        merge_outputs)
+        if isinstance(inputs, Symbol):
+            return self(inputs, [])
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs)
+
+
+class ModifierCell(BaseRNNCell):
+    """Wraps a cell to modify its behavior; shares its params
+    (reference rnn_cell.py:867)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization: randomly keep previous states
+    (reference rnn_cell.py:909)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), \
+            "FusedRNNCell doesn't support zoneout. Please unfuse first."
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout since it doesn't " \
+            "support step. Please add ZoneoutCell to the cells underneath " \
+            "instead."
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
+                                     self.zoneout_states)
+        next_output, next_states = cell(inputs, states)
+
+        def mask(p, like):
+            # Dropout(ones)*(1-p) is exactly Bernoulli(keep=1-p) in train
+            # mode and the (1-p) expectation in inference mode
+            return symbol.Dropout(symbol.ones_like(like), p=p) * (1.0 - p)
+
+        prev_output = self.prev_output if self.prev_output is not None \
+            else symbol.zeros(shape=(1, 1))
+        output = (prev_output + mask(p_outputs, next_output) *
+                  (next_output - prev_output)) if p_outputs != 0.0 \
+            else next_output
+        new_states = ([old + mask(p_states, new) * (new - old)
+                       for old, new in zip(states, next_states)]
+                      if p_states != 0.0 else next_states)
+        self.prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """output = base(x) + x (reference rnn_cell.py:957)."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = symbol.elemwise_add(output, inputs,
+                                     name="%s_plus_residual" % output.name)
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs)
+        self.base_cell._modified = True
+        merge_outputs = isinstance(outputs, Symbol) if merge_outputs is None \
+            else merge_outputs
+        inputs, _ = _normalize_sequence(length, inputs, layout,
+                                        merge_outputs)
+        if merge_outputs:
+            outputs = symbol.elemwise_add(outputs, inputs)
+        else:
+            outputs = [symbol.elemwise_add(i, j)
+                       for i, j in zip(outputs, inputs)]
+        return outputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Forward + backward cells over the sequence (reference :998)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
+        self._output_prefix = output_prefix
+        self._override_cell_params = params is not None
+        if self._override_cell_params:
+            assert l_cell._own_params and r_cell._own_params, \
+                "Either specify params for BidirectionalCell or child " \
+                "cells, not both."
+            l_cell.params._params.update(self.params._params)
+            r_cell.params._params.update(self.params._params)
+        self.params._params.update(l_cell.params._params)
+        self.params._params.update(r_cell.params._params)
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        return _cells_unpack_weights(self._cells, args)
+
+    def pack_weights(self, args):
+        return _cells_pack_weights(self._cells, args)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def state_info(self):
+        return _cells_state_info(self._cells)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        l_cell, r_cell = self._cells
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[:len(l_cell.state_info)], layout=layout,
+            merge_outputs=merge_outputs)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[len(l_cell.state_info):], layout=layout,
+            merge_outputs=merge_outputs)
+        if merge_outputs is None:
+            merge_outputs = (isinstance(l_outputs, Symbol) and
+                             isinstance(r_outputs, Symbol))
+            if not merge_outputs:
+                if isinstance(l_outputs, Symbol):
+                    l_outputs, _ = _normalize_sequence(length, l_outputs,
+                                                       layout, False)
+                if isinstance(r_outputs, Symbol):
+                    r_outputs, _ = _normalize_sequence(length, r_outputs,
+                                                       layout, False)
+        if merge_outputs:
+            r_outputs = symbol.reverse(r_outputs, axis=axis)
+            outputs = symbol.Concat(l_outputs, r_outputs, dim=2,
+                                    name="%sout" % self._output_prefix)
+        else:
+            outputs = [symbol.Concat(l_o, r_o, dim=1,
+                                     name="%st%d" % (self._output_prefix, i))
+                       for i, (l_o, r_o) in enumerate(
+                           zip(l_outputs, reversed(r_outputs)))]
+        states = l_states + r_states
+        return outputs, states
+
+
+def _cells_state_info(cells):
+    return sum([c.state_info for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _cells_unpack_weights(cells, args):
+    for cell in cells:
+        args = cell.unpack_weights(args)
+    return args
+
+
+def _cells_pack_weights(cells, args):
+    for cell in cells:
+        args = cell.pack_weights(args)
+    return args
+
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Convolutional recurrent base (reference rnn_cell.py:1094):
+    states and inputs are (N, C, H, W); i2h/h2h are Convolutions."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                 i2h_kernel, i2h_stride, i2h_pad, i2h_dilate, activation,
+                 prefix="", params=None, conv_layout="NCHW"):
+        super().__init__(prefix=prefix, params=params)
+        self._h2h_kernel = h2h_kernel
+        assert h2h_kernel[0] % 2 == 1 and h2h_kernel[1] % 2 == 1, \
+            "Only support odd numbers, got h2h_kernel= %s" % str(h2h_kernel)
+        self._h2h_pad = (h2h_dilate[0] * (h2h_kernel[0] - 1) // 2,
+                         h2h_dilate[1] * (h2h_kernel[1] - 1) // 2)
+        self._h2h_dilate = h2h_dilate
+        self._i2h_kernel = i2h_kernel
+        self._i2h_stride = i2h_stride
+        self._i2h_pad = i2h_pad
+        self._i2h_dilate = i2h_dilate
+        self._num_hidden = num_hidden
+        self._input_shape = input_shape
+        self._conv_layout = conv_layout
+        self._activation = activation
+        # infer state shape from the i2h conv geometry
+        data = symbol.Variable("tmp_for_shape_infer")
+        self._state_shape = symbol.Convolution(
+            data=data, num_filter=self._num_hidden,
+            kernel=self._i2h_kernel, stride=self._i2h_stride,
+            pad=self._i2h_pad, dilate=self._i2h_dilate).infer_shape(
+                tmp_for_shape_infer=(1,) + tuple(input_shape))[1][0]
+        self._iW = self._params.get("i2h_weight")
+        self._hW = self._params.get("h2h_weight")
+        self._iB = self._params.get("i2h_bias")
+        self._hB = self._params.get("h2h_bias")
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    # number of recurrent states; two-state cells (LSTM variants) override
+    _num_states = 1
+
+    @property
+    def state_info(self):
+        return [{"shape": self._state_shape, "__layout__": self._conv_layout}
+                for _ in range(self._num_states)]
+
+    def _conv_forward(self, inputs, states, name):
+        i2h = symbol.Convolution(data=inputs, num_filter=self._num_hidden *
+                                 self._num_gates,
+                                 kernel=self._i2h_kernel,
+                                 stride=self._i2h_stride,
+                                 pad=self._i2h_pad, dilate=self._i2h_dilate,
+                                 weight=self._iW, bias=self._iB,
+                                 name="%si2h" % name)
+        h2h = symbol.Convolution(data=states[0], num_filter=self._num_hidden *
+                                 self._num_gates,
+                                 kernel=self._h2h_kernel,
+                                 dilate=self._h2h_dilate,
+                                 pad=self._h2h_pad,
+                                 weight=self._hW, bias=self._hB,
+                                 name="%sh2h" % name)
+        return i2h, h2h
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """Conv Elman cell (reference rnn_cell.py:1176)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvRNN_", params=None, conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix=prefix, params=params,
+                         conv_layout=conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """Conv LSTM (Shi et al. 2015) (reference rnn_cell.py:1249)."""
+
+    _num_states = 2
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvLSTM_", params=None, forget_bias=1.0,
+                 conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix=prefix, params=params,
+                         conv_layout=conv_layout)
+        self._forget_bias = forget_bias
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        gates = i2h + h2h
+        slice_gates = symbol.SliceChannel(
+            gates, num_outputs=4,
+            axis=self._conv_layout.find("C"), name="%sslice" % name)
+        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",
+                                    name="%si" % name)
+        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid",
+                                        name="%sf" % name)
+        in_transform = self._get_activation(slice_gates[2], self._activation,
+                                            name="%sc" % name)
+        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid",
+                                     name="%so" % name)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(next_c, self._activation,
+                                                 name="%sstate" % name)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """Conv GRU (reference rnn_cell.py:1339)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvGRU_", params=None, conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix=prefix, params=params,
+                         conv_layout=conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        seq_idx = self._counter
+        name = "%st%d_" % (self._prefix, seq_idx)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(
+            i2h, num_outputs=3, name="%si2h_slice" % name,
+            axis=self._conv_layout.find("C"))
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(
+            h2h, num_outputs=3, name="%sh2h_slice" % name,
+            axis=self._conv_layout.find("C"))
+        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
+                                       name="%sr_act" % name)
+        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
+                                        name="%sz_act" % name)
+        next_h_tmp = self._get_activation(i2h + reset_gate * h2h,
+                                          self._activation,
+                                          name="%sh_act" % name)
+        next_h = next_h_tmp + update_gate * (states[0] - next_h_tmp)
+        return next_h, [next_h]

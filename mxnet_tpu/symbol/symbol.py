@@ -582,6 +582,12 @@ def _apply_op(op, name, sym_args, params, **sym_kwargs):
     name = NameManager.current().get(name, hint)
     attrs = AttrScope.current().get(None)
 
+    # variadic ops (Concat, add_n, stack, ...): fill num_args from the
+    # positional inputs, as the reference's generated wrappers do
+    if "num_args" in op.param_defaults and "num_args" not in params \
+            and len(sym_args) > 0:
+        params = dict(params, num_args=len(sym_args))
+
     arg_names = op.arg_names(params)
     aux_names = op.aux_names(params)
 

@@ -1,0 +1,195 @@
+"""mx.rnn tests — cells, unroll, fused/unfused consistency, bucketing IO.
+
+Mirrors the reference's tests/python/unittest/test_rnn.py shapes.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _bind_run(outputs, data_shapes, seed=0):
+    """Bind a symbol with random inputs, run forward, return outputs."""
+    rng = np.random.RandomState(seed)
+    exe = outputs.simple_bind(ctx=mx.cpu(), **data_shapes)
+    for name, arr in exe.arg_dict.items():
+        if name not in data_shapes:
+            arr[:] = rng.uniform(-0.1, 0.1, arr.shape)
+        else:
+            arr[:] = rng.uniform(-1, 1, arr.shape)
+    return exe.forward()
+
+
+def test_rnn_cell_unroll():
+    cell = mx.rnn.RNNCell(10, prefix="rnn_")
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    args, outs, _ = outputs.infer_shape(t0_data=(10, 50), t1_data=(10, 50),
+                                        t2_data=(10, 50))
+    assert outs == [(10, 10), (10, 10), (10, 10)]
+    res = _bind_run(outputs, dict(t0_data=(10, 50), t1_data=(10, 50),
+                                  t2_data=(10, 50)))
+    assert res[0].shape == (10, 10)
+
+
+def test_lstm_cell_unroll():
+    cell = mx.rnn.LSTMCell(10, prefix="lstm_", forget_bias=1.0)
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == [
+        "lstm_h2h_bias", "lstm_h2h_weight", "lstm_i2h_bias",
+        "lstm_i2h_weight"]
+    _, outs, _ = outputs.infer_shape(t0_data=(10, 50), t1_data=(10, 50),
+                                     t2_data=(10, 50))
+    assert outs == [(10, 10), (10, 10), (10, 10)]
+    assert len(states) == 2
+
+
+def test_gru_cell_unroll():
+    cell = mx.rnn.GRUCell(10, prefix="gru_")
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(t0_data=(10, 50), t1_data=(10, 50),
+                                     t2_data=(10, 50))
+    assert outs == [(10, 10), (10, 10), (10, 10)]
+    res = _bind_run(outputs, dict(t0_data=(10, 50), t1_data=(10, 50),
+                                  t2_data=(10, 50)))
+    assert res[0].shape == (10, 10)
+
+
+def test_stacked_and_dropout():
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.LSTMCell(10, prefix="l0_"))
+    cell.add(mx.rnn.DropoutCell(0.3, prefix="dp_"))
+    cell.add(mx.rnn.LSTMCell(10, prefix="l1_"))
+    inputs = mx.sym.Variable("data")
+    outputs, states = cell.unroll(4, inputs, merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 4, 8))
+    assert outs == [(2, 4, 10)]
+    assert len(states) == 4
+
+
+def test_bidirectional_unroll():
+    cell = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(8, prefix="l_"),
+        mx.rnn.LSTMCell(8, prefix="r_"), output_prefix="bi_")
+    inputs = mx.sym.Variable("data")
+    outputs, states = cell.unroll(3, inputs, merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 5))
+    assert outs == [(2, 3, 16)]
+
+
+def test_residual_zoneout():
+    cell = mx.rnn.ResidualCell(mx.rnn.RNNCell(10, prefix="res_"))
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(2)]
+    outputs, _ = cell.unroll(2, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(t0_data=(5, 10), t1_data=(5, 10))
+    assert outs == [(5, 10), (5, 10)]
+
+    zcell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(10, prefix="zo_"),
+                               zoneout_outputs=0.3, zoneout_states=0.2)
+    inputs = [mx.sym.Variable("zt%d_data" % i) for i in range(2)]
+    outputs, _ = zcell.unroll(2, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(zt0_data=(5, 10), zt1_data=(5, 10))
+    assert outs == [(5, 10), (5, 10)]
+
+
+def test_fused_unroll_shapes():
+    cell = mx.rnn.FusedRNNCell(16, num_layers=2, mode="lstm",
+                               bidirectional=True, get_next_state=True,
+                               prefix="f_")
+    inputs = mx.sym.Variable("data")
+    outputs, states = cell.unroll(5, inputs, layout="NTC",
+                                  merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(3, 5, 12))
+    assert outs == [(3, 5, 32)]
+    assert len(states) == 2
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "rnn_relu", "lstm", "gru"])
+def test_fused_vs_unfused_consistency(mode):
+    """Fused RNN op output must match the unfused cell stack on the same
+    (unpacked) weights."""
+    T, N, I, H = 4, 3, 5, 6
+    fused = mx.rnn.FusedRNNCell(H, num_layers=2, mode=mode, prefix="f_")
+    data = mx.sym.Variable("data")
+    f_out, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+
+    exe = f_out.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    rng = np.random.RandomState(42)
+    x = rng.uniform(-1, 1, (N, T, I)).astype(np.float32)
+    params = rng.uniform(-0.5, 0.5,
+                         exe.arg_dict["f_parameters"].shape).astype(
+                             np.float32)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["f_parameters"][:] = params
+    f_res = exe.forward()[0].asnumpy()
+
+    stack = fused.unfuse()
+    u_out, _ = stack.unroll(T, data, layout="NTC", merge_outputs=True)
+    u_exe = u_out.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    unpacked = fused.unpack_weights({"f_parameters": mx.nd.array(params)})
+    cellwise = stack.pack_weights(unpacked)
+    for k, v in cellwise.items():
+        u_exe.arg_dict[k][:] = v
+    u_exe.arg_dict["data"][:] = x
+    u_res = u_exe.forward()[0].asnumpy()
+    assert np.allclose(f_res, u_res, rtol=1e-4, atol=1e-5), \
+        "fused/unfused mismatch %s" % mode
+
+
+def test_pack_unpack_roundtrip():
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="gru",
+                               bidirectional=True, prefix="g_")
+    size = 0
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    size = rnn_param_size(2, 4, 8, True, "gru")
+    params = {"g_parameters": mx.nd.array(
+        np.random.RandomState(0).uniform(-1, 1, (size,)))}
+    unpacked = cell.unpack_weights(params)
+    assert "g_parameters" not in unpacked
+    packed = cell.pack_weights(unpacked)
+    assert np.allclose(packed["g_parameters"].asnumpy(),
+                       params["g_parameters"].asnumpy())
+
+
+def test_encode_sentences_and_bucket_iter():
+    sentences = [["a", "b", "c"], ["b", "c"], ["a", "b", "c", "d"],
+                 ["d", "c"], ["a", "c"]] * 4
+    coded, vocab = mx.rnn.encode_sentences(sentences, start_label=1)
+    assert len(vocab) == 5  # 4 words + invalid_key
+    it = mx.rnn.BucketSentenceIter(coded, batch_size=4, buckets=[3, 5],
+                                   invalid_label=0)
+    batches = list(it)
+    assert len(batches) >= 2
+    for b in batches:
+        assert b.bucket_key in (3, 5)
+        assert b.data[0].shape == (4, b.bucket_key)
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        # label is data shifted left
+        assert np.allclose(l[:, :-1], d[:, 1:])
+    it.reset()
+    assert len(list(it)) == len(batches)
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    cell = mx.rnn.FusedRNNCell(6, num_layers=1, mode="lstm", prefix="c_")
+    data = mx.sym.Variable("data")
+    out, _ = cell.unroll(3, data, layout="NTC", merge_outputs=True)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    size = rnn_param_size(1, 4, 6, False, "lstm")
+    arg = {"c_parameters": mx.nd.array(
+        np.random.RandomState(1).uniform(-1, 1, (size,)))}
+    prefix = str(tmp_path / "model")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 1, out, arg, {})
+    sym2, arg2, _ = mx.rnn.load_rnn_checkpoint(cell, prefix, 1)
+    assert np.allclose(arg2["c_parameters"].asnumpy(),
+                       arg["c_parameters"].asnumpy(), atol=1e-6)
