@@ -10,6 +10,7 @@ from . import base
 from .base import MXNetError
 from .context import Context, current_context, cpu, gpu, tpu, num_gpus
 from . import ops
+from . import operator  # registers the Custom op before nd/sym populate
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
@@ -42,6 +43,11 @@ from .module import Module
 from . import recordio
 from . import image
 from . import rnn
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
 from . import gluon
 
 __version__ = "0.1.0"

@@ -55,6 +55,7 @@ class Executor:
                                                 self._ctx)
         self._group2ctx = group2ctx
         self._monitor_callback = None
+        self._monitor_all = False
         self.outputs = []
         self._fwd_cache = {}
         self._grad_fn = None
@@ -66,7 +67,9 @@ class Executor:
         nodes = self._symbol._topo_nodes()
         sym_outputs = self._symbol._outputs
 
-        def graph_fn(arg_vals, aux_vals, rng, train):
+        def graph_fn(arg_vals, aux_vals, rng, train, tap=None):
+            """tap(node, vis_outputs) is called per node when set — used by
+            the monitor's eager interpret mode only (never under jit)."""
             vals = {}
             new_aux = {}
 
@@ -94,6 +97,8 @@ class Executor:
                     for aux_node, new_val in zip(aux_inputs[-len(extra):],
                                                  extra):
                         new_aux[aux_node.name] = new_val
+                if tap is not None:
+                    tap(node, vis)
 
             outs = [vals[id(n)][i] for n, i in sym_outputs]
             return outs, new_aux
@@ -142,8 +147,22 @@ class Executor:
     def _raw_aux(self):
         return {k: v._data for k, v in self.aux_dict.items()}
 
+    def _forward_interpret(self, train, rng):
+        """Eager (uncompiled) forward calling the monitor callback with
+        every node output — the XLA-era analogue of the reference's
+        per-op executor monitor (graph_executor.cc:1399-1419).  Slow;
+        used only when a Monitor installs with monitor_all."""
+        def tap(node, vis):
+            for j, v in enumerate(vis):
+                suffix = "_output" if len(vis) == 1 else "_output%d" % j
+                self._monitor_callback(node.name + suffix,
+                                       NDArray(v, self._ctx))
+        return self._plan(self._raw_args(), self._raw_aux(), rng, train,
+                          tap=tap)
+
     def forward(self, is_train=False, **kwargs):
         from . import random as _random
+        from . import profiler as _profiler
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("unknown argument %s" % k)
@@ -151,18 +170,24 @@ class Executor:
                 v._data if isinstance(v, NDArray) else jnp.asarray(v))
         rng = _random.next_key()
         self._last_rng = rng
-        outs, new_aux = self._fwd(bool(is_train))(
-            self._raw_args(), self._raw_aux(), rng)
+        if self._monitor_callback is not None and self._monitor_all:
+            outs, new_aux = self._forward_interpret(bool(is_train), rng)
+        else:
+            with _profiler._timed("executor_forward") as t:
+                outs, new_aux = self._fwd(bool(is_train))(
+                    self._raw_args(), self._raw_aux(), rng)
+                t.sync_arrays = outs
         if is_train:
             for k, v in new_aux.items():
                 self.aux_dict[k]._set_data(v)
         self.outputs = [NDArray(o, self._ctx) for o in outs]
-        if self._monitor_callback is not None:
+        if self._monitor_callback is not None and not self._monitor_all:
             for name, arr in zip(self._output_names, self.outputs):
                 self._monitor_callback(name, arr)
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):
+        from . import profiler as _profiler
         if all(r == "null" for r in self._grad_req.values()):
             return
         grad_fn = self._make_grad_fn()
@@ -177,8 +202,11 @@ class Executor:
         if rng is None:
             from . import random as _random
             rng = _random.next_key()
-        outs, new_aux, grads = grad_fn(self._raw_args(), self._raw_aux(),
-                                       rng, tuple(ograds))
+        with _profiler._timed("executor_backward") as t:
+            outs, new_aux, grads = grad_fn(self._raw_args(),
+                                           self._raw_aux(),
+                                           rng, tuple(ograds))
+            t.sync_arrays = list(grads.values()) + list(outs)
         self.outputs = [NDArray(o, self._ctx) for o in outs]
         for name, g in grads.items():
             req = self._grad_req.get(name, "null")
@@ -195,6 +223,7 @@ class Executor:
     def forward_backward(self, out_grads=None, **kwargs):
         """Fused train step: one compiled program for fwd+bwd+aux update."""
         from . import random as _random
+        from . import profiler as _profiler
         for k, v in kwargs.items():
             self.arg_dict[k]._set_data(
                 v._data if isinstance(v, NDArray) else jnp.asarray(v))
@@ -209,8 +238,10 @@ class Executor:
         else:
             ograds = tuple(g._data if isinstance(g, NDArray)
                            else jnp.asarray(g) for g in out_grads)
-        outs, new_aux, grads = grad_fn(self._raw_args(), self._raw_aux(),
-                                       rng, ograds)
+        with _profiler._timed("executor_forward_backward") as t:
+            outs, new_aux, grads = grad_fn(self._raw_args(),
+                                           self._raw_aux(), rng, ograds)
+            t.sync_arrays = list(grads.values()) + list(outs)
         for k, v in new_aux.items():
             self.aux_dict[k]._set_data(v)
         self.outputs = [NDArray(o, self._ctx) for o in outs]
@@ -262,8 +293,11 @@ class Executor:
                     raise ValueError("Find name \"%s\" that is not in the "
                                      "auxiliary states" % name)
 
-    def set_monitor_callback(self, callback):
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """monitor_all taps every node output via interpret mode (slow,
+        debug-only); otherwise only final outputs are reported."""
         self._monitor_callback = callback
+        self._monitor_all = monitor_all
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Rebind with new shapes (jit handles recompilation)."""

@@ -18,8 +18,8 @@ from . import random as _random
 from .ndarray.ndarray import NDArray
 
 __all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Orthogonal",
-           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "One", "Zero",
-           "Constant", "Mixed", "Load", "register", "create"]
+           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "FusedRNN",
+           "One", "Zero", "Constant", "Mixed", "Load", "register", "create"]
 
 _INIT_REGISTRY = {}
 
@@ -287,6 +287,64 @@ class Constant(Initializer):
 
     def _init_weight(self, name, arr):
         self._set(arr, _np.full(arr.shape, self.value, dtype="float32"))
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize a fused-RNN packed parameter blob
+    (reference initializer.py:FusedRNN).
+
+    Unpacks the blob via FusedRNNCell.unpack_weights, applies ``init`` to
+    the per-gate weights, zeros biases, sets the LSTM i2h forget-gate bias
+    to ``forget_bias``, and packs back — so fused and unfused stacks start
+    from equivalent states.
+    """
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, Initializer):
+            init_str = init.dumps()
+        else:
+            init_str = init  # None or dumps() JSON
+        super().__init__(init=init_str, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init if isinstance(init, Initializer) else (
+            None if init is None else
+            _INIT_REGISTRY[json.loads(init)[0].lower()](
+                **json.loads(init)[1]))
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn.rnn_cell import FusedRNNCell
+        cell = FusedRNNCell(self._num_hidden, num_layers=self._num_layers,
+                            mode=self._mode,
+                            bidirectional=self._bidirectional,
+                            forget_bias=self._forget_bias, prefix="")
+        args = cell.unpack_weights({"parameters": arr})
+        inner = self._init
+        if inner is None and isinstance(desc, InitDesc) \
+                and desc.global_init is not None:
+            inner = desc.global_init
+        if inner is None:
+            inner = Uniform(0.1)
+        for name in args:
+            desc_i = InitDesc(name, global_init=None)
+            if name.endswith("weight"):
+                inner._init_weight(desc_i, args[name])
+            elif name.endswith("bias"):
+                self._init_zero(desc_i, args[name])
+                if self._mode == "lstm" and name.endswith("i2h_f_bias"):
+                    self._set(args[name], _np.full(
+                        args[name].shape, self._forget_bias,
+                        dtype="float32"))
+        packed = cell.pack_weights(args)
+        self._set(arr, packed["parameters"].asnumpy())
 
 
 class Mixed:

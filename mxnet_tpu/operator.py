@@ -1,0 +1,276 @@
+"""Python-defined operators (mx.operator: CustomOp / CustomOpProp / register).
+
+Port of /root/reference/python/mxnet/operator.py (880 L) — user code
+subclasses ``CustomOp`` (imperative forward/backward on NDArrays) and
+``CustomOpProp`` (shapes/types), registers under a name, and invokes via
+``mx.nd.Custom(*data, op_type=name)`` or ``mx.sym.Custom``.
+
+TPU-native wiring: the reference routes callbacks through the C API's
+custom-op thread (src/operator/custom/custom.cc:385-408); here the Python
+forward runs inside the XLA program as a ``jax.pure_callback`` (host
+callback with declared result shapes), and the gradient is a
+``jax.custom_vjp`` whose backward is a second pure_callback into
+``CustomOp.backward`` — so Custom ops compose with jit/grad/vmap-free use
+like any native op.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+from .base import MXNetError
+from .ops.registry import register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_entry"]
+
+
+class CustomOp(object):
+    """Base class for operators implemented in Python
+    (reference operator.py:413)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs; write them with self.assign(out_data[i], ...)."""
+        pass
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into in_grad."""
+        pass
+
+    def assign(self, dst, req, src):
+        """Assign src to dst per req ('null'|'write'|'inplace'|'add')
+        (reference operator.py:450)."""
+        if req == "null":
+            return
+        elif req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst[:] + src  # noqa: E203 — NDArray in-place add
+
+
+class CustomOpProp(object):
+    """Property/metadata class for a custom op (reference operator.py:459)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_REGISTRY = {}
+
+
+def register(reg_name):
+    """Decorator: register a CustomOpProp subclass under reg_name
+    (reference operator.py:register)."""
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                "Can only register subclass of CustomOpProp")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_entry(op_type):
+    prop_cls = _REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise MXNetError("Custom op type %s is not registered with "
+                         "mx.operator.register" % op_type)
+    return prop_cls
+
+
+class _HostArray(object):
+    """Tiny NDArray-alike handed to CustomOp callbacks: supports
+    [:] read/write, asnumpy, shape/dtype — enough for the reference's
+    assign() idiom without device round-trips inside the callback."""
+
+    __slots__ = ("_arr",)
+
+    def __init__(self, arr):
+        self._arr = _np.asarray(arr)
+
+    def __getitem__(self, idx):
+        return self._arr[idx]
+
+    def __setitem__(self, idx, value):
+        self._arr[idx] = _np.asarray(
+            value._arr if isinstance(value, _HostArray) else value)
+
+    def asnumpy(self):
+        return self._arr
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    def __array__(self, dtype=None):
+        return self._arr if dtype is None else self._arr.astype(dtype)
+
+
+def _prop_for(op_type, kwargs):
+    """Instantiate the registered prop with the op's extra kwargs
+    (reference passes all kwargs as strings; we pass them as-is)."""
+    prop_cls = get_entry(op_type)
+    return prop_cls(**kwargs)
+
+
+def _parse_params(params):
+    op_type = params.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom op requires op_type kwarg")
+    kwargs = {k: v for k, v in params.items()
+              if k not in ("op_type",) and not k.startswith("_")}
+    return op_type, kwargs
+
+
+def _custom_arg_names(params):
+    op_type, kwargs = _parse_params(params)
+    return list(_prop_for(op_type, kwargs).list_arguments())
+
+
+def _custom_aux_names(params):
+    op_type, kwargs = _parse_params(params)
+    return list(_prop_for(op_type, kwargs).list_auxiliary_states())
+
+
+@functools.lru_cache(maxsize=None)
+def _custom_impl(op_type, kwargs_key, is_train):
+    """Build the custom_vjp-wrapped jax function for one
+    (op_type, kwargs, is_train); is_train is static so the callback fns
+    close over it (custom_vjp primals are the arrays only)."""
+    import jax
+    import jax.numpy as jnp
+
+    kwargs = dict(kwargs_key)
+    prop = _prop_for(op_type, kwargs)
+    n_args = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
+
+    def _shapes_dtypes(arrays):
+        in_shapes = [tuple(a.shape) for a in arrays[:n_args]]
+        inferred = prop.infer_shape(list(in_shapes))
+        out_shapes = [tuple(s) for s in inferred[1]]
+        in_types = [a.dtype for a in arrays[:n_args]]
+        tinferred = prop.infer_type(list(in_types))
+        out_types = list(tinferred[1])
+        return out_shapes, out_types
+
+    def _fwd_host(*arrays):
+        op = prop.create_operator(None, [a.shape for a in arrays[:n_args]],
+                                  [a.dtype for a in arrays[:n_args]])
+        out_shapes, out_types = _shapes_dtypes(arrays)
+        in_data = [_HostArray(a) for a in arrays[:n_args]]
+        aux = [_HostArray(a.copy()) for a in arrays[n_args:]]
+        out_data = [_HostArray(_np.zeros(s, t))
+                    for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_data, out_data=out_data, aux=aux)
+        return tuple([o.asnumpy() for o in out_data] +
+                     [a.asnumpy() for a in aux])
+
+    def _bwd_host(*arrays):
+        # arrays = out_grads + in_data + out_data + aux
+        og = [_HostArray(a) for a in arrays[:n_out]]
+        ind = [_HostArray(a) for a in arrays[n_out:n_out + n_args]]
+        outd = [_HostArray(a)
+                for a in arrays[n_out + n_args:n_out + n_args + n_out]]
+        aux = [_HostArray(a.copy())
+               for a in arrays[n_out + n_args + n_out:]]
+        op = prop.create_operator(None, [a.shape for a in ind],
+                                  [a.dtype for a in ind])
+        in_grad = [_HostArray(_np.zeros(a.shape, a.dtype)) for a in ind]
+        op.backward(req=["write"] * n_args, out_grad=og, in_data=ind,
+                    out_data=outd, in_grad=in_grad, aux=aux)
+        return tuple(g.asnumpy() for g in in_grad)
+
+    def _result_spec(arrays):
+        out_shapes, out_types = _shapes_dtypes(arrays)
+        spec = [jax.ShapeDtypeStruct(s, t)
+                for s, t in zip(out_shapes, out_types)]
+        spec += [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in arrays[n_args:]]
+        return tuple(spec)
+
+    @jax.custom_vjp
+    def run(*arrays):
+        return jax.pure_callback(_fwd_host, _result_spec(arrays), *arrays,
+                                 vmap_method="sequential")
+
+    def run_fwd(*arrays):
+        outs = run(*arrays)
+        return outs, (arrays, outs[:n_out])
+
+    def run_bwd(res, cotangents):
+        arrays, outs = res
+        in_data = arrays[:n_args]
+        aux = arrays[n_args:]
+        out_grads = cotangents[:n_out]
+        spec = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in in_data)
+        grads = jax.pure_callback(
+            _bwd_host, spec, *(tuple(out_grads) + tuple(in_data) +
+                               tuple(outs) + tuple(aux)),
+            vmap_method="sequential")
+        # aux states carry no gradient
+        return tuple(grads) + tuple(
+            jnp.zeros(a.shape, a.dtype) for a in aux)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run
+
+
+def _freeze(kwargs):
+    return tuple(sorted(kwargs.items()))
+
+
+@register_op("Custom",
+             arg_names=_custom_arg_names,
+             aux_names=_custom_aux_names,
+             num_outputs=lambda p: len(
+                 _prop_for(*_parse_params(p)).list_outputs()),
+             mutate_aux=True, takes_train=True,
+             param_defaults={"op_type": None})
+def _custom(*arrays, op_type=None, _train=False, **kwargs):
+    """Dispatch to the registered CustomOpProp (reference custom.cc:385).
+
+    Returns visible outputs, then updated aux values (mutate_aux
+    convention, as BatchNorm)."""
+    impl = _custom_impl(op_type, _freeze(kwargs), bool(_train))
+    outs = impl(*arrays)
+    prop = _prop_for(op_type, kwargs)
+    n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
+    if n_out == 1 and n_aux == 0:
+        return outs[0]
+    return tuple(outs)

@@ -1,0 +1,153 @@
+"""Profiler (mx.profiler): chrome://tracing dump + jax.profiler bridge.
+
+Port of /root/reference/python/mxnet/profiler.py (:27-55) over the
+reference's engine profiler (src/engine/profiler.{h,cc}: OprExecStat per
+engine op, DumpProfile writes chrome tracing JSON).  TPU-native shape:
+
+- step-level events are recorded by the Executor around each compiled
+  program invocation (forward/backward/fused step) — the XLA analogue of
+  the engine's per-op blocks, since ops fuse into one program;
+- ``profiler_set_config(filename=...)`` + ``dump_profile()`` write the
+  same chrome://tracing JSON format (load in chrome://tracing or perfetto);
+- for intra-program (per-fusion/per-op) detail, ``profiler_set_state`` can
+  also drive ``jax.profiler`` traces into ``<filename>.jaxtrace/`` —
+  viewable in TensorBoard/XProf (set ``use_jax_profiler=True``).
+
+Env autostart: MXNET_PROFILER_AUTOSTART=1 (reference env_var.md:101-108).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "State", "set_config", "set_state", "pause", "resume"]
+
+_lock = threading.Lock()
+_state = "stop"
+_mode = "symbolic"
+_filename = "profile.json"
+_use_jax = False
+_events = []
+_t0_us = None
+_paused = False
+
+
+class State:
+    stop = "stop"
+    run = "run"
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        use_jax_profiler=False):
+    """Configure the profiler (reference profiler.py:27).
+
+    mode: 'symbolic' (executor-level events) or 'all' (also imperative op
+    calls; identical here since both run compiled programs)."""
+    global _mode, _filename, _use_jax
+    with _lock:
+        _mode = mode
+        _filename = filename
+        _use_jax = use_jax_profiler
+
+
+def profiler_set_state(state="stop"):
+    """Start ('run') or stop ('stop') collecting (reference :43)."""
+    global _state, _t0_us
+    import jax
+    with _lock:
+        if state == _state:
+            return
+        if state == "run":
+            _events.clear()
+            _t0_us = time.perf_counter_ns() // 1000
+            if _use_jax:
+                logdir = _filename + ".jaxtrace"
+                os.makedirs(logdir, exist_ok=True)
+                try:
+                    jax.profiler.start_trace(logdir)
+                except RuntimeError:
+                    pass
+        elif state == "stop":
+            if _use_jax:
+                try:
+                    jax.profiler.stop_trace()
+                except RuntimeError:
+                    pass
+        else:
+            raise ValueError("state must be 'run' or 'stop'")
+        _state = state
+
+
+def pause():
+    """Temporarily skip recording (reference profiler.py:pause)."""
+    global _paused
+    _paused = True
+
+
+def resume():
+    global _paused
+    _paused = False
+
+
+def is_running():
+    return _state == "run" and not _paused
+
+
+def record_event(name, start_us, dur_us, cat="operator", tid=None):
+    """Append one duration event (called by the Executor hot path only
+    when is_running())."""
+    if not is_running():
+        return
+    _events.append({
+        "name": name, "cat": cat, "ph": "X",
+        "ts": start_us - (_t0_us or 0), "dur": dur_us,
+        "pid": os.getpid(),
+        "tid": tid if tid is not None else threading.get_ident() & 0xffff,
+    })
+
+
+class _timed(object):
+    """Context manager the Executor wraps compiled calls in; forces device
+    sync at exit so durations are real (only while profiling)."""
+
+    def __init__(self, name, sync_arrays=()):
+        self.name = name
+        self.sync_arrays = sync_arrays
+
+    def __enter__(self):
+        self.active = is_running()
+        if self.active:
+            self.start = time.perf_counter_ns() // 1000
+        return self
+
+    def __exit__(self, *exc):
+        if self.active:
+            for a in self.sync_arrays:
+                try:
+                    a.block_until_ready()
+                except Exception:
+                    pass
+            end = time.perf_counter_ns() // 1000
+            record_event(self.name, self.start, end - self.start)
+        return False
+
+
+def dump_profile():
+    """Write the chrome tracing JSON (reference profiler.py:55 /
+    src/engine/profiler.cc:152)."""
+    with _lock:
+        doc = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        with open(_filename, "w") as f:
+            json.dump(doc, f)
+    return _filename
+
+
+# aliases matching later-era reference spellings kept by examples
+set_config = profiler_set_config
+set_state = profiler_set_state
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    profiler_set_state("run")

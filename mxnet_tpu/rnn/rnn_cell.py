@@ -9,14 +9,11 @@ fused '{prefix}parameters') so checkpoints and unpack/pack round-trip.
 """
 from __future__ import annotations
 
-import math
-
 import numpy as _np
 
 from .. import symbol
 from ..symbol import Symbol
 from .. import ndarray as nd
-from ..base import MXNetError
 
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
            "FusedRNNCell", "SequentialRNNCell", "DropoutCell",
@@ -358,7 +355,11 @@ class FusedRNNCell(BaseRNNCell):
         self._get_next_state = get_next_state
         self._forget_bias = forget_bias
         self._directions = ["l", "r"] if bidirectional else ["l"]
-        self._parameter = self._params.get("parameters")
+        from ..initializer import FusedRNN as _FusedRNNInit
+        self._parameter = self._params.get(
+            "parameters", init=_FusedRNNInit(
+                None, num_hidden, num_layers, mode, bidirectional,
+                forget_bias))
 
     @property
     def state_info(self):
@@ -815,7 +816,8 @@ class BaseConvRNNCell(BaseRNNCell):
 
     def __init__(self, input_shape, num_hidden, h2h_kernel, h2h_dilate,
                  i2h_kernel, i2h_stride, i2h_pad, i2h_dilate, activation,
-                 prefix="", params=None, conv_layout="NCHW"):
+                 prefix="", params=None, conv_layout="NCHW",
+                 i2h_bias_init=None):
         super().__init__(prefix=prefix, params=params)
         self._h2h_kernel = h2h_kernel
         assert h2h_kernel[0] % 2 == 1 and h2h_kernel[1] % 2 == 1, \
@@ -840,7 +842,7 @@ class BaseConvRNNCell(BaseRNNCell):
                 tmp_for_shape_infer=(1,) + tuple(input_shape))[1][0]
         self._iW = self._params.get("i2h_weight")
         self._hW = self._params.get("h2h_weight")
-        self._iB = self._params.get("i2h_bias")
+        self._iB = self._params.get("i2h_bias", init=i2h_bias_init)
         self._hB = self._params.get("h2h_bias")
 
     @property
@@ -908,10 +910,12 @@ class ConvLSTMCell(BaseConvRNNCell):
                  i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
                  prefix="ConvLSTM_", params=None, forget_bias=1.0,
                  conv_layout="NCHW"):
+        from ..initializer import LSTMBias
         super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
                          i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
                          activation, prefix=prefix, params=params,
-                         conv_layout=conv_layout)
+                         conv_layout=conv_layout,
+                         i2h_bias_init=LSTMBias(forget_bias))
         self._forget_bias = forget_bias
 
     @property

@@ -1,0 +1,163 @@
+"""Network visualization (mx.viz): print_summary + plot_network.
+
+Port of /root/reference/python/mxnet/visualization.py — a keras-style
+text summary (layer, output shape, params, previous layers) and a
+graphviz rendering.  Works on any Symbol from this package's graph.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_label(node):
+    op = node.op.name if node.op is not None else "null"
+    if op == "null":
+        return node.name
+    p = node.params or {}
+    if op == "Convolution":
+        return "Convolution\n%s/%s, %s" % (
+            "x".join(str(x) for x in p.get("kernel", ())),
+            "x".join(str(x) for x in p.get("stride", (1,))),
+            p.get("num_filter", "?"))
+    if op == "FullyConnected":
+        return "FullyConnected\n%s" % p.get("num_hidden", "?")
+    if op == "Pooling":
+        return "Pooling\n%s, %s/%s" % (
+            p.get("pool_type", "max"),
+            "x".join(str(x) for x in p.get("kernel", ())),
+            "x".join(str(x) for x in p.get("stride", (1,))))
+    if op == "Activation" or op == "LeakyReLU":
+        return "%s\n%s" % (op, p.get("act_type", ""))
+    return op
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer-by-layer summary table (reference
+    visualization.py:print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    shape_dict = None
+    if shape is not None:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        shape_dict = dict(zip(symbol.list_arguments(), arg_shapes))
+        shape_dict.update(dict(zip(symbol.list_auxiliary_states(),
+                                   aux_shapes)))
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    nodes = symbol._topo_nodes()
+    # per-node output shapes via forward inference when shapes given
+    out_shape_of = {}
+    if shape_dict is not None:
+        import jax
+        import jax.numpy as jnp
+
+        vals = {}
+        for i, node in enumerate(nodes):
+            if node.is_var:
+                s = shape_dict.get(node.name)
+                vals[id(node)] = [jax.ShapeDtypeStruct(s or (), jnp.float32)]
+                continue
+            inputs = [vals[id(inp)][idx] for inp, idx in node.inputs]
+            params = dict(node.params)
+            if node.op.takes_train:
+                params["_train"] = False
+            if node.op.needs_rng:
+                inputs.append(jax.ShapeDtypeStruct((2,), jnp.uint32))
+            try:
+                out = node.op.abstract_eval(*inputs, **params)
+            except Exception:
+                vals[id(node)] = [jax.ShapeDtypeStruct((), jnp.float32)]
+                continue
+            flat = list(out) if isinstance(out, (tuple, list)) else [out]
+            vals[id(node)] = flat
+            out_shape_of[id(node)] = tuple(flat[0].shape)
+
+    total_params = 0
+    param_suffixes = ("weight", "bias", "gamma", "beta", "parameters")
+    for node in nodes:
+        if node.is_var:
+            continue
+        name = node.name
+        op = node.op.name
+        out_shape = out_shape_of.get(id(node), "")
+        cur_params = 0
+        pre_layers = []
+        for inp, _ in node.inputs:
+            if inp.is_var and inp.name.endswith(param_suffixes):
+                if shape_dict is not None and inp.name in shape_dict:
+                    n = 1
+                    for d in shape_dict[inp.name]:
+                        n *= d
+                    cur_params += n
+            else:
+                pre_layers.append(inp.name)
+        total_params += cur_params
+        fields = ["%s (%s)" % (name, op), str(out_shape), str(cur_params),
+                  ", ".join(pre_layers[:3])]
+        print_row(fields, positions)
+        print("_" * line_length)
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the network (reference
+    visualization.py:plot_network).  Requires the graphviz package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the 'graphviz' package; "
+                          "use print_summary for a text view")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    node_attrs = node_attrs or {}
+    node_attr = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    fill = {"null": "#8dd3c7", "Convolution": "#fb8072",
+            "FullyConnected": "#fb8072", "BatchNorm": "#bebada",
+            "Activation": "#ffffb3", "Pooling": "#80b1d3",
+            "Concat": "#fdb462", "SoftmaxOutput": "#b3de69"}
+    nodes = symbol._topo_nodes()
+    param_suffixes = ("weight", "bias", "gamma", "beta", "parameters",
+                      "moving_mean", "moving_var")
+    keep = {}
+    for node in nodes:
+        if node.is_var:
+            if hide_weights and node.name.endswith(param_suffixes):
+                continue
+            keep[id(node)] = node.name
+            dot.node(node.name, label=node.name,
+                     fillcolor=fill.get("null"), **node_attr)
+            continue
+        keep[id(node)] = node.name
+        dot.node(node.name, label=_node_label(node),
+                 fillcolor=fill.get(node.op.name, "#fccde5"), **node_attr)
+    for node in nodes:
+        if id(node) not in keep or node.is_var:
+            continue
+        for inp, _ in node.inputs:
+            if id(inp) in keep:
+                dot.edge(inp.name, node.name)
+    return dot

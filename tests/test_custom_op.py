@@ -1,0 +1,130 @@
+"""mx.operator CustomOp tests — Python ops inside the jitted graph.
+
+Mirrors the reference's tests/python/unittest/test_operator.py:test_custom_op
+(sqr custom op with numeric-gradient check).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.operator
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0][:] ** 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0][:] * out_grad[0][:])
+
+
+@mx.operator.register("swapcat")
+class SwapCatProp(mx.operator.CustomOpProp):
+    """Two inputs, two outputs: (y, x) swapped+scaled."""
+
+    def list_arguments(self):
+        return ["x", "y"]
+
+    def list_outputs(self):
+        return ["a", "b"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[1], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return SwapCat()
+
+
+class SwapCat(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], 3.0 * in_data[1][:])
+        self.assign(out_data[1], req[1], 2.0 * in_data[0][:])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2.0 * out_grad[1][:])
+        self.assign(in_grad[1], req[1], 3.0 * out_grad[0][:])
+
+
+def test_custom_nd_forward():
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = mx.nd.Custom(x, op_type="sqr")
+    assert np.allclose(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_custom_autograd_backward():
+    x = mx.nd.array(np.array([[1.0, -2.0], [0.5, 3.0]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="sqr")
+        loss = mx.nd.sum(y)
+    loss.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy(), atol=1e-5)
+
+
+def test_custom_symbolic_bind():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="sqr", name="sqr0")
+    z = mx.sym.sum(y)
+    exe = z.simple_bind(ctx=mx.cpu(), data=(3, 4))
+    xv = np.random.RandomState(0).uniform(-1, 1, (3, 4)).astype(np.float32)
+    exe.arg_dict["data"][:] = xv
+    out = exe.forward()[0].asnumpy()
+    assert np.allclose(out, (xv ** 2).sum(), rtol=1e-5)
+    exe.backward()
+    assert np.allclose(exe.grad_dict["data"].asnumpy(), 2 * xv, atol=1e-5)
+
+
+def test_custom_multi_io():
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    y = mx.nd.array(np.full((4, 5), 2.0, np.float32))
+    a, b = mx.nd.Custom(x, y, op_type="swapcat")
+    assert a.shape == (4, 5) and np.allclose(a.asnumpy(), 6.0)
+    assert b.shape == (2, 3) and np.allclose(b.asnumpy(), 2.0)
+
+
+def test_custom_multi_io_grad():
+    x = mx.nd.array(np.ones((2, 2), np.float32))
+    y = mx.nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    y.attach_grad()
+    with mx.autograd.record():
+        a, b = mx.nd.Custom(x, y, op_type="swapcat")
+        loss = mx.nd.sum(a) + mx.nd.sum(b)
+    loss.backward()
+    assert np.allclose(x.grad.asnumpy(), 2.0)
+    assert np.allclose(y.grad.asnumpy(), 3.0)
+
+
+def test_custom_in_gluon_net():
+    class SqrBlock(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Custom(x, op_type="sqr")
+
+    net = SqrBlock()
+    x = mx.nd.array(np.array([2.0, 3.0], np.float32))
+    out = net(x)
+    assert np.allclose(out.asnumpy(), [4.0, 9.0])
+
+
+def test_unregistered_custom_op_raises():
+    x = mx.nd.ones((2, 2))
+    with pytest.raises(Exception):
+        mx.nd.Custom(x, op_type="never_registered_xyz")

@@ -1,0 +1,57 @@
+"""Legacy FeedForward API tests (reference tests/python/train/test_mlp.py
+shape, at toy scale)."""
+import warnings
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    return x, y
+
+
+def test_feedforward_fit_predict_score(tmp_path):
+    x, y = _toy_data()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = mx.model.FeedForward(_mlp(), num_epoch=12,
+                                     numpy_batch_size=32,
+                                     learning_rate=0.5)
+        model.fit(x, y)
+    acc = model.score((x, y) if False else mx.io.NDArrayIter(
+        x, y, batch_size=32))
+    assert acc > 0.85, "FeedForward failed to learn: %s" % acc
+    preds = model.predict(x)
+    assert preds.shape == (256, 2)
+    assert (preds.argmax(axis=1) == y).mean() > 0.85
+    # save/load round trip
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 5)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        loaded = mx.model.FeedForward.load(prefix, 5)
+    preds2 = loaded.predict(x)
+    assert np.allclose(preds, preds2, atol=1e-5)
+
+
+def test_feedforward_create():
+    x, y = _toy_data(128, seed=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = mx.model.FeedForward.create(_mlp(), x, y, num_epoch=8,
+                                            learning_rate=0.5,
+                                            numpy_batch_size=32)
+    preds = model.predict(x)
+    assert (preds.argmax(axis=1) == y).mean() > 0.8
