@@ -8,6 +8,10 @@ what this package provides is everything above that line, TPU-first.
 """
 from . import base
 from .base import MXNetError
+
+# join the launch.py process mesh BEFORE any JAX backend initializes
+# (ps-lite bootstrap analogue; no-op without MXTPU_COORDINATOR)
+base._maybe_init_distributed()
 from .context import Context, current_context, cpu, gpu, tpu, num_gpus
 from . import ops
 from . import operator  # registers the Custom op before nd/sym populate

@@ -34,3 +34,35 @@ def _as_list(obj):
     if isinstance(obj, (list, tuple)):
         return list(obj)
     return [obj]
+
+
+def _maybe_init_distributed():
+    """Join the process mesh from tools/launch.py's env contract
+    (MXTPU_COORDINATOR / MXTPU_NUM_WORKERS / MXTPU_WORKER_RANK) — the
+    TPU-era replacement for ps-lite's DMLC_PS_ROOT_URI bootstrap.
+
+    Must run before any JAX backend initializes; mxnet_tpu/__init__ calls
+    it at import time, and kvstore.create('dist_*') re-invokes it as a
+    safety net, warning loudly if joining failed."""
+    import os
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    if not coord:
+        return
+    import jax
+    try:
+        if jax.distributed.is_initialized():
+            return
+    except AttributeError:
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["MXTPU_NUM_WORKERS"]),
+            process_id=int(os.environ["MXTPU_WORKER_RANK"]))
+    except RuntimeError as e:
+        import logging
+        logging.warning(
+            "mxnet_tpu: could not join the distributed mesh at %s (%s); "
+            "this process runs single-process. Import mxnet_tpu (or "
+            "create the dist kvstore) before touching any arrays.",
+            coord, e)

@@ -84,12 +84,16 @@ class Context:
         'tpu'/'gpu' map onto the accelerator platform when present (falling
         back to CPU so tests run anywhere); 'cpu'/'cpu_pinned' map to host.
         """
-        devs = jax.devices()
+        # local_devices only: under multi-process (launch.py / pods) the
+        # global list contains peers' non-addressable devices
+        devs = jax.local_devices()
         accel = [d for d in devs if d.platform != "cpu"]
         if self.device_type in ("tpu", "gpu"):
-            pool = accel if accel else jax.devices("cpu")
+            pool = accel or [d for d in devs if d.platform == "cpu"]
         else:
-            pool = jax.devices("cpu")
+            pool = [d for d in devs if d.platform == "cpu"]
+        if not pool:
+            pool = devs
         return pool[self.device_id % len(pool)]
 
     def empty_cache(self):

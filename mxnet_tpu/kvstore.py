@@ -171,6 +171,9 @@ class KVStore:
             self._updater.set_states(f.read())
 
 
+from .base import _maybe_init_distributed
+
+
 def create(name="local"):
     """Create a KVStore (reference kvstore.py:create)."""
     if not isinstance(name, str):
@@ -180,4 +183,6 @@ def create(name="local"):
              "dist_device_sync", "dist_sync_device")
     if name not in valid:
         raise MXNetError("unknown KVStore type %s" % name)
+    if name.startswith("dist"):
+        _maybe_init_distributed()
     return KVStore(name)

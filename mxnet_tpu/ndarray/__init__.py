@@ -10,3 +10,14 @@ from .sparse import (BaseSparseNDArray, RowSparseNDArray, CSRNDArray,
                      cast_storage, sparse_retain)
 
 _register.populate(globals())
+
+
+# mx.nd.contrib namespace: every _contrib_<X> op surfaces as contrib.<X>
+# (mirrors /root/reference/python/mxnet/ndarray/contrib.py's autogen)
+import types as _types
+
+contrib = _types.ModuleType(__name__ + ".contrib",
+                            "Contrib operators (experimental).")
+for _n, _f in list(globals().items()):
+    if _n.startswith("_contrib_"):
+        setattr(contrib, _n[len("_contrib_"):], _f)

@@ -1,0 +1,457 @@
+"""R-CNN detection ops (contrib): Proposal, MultiProposal, PSROIPooling,
+DeformableConvolution, DeformablePSROIPooling.
+
+TPU-native lowerings of /root/reference/src/operator/contrib/
+{proposal,multi_proposal,psroi_pooling,deformable_convolution,
+deformable_psroi_pooling}*.  The reference ships hand-written CUDA kernels;
+here each op is a vectorized jnp program: anchor/bbox math is dense
+elementwise work, greedy NMS is a fixed-trip lax.fori_loop (static shapes
+keep it jittable), and the deformable ops build bilinear-sampled patch
+tensors with gathers, reducing to MXU matmuls.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, alias
+
+# ---------------------------------------------------------------------------
+# anchors + box utils (proposal-inl.h helpers)
+# ---------------------------------------------------------------------------
+
+
+def _generate_anchors(base_size, scales, ratios):
+    """(A, 4) anchors centered on a base_size box at the origin
+    (reference rcnn generate_anchors)."""
+    import numpy as np
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    out = []
+    for r in ratios:
+        size = w * h
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.array(out, np.float32)
+
+
+def _bbox_transform_inv(anchors, deltas):
+    """Apply (dx, dy, dw, dh) regression deltas to anchors."""
+    w = anchors[:, 2] - anchors[:, 0] + 1.0
+    h = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * (w - 1.0)
+    cy = anchors[:, 1] + 0.5 * (h - 1.0)
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    pcx = dx * w + cx
+    pcy = dy * h + cy
+    pw = jnp.exp(dw) * w
+    ph = jnp.exp(dh) * h
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                     axis=1)
+
+
+def _iou_one_vs_all(box, boxes):
+    ix0 = jnp.maximum(box[0], boxes[:, 0])
+    iy0 = jnp.maximum(box[1], boxes[:, 1])
+    ix1 = jnp.minimum(box[2], boxes[:, 2])
+    iy1 = jnp.minimum(box[3], boxes[:, 3])
+    iw = jnp.maximum(0.0, ix1 - ix0 + 1.0)
+    ih = jnp.maximum(0.0, iy1 - iy0 + 1.0)
+    inter = iw * ih
+    a1 = (box[2] - box[0] + 1.0) * (box[3] - box[1] + 1.0)
+    a2 = (boxes[:, 2] - boxes[:, 0] + 1.0) * (boxes[:, 3] - boxes[:, 1] + 1.0)
+    return inter / jnp.maximum(a1 + a2 - inter, 1e-12)
+
+
+def _greedy_nms_mask(boxes, scores, thresh):
+    """Boolean keep-mask of greedy NMS over score-sorted boxes."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sboxes = boxes[order]
+
+    def body(i, keep):
+        iou = _iou_one_vs_all(sboxes[i], sboxes)
+        suppress = (iou > thresh) & (jnp.arange(n) > i)
+        return jnp.where(keep[i], keep & ~suppress, keep)
+
+    keep_sorted = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def _proposal_one(scores_fg, bbox_deltas, im_info, anchors_np,
+                  feature_stride, rpn_pre_nms_top_n, rpn_post_nms_top_n,
+                  threshold, rpn_min_size, iou_loss=False):
+    """Proposals for ONE image.
+
+    scores_fg: (A, H, W) foreground scores; bbox_deltas: (4A, H, W).
+    Returns (rois (post, 4), roi_scores (post,)).
+    """
+    A = scores_fg.shape[0]
+    H, W = scores_fg.shape[1], scores_fg.shape[2]
+    # full anchor field (H*W*A, 4), matching the reference's enumeration
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)  # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
+    anchors = (jnp.asarray(anchors_np)[None, None, :, :] +
+               shifts[:, :, None, :]).reshape(-1, 4)  # (H*W*A, 4)
+    # deltas (4A, H, W) -> (H, W, A, 4) -> (H*W*A, 4)
+    deltas = bbox_deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1)
+    deltas = deltas.reshape(-1, 4)
+    scores = scores_fg.transpose(1, 2, 0).reshape(-1)  # (H*W*A,)
+
+    if iou_loss:
+        # IoU-loss models regress direct corner offsets
+        # (reference proposal-inl.h IoUTransformInv)
+        proposals = anchors + deltas
+    else:
+        proposals = _bbox_transform_inv(anchors, deltas)
+    # clip to image
+    im_h, im_w = im_info[0], im_info[1]
+    proposals = jnp.stack([
+        jnp.clip(proposals[:, 0], 0, im_w - 1.0),
+        jnp.clip(proposals[:, 1], 0, im_h - 1.0),
+        jnp.clip(proposals[:, 2], 0, im_w - 1.0),
+        jnp.clip(proposals[:, 3], 0, im_h - 1.0)], axis=1)
+    # filter boxes below min_size (scaled by im scale)
+    min_size = rpn_min_size * im_info[2]
+    ws = proposals[:, 2] - proposals[:, 0] + 1.0
+    hs = proposals[:, 3] - proposals[:, 1] + 1.0
+    valid = (ws >= min_size) & (hs >= min_size)
+    scores = jnp.where(valid, scores, -jnp.inf)
+
+    pre = min(rpn_pre_nms_top_n, scores.shape[0])
+    top_scores, top_idx = lax.top_k(scores, pre)
+    top_boxes = proposals[top_idx]
+    keep = _greedy_nms_mask(top_boxes, top_scores, threshold)
+    keep &= jnp.isfinite(top_scores)
+    # stable-select kept boxes in score order, pad to post_nms_top_n
+    rank = jnp.where(keep, jnp.arange(pre), pre + jnp.arange(pre))
+    sel = jnp.argsort(rank)[:rpn_post_nms_top_n]
+    out_boxes = jnp.where(keep[sel][:, None], top_boxes[sel], 0.0)
+    out_scores = jnp.where(keep[sel], top_scores[sel], 0.0)
+    if rpn_post_nms_top_n > sel.shape[0]:
+        pad = rpn_post_nms_top_n - sel.shape[0]
+        out_boxes = jnp.concatenate(
+            [out_boxes, jnp.zeros((pad, 4), out_boxes.dtype)])
+        out_scores = jnp.concatenate(
+            [out_scores, jnp.zeros((pad,), out_scores.dtype)])
+    return out_boxes, out_scores
+
+
+def _proposal_params():
+    return {"rpn_pre_nms_top_n": 6000, "rpn_post_nms_top_n": 300,
+            "threshold": 0.7, "rpn_min_size": 16,
+            "scales": (4.0, 8.0, 16.0, 32.0), "ratios": (0.5, 1.0, 2.0),
+            "feature_stride": 16, "output_score": False, "iou_loss": False}
+
+
+@register_op("_contrib_Proposal",
+             arg_names=("cls_prob", "bbox_pred", "im_info"),
+             num_outputs=lambda p: 2 if p.get("output_score") else 1,
+             param_defaults=_proposal_params())
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal layer (reference contrib/proposal.cc; batch size 1).
+
+    cls_prob: (1, 2A, H, W) softmax over {bg, fg} per anchor;
+    bbox_pred: (1, 4A, H, W); im_info: (1, 3) = (h, w, scale).
+    Output rois: (post_nms_top_n, 5) with batch-index column 0.
+    """
+    anchors_np = _generate_anchors(feature_stride, scales, ratios)
+    A = anchors_np.shape[0]
+    boxes, scores = _proposal_one(
+        cls_prob[0, A:], bbox_pred[0], im_info[0], anchors_np,
+        feature_stride, rpn_pre_nms_top_n, rpn_post_nms_top_n, threshold,
+        rpn_min_size, iou_loss)
+    rois = jnp.concatenate(
+        [jnp.zeros((boxes.shape[0], 1), boxes.dtype), boxes], axis=1)
+    if output_score:
+        return rois, scores[:, None]
+    return rois
+
+
+alias("_contrib_Proposal", "Proposal")
+
+
+@register_op("_contrib_MultiProposal",
+             arg_names=("cls_prob", "bbox_pred", "im_info"),
+             num_outputs=lambda p: 2 if p.get("output_score") else 1,
+             param_defaults=_proposal_params())
+def _multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                    rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                    scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+                    feature_stride=16, output_score=False, iou_loss=False):
+    """Batched Proposal (reference contrib/multi_proposal.cc): rois
+    (N*post, 5), column 0 = batch index."""
+    import jax
+    anchors_np = _generate_anchors(feature_stride, scales, ratios)
+    A = anchors_np.shape[0]
+
+    def per_image(args):
+        cp, bp, info = args
+        return _proposal_one(cp[A:], bp, info, anchors_np, feature_stride,
+                             rpn_pre_nms_top_n, rpn_post_nms_top_n,
+                             threshold, rpn_min_size, iou_loss)
+
+    boxes, scores = jax.vmap(per_image)((cls_prob, bbox_pred, im_info))
+    N, P = boxes.shape[0], boxes.shape[1]
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), P)
+    rois = jnp.concatenate([batch_idx[:, None], boxes.reshape(-1, 4)],
+                           axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+alias("_contrib_MultiProposal", "MultiProposal")
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (reference contrib/psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("_contrib_PSROIPooling", arg_names=("data", "rois"),
+             param_defaults={"spatial_scale": 1.0, "output_dim": 0,
+                             "pooled_size": 0, "group_size": 0})
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                   pooled_size=0, group_size=0):
+    """Position-sensitive ROI average pooling.
+
+    data: (N, group²·output_dim, H, W); rois: (R, 5).
+    Output: (R, output_dim, pooled, pooled); bin (i, j) of channel c pools
+    data channel (c·group + gi)·group + gj over the bin's rectangle.
+    """
+    if group_size == 0:
+        group_size = pooled_size
+    P = pooled_size
+    G = group_size
+    N, C, H, W = data.shape
+
+    yy = jnp.arange(H, dtype=jnp.float32)
+    xx = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / P
+        bin_h = rh / P
+        img = data[b]  # (C, H, W)
+
+        # bin edges per pooled cell
+        ph = jnp.arange(P, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(y1 + ph * bin_h), 0, H)      # (P,)
+        hend = jnp.clip(jnp.ceil(y1 + (ph + 1) * bin_h), 0, H)
+        wstart = jnp.clip(jnp.floor(x1 + ph * bin_w), 0, W)
+        wend = jnp.clip(jnp.ceil(x1 + (ph + 1) * bin_w), 0, W)
+
+        # mask-based average per bin: (P, H) row masks, (P, W) col masks
+        row_m = ((yy[None, :] >= hstart[:, None]) &
+                 (yy[None, :] < hend[:, None])).astype(jnp.float32)
+        col_m = ((xx[None, :] >= wstart[:, None]) &
+                 (xx[None, :] < wend[:, None])).astype(jnp.float32)
+        # sums over bins: (C, P, P)
+        tmp = jnp.einsum("ih,chw->ciw", row_m, img)
+        sums = jnp.einsum("jw,ciw->cij", col_m, tmp)
+        counts = row_m.sum(1)[None, :, None] * col_m.sum(1)[None, None, :]
+        means = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), 0.0)
+        # position-sensitive channel select: bin (i,j) takes channel
+        # (c*G + gi)*G + gj with gi = i*G//P, gj = j*G//P
+        gi = (jnp.arange(P) * G // P).astype(jnp.int32)
+        gj = (jnp.arange(P) * G // P).astype(jnp.int32)
+        c_idx = (jnp.arange(output_dim)[:, None, None] * G +
+                 gi[None, :, None]) * G + gj[None, None, :]
+        return means[c_idx, jnp.arange(P)[None, :, None],
+                     jnp.arange(P)[None, None, :]]
+
+    import jax
+    return jax.vmap(one_roi)(rois)
+
+
+alias("_contrib_PSROIPooling", "PSROIPooling")
+
+
+# ---------------------------------------------------------------------------
+# Deformable ops (reference contrib/deformable_convolution.cc,
+# deformable_psroi_pooling.cc — Dai et al. 2017)
+# ---------------------------------------------------------------------------
+
+def _bilinear_at(img, y, x):
+    """Bilinear sample img (C, H, W) at float coords y, x (...); zero
+    outside [0, H/W-1] as the reference's im2col does."""
+    C, H, W = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+
+    def at(yi, xi):
+        inb = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # (C, ...)
+        return jnp.where(inb, v, 0.0)
+
+    out = (at(y0, x0) * (wy0 * wx0) + at(y0, x0 + 1) * (wy0 * wx1) +
+           at(y0 + 1, x0) * (wy1 * wx0) + at(y0 + 1, x0 + 1) * (wy1 * wx1))
+    valid = (y > -1) & (y < H) & (x > -1) & (x < W)
+    return jnp.where(valid, out, 0.0)
+
+
+@register_op("_contrib_DeformableConvolution",
+             arg_names=("data", "offset", "weight", "bias"),
+             param_defaults={"kernel": (3, 3), "stride": (1, 1),
+                             "dilate": (1, 1), "pad": (0, 0),
+                             "num_filter": 0, "num_group": 1,
+                             "num_deformable_group": 1, "workspace": 1024,
+                             "no_bias": False})
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                            num_filter=0, num_group=1,
+                            num_deformable_group=1, workspace=1024,
+                            no_bias=False):
+    """Deformable conv v1: kernel taps sample at learned offsets.
+
+    data (N, C, H, W); offset (N, 2·dg·KH·KW, OH, OW) ordered
+    (dg, kh, kw, {y, x}); weight (F, C/g, KH, KW).
+    Lowering: bilinear-gather a deformable im2col tensor
+    (N, C·KH·KW, OH, OW), then one MXU matmul per group.
+    """
+    import jax
+    KH, KW = kernel
+    SH, SW = stride
+    DH, DW = dilate
+    PH, PW = pad
+    N, C, H, W = data.shape
+    OH = (H + 2 * PH - DH * (KH - 1) - 1) // SH + 1
+    OW = (W + 2 * PW - DW * (KW - 1) - 1) // SW + 1
+    dg = num_deformable_group
+
+    # base sampling positions (KH, KW, OH, OW), in unpadded coords
+    oy = jnp.arange(OH, dtype=jnp.float32) * SH - PH
+    ox = jnp.arange(OW, dtype=jnp.float32) * SW - PW
+    ky = jnp.arange(KH, dtype=jnp.float32) * DH
+    kx = jnp.arange(KW, dtype=jnp.float32) * DW
+    base_y = oy[None, None, :, None] + ky[:, None, None, None]
+    base_x = ox[None, None, None, :] + kx[None, :, None, None]
+    base_y = jnp.broadcast_to(base_y, (KH, KW, OH, OW))
+    base_x = jnp.broadcast_to(base_x, (KH, KW, OH, OW))
+
+    def per_image(img, off):
+        # off: (2*dg*KH*KW, OH, OW) -> (dg, KH, KW, 2, OH, OW)
+        off = off.reshape(dg, KH, KW, 2, OH, OW)
+
+        def per_dgroup(img_g, off_g):
+            # img_g: (C/dg, H, W); off_g: (KH, KW, 2, OH, OW)
+            y = base_y + off_g[:, :, 0]
+            x = base_x + off_g[:, :, 1]
+            return _bilinear_at(img_g, y, x)  # (C/dg, KH, KW, OH, OW)
+
+        img_d = img.reshape(dg, C // dg, H, W)
+        cols = jax.vmap(per_dgroup)(img_d, off)  # (dg, C/dg, KH, KW, OH, OW)
+        return cols.reshape(C, KH, KW, OH, OW)
+
+    cols = jax.vmap(per_image)(data, offset)  # (N, C, KH, KW, OH, OW)
+    # grouped matmul: weight (F, C/g, KH, KW)
+    F = num_filter
+    g = num_group
+    cols = cols.reshape(N, g, C // g, KH * KW, OH * OW)
+    wmat = weight.reshape(g, F // g, (C // g) * KH * KW)
+    cols2 = cols.reshape(N, g, (C // g) * KH * KW, OH * OW)
+    out = jnp.einsum("gfk,ngko->ngfo", wmat, cols2)
+    out = out.reshape(N, F, OH, OW)
+    if not no_bias and bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+alias("_contrib_DeformableConvolution", "DeformableConvolution")
+
+
+@register_op("_contrib_DeformablePSROIPooling",
+             arg_names=lambda p: (["data", "rois"] if p.get("no_trans")
+                                  else ["data", "rois", "trans"]),
+             param_defaults={"spatial_scale": 1.0, "output_dim": 0,
+                             "group_size": 1, "pooled_size": 0,
+                             "part_size": 0, "sample_per_part": 1,
+                             "trans_std": 0.0, "no_trans": False})
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=0, group_size=1, pooled_size=0,
+                              part_size=0, sample_per_part=1, trans_std=0.0,
+                              no_trans=False):
+    """Deformable position-sensitive ROI pooling (Dai et al. 2017).
+
+    Bins sample a regular sub-grid (sample_per_part²) with a learned
+    per-part (dy, dx) shift from `trans` (R, 2·cls, part, part).
+    """
+    import jax
+    P = pooled_size
+    G = group_size
+    PS = part_size if part_size > 0 else P
+    N, C, H, W = data.shape
+    sp = sample_per_part
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / P
+        bin_h = rh / P
+        img = data[b]
+
+        ph = jnp.arange(P)
+        pw = jnp.arange(P)
+        # per-bin trans offsets: part index = bin * PS // P
+        pi = (ph * PS // P).astype(jnp.int32)
+        pj = (pw * PS // P).astype(jnp.int32)
+        if no_trans or tr is None:
+            dy = jnp.zeros((P, P), jnp.float32)
+            dx = jnp.zeros((P, P), jnp.float32)
+        else:
+            # trans: (2*cls, PS, PS); class 0 used per reference default
+            dy = tr[0, pi[:, None], pj[None, :]] * trans_std * rh
+            dx = tr[1, pi[:, None], pj[None, :]] * trans_std * rw
+        # sample grid per bin: (P, P, sp, sp)
+        sy = (y1 + ph[:, None, None, None] * bin_h + dy[:, :, None, None] +
+              (jnp.arange(sp, dtype=jnp.float32)[None, None, :, None] + 0.5)
+              * bin_h / sp)
+        sx = (x1 + pw[None, :, None, None] * bin_w + dx[:, :, None, None] +
+              (jnp.arange(sp, dtype=jnp.float32)[None, None, None, :] + 0.5)
+              * bin_w / sp)
+        vals = _bilinear_at(img, sy, sx)  # (C, P, P, sp, sp)
+        means = vals.mean(axis=(3, 4))  # (C, P, P)
+        # position-sensitive channel select
+        gi = (ph * G // P).astype(jnp.int32)
+        gj = (pw * G // P).astype(jnp.int32)
+        c_idx = (jnp.arange(output_dim)[:, None, None] * G +
+                 gi[None, :, None]) * G + gj[None, None, :]
+        return means[c_idx, ph[None, :, None], pw[None, None, :]]
+
+    if trans is None:
+        return jax.vmap(lambda r: one_roi(r, None))(rois)
+    return jax.vmap(one_roi)(rois, trans)
+
+
+alias("_contrib_DeformablePSROIPooling", "DeformablePSROIPooling")

@@ -237,6 +237,20 @@ alias("max", "max_axis")
 alias("min", "min_axis")
 
 
+@register_op("_square_sum", arg_names=("data",),
+             param_defaults={"axis": None, "keepdims": False,
+                             "exclude": False})
+def _square_sum(data, axis=None, keepdims=False, exclude=False):
+    """sum(data**2) — the reference's fused sparse reduction
+    (src/operator/tensor/square_sum*.h); dense here, XLA fuses the square
+    into the reduce."""
+    ax = _norm_axis(axis)
+    if exclude and ax is not None:
+        ax = tuple(i for i in range(data.ndim)
+                   if i not in tuple(a % data.ndim for a in ax))
+    return jnp.sum(data * data, axis=ax, keepdims=bool(keepdims))
+
+
 @register_op("norm", arg_names=("data",))
 def _norm(data):
     return jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,))

@@ -23,3 +23,13 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
     return globals()["_arange"](start=start, stop=stop, step=step,
                                 repeat=repeat,
                                 dtype=str(dtype or "float32"), **kwargs)
+
+
+# mx.sym.contrib namespace (mirrors python/mxnet/symbol/contrib.py)
+import types as _types
+
+contrib = _types.ModuleType(__name__ + ".contrib",
+                            "Contrib operators (experimental).")
+for _n, _f in list(globals().items()):
+    if _n.startswith("_contrib_"):
+        setattr(contrib, _n[len("_contrib_"):], _f)

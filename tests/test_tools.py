@@ -1,0 +1,93 @@
+"""Tests for tools/: im2rec, parse_log, launch (local), bandwidth."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _write_images(root, n_per_class=3):
+    from PIL import Image
+    for cls in ["cats", "dogs"]:
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_per_class):
+            arr = np.full((40, 40, 3),
+                          60 if cls == "cats" else 180, np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, "im%d.jpg" % i))
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    import im2rec
+    root = str(tmp_path / "imgs")
+    _write_images(root)
+    prefix = str(tmp_path / "data")
+    im2rec.main([prefix, root, "--list", "--recursive"])
+    assert os.path.exists(prefix + ".lst")
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    im2rec.main([prefix, root, "--resize", "32"])
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+    # the produced rec feeds ImageIter
+    from mxnet_tpu import image
+    it = image.ImageIter(batch_size=2, data_shape=(3, 28, 28),
+                         path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx")
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 28, 28)
+    labels = set()
+    it.reset()
+    for b in it:
+        labels.update(b.label[0].asnumpy().tolist())
+    assert labels == {0.0, 1.0}
+
+
+def test_parse_log(tmp_path):
+    import parse_log
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Train-accuracy=0.50\n"
+        "INFO Epoch[0] Validation-accuracy=0.55\n"
+        "INFO Epoch[0] Time cost=10.5\n"
+        "INFO Epoch[1] Train-accuracy=0.80\n"
+        "INFO Epoch[1] Validation-accuracy=0.75\n"
+        "INFO Epoch[1] Time cost=9.5\n")
+    data = parse_log.parse_log(open(str(log)))
+    assert data[0][0] == 0.50 and data[1][2] == 0.75
+    table = parse_log.format_table(data)
+    assert "| 1 | 0.800000 | 0.750000 | 9.500000 |" in table
+
+
+def test_bandwidth_measure():
+    import importlib
+    sys.path.insert(0, os.path.join(REPO, "tools", "bandwidth"))
+    measure = importlib.import_module("measure")
+    res = measure.measure(num_devices=0, size_mb=4.0, num_arrays=4,
+                          iters=2, warmup=1)
+    assert res["algbw_GBps"] > 0
+    assert res["devices"] >= 1
+
+
+def test_launch_local_spawns_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "rank = os.environ['MXTPU_WORKER_RANK']\n"
+        "n = os.environ['DMLC_NUM_WORKER']\n"
+        "open(os.path.join(%r, 'out_%%s.txt' %% rank), 'w').write(n)\n"
+        % str(tmp_path))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "3", "--cpu-fake-devices", sys.executable, str(script)],
+        env=env, capture_output=True, timeout=120)
+    assert r.returncode == 0, r.stderr.decode()
+    for rank in range(3):
+        p = tmp_path / ("out_%d.txt" % rank)
+        assert p.exists() and p.read_text() == "3"

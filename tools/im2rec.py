@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / .lst into RecordIO (.rec + .idx).
+
+Port of /root/reference/tools/im2rec.py (the C++ twin is tools/im2rec.cc).
+Same CLI shape: `--list` generates prefix.lst from a root dir;
+without --list, packs prefix.lst into prefix.rec/.idx with optional resize
++ JPEG re-encode; `--num-thread N` decodes in a thread pool (PIL codecs
+release the GIL), playing the role of the reference's OpenMP threads.
+"""
+from __future__ import annotations
+
+import argparse
+import io as pyio
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) walking root (reference
+    im2rec.py:list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda x: x[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        if not chunk:
+            continue
+        str_chunk = "_%d" % i if args.chunks > 1 else ""
+        sep = int(len(chunk) * args.train_ratio)
+        sep_test = int(len(chunk) * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        while True:
+            line = fin.readline()
+            if not line:
+                break
+            line = [i.strip() for i in line.strip().split("\t")]
+            line_len = len(line)
+            if line_len < 3:
+                print("lst should have at least has three parts, but only "
+                      "has %s parts for %s" % (line_len, line))
+                continue
+            try:
+                item = [int(line[0])] + [line[-1]] + \
+                    [float(i) for i in line[1:-1]]
+            except Exception as e:
+                print("Parsing lst met error for %s, detail: %s"
+                      % (line, e))
+                continue
+            yield item
+
+
+def image_encode(args, item):
+    """Encode one list item; returns packed record bytes or None."""
+    from mxnet_tpu import recordio
+    fullpath = os.path.join(args.root, item[1])
+    if len(item) > 3 and args.pack_label:
+        header = recordio.IRHeader(0, item[2:], item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[2], item[0], 0)
+    if args.pass_through:
+        try:
+            with open(fullpath, "rb") as fin:
+                img = fin.read()
+            return recordio.pack(header, img)
+        except Exception as e:
+            print("pack_img error:", item[1], e)
+            return None
+    try:
+        from PIL import Image
+        img = Image.open(fullpath)
+        if args.color == 0:
+            img = img.convert("L")
+        elif args.color == 1:
+            img = img.convert("RGB")
+        # color == -1: keep the file's original channels (IMREAD_UNCHANGED)
+        if args.resize:
+            w, h = img.size
+            if w > h:
+                nh, nw = args.resize, int(w * args.resize / h)
+            else:
+                nh, nw = int(h * args.resize / w), args.resize
+            img = img.resize((nw, nh), Image.BILINEAR)
+        if args.center_crop:
+            w, h = img.size
+            s = min(w, h)
+            img = img.crop(((w - s) // 2, (h - s) // 2,
+                            (w + s) // 2, (h + s) // 2))
+        buf = pyio.BytesIO()
+        fmt = "JPEG" if args.encoding in (".jpg", ".jpeg") else "PNG"
+        if fmt == "JPEG" and img.mode not in ("L", "RGB", "CMYK"):
+            img = img.convert("RGB")  # JPEG can't hold alpha
+        img.save(buf, format=fmt, quality=args.quality)
+        return recordio.pack(header, buf.getvalue())
+    except Exception as e:
+        print("imread error trying to load file: %s; %s" % (fullpath, e))
+        return None
+
+
+def write_record(args, fname):
+    from mxnet_tpu import recordio
+    fname = os.path.basename(fname)
+    fname_rec = os.path.splitext(fname)[0] + ".rec"
+    fname_idx = os.path.splitext(fname)[0] + ".idx"
+    working_dir = args.prefix if os.path.isdir(args.prefix) \
+        else os.path.dirname(args.prefix)
+    record = recordio.MXIndexedRecordIO(
+        os.path.join(working_dir, fname_idx),
+        os.path.join(working_dir, fname_rec), "w")
+    image_list = list(read_list(os.path.join(working_dir, fname)
+                                if not os.path.isabs(fname) else fname))
+    cnt = 0
+    pre_time = time.time()
+    if args.num_thread > 1:
+        # decode/encode in a thread pool (PIL releases the GIL for codec
+        # work) — the reference's OpenMP parser role, tools/im2rec.cc
+        from multiprocessing.pool import ThreadPool
+        pool = ThreadPool(args.num_thread)
+        encoded = pool.imap(lambda it: (it, image_encode(args, it)),
+                            image_list, chunksize=8)
+    else:
+        encoded = ((it, image_encode(args, it)) for it in image_list)
+    for item, s in encoded:
+        if s is None:
+            continue
+        record.write_idx(item[0], s)
+        if cnt % 1000 == 0 and cnt > 0:
+            cur_time = time.time()
+            print("time:", cur_time - pre_time, " count:", cnt)
+            pre_time = cur_time
+        cnt += 1
+    if args.num_thread > 1:
+        pool.close()
+        pool.join()
+    record.close()
+    print("total", cnt, "records ->", fname_rec)
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Create an image list or RecordIO file "
+        "(reference tools/im2rec.py)")
+    parser.add_argument("prefix", help="prefix of input/output lst+rec files")
+    parser.add_argument("root", help="path to folder containing images")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", action="store_true",
+                        help="make a list instead of a record")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true")
+    cgroup.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    rgroup = parser.add_argument_group("Options for creating database")
+    rgroup.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack original bytes")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--num-thread", type=int, default=1)
+    rgroup.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    rgroup.add_argument("--encoding", default=".jpg",
+                        choices=[".jpg", ".png"])
+    rgroup.add_argument("--pack-label", action="store_true")
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+        return
+    working_dir = args.prefix if os.path.isdir(args.prefix) \
+        else os.path.dirname(args.prefix) or "."
+    prefix_base = os.path.basename(args.prefix)
+    files = [os.path.join(working_dir, f) for f in os.listdir(working_dir)
+             if f.startswith(prefix_base) and f.endswith(".lst")]
+    for fname in sorted(files):
+        print("Creating .rec file from", fname, "in", working_dir)
+        write_record(args, fname)
+
+
+if __name__ == "__main__":
+    main()

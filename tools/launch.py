@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Launch a distributed training job.
+
+Port of /root/reference/tools/launch.py, re-targeted: the reference
+spawned ps-lite scheduler/server/worker processes through dmlc_tracker
+(ssh/mpi/sge/yarn, launch.py:59-84); the TPU-native framework has no
+server processes — every worker is a JAX process in one collective mesh.
+
+Launchers:
+- ``local``: spawn N worker processes on this host wired together with
+  ``jax.distributed`` (coordinator on 127.0.0.1).  Each worker sees the
+  env contract DMLC_ROLE=worker, DMLC_NUM_WORKER, DMLC_WORKER_ID (kept
+  for script compat) plus JAX_* coordination vars.  This is the
+  reference's `--launcher local` fake-cluster test mode
+  (tests/nightly/dist_sync_kvstore.py workflow).
+- ``ssh``: run one worker per host from `-H hostfile` via ssh, pointing
+  all of them at this host's coordinator port.
+- On real TPU pods, prefer the platform launcher (GKE/queued resources):
+  every pod VM already runs one process; pass --use-env-ranks to adopt
+  the platform-provided rank env instead of spawning.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(args, cmd):
+    port = args.port or _free_port()
+    coordinator = "127.0.0.1:%d" % port
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            # JAX multi-process coordination
+            "MXTPU_COORDINATOR": coordinator,
+            "MXTPU_NUM_WORKERS": str(args.num_workers),
+            "MXTPU_WORKER_RANK": str(rank),
+            # reference env contract (dmlc_tracker) for script compat
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_WORKER_ID": str(rank),
+        })
+        if args.cpu_fake_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen(cmd, env=env))
+    code = 0
+    try:
+        for p in procs:
+            p.wait()
+            code = code or p.returncode
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+        code = 1
+    return code
+
+
+def launch_ssh(args, cmd):
+    assert args.hostfile, "--launcher ssh requires -H hostfile"
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    hosts = (hosts * args.num_workers)[:args.num_workers]
+    port = args.port or _free_port()
+    coordinator = "%s:%d" % (socket.gethostname(), port)
+    procs = []
+    for rank, host in enumerate(hosts):
+        envs = ("MXTPU_COORDINATOR=%s MXTPU_NUM_WORKERS=%d "
+                "MXTPU_WORKER_RANK=%d DMLC_ROLE=worker DMLC_NUM_WORKER=%d "
+                "DMLC_WORKER_ID=%d"
+                % (shlex.quote(coordinator), args.num_workers, rank,
+                   args.num_workers, rank))
+        remote = "cd %s; %s %s" % (shlex.quote(os.getcwd()), envs,
+                                   " ".join(shlex.quote(c) for c in cmd))
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no", host,
+                                       remote]))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (reference tools/launch.py)")
+    parser.add_argument("-n", "--num-workers", required=True, type=int,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="ignored — no parameter servers in the "
+                        "all-reduce design (kept for CLI compat)")
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="hostfile for ssh launcher")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh"],
+                        help="cluster type")
+    parser.add_argument("--port", type=int, default=0,
+                        help="coordinator port (0 = pick a free one)")
+    parser.add_argument("--cpu-fake-devices", action="store_true",
+                        help="force JAX_PLATFORMS=cpu in workers (local "
+                        "fake-cluster testing)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command for launching the program")
+    args = parser.parse_args(argv)
+    cmd = [c for c in args.command if c != "--"]
+    assert cmd, "no command given"
+    if args.launcher == "local":
+        return launch_local(args, cmd)
+    return launch_ssh(args, cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

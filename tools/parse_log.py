@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown table.
+
+Port of /root/reference/tools/parse_log.py: reads `Epoch[k] ...
+Validation-accuracy=...` / `Train-accuracy=...` / `Time cost=...` lines
+emitted by Module.fit and prints per-epoch train/val/time columns.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse_log(lines, metric_name="accuracy"):
+    """Returns dict epoch -> [train, val, time]."""
+    res = [re.compile(r".*Epoch\[(\d+)\] Train-%s.*=([.\d]+)" % metric_name),
+           re.compile(r".*Epoch\[(\d+)\] Validation-%s.*=([.\d]+)"
+                      % metric_name),
+           re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")]
+    data = {}
+    for line in lines:
+        for i, pat in enumerate(res):
+            m = pat.match(line)
+            if m:
+                epoch = int(m.groups()[0])
+                val = float(m.groups()[1])
+                if epoch not in data:
+                    data[epoch] = [0.0] * len(res) * 2
+                data[epoch][i * 2] += val
+                data[epoch][i * 2 + 1] += 1
+    return data
+
+
+def format_table(data):
+    out = ["| epoch | train-accuracy | valid-accuracy | time |",
+           "| --- | --- | --- | --- |"]
+    for k, v in sorted(data.items()):
+        def cell(i):
+            return "%.6f" % (v[i * 2] / v[i * 2 + 1]) if v[i * 2 + 1] else "-"
+        out.append("| %d | %s | %s | %s |" % (k, cell(0), cell(1), cell(2)))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Parse mxnet_tpu training logs")
+    parser.add_argument("logfile", help="the log file for parsing")
+    parser.add_argument("--format", default="markdown",
+                        choices=["markdown", "none"])
+    parser.add_argument("--metric-name", default="accuracy",
+                        help="metric name in the log (e.g. accuracy)")
+    args = parser.parse_args(argv)
+    with open(args.logfile) as f:
+        data = parse_log(f, args.metric_name)
+    if args.format == "markdown":
+        print(format_table(data))
+    return data
+
+
+if __name__ == "__main__":
+    main()
