@@ -1,0 +1,113 @@
+"""Data iterators for the image-classification examples.
+
+Port of /root/reference/example/image-classification/common/data.py:
+ImageRecordIter pipelines from --data-train/--data-val .rec files, plus
+the synthetic benchmark iterator (`SyntheticDataIter`) the reference used
+for --benchmark runs.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, help="the training data")
+    data.add_argument("--data-val", type=str, help="the validation data")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939",
+                      help="a tuple of size 3 for the mean rgb")
+    data.add_argument("--pad-size", type=int, default=0,
+                      help="padding size before random crop")
+    data.add_argument("--image-shape", type=str, default="3,224,224",
+                      help="the image shape feed into the network")
+    data.add_argument("--num-classes", type=int, default=1000,
+                      help="the number of classes")
+    data.add_argument("--num-examples", type=int, default=1281167,
+                      help="the number of training examples")
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="number of threads for data decoding")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="if 1, then feed the network with synthetic "
+                      "data")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group(
+        "Image augmentations", "implemented in the decode pipeline")
+    aug.add_argument("--random-crop", type=int, default=1)
+    aug.add_argument("--random-mirror", type=int, default=1)
+    aug.add_argument("--max-random-h", type=int, default=0)
+    aug.add_argument("--max-random-s", type=int, default=0)
+    aug.add_argument("--max-random-l", type=int, default=0)
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0)
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0)
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0)
+    aug.add_argument("--max-random-scale", type=float, default=1)
+    aug.add_argument("--min-random-scale", type=float, default=1)
+    return aug
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """Deterministic random batches entirely on the host — the reference's
+    benchmark-mode iterator; removes IO from throughput measurements."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        super().__init__(data_shape[0])
+        self.batch_size = data_shape[0]
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        self.dtype = dtype
+        label = np.random.randint(0, num_classes, [self.batch_size])
+        data = np.random.uniform(-1, 1, data_shape).astype(dtype)
+        self.data = mx.nd.array(data)
+        self.label = mx.nd.array(label.astype(np.float32))
+        self.provide_data = [mx.io.DataDesc("data", data_shape, dtype)]
+        self.provide_label = [mx.io.DataDesc("softmax_label",
+                                             (self.batch_size,))]
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter > self.max_iter:
+            raise StopIteration
+        return mx.io.DataBatch(data=[self.data], label=[self.label],
+                               pad=0, index=None,
+                               provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def get_rec_iter(args, kv=None):
+    """ImageRecordIter pair from --data-train/--data-val, or synthetic
+    when --benchmark (reference common/data.py:get_rec_iter)."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.benchmark or not args.data_train:
+        data_shape = (args.batch_size,) + image_shape
+        train = SyntheticDataIter(args.num_classes, data_shape,
+                                  max(1, args.num_examples //
+                                      args.batch_size))
+        return (train, None)
+    rgb_mean = [float(i) for i in args.rgb_mean.split(",")]
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        preprocess_threads=args.data_nthreads,
+        shuffle=True,
+        rand_crop=bool(args.random_crop),
+        rand_mirror=bool(args.random_mirror),
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2])
+    if not args.data_val:
+        return (train, None)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        preprocess_threads=args.data_nthreads,
+        shuffle=False,
+        rand_crop=False, rand_mirror=False,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2])
+    return (train, val)

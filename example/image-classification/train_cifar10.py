@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Train on CIFAR-10 RecordIO (reference example/image-classification/
+train_cifar10.py; the ≥0.93 top-1 CI gate lives on this script,
+Jenkinsfile:476)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(os.path.expanduser(__file__))), "..", ".."))
+from common import data, fit  # noqa: E402
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    parser.set_defaults(
+        network="resnet", num_layers=110,
+        image_shape="3,28,28", pad_size=4,
+        num_classes=10, num_examples=50000,
+        num_epochs=300, lr=0.05, lr_step_epochs="200,250",
+        batch_size=128)
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    from importlib import import_module
+    net = import_module("symbols." + args.network).get_symbol(
+        num_classes=args.num_classes, num_layers=args.num_layers,
+        image_shape=args.image_shape)
+    fit.fit(args, net, data.get_rec_iter)

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Train on ImageNet RecordIO — BASELINE config #2
+(`--kv-store device` unmodified).
+
+Port of /root/reference/example/image-classification/train_imagenet.py
+(:58 is the entry the north-star call stack names).  `--benchmark 1`
+feeds synthetic batches (throughput mode, no dataset needed).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(os.path.expanduser(__file__))), "..", ".."))
+from common import data, fit  # noqa: E402
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="train imagenet-1k",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    parser.set_defaults(
+        network="resnet", num_layers=50,
+        image_shape="3,224,224", num_classes=1000,
+        num_examples=1281167,
+        num_epochs=80, lr_step_epochs="30,60",
+        batch_size=128)
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    from importlib import import_module
+    net = import_module("symbols." + args.network).get_symbol(
+        num_classes=args.num_classes, num_layers=args.num_layers,
+        image_shape=args.image_shape)
+    fit.fit(args, net, data.get_rec_iter)

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Train mlp/lenet on MNIST — BASELINE config #1, runs unmodified on
+ctx=tpu.
+
+Port of /root/reference/example/image-classification/train_mnist.py.
+Reads idx-format MNIST from --data-dir when present; zero-egress
+environments fall back to a deterministic synthetic digit set (drawn
+digit strokes, still a real 10-class image problem).
+"""
+import argparse
+import gzip
+import logging
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(os.path.expanduser(__file__))), "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from common import fit  # noqa: E402
+
+
+def read_data(label_path, image_path):
+    with gzip.open(label_path) as flbl:
+        struct.unpack(">II", flbl.read(8))
+        label = np.frombuffer(flbl.read(), dtype=np.int8)
+    with gzip.open(image_path) as fimg:
+        _, num, rows, cols = struct.unpack(">IIII", fimg.read(16))
+        image = np.frombuffer(fimg.read(), dtype=np.uint8)
+        image = image.reshape(len(label), rows, cols)
+    return (label, image)
+
+
+def _synthetic_digits(n, seed=0):
+    """Deterministic 10-class 'digit' images: class k = k bright bars at
+    distinct row positions + noise.  Linearly separable enough for an
+    MLP, conv-friendly for LeNet."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.uniform(0, 0.15, (n, 28, 28)).astype(np.float32)
+    for i, cls in enumerate(y):
+        rows = (np.arange(cls + 1) * 28) // 10
+        for r in rows:
+            x[i, r:r + 2, 4:24] += 0.8
+    return y.astype(np.float32), np.clip(x, 0, 1)
+
+
+def to4d(img):
+    return img.reshape(img.shape[0], 1, 28, 28).astype(np.float32) / 255
+
+
+def get_mnist_iter(args, kv):
+    data_dir = args.data_dir
+    files = ["train-labels-idx1-ubyte.gz", "train-images-idx3-ubyte.gz",
+             "t10k-labels-idx1-ubyte.gz", "t10k-images-idx3-ubyte.gz"]
+    if data_dir and all(os.path.exists(os.path.join(data_dir, f))
+                        for f in files):
+        (train_lbl, train_img) = read_data(
+            os.path.join(data_dir, files[0]), os.path.join(data_dir,
+                                                           files[1]))
+        (val_lbl, val_img) = read_data(
+            os.path.join(data_dir, files[2]), os.path.join(data_dir,
+                                                           files[3]))
+        train_img, val_img = to4d(train_img), to4d(val_img)
+    else:
+        logging.warning("MNIST files not found under %r; using the "
+                        "synthetic digit set", data_dir)
+        train_lbl, timg = _synthetic_digits(args.num_examples, seed=0)
+        val_lbl, vimg = _synthetic_digits(args.num_examples // 6, seed=1)
+        train_img = timg[:, None, :, :]
+        val_img = vimg[:, None, :, :]
+    train = mx.io.NDArrayIter(train_img, train_lbl, args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(val_img, val_lbl, args.batch_size)
+    return (train, val)
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="train an image classifier on mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-dir", type=str, default="mnist_data")
+    parser.add_argument("--add_stn", action="store_true")
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=10,
+                        lr=0.05, lr_step_epochs="10", batch_size=64,
+                        disp_batches=100)
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    from importlib import import_module
+    net = import_module("symbols." + args.network).get_symbol(
+        num_classes=args.num_classes, num_layers=args.num_layers or 2,
+        image_shape="1,28,28", add_stn=args.add_stn)
+    fit.fit(args, net, get_mnist_iter)

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Model-parallel LSTM: layers placed on different devices —
+BASELINE config #5.
+
+Port of /root/reference/example/model-parallel-lstm/lstm.py:65-116: each
+LSTM layer is built inside ``with mx.AttrScope(ctx_group='layer%d')`` and
+bind maps groups to devices via ``group2ctx``.  TPU-native, the ctx_group
+becomes a placement constraint inside ONE XLA program (executor.py) —
+XLA partitions the program and inserts the transfers that the reference's
+PlaceDevice pass expressed as _CrossDeviceCopy nodes.
+
+Run on CPU with 8 virtual devices to see the partitioning:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python lstm.py --num-layers 4 --ngpu 4
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(os.path.expanduser(__file__))), "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+
+def lstm_unroll(num_layers, seq_len, input_size, num_hidden, num_embed,
+                num_label, group_for_layer):
+    """Unrolled multi-layer LSTM with per-layer ctx groups."""
+    cells = []
+    for i in range(num_layers):
+        with mx.AttrScope(ctx_group=group_for_layer(i)):
+            cells.append(mx.rnn.LSTMCell(num_hidden, prefix="l%d_" % i))
+
+    with mx.AttrScope(ctx_group=group_for_layer(0)):
+        data = mx.sym.Variable("data")
+        embed = mx.sym.Embedding(data=data, input_dim=input_size,
+                                 output_dim=num_embed, name="embed")
+        inputs = mx.sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
+                                     squeeze_axis=1)
+
+    states = [c.begin_state() for c in cells]
+    hiddens = list(inputs)
+    for i, cell in enumerate(cells):
+        with mx.AttrScope(ctx_group=group_for_layer(i)):
+            next_h = []
+            for t in range(seq_len):
+                h, states[i] = cell(hiddens[t], states[i])
+                next_h.append(h)
+            hiddens = next_h
+
+    with mx.AttrScope(ctx_group=group_for_layer(num_layers - 1)):
+        concat = mx.sym.Concat(*[mx.sym.expand_dims(h, axis=1)
+                                 for h in hiddens], dim=1)
+        pred = mx.sym.Reshape(concat, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=num_label,
+                                     name="pred")
+        label = mx.sym.Variable("softmax_label")
+        label_r = mx.sym.Reshape(label, shape=(-1,))
+        sm = mx.sym.SoftmaxOutput(data=pred, label=label_r, name="softmax")
+    return sm
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="model-parallel LSTM (reference "
+        "example/model-parallel-lstm)")
+    parser.add_argument("--num-layers", type=int, default=4)
+    parser.add_argument("--seq-len", type=int, default=16)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--vocab", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--ngpu", type=int, default=2,
+                        help="number of devices to spread layers over")
+    parser.add_argument("--steps", type=int, default=300)
+    parser.add_argument("--lr", type=float, default=0.7)
+    parser.add_argument("--clip", type=float, default=5.0)
+    args = parser.parse_args()
+
+    import jax
+    ndev = min(args.ngpu, len(jax.local_devices()))
+    print("spreading %d layers over %d devices" % (args.num_layers, ndev))
+
+    def group_for_layer(i):
+        return "group%d" % (i * ndev // args.num_layers)
+
+    sym = lstm_unroll(args.num_layers, args.seq_len, args.vocab,
+                      args.num_hidden, args.num_embed, args.vocab,
+                      group_for_layer)
+    ctx = mx.tpu if mx.num_gpus() > 0 else mx.cpu
+    group2ctx = {"group%d" % i: ctx(i) for i in range(ndev)}
+
+    exe = sym.simple_bind(ctx=ctx(0), group2ctx=group2ctx,
+                          data=(args.batch_size, args.seq_len),
+                          softmax_label=(args.batch_size, args.seq_len),
+                          grad_req="write")
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rng.uniform(-0.08, 0.08, arr.shape)
+
+    # synthetic next-token task: t+1 = (t + 1) % vocab
+    x = np.zeros((args.batch_size, args.seq_len), np.float32)
+    y = np.zeros((args.batch_size, args.seq_len), np.float32)
+    for b in range(args.batch_size):
+        start = rng.randint(0, args.vocab)
+        seq = [(start + t) % args.vocab for t in range(args.seq_len + 1)]
+        x[b] = seq[:-1]
+        y[b] = seq[1:]
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["softmax_label"][:] = y
+
+    import time
+    for step in range(args.steps):
+        t0 = time.time()
+        exe.forward_backward()
+        # global-norm gradient clipping, as the reference example's
+        # training loop (model-parallel-lstm/lstm.py) did
+        grads = {name: grad.asnumpy()
+                 for name, grad in exe.grad_dict.items()
+                 if grad is not None and
+                 name not in ("data", "softmax_label")}
+        gnorm = np.sqrt(sum(float((g * g).sum())
+                            for g in grads.values()))
+        scale = args.clip / max(gnorm, args.clip)
+        for name, g in grads.items():
+            exe.arg_dict[name][:] = \
+                exe.arg_dict[name].asnumpy() - (args.lr * scale) * g
+        if step % 10 == 0:
+            out = exe.outputs[0].asnumpy()
+            nll = -np.log(np.maximum(
+                out[np.arange(out.shape[0]), y.reshape(-1).astype(int)],
+                1e-9)).mean()
+            print("step %d nll %.4f (%.3fs)" % (step, nll,
+                                                time.time() - t0))
+    print("final nll:", nll)
+    if args.steps >= 200:
+        assert nll < 2.5, "model-parallel LSTM failed to learn"
+    print("MODEL PARALLEL LSTM OK")
+
+
+if __name__ == "__main__":
+    main()

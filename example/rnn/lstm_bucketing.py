@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""PTB-style LSTM language model with BucketingModule — BASELINE
+config #3.
+
+Port of /root/reference/example/rnn/lstm_bucketing.py: FusedRNNCell (the
+lax.scan fused RNN) unrolled per bucket; each bucket length is one
+static-shape XLA program in the jit cache.  Without --data-train it
+generates a synthetic corpus with learnable bigram structure.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(os.path.expanduser(__file__))), "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+parser = argparse.ArgumentParser(
+    description="Train an LSTM language model with bucketing",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--data-train", type=str, default=None,
+                    help="tokenized text file (one sentence per line); "
+                    "synthetic corpus when absent")
+parser.add_argument("--num-hidden", type=int, default=200)
+parser.add_argument("--num-embed", type=int, default=200)
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-epochs", type=int, default=25)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--optimizer", type=str, default="adam")
+parser.add_argument("--mom", type=float, default=0.0)
+parser.add_argument("--wd", type=float, default=1e-5)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--disp-batches", type=int, default=50)
+parser.add_argument("--kv-store", type=str, default="device")
+parser.add_argument("--buckets", type=str, default="10,20,30,40")
+
+
+def synthetic_corpus(n_sent=2000, vocab=200, seed=0):
+    """Markov-chain sentences: token t+1 = (2*t + noise) mod vocab."""
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n_sent):
+        L = rng.randint(5, 40)
+        s = [rng.randint(1, vocab)]
+        for _ in range(L - 1):
+            s.append((2 * s[-1] + rng.randint(0, 3)) % (vocab - 1) + 1)
+        sents.append(s)
+    return sents, vocab
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = [line.split() for line in f]
+    return mx.rnn.encode_sentences(lines, vocab=vocab,
+                                   invalid_label=invalid_label,
+                                   start_label=start_label)
+
+
+if __name__ == "__main__":
+    import logging
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    args = parser.parse_args()
+    buckets = [int(b) for b in args.buckets.split(",")]
+    invalid_label = 0
+    if args.data_train and os.path.exists(args.data_train):
+        sentences, vocab = tokenize_text(args.data_train, start_label=1)
+        vocab_size = len(vocab) + 1
+    else:
+        sentences, vocab_size = synthetic_corpus()
+
+    data_train = mx.rnn.BucketSentenceIter(
+        sentences, args.batch_size, buckets=buckets,
+        invalid_label=invalid_label)
+
+    cell = mx.rnn.FusedRNNCell(args.num_hidden,
+                               num_layers=args.num_layers, mode="lstm",
+                               prefix="lstm_")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        cell.reset()
+        outputs, states = cell.unroll(seq_len, inputs=embed,
+                                      merge_outputs=True, layout="NTC")
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+        label_r = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label_r,
+                                    name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=mx.tpu() if mx.num_gpus() > 0 else mx.cpu())
+
+    model.fit(
+        train_data=data_train,
+        eval_metric=mx.metric.Perplexity(invalid_label),
+        kvstore=args.kv_store,
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr, "wd": args.wd},
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))
+
+    score = model.score(data_train,
+                        mx.metric.Perplexity(invalid_label))
+    print("final train perplexity: %.3f" % dict(score)["perplexity"])

@@ -67,6 +67,19 @@ class Executor:
         nodes = self._symbol._topo_nodes()
         sym_outputs = self._symbol._outputs
 
+        # ctx_group model parallelism (reference: nnvm PlaceDevice pass +
+        # _CrossDeviceCopy, graph_executor.cc:309-395).  TPU-native: each
+        # group's ctx resolves to a device, and jax.device_put inside the
+        # traced program becomes a placement constraint — XLA partitions
+        # the one program across devices instead of inserting copy ops.
+        placement = {}
+        if self._group2ctx:
+            for node in nodes:
+                grp = (node.attrs or {}).get("ctx_group")
+                if grp and grp in self._group2ctx:
+                    placement[id(node)] = \
+                        self._group2ctx[grp].jax_device()
+
         def graph_fn(arg_vals, aux_vals, rng, train, tap=None):
             """tap(node, vis_outputs) is called per node when set — used by
             the monitor's eager interpret mode only (never under jit)."""
@@ -75,10 +88,12 @@ class Executor:
 
             for i, node in enumerate(nodes):
                 if node.is_var:
-                    if node.is_aux_var:
-                        vals[id(node)] = [aux_vals[node.name]]
-                    else:
-                        vals[id(node)] = [arg_vals[node.name]]
+                    v = aux_vals[node.name] if node.is_aux_var \
+                        else arg_vals[node.name]
+                    dev = placement.get(id(node))
+                    if dev is not None and tap is None:
+                        v = jax.device_put(v, dev)
+                    vals[id(node)] = [v]
                     continue
                 inputs = [vals[id(inp)][idx] for inp, idx in node.inputs]
                 params = dict(node.params)
@@ -90,6 +105,12 @@ class Executor:
                 flat = list(out) if isinstance(out, (tuple, list)) else [out]
                 n_vis = node.op.num_outputs(node.params)
                 vis, extra = flat[:n_vis], flat[n_vis:]
+                dev = placement.get(id(node))
+                if dev is not None and tap is None:
+                    # placement constraints only under jit — eager
+                    # (monitor interpret) mode would make mixed-device
+                    # op calls illegal in JAX
+                    vis = [jax.device_put(v, dev) for v in vis]
                 vals[id(node)] = vis
                 if node.op.mutate_aux and extra and train:
                     aux_inputs = [inp for inp, _ in node.inputs

@@ -165,7 +165,6 @@ _UNARY = {
     "negative": jnp.negative,
     "reciprocal": jnp.reciprocal,
     "identity": lambda x: x,
-    "make_loss": lambda x: x,
     "stop_gradient": lax.stop_gradient,
     "zeros_like": jnp.zeros_like,
     "ones_like": jnp.ones_like,
@@ -180,8 +179,31 @@ for _name, _jfn in _UNARY.items():
 
 alias("identity", "_copy")
 alias("stop_gradient", "BlockGrad")
-alias("make_loss", "MakeLoss")
 alias("negative", "_neg")
+
+
+@register_op("make_loss", arg_names=("data",),
+             param_defaults={"grad_scale": 1.0, "normalization": "null",
+                             "valid_thresh": 0.0})
+def _make_loss(data, grad_scale=1.0, normalization="null",
+               valid_thresh=0.0):
+    """Identity forward whose gradient is grad_scale (normalized) —
+    reference src/operator/make_loss-inl.h.  grad_scale=0 blocks the
+    gradient (used to expose extra outputs from training symbols)."""
+    if normalization == "batch":
+        s = grad_scale / data.shape[0]
+    elif normalization == "valid":
+        # reference counts data > valid_thresh (mshadow_op::threshold,
+        # make_loss-inl.h:107) — signed, not abs
+        cnt = lax.stop_gradient(
+            jnp.maximum((data > valid_thresh).sum(), 1))
+        s = grad_scale / cnt.astype(data.dtype)
+    else:
+        s = grad_scale
+    # forward value is exactly `data`; d(out)/d(data) = s
+    return data * s + lax.stop_gradient(data * (1.0 - s))
+
+alias("make_loss", "MakeLoss")
 
 
 @register_op("Cast", arg_names=("data",), param_defaults={"dtype": "float32"})

@@ -1,0 +1,61 @@
+"""Smoke tests for example/ scripts (the reference gates via
+example/image-classification/test_score.py + nightly runs; here each
+script runs a short config as a subprocess)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(REPO, "example")
+
+
+def _run(cwd, args, timeout=420):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    r = subprocess.run([sys.executable] + args, cwd=cwd, env=env,
+                       capture_output=True, timeout=timeout)
+    assert r.returncode == 0, (r.stdout.decode()[-1500:] +
+                               r.stderr.decode()[-1500:])
+    return r.stdout.decode() + r.stderr.decode()
+
+
+def test_train_mnist_synthetic():
+    out = _run(os.path.join(EX, "image-classification"),
+               ["train_mnist.py", "--num-epochs", "2", "--num-examples",
+                "1200", "--network", "mlp", "--data-dir", "/nonexistent"])
+    assert "Train-accuracy" in out
+
+
+def test_train_imagenet_benchmark_mode():
+    out = _run(os.path.join(EX, "image-classification"),
+               ["train_imagenet.py", "--benchmark", "1", "--num-epochs",
+                "1", "--num-examples", "64", "--batch-size", "8",
+                "--image-shape", "3,32,32", "--num-classes", "10",
+                "--num-layers", "18", "--kv-store", "device"])
+    assert "Train-accuracy" in out
+
+
+def test_lstm_bucketing_short():
+    out = _run(os.path.join(EX, "rnn"),
+               ["lstm_bucketing.py", "--num-epochs", "1", "--num-hidden",
+                "32", "--num-embed", "16"])
+    assert "perplexity" in out.lower()
+
+
+def test_ssd_smoke():
+    out = _run(os.path.join(EX, "ssd"),
+               ["train.py", "--steps", "5", "--batch-size", "4",
+                "--image-size", "32"])
+    assert "detections shape" in out
+
+
+def test_model_parallel_lstm_smoke():
+    out = _run(os.path.join(EX, "model-parallel-lstm"),
+               ["lstm.py", "--num-layers", "2", "--ngpu", "2", "--steps",
+                "15", "--num-hidden", "32", "--num-embed", "16",
+                "--seq-len", "8"])
+    assert "MODEL PARALLEL LSTM OK" in out
