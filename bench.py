@@ -8,6 +8,7 @@ per step, images 224x224x3.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
+import functools
 import json
 import os
 import sys
@@ -18,8 +19,8 @@ BASELINE_IMG_S = 109.0  # 1x K80, bs 32, reference README
 
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
 
     import jax
@@ -42,17 +43,31 @@ def main():
     aux_params = params[n_diff:]
     mom = [jnp.zeros_like(p) for p in diff_params]
 
+    # mixed precision: bf16 activations/weights on the MXU, fp32 master
+    # weights + fp32 update (the reference's mp_sgd fp16 recipe,
+    # src/operator/optimizer_op.cc; BENCH_DTYPE=float32 opts out)
+    bench_dtype = os.environ.get(
+        "BENCH_DTYPE", "bfloat16" if platform != "cpu" else "float32")
+    if bench_dtype not in ("bfloat16", "float32"):
+        raise ValueError("BENCH_DTYPE must be bfloat16 or float32, got %r"
+                         % bench_dtype)
+    cdt = jnp.bfloat16 if bench_dtype == "bfloat16" else jnp.float32
+
     def loss_fn(diff, aux, x, y, rng):
-        (logits,), new_aux = fn(list(diff) + list(aux), x, rng=rng)
+        cdiff = [p.astype(cdt) for p in diff]
+        (logits,), new_aux = fn(cdiff + list(aux), x.astype(cdt), rng=rng)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         loss = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
         return loss, new_aux
 
-    @jax.jit
+    # donate params/aux/momentum: the step updates them in place in HBM
+    # (PlanMemory's inplace discipline, done by XLA buffer donation)
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(diff, aux, mom, x, y, rng):
         (loss, new_aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(diff, aux, x, y, rng)
-        new_mom = [0.9 * m - 0.05 * g for m, g in zip(mom, grads)]
+        new_mom = [0.9 * m - 0.05 * g.astype(jnp.float32)
+                   for m, g in zip(mom, grads)]
         new_diff = [p + m for p, m in zip(diff, new_mom)]
         return new_diff, list(new_aux), new_mom, loss
 
@@ -76,8 +91,8 @@ def main():
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",
         "value": round(img_s, 2),
-        "unit": "img/s (bs %d, %dx%d, 1 %s device)" % (
-            batch, image, image, platform),
+        "unit": "img/s (bs %d, %dx%d, %s, 1 %s device)" % (
+            batch, image, image, bench_dtype, platform),
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
     }))
 

@@ -431,18 +431,26 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     if fix_gamma:
         gamma = lax.stop_gradient(jnp.ones_like(gamma))
+    # stats reduce in >= fp32 — the AMP recipe: bf16/fp16 activations
+    # with fp32 statistics (batch_norm-inl.h computes in real_t
+    # regardless of the data dtype); f64 test data stays f64
+    sdt = jnp.promote_types(data.dtype, jnp.float32)
     if _train and not use_global_stats:
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
-        new_mm = moving_mean * momentum + lax.stop_gradient(mean) * (1 - momentum)
-        new_mv = moving_var * momentum + lax.stop_gradient(var) * (1 - momentum)
+        data_s = data.astype(sdt)
+        mean = jnp.mean(data_s, axis=reduce_axes)
+        var = jnp.var(data_s, axis=reduce_axes)
+        new_mm = moving_mean * momentum + \
+            lax.stop_gradient(mean).astype(moving_mean.dtype) * (1 - momentum)
+        new_mv = moving_var * momentum + \
+            lax.stop_gradient(var).astype(moving_var.dtype) * (1 - momentum)
     else:
-        mean = moving_mean
-        var = moving_var
+        mean = moving_mean.astype(sdt)
+        var = moving_var.astype(sdt)
         new_mm, new_mv = moving_mean, moving_var
     inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) \
-        * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = ((data.astype(sdt) - mean.reshape(bshape))
+           * inv.reshape(bshape) * gamma.astype(sdt).reshape(bshape)
+           + beta.astype(sdt).reshape(bshape)).astype(data.dtype)
     if output_mean_var:
         return out, mean, inv, new_mm, new_mv
     return out, new_mm, new_mv

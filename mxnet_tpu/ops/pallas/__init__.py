@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference's hand-written CUDA kernels (src/operator/*.cu) map to XLA
+lowerings almost everywhere — XLA's fusion already covers what mshadow
+kernel launches did.  The kernels here cover the cases XLA does NOT fuse
+well: flash attention (online-softmax blockwise attention, the long-
+context workhorse the 2017 reference predates).
+"""
+from .flash_attention import flash_attention, flash_attention_reference
+
+__all__ = ["flash_attention", "flash_attention_reference"]

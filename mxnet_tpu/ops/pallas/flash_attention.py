@@ -1,0 +1,375 @@
+"""Flash attention as Pallas TPU kernels (fwd + bwd), with custom VJP.
+
+Design (standard two-pass scheme, Dao et al.):
+- forward: grid over (batch·heads, q-blocks); each program streams K/V
+  blocks through VMEM with an online-softmax (m, l) accumulator — the
+  T×T score matrix never exists; saves out + logsumexp for backward.
+- backward: dq kernel (grid over q-blocks) and dk/dv kernel (grid over
+  k-blocks) recompute P = exp(S - lse) blockwise on the MXU.
+
+All matmuls run with preferred_element_type=float32 (MXU accumulates in
+fp32 even for bf16 inputs).  Off-TPU the same kernels run under the
+Pallas interpreter, so tests pass on CPU unchanged.
+
+The 2017 reference has no attention op at all (SURVEY §5: pre-attention
+era — its sequence story was bucketing); this kernel is the long-context
+foundation `parallel/ring_attention.py` documents.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _pl():
+    """Import pallas lazily: under the axon tunnel's forced-CPU test env
+    the checkify import chain can fail at process level; real TPU and
+    clean-CPU processes import fine."""
+    from jax.experimental import pallas as pl
+    return pl
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _causal_mask(q_off, k_off, bq, bk):
+    q_pos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos >= k_pos
+
+
+def _kv_bounds_mask(k_off, bq, bk, tk):
+    """False on K columns beyond the true sequence length (block padding
+    when tk is not a multiple of block_k)."""
+    k_pos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return k_pos < tk
+
+
+def _q_bounds_mask(q_off, bq, bk, tq):
+    q_pos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    return q_pos < tq
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, q_off_base, tk_true):
+    pl = _pl()
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    tk = k_ref.shape[1]
+    nk = pl.cdiv(tk, block_k)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+    q_off = q_off_base + qi * bq
+
+    def body(step, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(step * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(step * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        mask = _kv_bounds_mask(step * block_k, bq, block_k, tk_true)
+        if causal:
+            mask &= _causal_mask(q_off, step * block_k, bq, block_k)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_new = o * corr + pv
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((bq, v_ref.shape[2]), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o, m, l = lax.fori_loop(0, nk, body, (o0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)  # (bq, 1)
+
+
+def _pad_to(x, axis, mult):
+    """Zero-pad axis up to a multiple of mult (pl.ds clamps out-of-range
+    block starts, silently shifting the window — aligned shapes + masks
+    keep the math exact)."""
+    size = x.shape[axis]
+    rem = size % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, mult - rem)
+    return jnp.pad(x, pad)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    pl = _pl()
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    dv = v.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tk % block_k:
+        # kernels mask on the padded length's tail via tk_true
+        kp = _pad_to(k, 1, block_k)
+        vp = _pad_to(v, 1, block_k)
+        out, lse = _flash_fwd_aligned(q, kp, vp, scale, causal, block_q,
+                                      block_k, tk_true=tk)
+        return out, lse
+    return _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k,
+                              tk_true=tk)
+
+
+def _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k, tk_true):
+    pl = _pl()
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    dv = v.shape[2]
+    grid = (bh, pl.cdiv(tq, block_q))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, q_off_base=0, tk_true=tk_true),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, dv), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dv), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, dv), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, causal, block_k, tk_true):
+    pl = _pl()
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    tk = k_ref.shape[1]
+    nk = pl.cdiv(tk, block_k)
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]      # (bq, 1)
+    delta = delta_ref[0]  # (bq, 1)
+    q_off = qi * bq
+
+    def body(step, dq):
+        k = k_ref[0, pl.ds(step * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(step * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mask = _kv_bounds_mask(step * block_k, bq, block_k, tk_true)
+        if causal:
+            mask &= _causal_mask(q_off, step * block_k, bq, block_k)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_step = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dq + dq_step * scale
+
+    dq = lax.fori_loop(0, nk, body,
+                       jnp.zeros((bq, q_ref.shape[2]), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, tq_true):
+    pl = _pl()
+    ki = pl.program_id(1)
+    bk = k_ref.shape[1]
+    tq = q_ref.shape[1]
+    nq = pl.cdiv(tq, block_q)
+
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_off = ki * bk
+
+    def body(step, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(step * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(step * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, pl.ds(step * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(step * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        # padded/clamped q rows (tq % block_q: pl.ds clamps, duplicating
+        # the tail rows) must contribute zero to dk/dv
+        mask = _q_bounds_mask(step * block_q, block_q, bk, tq_true)
+        if causal:
+            mask &= _causal_mask(step * block_q, k_off, block_q, bk)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        p = jnp.where(mask, p, 0.0)
+        # dv += P^T @ dO
+        dv_step = jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)  # (bq, bk)
+        dk_step = jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk + dk_step * scale, dv + dv_step
+
+    dk0 = jnp.zeros((bk, k_ref.shape[2]), jnp.float32)
+    dv0 = jnp.zeros((bk, v_ref.shape[2]), jnp.float32)
+    dk, dv = lax.fori_loop(0, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k):
+    pl = _pl()
+    q, k, v, out, lse = res
+    do = g
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    dv_dim = v.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    # delta_i = rowsum(dO_i * O_i)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    # pad every pl.ds-streamed operand to its block multiple (clamped
+    # dynamic-slice starts would silently shift the window otherwise);
+    # kernels mask on the true lengths, outputs are sliced back
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    qp = _pad_to(q, 1, block_q)
+    dop = _pad_to(do, 1, block_q)
+    lsep = _pad_to(lse, 1, block_q)
+    deltap = _pad_to(delta, 1, block_q)
+    tkp = kp.shape[1]
+    tqp = qp.shape[1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, tk_true=tk),
+        grid=(bh, pl.cdiv(tq, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tkp, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tkp, dv_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, dv_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_use_interpret(),
+    )(q, kp, vp, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, tq_true=tq),
+        grid=(bh, pl.cdiv(tk, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, tqp, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tqp, dv_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tqp, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tqp, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_use_interpret(),
+    )(qp, k, v, dop, lsep, deltap)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_vjp_fwd(q3, k3, v3, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+    return _flash_bwd(res, g, scale, causal, block_q, block_k)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128):
+    """Fused attention over [B, H, T, D] tensors.
+
+    Memory O(T) per program instead of O(T²); differentiable (flash
+    backward kernels).  Off-TPU backends run the same kernels in the
+    Pallas interpreter.
+    """
+    b, h, tq, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    q3 = q.reshape(b * h, tq, d)
+    k3 = k.reshape(b * h, k.shape[2], k.shape[3])
+    v3 = v.reshape(b * h, v.shape[2], v.shape[3])
+    out = _flash(q3, k3, v3, float(scale), bool(causal), int(block_q),
+                 int(block_k))
+    return out.reshape(b, h, tq, v.shape[3])
+
+
+def flash_attention_reference(q, k, v, causal=False, scale=None):
+    """O(T²) jnp oracle for tests."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        mask = _causal_mask(0, 0, tq, tk)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,84 @@
+"""Standalone flash-attention checks; run in a CLEAN process (no axon
+sitecustomize contamination) by tests/test_flash_attention.py.
+
+Prints FLASH_OK on success; asserts otherwise.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from mxnet_tpu.ops.pallas import (flash_attention,  # noqa: E402
+                                  flash_attention_reference)
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.RandomState(seed).uniform(
+        -1, 1, shape).astype(np.float32))
+
+
+def check_forward():
+    for causal in (False, True):
+        for shape in ((2, 3, 64, 32), (1, 2, 128, 64)):
+            q, k, v = (_rand(shape, i) for i in range(3))
+            out = flash_attention(q, k, v, causal=causal, block_q=32,
+                                  block_k=32)
+            ref = flash_attention_reference(q, k, v, causal=causal)
+            err = np.abs(np.asarray(out) - np.asarray(ref)).max()
+            assert err < 2e-5, ("fwd", causal, shape, err)
+
+
+def check_cross_attention():
+    q = _rand((2, 2, 32, 16), 0)
+    k = _rand((2, 2, 96, 16), 1)
+    v = _rand((2, 2, 96, 24), 2)
+    out = flash_attention(q, k, v, block_q=16, block_k=32)
+    ref = flash_attention_reference(q, k, v)
+    assert out.shape == (2, 2, 32, 24)
+    assert np.allclose(out, ref, atol=2e-5)
+
+
+def check_grads():
+    for causal in (False, True):
+        shape = (1, 2, 64, 32)
+        q, k, v, tgt = (_rand(shape, i + 3) for i in range(4))
+
+        def loss(att):
+            def f(q, k, v):
+                o = att(q, k, v)
+                return jnp.sum((o - tgt) ** 2)
+            return f
+
+        g_f = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=32, block_k=32)),
+            argnums=(0, 1, 2))(q, k, v)
+        g_r = jax.grad(loss(lambda q, k, v: flash_attention_reference(
+            q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_f, g_r, "qkv"):
+            err = np.abs(np.asarray(gf) - np.asarray(gr)).max()
+            assert err < 5e-4, ("grad d%s" % name, causal, err)
+
+
+def check_jit_odd_lengths():
+    q = _rand((1, 1, 48, 16), 7)
+    k = _rand((1, 1, 80, 16), 8)
+    v = _rand((1, 1, 80, 16), 9)
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_q=32,
+                                                block_k=32))
+    out = f(q, k, v)
+    ref = flash_attention_reference(q, k, v)
+    assert np.allclose(out, ref, atol=2e-5)
+
+
+if __name__ == "__main__":
+    jax.config.update("jax_default_matmul_precision", "float32")
+    check_forward()
+    check_cross_attention()
+    check_grads()
+    check_jit_odd_lengths()
+    print("FLASH_OK backend=%s" % jax.default_backend())

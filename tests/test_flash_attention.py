@@ -1,0 +1,26 @@
+"""Pallas flash-attention kernel vs the O(T²) oracle (fwd + grads).
+
+Runs in a clean subprocess: the axon sitecustomize contaminates this
+pytest process's JAX platform registry when forced to CPU, breaking the
+checkify import pallas needs.  A fresh `env -u PALLAS_AXON_POOL_IPS`
+interpreter runs the kernels under the Pallas interpreter on CPU (the
+same kernels run natively on TPU — bench/real-chip covered separately).
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_flash_attention_kernels():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tests", "flash_attention_driver.py")],
+        env=env, capture_output=True, timeout=420)
+    out = r.stdout.decode() + r.stderr.decode()
+    assert r.returncode == 0, out[-2000:]
+    assert "FLASH_OK" in out
