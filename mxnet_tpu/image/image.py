@@ -381,7 +381,13 @@ class SaturationJitterAug(Augmenter):
 
 
 class HueJitterAug(Augmenter):
-    """Rotate hue in YIQ space (reference :706)."""
+    """Rotate hue in YIQ space (reference :706).
+
+    Intentional deviation from the reference: the transform here is the
+    mathematically correct YIQ hue rotation ``(ityiq . bt . tyiq).T``;
+    the reference composes the matrices in the opposite order
+    (``(tyiq . bt . ityiq).T``), which is a bug on its side.  Output is
+    therefore not bit-identical to reference augmentation pipelines."""
 
     def __init__(self, hue):
         super().__init__(hue=hue)

@@ -134,18 +134,15 @@ def _proposal_one(scores_fg, bbox_deltas, im_info, anchors_np,
     top_boxes = proposals[top_idx]
     keep = _greedy_nms_mask(top_boxes, top_scores, threshold)
     keep &= jnp.isfinite(top_scores)
-    # stable-select kept boxes in score order, pad to post_nms_top_n
+    # stable-select kept boxes in score order; when NMS keeps fewer than
+    # post_nms_top_n, pad by CYCLING the kept proposals (reference
+    # proposal.cc:412 keep[i % out_size]) — downstream ROI sampling must
+    # see valid duplicates, not degenerate zero boxes
     rank = jnp.where(keep, jnp.arange(pre), pre + jnp.arange(pre))
-    sel = jnp.argsort(rank)[:rpn_post_nms_top_n]
-    out_boxes = jnp.where(keep[sel][:, None], top_boxes[sel], 0.0)
-    out_scores = jnp.where(keep[sel], top_scores[sel], 0.0)
-    if rpn_post_nms_top_n > sel.shape[0]:
-        pad = rpn_post_nms_top_n - sel.shape[0]
-        out_boxes = jnp.concatenate(
-            [out_boxes, jnp.zeros((pad, 4), out_boxes.dtype)])
-        out_scores = jnp.concatenate(
-            [out_scores, jnp.zeros((pad,), out_scores.dtype)])
-    return out_boxes, out_scores
+    order_all = jnp.argsort(rank)
+    num_kept = jnp.maximum(keep.sum(), 1)
+    pick = order_all[jnp.arange(rpn_post_nms_top_n) % num_kept]
+    return top_boxes[pick], top_scores[pick]
 
 
 def _proposal_params():
@@ -244,10 +241,12 @@ def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
 
     def one_roi(roi):
         b = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1]) * spatial_scale
-        y1 = jnp.round(roi[2]) * spatial_scale
-        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
-        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        # round-half-up = C round() for non-negative coords (the
+        # reference psroi_pooling.cu uses C round, not half-to-even)
+        x1 = jnp.floor(roi[1] + 0.5) * spatial_scale
+        y1 = jnp.floor(roi[2] + 0.5) * spatial_scale
+        x2 = (jnp.floor(roi[3] + 0.5) + 1.0) * spatial_scale
+        y2 = (jnp.floor(roi[4] + 0.5) + 1.0) * spatial_scale
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_w = rw / P
@@ -314,6 +313,25 @@ def _bilinear_at(img, y, x):
            at(y0 + 1, x0) * (wy1 * wx0) + at(y0 + 1, x0 + 1) * (wy1 * wx1))
     valid = (y > -1) & (y < H) & (x > -1) & (x < W)
     return jnp.where(valid, out, 0.0)
+
+
+def _bilinear_clamped(img, y, x):
+    """Bilinear sample img (C, H, W) at in-range float coords y, x using
+    floor/ceil corner pairs, matching the reference's bilinear_interp
+    (deformable_psroi_pooling.cu:49-68).  Coords must already be clamped
+    to [0, H-1]/[0, W-1]."""
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1 = jnp.ceil(y)
+    x1 = jnp.ceil(x)
+    dy = y - y0
+    dx = x - x0
+
+    def at(yi, xi):
+        return img[:, yi.astype(jnp.int32), xi.astype(jnp.int32)]
+
+    return ((1 - dx) * (1 - dy) * at(y0, x0) + (1 - dx) * dy * at(y1, x0) +
+            dx * (1 - dy) * at(y0, x1) + dx * dy * at(y1, x1))
 
 
 @register_op("_contrib_DeformableConvolution",
@@ -399,8 +417,14 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
                               no_trans=False):
     """Deformable position-sensitive ROI pooling (Dai et al. 2017).
 
-    Bins sample a regular sub-grid (sample_per_part²) with a learned
-    per-part (dy, dx) shift from `trans` (R, 2·cls, part, part).
+    Matches the reference kernel (deformable_psroi_pooling.cu:89-162)
+    exactly: bins sample a sub-grid at *corners* ``start + i*sub_bin``,
+    out-of-range samples (beyond ±0.5 of the border) are excluded from
+    both the sum and the divisor, in-range coords are clamped (not
+    zeroed) before bilinear interp, and the learned (dx, dy) shift comes
+    from `trans` (R, 2·num_classes, part, part) with class index
+    ``ctop // (output_dim // num_classes)`` — class-aware R-FCN layout,
+    channel 2·cls = x, 2·cls+1 = y.
     """
     import jax
     P = pooled_size
@@ -408,13 +432,15 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
     PS = part_size if part_size > 0 else P
     N, C, H, W = data.shape
     sp = sample_per_part
+    ncls = 1 if (no_trans or trans is None) else trans.shape[1] // 2
+    cec = output_dim // max(ncls, 1)  # channels_each_class
 
     def one_roi(roi, tr):
         b = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
-        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
-        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
-        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        x1 = jnp.floor(roi[1] + 0.5) * spatial_scale - 0.5
+        y1 = jnp.floor(roi[2] + 0.5) * spatial_scale - 0.5
+        x2 = (jnp.floor(roi[3] + 0.5) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.floor(roi[4] + 0.5) + 1.0) * spatial_scale - 0.5
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_w = rw / P
@@ -423,31 +449,52 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
 
         ph = jnp.arange(P)
         pw = jnp.arange(P)
-        # per-bin trans offsets: part index = bin * PS // P
+        # per-bin part index = floor(bin / P * PS)
         pi = (ph * PS // P).astype(jnp.int32)
         pj = (pw * PS // P).astype(jnp.int32)
-        if no_trans or tr is None:
-            dy = jnp.zeros((P, P), jnp.float32)
-            dx = jnp.zeros((P, P), jnp.float32)
-        else:
-            # trans: (2*cls, PS, PS); class 0 used per reference default
-            dy = tr[0, pi[:, None], pj[None, :]] * trans_std * rh
-            dx = tr[1, pi[:, None], pj[None, :]] * trans_std * rw
-        # sample grid per bin: (P, P, sp, sp)
-        sy = (y1 + ph[:, None, None, None] * bin_h + dy[:, :, None, None] +
-              (jnp.arange(sp, dtype=jnp.float32)[None, None, :, None] + 0.5)
-              * bin_h / sp)
-        sx = (x1 + pw[None, :, None, None] * bin_w + dx[:, :, None, None] +
-              (jnp.arange(sp, dtype=jnp.float32)[None, None, None, :] + 0.5)
-              * bin_w / sp)
-        vals = _bilinear_at(img, sy, sx)  # (C, P, P, sp, sp)
-        means = vals.mean(axis=(3, 4))  # (C, P, P)
-        # position-sensitive channel select
-        gi = (ph * G // P).astype(jnp.int32)
-        gj = (pw * G // P).astype(jnp.int32)
-        c_idx = (jnp.arange(output_dim)[:, None, None] * G +
+        iw = jnp.arange(sp, dtype=jnp.float32)
+        ih = jnp.arange(sp, dtype=jnp.float32)
+
+        means_cls = []
+        for cls in range(ncls):
+            if no_trans or tr is None:
+                dx = jnp.zeros((P, P), jnp.float32)
+                dy = jnp.zeros((P, P), jnp.float32)
+            else:
+                t = tr.reshape(ncls, 2, PS, PS)
+                dx = t[cls, 0, pi[:, None], pj[None, :]] * trans_std * rw
+                dy = t[cls, 1, pi[:, None], pj[None, :]] * trans_std * rh
+            wstart = x1 + pw[None, :] * bin_w + dx  # (P, P)
+            hstart = y1 + ph[:, None] * bin_h + dy
+            # corner sampling: start + i * sub_bin_size
+            sy = (hstart[:, :, None, None] +
+                  ih[None, None, :, None] * bin_h / sp)
+            sx = (wstart[:, :, None, None] +
+                  iw[None, None, None, :] * bin_w / sp)
+            inb = ((sx >= -0.5) & (sx <= W - 0.5) &
+                   (sy >= -0.5) & (sy <= H - 0.5))
+            syc = jnp.clip(sy, 0.0, H - 1.0)
+            sxc = jnp.clip(sx, 0.0, W - 1.0)
+            # only this class's channel slice is ever read downstream
+            img_cls = img[cls * cec * G * G:(cls + 1) * cec * G * G]
+            vals = _bilinear_clamped(img_cls, syc, sxc)  # (cec·G², P,P,sp,sp)
+            vals = jnp.where(inb[None], vals, 0.0)
+            cnt = inb.sum(axis=(2, 3)).astype(jnp.float32)  # (P, P)
+            s = vals.sum(axis=(3, 4))  # (cec·G², P, P)
+            means_cls.append(
+                jnp.where(cnt > 0, s / jnp.maximum(cnt, 1.0), 0.0))
+        means = jnp.stack(means_cls)  # (ncls, cec·G², P, P)
+
+        # position-sensitive channel select: c = (ctop*G + gh)*G + gw,
+        # relative to the class's slice
+        gi = jnp.clip((ph * G // P).astype(jnp.int32), 0, G - 1)
+        gj = jnp.clip((pw * G // P).astype(jnp.int32), 0, G - 1)
+        ctop = jnp.arange(output_dim)
+        cls_idx = (ctop // cec).astype(jnp.int32)
+        rel_c = ((ctop - cls_idx * cec)[:, None, None] * G +
                  gi[None, :, None]) * G + gj[None, None, :]
-        return means[c_idx, ph[None, :, None], pw[None, None, :]]
+        return means[cls_idx[:, None, None], rel_c,
+                     ph[None, :, None], pw[None, None, :]]
 
     if trans is None:
         return jax.vmap(lambda r: one_roi(r, None))(rois)

@@ -187,21 +187,41 @@ alias("negative", "_neg")
                              "valid_thresh": 0.0})
 def _make_loss(data, grad_scale=1.0, normalization="null",
                valid_thresh=0.0):
-    """Identity forward whose gradient is grad_scale (normalized) —
-    reference src/operator/make_loss-inl.h.  grad_scale=0 blocks the
-    gradient (used to expose extra outputs from training symbols)."""
-    if normalization == "batch":
-        s = grad_scale / data.shape[0]
-    elif normalization == "valid":
-        # reference counts data > valid_thresh (mshadow_op::threshold,
-        # make_loss-inl.h:107) — signed, not abs
-        cnt = lax.stop_gradient(
-            jnp.maximum((data > valid_thresh).sum(), 1))
-        s = grad_scale / cnt.astype(data.dtype)
-    else:
-        s = grad_scale
-    # forward value is exactly `data`; d(out)/d(data) = s
-    return data * s + lax.stop_gradient(data * (1.0 - s))
+    """Identity forward whose input gradient is the CONSTANT grad_scale
+    (normalized) — reference src/operator/make_loss-inl.h assigns the
+    scale unconditionally in backward, ignoring any incoming out_grad.
+    Implemented with jax.custom_vjp so the forward value is exactly
+    `data` (no 1-ulp drift) and the cotangent is the constant even when
+    the MakeLoss output feeds further computation.  grad_scale=0 blocks
+    the gradient (used to expose extra outputs from training symbols)."""
+    import jax
+
+    shape = jnp.shape(data)
+    dtype = jnp.result_type(data)
+
+    @jax.custom_vjp
+    def _ml(x):
+        return x
+
+    def _fwd(x):
+        if normalization == "batch":
+            s = grad_scale / x.shape[0]
+        elif normalization == "valid":
+            # reference counts data > valid_thresh (mshadow_op::threshold,
+            # make_loss-inl.h:107) — signed, not abs
+            cnt = jnp.maximum((x > valid_thresh).sum(), 1)
+            s = grad_scale / cnt.astype(x.dtype)
+        else:
+            s = grad_scale
+        # O(1) residual: just the scalar scale (shape/dtype via closure)
+        return x, jnp.asarray(s, dtype)
+
+    def _bwd(s, g):
+        del g  # reference backward ignores out_grad entirely
+        return (jnp.full(shape, s, dtype),)
+
+    _ml.defvjp(_fwd, _bwd)
+    return _ml(data)
 
 alias("make_loss", "MakeLoss")
 

@@ -71,8 +71,12 @@ def test_proposal_nms_suppresses_duplicates():
         rpn_min_size=1, scales=scales, ratios=ratios, feature_stride=16,
         output_score=True)
     r, s = rois.asnumpy(), scores.asnumpy().ravel()
-    kept = r[s > 0]
-    # pairwise IOU of kept boxes must be <= threshold
+    # when NMS keeps fewer than post_nms_top_n the output is padded by
+    # CYCLING the kept proposals (reference proposal.cc:412), so no
+    # degenerate zero boxes appear and duplicates are expected
+    assert (r[:, 3] > r[:, 1]).all() and (r[:, 4] > r[:, 2]).all()
+    kept = np.unique(r, axis=0)
+    # pairwise IOU of distinct kept boxes must be <= threshold
     for i in range(len(kept)):
         for j in range(i + 1, len(kept)):
             a, b = kept[i, 1:], kept[j, 1:]
@@ -224,3 +228,88 @@ def test_deformable_psroi_trans_shifts_result():
         mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
         **kw).asnumpy()
     assert not np.allclose(out0, out1)
+
+
+def _ref_deformable_psroi(data, rois, trans, spatial_scale, output_dim,
+                          group_size, pooled_size, part_size,
+                          sample_per_part, trans_std, no_trans):
+    """Direct numpy transcription of the reference CUDA kernel
+    (deformable_psroi_pooling.cu:89-162) as an oracle."""
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    P, G, PS, sp = pooled_size, group_size, part_size, sample_per_part
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    cec = output_dim // ncls
+    out = np.zeros((R, output_dim, P, P), np.float64)
+
+    def interp(ch, h, w):
+        x1, x2 = int(np.floor(w)), int(np.ceil(w))
+        y1, y2 = int(np.floor(h)), int(np.ceil(h))
+        dx, dy = w - x1, h - y1
+        return ((1 - dx) * (1 - dy) * ch[y1, x1] +
+                (1 - dx) * dy * ch[y2, x1] +
+                dx * (1 - dy) * ch[y1, x2] + dx * dy * ch[y2, x2])
+
+    for n in range(R):
+        b = int(rois[n, 0])
+        x1 = np.floor(rois[n, 1] + 0.5) * spatial_scale - 0.5
+        y1 = np.floor(rois[n, 2] + 0.5) * spatial_scale - 0.5
+        x2 = (np.floor(rois[n, 3] + 0.5) + 1.0) * spatial_scale - 0.5
+        y2 = (np.floor(rois[n, 4] + 0.5) + 1.0) * spatial_scale - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bw, bh = rw / P, rh / P
+        for ctop in range(output_dim):
+            cls = ctop // cec
+            for ph in range(P):
+                for pw in range(P):
+                    part_h = int(np.floor(float(ph) / P * PS))
+                    part_w = int(np.floor(float(pw) / P * PS))
+                    if no_trans:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[n, cls * 2, part_h, part_w] * trans_std
+                        ty = trans[n, cls * 2 + 1, part_h, part_w] * trans_std
+                    wstart = pw * bw + x1 + tx * rw
+                    hstart = ph * bh + y1 + ty * rh
+                    gw = min(max(int(np.floor(float(pw) * G / P)), 0), G - 1)
+                    gh = min(max(int(np.floor(float(ph) * G / P)), 0), G - 1)
+                    c = (ctop * G + gh) * G + gw
+                    s, cnt = 0.0, 0
+                    for ih in range(sp):
+                        for iw in range(sp):
+                            w = wstart + iw * bw / sp
+                            h = hstart + ih * bh / sp
+                            if w < -0.5 or w > W - 0.5 or h < -0.5 \
+                                    or h > H - 0.5:
+                                continue
+                            w = min(max(w, 0.0), W - 1.0)
+                            h = min(max(h, 0.0), H - 1.0)
+                            s += interp(data[b, c], h, w)
+                            cnt += 1
+                    out[n, ctop, ph, pw] = 0.0 if cnt == 0 else s / cnt
+    return out
+
+
+def test_deformable_psroi_matches_reference_kernel_oracle():
+    """Corner sampling, in-bounds-count mean, and class-aware trans index
+    must match a direct transcription of the reference CUDA kernel."""
+    rng = np.random.RandomState(7)
+    G = P = PS = 2
+    ncls = 2
+    OD = 4  # 2 channels per class
+    data = rng.randn(2, G * G * OD, 9, 9).astype(np.float32)
+    # one roi partially outside the image to exercise the count logic
+    rois = np.array([[0, 1, 1, 6, 6], [1, -3, -3, 4, 5]], np.float32)
+    trans = (rng.randn(2, 2 * ncls, PS, PS) * 0.7).astype(np.float32)
+    kw = dict(spatial_scale=0.5, output_dim=OD, pooled_size=P,
+              group_size=G, part_size=PS, sample_per_part=3, trans_std=0.3)
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        **kw).asnumpy()
+    ref = _ref_deformable_psroi(data, rois, trans, no_trans=False, **kw)
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+    # no_trans path
+    out_nt = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), no_trans=True, **kw).asnumpy()
+    ref_nt = _ref_deformable_psroi(data, rois, trans, no_trans=True, **kw)
+    assert np.allclose(out_nt, ref_nt, atol=1e-4)
