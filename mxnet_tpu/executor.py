@@ -22,6 +22,7 @@ import functools
 import numpy as _np
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as _P
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
@@ -31,9 +32,13 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
-                 group2ctx=None, shared_exec=None):
+                 group2ctx=None, shared_exec=None, mesh=None,
+                 batch_names=None, dp_axis="dp"):
         self._symbol = symbol
         self._ctx = ctx
+        self._mesh = mesh
+        self._dp_axis = dp_axis
+        self._batch_names = frozenset(batch_names or ())
         self.arg_dict = dict(args)
         self.grad_dict = dict(args_grad) if args_grad else {}
         self.aux_dict = dict(aux_states) if aux_states else {}
@@ -59,7 +64,44 @@ class Executor:
         self.outputs = []
         self._fwd_cache = {}
         self._grad_fn = None
+        self._shardings = self._build_shardings() if mesh is not None else {}
         self._plan = self._build_plan()
+
+    # -- SPMD placement ----------------------------------------------------
+    def _build_shardings(self):
+        """Mesh layout: batch args sharded over ``dp``, everything else
+        replicated.  This single placement decision replaces the reference's
+        DataParallelExecutorGroup batch slicing
+        (/root/reference/python/mxnet/module/executor_group.py:296-378) —
+        XLA GSPMD partitions the one compiled program across the mesh and
+        inserts the gradient all-reduce (vjp of a replicated parameter
+        against dp-sharded activations IS a psum over ``dp``)."""
+        mesh, axis = self._mesh, self._dp_axis
+        ndev = mesh.shape[axis]
+        shardings = {}
+        for name, arr in list(self.arg_dict.items()) + \
+                list(self.aux_dict.items()):
+            if name in self._batch_names and arr.ndim >= 1:
+                if arr.shape[0] % ndev:
+                    raise MXNetError(
+                        "batch axis of %r (shape %s) not divisible by the "
+                        "%d-device data-parallel mesh" %
+                        (name, arr.shape, ndev))
+                spec = _P(axis, *([None] * (arr.ndim - 1)))
+            else:
+                spec = _P()
+            shardings[name] = NamedSharding(mesh, spec)
+        return shardings
+
+    def _placed(self, name, data):
+        """Reshard ``data`` to its mesh placement (no-op when it already
+        lives there, or when no mesh is attached)."""
+        target = self._shardings.get(name)
+        if target is None:
+            return data
+        if getattr(data, "sharding", None) == target:
+            return data
+        return jax.device_put(data, target)
 
     # -- graph compilation -------------------------------------------------
     def _build_plan(self):
@@ -163,10 +205,26 @@ class Executor:
 
     # -- execution ---------------------------------------------------------
     def _raw_args(self):
-        return {k: v._data for k, v in self.arg_dict.items()}
+        if self._mesh is None:
+            return {k: v._data for k, v in self.arg_dict.items()}
+        out = {}
+        for k, v in self.arg_dict.items():
+            placed = self._placed(k, v._data)
+            if placed is not v._data:
+                v._set_data(placed)  # cache the mesh placement
+            out[k] = placed
+        return out
 
     def _raw_aux(self):
-        return {k: v._data for k, v in self.aux_dict.items()}
+        if self._mesh is None:
+            return {k: v._data for k, v in self.aux_dict.items()}
+        out = {}
+        for k, v in self.aux_dict.items():
+            placed = self._placed(k, v._data)
+            if placed is not v._data:
+                v._set_data(placed)
+            out[k] = placed
+        return out
 
     def _forward_interpret(self, train, rng):
         """Eager (uncompiled) forward calling the monitor callback with
@@ -339,4 +397,6 @@ class Executor:
                      for n, a in new_args.items()
                      if grad_req.get(n, "null") != "null"}
         return Executor(self._symbol, self._ctx, new_args, args_grad,
-                        grad_req, new_aux, group2ctx=self._group2ctx)
+                        grad_req, new_aux, group2ctx=self._group2ctx,
+                        mesh=self._mesh, batch_names=self._batch_names,
+                        dp_axis=self._dp_axis)

@@ -24,6 +24,15 @@ class DeferredInitializationError(MXNetError):
     """Parameter is not initialized yet because shape is unknown."""
 
 
+def _replicate_over(ctx_list, data):
+    """Replicate a raw array over the dp mesh formed by ``ctx_list``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ..parallel.mesh import dp_mesh_from_ctx
+    mesh = dp_mesh_from_ctx(ctx_list)
+    return jax.device_put(data, NamedSharding(mesh, PartitionSpec()))
+
+
 class Parameter:
     def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
@@ -62,11 +71,21 @@ class Parameter:
         self._finish_init(init, ctx, default_init)
 
     def _finish_init(self, init, ctx, default_init):
+        mesh_ctx = None
+        if isinstance(ctx, (list, tuple)):
+            if len(ctx) > 1:
+                mesh_ctx = list(ctx)
+            ctx = ctx[0] if ctx else None
         data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx)
         initializer = init or self.init or default_init
         if isinstance(initializer, str):
             initializer = init_mod.create(initializer)
         initializer(init_mod.InitDesc(self.name), data)
+        if mesh_ctx is not None:
+            # ctx list → replicate over a dp mesh of those devices; the
+            # reference kept one copy per GPU and broadcast through KVStore
+            # (gluon/trainer.py:init), here replication is a sharding
+            data._set_data(_replicate_over(mesh_ctx, data._data))
         self._data = data
         self._init_grad()
         self._deferred_init = None

@@ -7,8 +7,39 @@ import os
 
 from .. import ndarray as nd
 
-__all__ = ["split_data", "split_and_load", "clip_global_norm",
-           "check_sha1", "download"]
+__all__ = ["split_data", "split_and_load", "shard_and_load",
+           "clip_global_norm", "check_sha1", "download"]
+
+
+def shard_and_load(data, ctx_list, batch_axis=0):
+    """dp-shard one batch over the mesh formed by ``ctx_list``.
+
+    TPU-native sibling of :func:`split_and_load`: where the reference split
+    the batch into per-GPU slices for per-device executors
+    (/root/reference/python/mxnet/gluon/utils.py:66), this returns ONE
+    NDArray whose batch axis is sharded across the devices — the model runs
+    once as a single SPMD program and XLA inserts the gradient all-reduce.
+    Use with parameters initialized via ``initialize(ctx=ctx_list)``.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ..parallel.mesh import AXIS_DP, dp_mesh_from_ctx
+    if not isinstance(data, nd.NDArray):
+        data = nd.array(data)
+    if not isinstance(ctx_list, (list, tuple)):
+        ctx_list = [ctx_list]
+    if len(ctx_list) == 1:
+        return data.as_in_context(ctx_list[0])
+    if data.shape[batch_axis] % len(ctx_list):
+        raise ValueError(
+            "batch axis %d of shape %s not divisible by %d devices"
+            % (batch_axis, data.shape, len(ctx_list)))
+    mesh = dp_mesh_from_ctx(ctx_list)
+    spec = [None] * data.ndim
+    spec[batch_axis] = AXIS_DP
+    placed = jax.device_put(data._data,
+                            NamedSharding(mesh, PartitionSpec(*spec)))
+    return nd.NDArray(placed, ctx_list[0])
 
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):

@@ -104,7 +104,16 @@ class KVStore:
                 raise MXNetError("key %s was not initialized" % str(k))
             merged = self._merge(vlist)
             if self._updater is not None:
-                self._updater(k, merged, self._store[k])
+                dst = self._store[k]
+                m_shd = getattr(merged._data, "sharding", None)
+                if hasattr(m_shd, "mesh") and \
+                        getattr(dst._data, "sharding", None) != m_shd:
+                    # follow the gradient's mesh placement (SPMD Module
+                    # pushes mesh-replicated grads; the stored weight may
+                    # still live on a single device from init)
+                    import jax
+                    dst._set_data(jax.device_put(dst._data, m_shd))
+                self._updater(k, merged, dst)
             else:
                 self._store[k]._set_data(merged._data)
 

@@ -253,7 +253,18 @@ class Module(BaseModule):
                     else grad_req.get(name, "write")
 
         ctx = self._context[0]
-        self._exec = self._symbol.simple_bind(ctx, grad_req=req,
+        mesh = batch_names = None
+        if len(self._context) > 1:
+            # Module(context=[N devices]) → one SPMD program over a dp mesh.
+            # The reference sliced every batch across per-device executors
+            # (executor_group.py:296-378) and reduced grads through KVStore;
+            # here the whole batch is dp-sharded into ONE compiled step and
+            # XLA inserts the gradient all-reduce over ICI.
+            from ..parallel.mesh import dp_mesh_from_ctx
+            mesh = dp_mesh_from_ctx(self._context)
+            batch_names = self._data_names + self._label_names
+        self._exec = self._symbol.simple_bind(ctx, grad_req=req, mesh=mesh,
+                                              batch_names=batch_names,
                                               **shape_kwargs)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:

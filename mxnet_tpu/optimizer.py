@@ -499,6 +499,31 @@ class Test(Optimizer):
             (weight - self.lr * grad * self.rescale_grad)._data)
 
 
+def _place_like(state, weight):
+    """Reshard optimizer state onto the weight's mesh placement.
+
+    Under the SPMD Module the weight is committed to a device mesh; state
+    created by `create_state` (or restored from a checkpoint) starts on the
+    default device and must follow, else jitted update ops see mixed
+    committed devices.  No-op (an attribute compare) when already placed.
+    """
+    shd = getattr(getattr(weight, "_data", None), "sharding", None)
+    if shd is None or not hasattr(shd, "mesh"):
+        return state
+    if isinstance(state, tuple):
+        return tuple(_place_like(s, weight) for s in state)
+    if state is None or not hasattr(state, "_data"):
+        return state
+    if getattr(state._data, "sharding", None) != shd:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        # states match the weight's mesh but stay replicated (they are
+        # elementwise companions of a replicated weight)
+        state._set_data(jax.device_put(
+            state._data, NamedSharding(shd.mesh, PartitionSpec())))
+    return state
+
+
 class Updater:
     """KVStore-facing update closure (reference optimizer.py:1034)."""
 
@@ -509,6 +534,7 @@ class Updater:
     def __call__(self, index, grad, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
+        self.states[index] = _place_like(self.states[index], weight)
         self.optimizer.update(index, weight, grad, self.states[index])
 
     def set_states(self, states):

@@ -91,6 +91,23 @@ def make_mesh(axes=None, devices=None, **axis_sizes):
     return Mesh(dev_array, tuple(spec.keys()))
 
 
+def dp_mesh_from_ctx(ctx_list):
+    """Build a pure-dp mesh from a Module/Gluon context list.
+
+    The single funnel for `context=[N devices]` → mesh (Module.bind,
+    Parameter.initialize, shard_and_load): resolves each Context to its
+    jax.Device, rejects duplicates (two ctx ids mapping to the same
+    physical chip would silently halve the mesh), and names one ``dp``
+    axis over them.
+    """
+    devices = [c.jax_device() for c in ctx_list]
+    if len(set(devices)) != len(devices):
+        from ..base import MXNetError
+        raise MXNetError(
+            "context list resolves to duplicate devices: %s" % devices)
+    return make_mesh({AXIS_DP: len(devices)}, devices=devices)
+
+
 def full_mesh(devices=None, dp=-1, tp=1, pp=1, sp=1, ep=1):
     """A mesh naming all five canonical axes (unused ones size 1)."""
     if devices is None:

@@ -395,7 +395,8 @@ class Symbol:
     # -- binding -----------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     group2ctx=None, shared_arg_names=None, shared_exec=None,
-                    shared_buffer=None, **kwargs):
+                    shared_buffer=None, mesh=None, batch_names=None,
+                    **kwargs):
         from ..executor import Executor
         from ..context import current_context
         from .. import nd
@@ -418,7 +419,8 @@ class Symbol:
                 name: nd.zeros(a.shape, ctx=ctx, dtype=a.dtype)
                 for name, a in args.items()}
         return Executor(self, ctx, args, args_grad, grad_req, aux,
-                        group2ctx=group2ctx, shared_exec=shared_exec)
+                        group2ctx=group2ctx, shared_exec=shared_exec,
+                        mesh=mesh, batch_names=batch_names)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
