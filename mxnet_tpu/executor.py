@@ -204,27 +204,32 @@ class Executor:
         return grad_fn
 
     # -- execution ---------------------------------------------------------
-    def _raw_args(self):
+    def _raw(self, d):
         if self._mesh is None:
-            return {k: v._data for k, v in self.arg_dict.items()}
+            return {k: v._data for k, v in d.items()}
         out = {}
-        for k, v in self.arg_dict.items():
+        for k, v in d.items():
             placed = self._placed(k, v._data)
             if placed is not v._data:
                 v._set_data(placed)  # cache the mesh placement
             out[k] = placed
         return out
 
+    def _raw_args(self):
+        return self._raw(self.arg_dict)
+
     def _raw_aux(self):
-        if self._mesh is None:
-            return {k: v._data for k, v in self.aux_dict.items()}
-        out = {}
-        for k, v in self.aux_dict.items():
-            placed = self._placed(k, v._data)
-            if placed is not v._data:
-                v._set_data(placed)
-            out[k] = placed
-        return out
+        return self._raw(self.aux_dict)
+
+    def _accum_grad(self, dst, g):
+        """grad_req='add' accumulate; under a mesh the initial zeros may
+        still be committed to one device while ``g`` comes out of the
+        sharded program — move dst to g's placement first."""
+        gshd = getattr(g, "sharding", None)
+        if self._mesh is not None and \
+                getattr(dst._data, "sharding", None) != gshd:
+            dst._set_data(jax.device_put(dst._data, gshd))
+        dst._set_data(dst._data + g)
 
     def _forward_interpret(self, train, rng):
         """Eager (uncompiled) forward calling the monitor callback with
@@ -295,7 +300,7 @@ class Executor:
             if dst is None:
                 continue
             if req == "add":
-                dst._set_data(dst._data + g)
+                self._accum_grad(dst, g)
             else:
                 dst._set_data(g)
 
@@ -330,7 +335,7 @@ class Executor:
                 continue
             dst = self.grad_dict[name]
             if req == "add":
-                dst._set_data(dst._data + g)
+                self._accum_grad(dst, g)
             else:
                 dst._set_data(g)
         return self.outputs

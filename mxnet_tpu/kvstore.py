@@ -56,6 +56,8 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compress_params = {"type": "none"}
+        self._worker_mesh = None
+        self._allreduce_jit = None
 
     # -- identity ----------------------------------------------------------
     @property
@@ -88,21 +90,64 @@ class KVStore:
         merged = vlist[0]
         for v in vlist[1:]:
             merged = merged + v
-        if self._kind.startswith("dist") and self.num_workers > 1:
-            # all-reduce across processes over ICI/DCN — the ps-lite
-            # ZPush/merge/ZPull cycle becomes one XLA collective
-            from jax.experimental import multihost_utils
-            import jax.numpy as jnp
-            summed = multihost_utils.process_allgather(merged._data)
-            merged = NDArray(jnp.sum(summed, axis=0), merged._ctx)
         return merged
+
+    # -- cross-process all-reduce (the ps-lite ZPush/merge/ZPull cycle
+    # becomes ONE jitted XLA program of psums riding ICI/DCN;
+    # /root/reference/src/kvstore/comm.h:460-549 overlapped per-key engine
+    # ops — here the whole key batch is a single compiled collective) ----
+    def _get_worker_mesh(self):
+        if self._worker_mesh is None:
+            import jax
+            import numpy as _np
+            from jax.sharding import Mesh
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[p] for p in sorted(per_proc)]
+            self._worker_mesh = Mesh(_np.array(devs), ("workers",))
+        return self._worker_mesh
+
+    def _dist_allreduce(self, raws):
+        """Sum a batch of local arrays across all worker processes.
+
+        Each process contributes its array as one shard of a global
+        (num_workers, *shape) array; one jitted program sums over the
+        worker axis for every key at once and leaves the (replicated)
+        result addressable on this process.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._get_worker_mesh()
+        n = mesh.devices.size
+        local_dev = next(d for d in mesh.devices.flat
+                         if d.process_index == jax.process_index())
+        in_shd = NamedSharding(mesh, P("workers"))
+        gs = []
+        for x in raws:
+            shard = jax.device_put(x[None], local_dev)
+            gs.append(jax.make_array_from_single_device_arrays(
+                (n,) + tuple(x.shape), in_shd, [shard]))
+        if self._allreduce_jit is None:
+            self._allreduce_jit = jax.jit(
+                lambda xs: tuple(jnp.sum(x, axis=0) for x in xs),
+                out_shardings=NamedSharding(mesh, P()))
+        summed = self._allreduce_jit(tuple(gs))
+        return [s.addressable_data(0) for s in summed]
 
     def push(self, key, value, priority=0):
         keys, vals = _flatten_pairs(key, value)
+        merged_list = []
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %s was not initialized" % str(k))
-            merged = self._merge(vlist)
+            merged_list.append(self._merge(vlist))
+        if self._kind.startswith("dist") and self.num_workers > 1:
+            summed = self._dist_allreduce([m._data for m in merged_list])
+            merged_list = [NDArray(s, m._ctx)
+                           for s, m in zip(summed, merged_list)]
+        for k, merged in zip(keys, merged_list):
             if self._updater is not None:
                 dst = self._store[k]
                 m_shd = getattr(merged._data, "sharding", None)
@@ -153,6 +198,13 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params):
         self._compress_params = dict(compression_params)
+        if self._compress_params.get("type", "none") != "none":
+            import logging
+            logging.warning(
+                "set_gradient_compression(%s): gradient compression is "
+                "not implemented in the TPU backend (XLA collectives ride "
+                "ICI at full precision); gradients will be exchanged "
+                "uncompressed", self._compress_params)
 
     # -- distributed control -----------------------------------------------
     def barrier(self):

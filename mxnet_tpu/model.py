@@ -63,27 +63,35 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
                               param_names):
-    """push grads / pull weights (reference model.py:105-115)."""
+    """push grads / pull weights (reference model.py:105-115).
+
+    All keys go in ONE push/pull call: for dist stores the whole key
+    batch becomes a single jitted all-reduce program (kvstore.py
+    _dist_allreduce) instead of the reference's per-key engine ops."""
+    names, grads, args = [], [], []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
+        names.append(param_names[index])
+        grads.append(grad_list)
+        args.append(arg_list)
+    if names:
+        kvstore.push(names, grads)
+        kvstore.pull(names, args)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
     """aggregate via kvstore, update locally (reference model.py:117-130)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
+    live = [(i, a, g) for i, (a, g) in
+            enumerate(zip(param_arrays, grad_arrays)) if g[0] is not None]
+    if kvstore and live:
+        names = [param_names[i] for i, _, _ in live]
+        grads = [g for _, _, g in live]
+        kvstore.push(names, grads)
+        kvstore.pull(names, grads)
+    for index, arg_list, grad_list in live:
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updater(index * num_device + k, g, w)

@@ -3,42 +3,88 @@
 API-compatible with the reference's ``mx.nd.save/load``
 (/root/reference/python/mxnet/ndarray/utils.py:158-248): accepts a single
 array, a list, or a str->NDArray dict, and round-trips exactly that
-structure.  The container is an uncompressed ``.npz`` (a zip of raw numpy
-buffers) rather than the reference's custom V2 binary
-(src/ndarray/ndarray.cc:809-817) — same two-artifact checkpoint contract,
-portable, and mmap-friendly for large parameter maps.
+structure.  The container is the reference's own V2 binary (magic
+0xF993FAC9 records in a 0x112 list file, src/ndarray/ndarray.cc:809-1044)
+— reference-produced ``.params`` checkpoints load here unmodified and
+saves made here load in the reference.  Files written by rounds 1-2 of
+this framework (uncompressed ``.npz``) are still read transparently.
 """
 from __future__ import annotations
 
 import numpy as _np
 
+from . import serialization as _ser
 from .ndarray import NDArray, array
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "load_frombuffer"]
 
 _LIST_KEY = "__mx_list_%d"
 
 
-def save(fname, data):
+def _to_payload(data):
+    """Normalize to (list of numpy/sparse-tuples, list of names)."""
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    def conv(v):
+        if isinstance(v, RowSparseNDArray):
+            # one host transfer; find live rows locally (the .data/.indices
+            # properties would each re-fetch and re-scan)
+            dense = v.asnumpy()
+            rows = _np.where(_np.any(
+                dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+            return ("row_sparse", dense[rows], rows.astype(_np.int64),
+                    tuple(v.shape))
+        if isinstance(v, CSRNDArray):
+            d, idx, indptr = v._csr_parts()
+            return ("csr", d, indptr.astype(_np.int64),
+                    idx.astype(_np.int64), tuple(v.shape))
+        return v.asnumpy()
+
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
-    elif isinstance(data, (list, tuple)):
-        payload = {_LIST_KEY % i: v.asnumpy() for i, v in enumerate(data)}
-    else:
-        raise ValueError("data needs to either be a NDArray, dict of str to "
-                         "NDArray or a list of NDArray")
-    with open(fname, "wb") as f:
-        _np.savez(f, **payload)
+        names = list(data.keys())
+        return [conv(data[k]) for k in names], names
+    if isinstance(data, (list, tuple)):
+        return [conv(v) for v in data], []
+    raise ValueError("data needs to either be a NDArray, dict of str to "
+                     "NDArray or a list of NDArray")
+
+
+def save(fname, data):
+    arrays, names = _to_payload(data)
+    _ser.save_ndarray_list(fname, arrays, names)
+
+
+def _from_record(rec):
+    from .sparse import row_sparse_array
+    if isinstance(rec, tuple) and rec and rec[0] == "row_sparse":
+        return row_sparse_array((rec[1], rec[2]), shape=rec[3])
+    if isinstance(rec, tuple) and rec and rec[0] == "csr":
+        from .sparse import csr_matrix
+        return csr_matrix((rec[1], rec[3], rec[2]), shape=rec[4])
+    return array(rec)
+
+
+def load_frombuffer(buf):
+    """Load from in-memory bytes (reference ndarray/utils.py:load_frombuffer)."""
+    arrays, names = _ser.load_ndarray_list(buf)
+    if names:
+        return {n: _from_record(a) for n, a in zip(names, arrays)}
+    return [_from_record(a) for a in arrays]
 
 
 def load(fname):
-    with _np.load(fname, allow_pickle=False) as zf:
-        keys = list(zf.keys())
-        if keys and all(k.startswith("__mx_list_") for k in keys):
-            out = [None] * len(keys)
-            for k in keys:
-                out[int(k[len("__mx_list_"):])] = array(zf[k])
-            return out
-        return {k: array(zf[k]) for k in keys}
+    with open(fname, "rb") as f:
+        head = f.read(2)
+    if head == b"PK":  # rounds-1/2 npz container
+        with _np.load(fname, allow_pickle=False) as zf:
+            keys = list(zf.keys())
+            if keys and all(k.startswith("__mx_list_") for k in keys):
+                out = [None] * len(keys)
+                for k in keys:
+                    out[int(k[len("__mx_list_"):])] = array(zf[k])
+                return out
+            return {k: array(zf[k]) for k in keys}
+    with open(fname, "rb") as f:
+        return load_frombuffer(f.read())

@@ -12,5 +12,6 @@ from . import random_ops  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import rcnn  # noqa: F401
+from . import tail  # noqa: F401
 
 __all__ = ["OpDef", "register_op", "get_op", "list_ops", "alias"]

@@ -561,15 +561,35 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False):
 # Spatial ops: UpSampling, BilinearSampler, GridGenerator, ROIPooling
 # ---------------------------------------------------------------------------
 
+def _upsampling_args(p):
+    # reference ListArguments: bilinear → {data, weight}; nearest with one
+    # input → {data}; multi-input nearest → arg0..argN-1
+    if p.get("sample_type") == "bilinear":
+        return ["data", "weight"]
+    n = int(p.get("num_args", 1))
+    return ["data"] if n == 1 else ["arg%d" % i for i in range(n)]
+
+
 @register_op("UpSampling",
-             arg_names=lambda p: ["arg%d" % i for i in
-                                  range(int(p.get("num_args", 1)))],
+             arg_names=_upsampling_args,
              param_defaults={"scale": 1, "num_filter": 0,
                              "sample_type": "nearest",
                              "multi_input_mode": "concat", "num_args": 1,
                              "workspace": 512})
 def _upsampling(*args, scale=1, num_filter=0, sample_type="nearest",
                 multi_input_mode="concat", num_args=1, workspace=512):
+    if sample_type == "bilinear":
+        # learnable deconv upsampling (reference upsampling-inl.h:189-200:
+        # kernel 2s-s%2, stride s, pad ceil((s-1)/2), one group per
+        # channel); weight shape (C, 1, k, k) — init.Bilinear gives the
+        # classic interpolation kernel
+        data, weight = args
+        c = data.shape[1]
+        k = weight.shape[-1]
+        pad = int(-(-(scale - 1) // 2))
+        return _deconvolution(data, weight, kernel=(k, k),
+                              stride=(scale, scale), pad=(pad, pad),
+                              num_filter=c, num_group=c, no_bias=True)
     outs = []
     target = args[0].shape[2] * scale
     for a in args:

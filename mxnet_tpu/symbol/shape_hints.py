@@ -93,6 +93,22 @@ def _embedding_hint(shapes, params):
 _register("Embedding", _embedding_hint)
 
 
+def _upsampling_hint(shapes, params):
+    # bilinear mode: weight (C, 1, k, k), k = 2s - s%2
+    # (reference upsampling-inl.h:189-200)
+    if params.get("sample_type") != "bilinear":
+        return {}
+    data = shapes.get("data")
+    if data is None:
+        return {}
+    s = int(params.get("scale", 1))
+    k = 2 * s - s % 2
+    return {"weight": (data[1], 1, k, k)}
+
+
+_register("UpSampling", _upsampling_hint)
+
+
 def _rnn_hint(shapes, params):
     data = shapes.get("data")
     if data is None:

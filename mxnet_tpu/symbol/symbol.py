@@ -500,15 +500,59 @@ def _parse_attr_value(v):
     try:
         return ast.literal_eval(v)
     except (ValueError, SyntaxError):
+        # python-2-era checkpoints spell tuples with long suffixes:
+        # "(2L, 2L)" (reference upgrades these in legacy_json_util.cc)
+        if isinstance(v, str) and "L" in v:
+            try:
+                return ast.literal_eval(
+                    __import__("re").sub(r"(\d)L\b", r"\1", v))
+            except (ValueError, SyntaxError):
+                pass
         return v
 
 
+#: attr keys the reference stored bare in old JSON and moved to hidden
+#: __key__ form on load (/root/reference/src/c_api/c_api_symbolic.cc:39,
+#: src/nnvm/legacy_json_util.cc UpgradeJSON_FixParsing)
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage")
+
+
+def _upgrade_legacy_attrs(entry, attrs):
+    """Reference-era JSON upgrade: bare hidden keys become __key__ user
+    attrs; '<arg>_<key>' entries on an op node are remembered so they can
+    be moved onto the matching input variable (legacy_json_util.cc:29-90).
+    Returns (attrs, moved) where moved = {arg_name: {key: value}}."""
+    out, moved = {}, {}
+    for k, v in attrs.items():
+        hit = False
+        for hk in _HIDDEN_KEYS:
+            if k == hk:
+                out["__%s__" % hk] = v
+                hit = True
+            elif k.endswith("_" + hk) and entry.get("op") != "null":
+                moved.setdefault(k[:-len(hk) - 1], {})["__%s__" % hk] = v
+                hit = True
+            if hit:
+                break
+        if not hit:
+            out[k] = v
+    return out, moved
+
+
 def load_json(json_str):
-    """Load a Symbol from its JSON string (reference: mx.sym.load_json)."""
+    """Load a Symbol from its JSON string (reference: mx.sym.load_json).
+
+    Accepts the current format and reference-era legacy JSON: per-node
+    attrs under "attrs", "attr" (nnvm-era) or "param" (pre-nnvm), bare
+    hidden keys, and python-2 long literals — the role of the reference's
+    legacy_json_util.cc upgrade pass."""
     data = json.loads(json_str)
     nodes = []
     for entry in data["nodes"]:
-        attrs = entry.get("attrs", entry.get("param", {})) or {}
+        attrs = entry.get("attrs", entry.get("attr",
+                                             entry.get("param", {}))) or {}
+        attrs, moved = _upgrade_legacy_attrs(entry, attrs)
         user_attrs = {k[2:-2]: v for k, v in attrs.items()
                       if k.startswith("__") and k.endswith("__")
                       and k != "__aux__"}
@@ -523,11 +567,20 @@ def load_json(json_str):
             inputs = [(nodes[i], idx) for i, idx, *_ in entry["inputs"]]
             node = _SymNode(op, entry["name"], params, inputs,
                             attrs=user_attrs)
+            if moved:
+                # '<arg>_<key>' → the input variable whose name ends with
+                # '_<arg>' (or equals it), matching FListInputNames intent
+                for arg_name, kv in moved.items():
+                    for inp, _idx in inputs:
+                        if inp.is_var and (
+                                inp.name == arg_name or
+                                inp.name.endswith("_" + arg_name)):
+                            inp.attrs.update(
+                                {k[2:-2]: v for k, v in kv.items()})
+                            break
         nodes.append(node)
     heads = data.get("heads", [[len(nodes) - 1, 0, 0]])
     outs = [(nodes[h[0]], h[1]) for h in heads]
-    if len(outs) == 1:
-        return Symbol(outs[0][0], outs)
     return Symbol(outs[0][0], outs)
 
 

@@ -10,6 +10,7 @@ cross-context consistency checks.  The finite-difference checker validates
 from __future__ import annotations
 
 import numpy as np
+import jax.numpy as jnp
 
 from .context import Context, cpu, current_context
 
@@ -107,12 +108,16 @@ def numeric_grad(fn, inputs, eps=1e-4):
 
 
 def check_numeric_gradient(sym, location, aux_states=None, rtol=1e-2,
-                           atol=None, eps=1e-4, ignore=()):
+                           atol=None, eps=1e-4, ignore=(), fixed=()):
     """Finite-difference check of a Symbol's backward.
 
     Mirrors the reference check_numeric_gradient (test_utils.py:620): bind
     the symbol with float64 data, compare the symbolic gradient of
     sum(outputs) against central differences.
+
+    ``fixed`` names non-differentiable inputs (integer indices, labels):
+    they keep their dtype, are not perturbed, and get no gradient compare.
+    ``ignore`` checks forward/backward but skips the compare for a name.
     """
     from . import nd
     from .executor import Executor  # noqa: F401 - ensures module exists
@@ -120,31 +125,41 @@ def check_numeric_gradient(sym, location, aux_states=None, rtol=1e-2,
     arg_names = sym.list_arguments()
     if isinstance(location, (list, tuple)):
         location = dict(zip(arg_names, location))
-    loc_np = {k: _as_numpy(v).astype(np.float64) for k, v in location.items()}
+    fixed = set(fixed)
+    loc_np = {k: (_as_numpy(v) if k in fixed
+                  else _as_numpy(v).astype(np.float64))
+              for k, v in location.items()}
     aux_np = {k: _as_numpy(v).astype(np.float64)
               for k, v in (aux_states or {}).items()}
 
-    args = {k: nd.array(v, dtype=np.float64) for k, v in loc_np.items()}
-    args_grad = {k: nd.zeros(v.shape, dtype=np.float64)
-                 for k, v in loc_np.items()}
+    diff_names = [n for n in arg_names if n not in fixed]
+    args = {k: nd.array(v, dtype=v.dtype) for k, v in loc_np.items()}
+    args_grad = {k: nd.zeros(loc_np[k].shape, dtype=np.float64)
+                 for k in diff_names}
+    grad_req = {k: ("write" if k in diff_names else "null")
+                for k in arg_names}
     aux = {k: nd.array(v, dtype=np.float64) for k, v in aux_np.items()}
     exe = sym.bind(default_context(), args=args, args_grad=args_grad,
-                   aux_states=aux)
+                   grad_req=grad_req, aux_states=aux)
     outs = exe.forward(is_train=True)
     exe.backward([nd.ones(o.shape, dtype=np.float64) for o in outs])
 
+    # one executor reused for every finite-difference evaluation — its
+    # jitted forward is traced once; per-eval cost is a compiled call
+    a0 = {k: nd.array(v, dtype=v.dtype) for k, v in loc_np.items()}
+    ex2 = sym.bind(default_context(), args=a0, grad_req="null",
+                   aux_states={k: nd.array(v, dtype=np.float64)
+                               for k, v in aux_np.items()})
+
     def f(*vals):
-        a = {k: nd.array(v, dtype=np.float64)
-             for k, v in zip(arg_names, vals)}
-        ex2 = sym.bind(default_context(), args=a,
-                       aux_states={k: nd.array(v, dtype=np.float64)
-                                   for k, v in aux_np.items()})
+        for k, v in zip(diff_names, vals):
+            ex2.arg_dict[k]._set_data(jnp.asarray(v))
         os_ = ex2.forward(is_train=True)
         return sum(float(o.asnumpy().sum()) for o in os_)
 
-    vals = [loc_np[k] for k in arg_names]
+    vals = [loc_np[k] for k in diff_names]
     ngrads = numeric_grad(f, vals, eps=eps)
-    for name, ng in zip(arg_names, ngrads):
+    for name, ng in zip(diff_names, ngrads):
         if name in ignore:
             continue
         sg = exe.grad_dict[name].asnumpy()

@@ -1,0 +1,258 @@
+"""Reference checkpoint binary compatibility.
+
+The fixture bytes below are hand-assembled straight from the reference's
+serializer code paths (/root/reference/src/ndarray/ndarray.cc:809-885
+NDArray::Save, :1010-1025 list container; include/mxnet/base.h:188
+Context::Save; uint32-ndim + int64-dims TShape) — NOT produced by the
+code under test — so they pin the on-disk format byte-for-byte.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _tshape(shape):
+    return struct.pack("<I", len(shape)) + \
+        struct.pack("<%dq" % len(shape), *shape)
+
+
+def _dense_record(a, dev_type=1, dev_id=0):
+    """NDArray::Save V2 for a dense numpy array."""
+    return (struct.pack("<I", 0xF993FAC9) +      # NDARRAY_V2_MAGIC
+            struct.pack("<i", 0) +               # kDefaultStorage
+            _tshape(a.shape) +
+            struct.pack("<ii", dev_type, dev_id) +  # Context::Save
+            struct.pack("<i", {np.dtype(np.float32): 0,
+                               np.dtype(np.float64): 1,
+                               np.dtype(np.uint8): 3,
+                               np.dtype(np.int32): 4,
+                               np.dtype(np.int64): 6}[a.dtype]) +
+            a.tobytes())
+
+
+def _list_file(records, names):
+    out = struct.pack("<QQ", 0x112, 0)           # kMXAPINDArrayListMagic
+    out += struct.pack("<Q", len(records)) + b"".join(records)
+    out += struct.pack("<Q", len(names))
+    for n in names:
+        b = n.encode()
+        out += struct.pack("<Q", len(b)) + b
+    return out
+
+
+def test_load_reference_format_fixture(tmp_path):
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.array([1.5, -2.0], dtype=np.float32)
+    blob = _list_file([_dense_record(w, dev_type=2, dev_id=1),  # gpu(1)
+                       _dense_record(b)],
+                      ["arg:fc_weight", "arg:fc_bias"])
+    f = tmp_path / "ref-0000.params"
+    f.write_bytes(blob)
+    loaded = nd.load(str(f))
+    assert set(loaded) == {"arg:fc_weight", "arg:fc_bias"}
+    np.testing.assert_array_equal(loaded["arg:fc_weight"].asnumpy(), w)
+    np.testing.assert_array_equal(loaded["arg:fc_bias"].asnumpy(), b)
+
+
+def test_load_reference_int_dtypes_and_list(tmp_path):
+    a = np.array([[1, 2], [3, 4]], dtype=np.int32)
+    c = np.array([7], dtype=np.int64)
+    f = tmp_path / "x.nd"
+    f.write_bytes(_list_file([_dense_record(a), _dense_record(c)], []))
+    loaded = nd.load(str(f))
+    assert isinstance(loaded, list) and len(loaded) == 2
+    np.testing.assert_array_equal(loaded[0].asnumpy(), a)
+    assert loaded[0].dtype == np.int32
+    np.testing.assert_array_equal(loaded[1].asnumpy(), c)
+
+
+def test_load_legacy_v1_and_pre_v1_records(tmp_path):
+    a = np.array([3.0, 4.0], dtype=np.float32)
+    v1 = (struct.pack("<I", 0xF993FAC8) + _tshape(a.shape) +
+          struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + a.tobytes())
+    pre = (struct.pack("<I", 1) + struct.pack("<I", 2) +  # magic==ndim
+           struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + a.tobytes())
+    f = tmp_path / "legacy.nd"
+    f.write_bytes(_list_file([v1, pre], ["v1", "pre"]))
+    loaded = nd.load(str(f))
+    np.testing.assert_array_equal(loaded["v1"].asnumpy(), a)
+    np.testing.assert_array_equal(loaded["pre"].asnumpy(), a)
+
+
+def test_save_produces_reference_bytes(tmp_path):
+    """Our save must be byte-parseable by the fixture's grammar."""
+    w = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    f = tmp_path / "out.params"
+    nd.save(str(f), {"arg:w": nd.array(w)})
+    blob = f.read_bytes()
+    header, reserved, count = struct.unpack("<QQQ", blob[:24])
+    assert header == 0x112 and reserved == 0 and count == 1
+    magic, stype = struct.unpack("<Ii", blob[24:32])
+    assert magic == 0xF993FAC9 and stype == 0
+    ndim = struct.unpack("<I", blob[32:36])[0]
+    assert ndim == 2
+    dims = struct.unpack("<2q", blob[36:52])
+    assert dims == (2, 3)
+    dev_type, dev_id, type_flag = struct.unpack("<iii", blob[52:64])
+    assert dev_type == 1 and type_flag == 0
+    data = np.frombuffer(blob[64:64 + 24], np.float32).reshape(2, 3)
+    np.testing.assert_array_equal(data, w)
+
+
+def test_roundtrip_structures(tmp_path):
+    d = {"a": nd.array(np.ones((2, 2), np.float32)),
+         "b": nd.array(np.arange(3, dtype=np.float64))}
+    f = tmp_path / "d.nd"
+    nd.save(str(f), d)
+    back = nd.load(str(f))
+    for k in d:
+        np.testing.assert_array_equal(back[k].asnumpy(), d[k].asnumpy())
+        assert back[k].dtype == d[k].dtype
+    lst = [nd.array(np.eye(3, dtype=np.float32))]
+    f2 = tmp_path / "l.nd"
+    nd.save(str(f2), lst)
+    back2 = nd.load(str(f2))
+    assert isinstance(back2, list)
+    np.testing.assert_array_equal(back2[0].asnumpy(), np.eye(3))
+
+
+def test_roundtrip_row_sparse(tmp_path):
+    from mxnet_tpu.ndarray import sparse
+    data = np.array([[1., 2.], [3., 4.]], np.float32)
+    idx = np.array([0, 3], np.int64)
+    rs = sparse.row_sparse_array((data, idx), shape=(5, 2))
+    f = tmp_path / "rs.nd"
+    nd.save(str(f), {"emb": rs})
+    back = nd.load(str(f))["emb"]
+    assert back.stype == "row_sparse"
+    np.testing.assert_array_equal(back.asnumpy(), rs.asnumpy())
+
+
+def test_roundtrip_scalar_and_csr(tmp_path):
+    from mxnet_tpu.ndarray import sparse
+    f = tmp_path / "mix.nd"
+    dense = np.array([[0., 2., 0.], [1., 0., 3.]], np.float32)
+    csr = sparse.csr_matrix(dense)
+    nd.save(str(f), {"s": nd.array(np.float32(3.5)),
+                     "c": csr,
+                     "v": nd.array(np.arange(3, dtype=np.float32))})
+    back = nd.load(str(f))
+    # scalars persist as shape-(1,) (MXNet has no 0-d arrays)
+    np.testing.assert_allclose(back["s"].asnumpy(), [3.5])
+    assert back["c"].stype == "csr"
+    np.testing.assert_array_equal(back["c"].asnumpy(), dense)
+    np.testing.assert_array_equal(back["v"].asnumpy(), [0, 1, 2])
+
+
+def test_upsampling_bilinear_data_kwarg():
+    x = mx.sym.Variable("x")
+    up = mx.sym.UpSampling(data=x, scale=2, sample_type="bilinear",
+                           num_filter=2, num_args=1)
+    assert set(up.list_arguments()) >= {"x"}
+    exe = up.simple_bind(mx.cpu(), grad_req="null", x=(1, 2, 3, 3))
+    out = exe.forward()
+    assert out[0].shape == (1, 2, 6, 6)
+
+
+def test_npz_legacy_files_still_load(tmp_path):
+    f = tmp_path / "old.params"
+    payload = {"arg:w": np.ones((2,), np.float32)}
+    with open(f, "wb") as fh:
+        np.savez(fh, **payload)
+    back = nd.load(str(f))
+    np.testing.assert_array_equal(back["arg:w"].asnumpy(), payload["arg:w"])
+
+
+REFERENCE_ERA_JSON = """{
+  "nodes": [
+    {"op": "null", "name": "data", "inputs": []},
+    {"op": "null", "name": "fc1_weight", "inputs": []},
+    {"op": "null", "name": "fc1_bias", "inputs": []},
+    {
+      "op": "FullyConnected",
+      "name": "fc1",
+      "attr": {
+        "num_hidden": "8",
+        "lr_mult": "2.0",
+        "weight_wd_mult": "0.5"
+      },
+      "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]
+    },
+    {
+      "op": "Activation",
+      "name": "relu1",
+      "attr": {"act_type": "relu"},
+      "inputs": [[3, 0, 0]]
+    }
+  ],
+  "arg_nodes": [0, 1, 2],
+  "node_row_ptr": [0, 1, 2, 3, 4, 5],
+  "heads": [[4, 0, 0]],
+  "attrs": {"mxnet_version": ["int", 1100]}
+}"""
+
+
+def test_load_reference_era_symbol_json(tmp_path):
+    """v0.11 JSON: 'attr' node key, bare hidden keys, py2 long tuples
+    (the reference upgraded these in src/nnvm/legacy_json_util.cc)."""
+    f = tmp_path / "net-symbol.json"
+    f.write_text(REFERENCE_ERA_JSON)
+    sym = mx.sym.load(str(f))
+    args = sym.list_arguments()
+    assert "fc1_weight" in args and "data" in args
+    # bare lr_mult became a hidden user attr on the fc node
+    attrs = sym.attr_dict()
+    assert attrs.get("fc1", {}).get("lr_mult") == "2.0"
+    # weight_wd_mult moved onto the weight variable
+    assert attrs.get("fc1_weight", {}).get("wd_mult") == "0.5"
+    # forward works end to end (8-hidden fc + relu head)
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(2, 5))
+    out = exe.forward()
+    assert out[0].shape == (2, 8)
+
+
+def test_load_py2_long_tuple_conv_json(tmp_path):
+    import json as _json
+    doc = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "c_weight", "inputs": []},
+            {"op": "Convolution", "name": "c",
+             "attr": {"kernel": "(3L, 3L)", "num_filter": "4",
+                      "pad": "(1L, 1L)", "no_bias": "True"},
+             "inputs": [[0, 0, 0], [1, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1],
+        "heads": [[2, 0, 0]],
+    }
+    f = tmp_path / "conv-symbol.json"
+    f.write_text(_json.dumps(doc))
+    sym = mx.sym.load(str(f))
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(1, 2, 8, 8))
+    out = exe.forward()
+    assert out[0].shape == (1, 4, 8, 8)
+
+
+def test_module_checkpoint_binary_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    X = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    it = mx.io.NDArrayIter(X, np.zeros(8, np.float32), batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 0)
+    # the .params artifact is reference-format binary
+    blob = open(prefix + "-0000.params", "rb").read()
+    assert struct.unpack("<Q", blob[:8])[0] == 0x112
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
+    old_args, _ = mod.get_params()
+    for k in old_args:
+        np.testing.assert_array_equal(args[k].asnumpy(),
+                                      old_args[k].asnumpy())

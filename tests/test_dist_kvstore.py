@@ -48,3 +48,44 @@ def test_dist_sync_two_processes(tmp_path):
     assert r.returncode == 0, (r.stdout.decode()[-2000:] +
                                r.stderr.decode()[-2000:])
     assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
+
+
+BANDWIDTH_WORKER = """
+import os, sys
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tools", "bandwidth"))
+import measure
+res = measure.measure_kvstore("dist_sync", size_mb=4.0, num_arrays=4,
+                              iters=3, warmup=1)
+assert res["workers"] == 2, res
+assert res["GBps"] > 0 and res["per_key_GBps"] > 0, res
+open(os.path.join(%(tmp)r, "bw_%%d" %% int(os.environ["MXTPU_WORKER_RANK"])),
+     "w").write(repr(res))
+"""
+
+
+@pytest.mark.slow
+def test_dist_kvstore_bandwidth_two_processes(tmp_path):
+    """tools/bandwidth --kv-store dist_sync reports per-key GB/s through
+    the jitted psum path (reference tools/bandwidth/README.md:33-67)."""
+    script = tmp_path / "bw_worker.py"
+    script.write_text(BANDWIDTH_WORKER % {"repo": REPO, "tmp": str(tmp_path)})
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu-fake-devices", sys.executable, str(script)],
+        env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, (r.stdout.decode()[-2000:] +
+                               r.stderr.decode()[-2000:])
+    assert (tmp_path / "bw_0").exists() and (tmp_path / "bw_1").exists()
+
+
+def test_gradient_compression_warns(caplog):
+    import logging
+    import mxnet_tpu as mx
+    kv = mx.kv.create("device")
+    with caplog.at_level(logging.WARNING):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert any("compression" in r.message for r in caplog.records)

@@ -106,6 +106,21 @@ def test_spmd_duplicate_context_raises():
                  label_shapes=train.provide_label)
 
 
+def test_spmd_grad_req_add():
+    X, Y = _problem()
+    train = mx.io.NDArrayIter(X, Y, batch_size=64)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label, grad_req="add")
+    mod.init_params(mx.init.Xavier())
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    g1 = mod._exec.grad_dict["fc1_weight"].asnumpy().copy()
+    mod.forward_backward(batch)
+    g2 = mod._exec.grad_dict["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-5, atol=1e-6)
+
+
 def test_spmd_forward_only_inference():
     X, Y = _problem()
     ctx = [mx.cpu(i) for i in range(8)]

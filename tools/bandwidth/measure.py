@@ -72,6 +72,44 @@ def measure(num_devices=0, size_mb=256.0, num_arrays=30, iters=10,
             "algbw_GBps": algbw, "busbw_GBps": busbw}
 
 
+def measure_kvstore(kv_type="dist_sync", size_mb=64.0, num_arrays=10,
+                    iters=10, warmup=2, dtype="float32"):
+    """Time KVStore push+pull per key batch — the user-facing path the
+    reference README benchmarked (push grads, pull weights, ~11 GB/s on
+    2 GPUs).  Run under tools/launch.py -n 2 for the dist path."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create(kv_type)
+    itemsize = np.dtype(dtype).itemsize
+    per_array = max(1, int(size_mb * 1e6 / num_arrays / itemsize))
+    keys = [str(i) for i in range(num_arrays)]
+    vals = [mx.nd.ones((per_array,), dtype=dtype) for _ in keys]
+    outs = [mx.nd.zeros((per_array,), dtype=dtype) for _ in keys]
+    for k, v in zip(keys, vals):
+        kv.init(k, v)
+    total_bytes = sum(v._data.nbytes for v in vals)
+
+    def roundtrip():
+        kv.push(keys, [[v] for v in vals])
+        kv.pull(keys, [[o] for o in outs])
+        for o in outs:
+            np.asarray(o._data[-1])  # completion barrier
+
+    for _ in range(warmup):
+        roundtrip()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        roundtrip()
+        times.append(time.perf_counter() - t0)
+    t = min(times)
+    return {"kv_type": kv_type, "workers": kv.num_workers,
+            "num_keys": num_arrays, "total_mb": total_bytes / 1e6,
+            "time_s": t, "GBps": total_bytes / t / 1e9,
+            "per_key_GBps": total_bytes / num_arrays / t / 1e9}
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="all-reduce bandwidth over the mesh "
@@ -92,7 +130,21 @@ def main(argv=None):
     parser.add_argument("--iters", type=int, default=10)
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"])
+    parser.add_argument("--kv-store", default=None,
+                        help="measure through the KVStore API instead of "
+                        "the raw mesh psum (e.g. 'device', 'dist_sync'; "
+                        "run dist under tools/launch.py -n 2)")
     args = parser.parse_args(argv)
+    if args.kv_store:
+        res = measure_kvstore(args.kv_store, args.size_mb,
+                              args.num_arrays, args.iters,
+                              dtype=args.dtype)
+        print("kv=%s workers=%d keys=%d total=%.1f MB time=%.4f s "
+              "agg=%.2f GB/s per-key=%.3f GB/s"
+              % (res["kv_type"], res["workers"], res["num_keys"],
+                 res["total_mb"], res["time_s"], res["GBps"],
+                 res["per_key_GBps"]))
+        return res
     res = measure(args.devices, args.size_mb, args.num_arrays, args.iters,
                   dtype=args.dtype)
     print("devices=%d total=%.1f MB time=%.4f s algbw=%.2f GB/s "

@@ -1,0 +1,25 @@
+"""Tunnel completion-barrier probe (PERF.md §1): shows block_until_ready
+returning early vs a forced host fetch on a known-FLOPs matmul chain."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+
+N = 8192
+@jax.jit
+def f(a, b):
+    for _ in range(10):
+        a = jnp.tanh(a @ b)
+    return a
+
+a = jax.random.normal(jax.random.PRNGKey(0), (N, N), jnp.bfloat16)
+b = jax.random.normal(jax.random.PRNGKey(1), (N, N), jnp.bfloat16)
+o = f(a, b); _ = np.asarray(o[0, 0])
+for ITER in (5, 20):
+    t0 = time.perf_counter()
+    o = f(o, b)
+    for _ in range(ITER - 1):
+        o = f(o, b)
+    _ = np.asarray(o[0, 0])   # scalar fetch forces the whole chain
+    dt = time.perf_counter() - t0
+    fl = 2.0 * N**3 * 10 * ITER
+    print("ITER=%d: %.3fs -> %.1f TFLOP/s" % (ITER, dt, fl / dt / 1e12))
