@@ -36,7 +36,67 @@ PEAK_FLOPS = {
 }
 
 
+def bench_attention():
+    """BENCH_MODE=attention: Pallas flash-attention step vs chip peak.
+
+    Times fwd+bwd of the fused kernel on [B,H,T,D] = (4, 16, 4096, 128)
+    — ~O(T) memory where the einsum oracle would hold a 4096² score
+    matrix per head.  Attention FLOPs: 4·B·H·T²·D per fwd, ×3.5 for
+    fwd+bwd (dq, dk, dv re-use the two matmuls plus recompute).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, h, t, d = (int(os.environ.get("BENCH_ATTN_" + k, v)) for k, v in
+                  (("B", 4), ("H", 16), ("T", 4096), ("D", 128)))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
+    platform = jax.devices()[0].platform
+    device_kind = jax.devices()[0].device_kind
+    if platform == "cpu" and "BENCH_ATTN_T" not in os.environ:
+        t, steps = 512, 2
+
+    key = jax.random.PRNGKey(0)
+    dt = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (b, h, t, d), dt) for i in range(3))
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l, grads
+
+    l, _ = step(q, k, v)
+    np.asarray(l)                       # completion barrier (PERF.md §1)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, grads = step(q, k, v)
+    np.asarray(l)
+    dtime = time.perf_counter() - t0
+    # causal halves the score matrix work
+    flops = 3.5 * 4 * b * h * t * t * d / 2 * steps
+    result = {
+        "metric": "flash_attention_train_tflops",
+        "value": round(flops / dtime / 1e12, 2),
+        "unit": "TFLOP/s (B%d H%d T%d D%d causal %s fwd+bwd, 1 %s)"
+                % (b, h, t, d, jnp.dtype(dt).name, platform),
+        "vs_baseline": 0.0,  # no reference counterpart (2017, pre-attention)
+        "ms_per_step": round(dtime / steps * 1e3, 2),
+    }
+    peak = PEAK_FLOPS.get(device_kind)
+    if peak:
+        result["mfu"] = round(flops / dtime / peak, 3)
+    print(json.dumps(result))
+
+
 def main():
+    if os.environ.get("BENCH_MODE") == "attention":
+        bench_attention()
+        return
     # bs 128 is the measured single-chip sweet spot on v5e (PERF.md:
     # 2379 img/s vs 2263 at bs 256, 2114 at bs 512)
     batch = int(os.environ.get("BENCH_BATCH", "128"))

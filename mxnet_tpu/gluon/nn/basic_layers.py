@@ -14,6 +14,7 @@ from ..block import Block, HybridBlock
 from ...base import MXNetError
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Activation",
+           "FlashSelfAttention",
            "Dropout", "BatchNorm", "LeakyReLU", "Embedding", "Flatten",
            "Lambda", "HybridLambda"]
 
@@ -224,6 +225,50 @@ class Embedding(HybridBlock):
     def __repr__(self):
         return "Embedding({} -> {})".format(
             self._kwargs["input_dim"], self._kwargs["output_dim"])
+
+
+class FlashSelfAttention(HybridBlock):
+    """Multi-head self-attention over [B, T, C] through the fused
+    O(T)-memory attention op (`_contrib_flash_attention`, the Pallas
+    kernel on TPU).  TPU-native addition — the 2017 reference predates
+    attention; exposed as a gluon layer so the kernel is reachable from
+    the layer API, not just raw ops."""
+
+    def __init__(self, units, num_heads, causal=False, use_bias=True,
+                 weight_initializer=None, in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError("units %d not divisible by num_heads %d"
+                             % (units, num_heads))
+        self._units = units
+        self._num_heads = num_heads
+        self._causal = causal
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, use_bias=use_bias,
+                             weight_initializer=weight_initializer,
+                             in_units=in_units, prefix="qkv_")
+            self.out_proj = Dense(units, flatten=False, use_bias=use_bias,
+                                  weight_initializer=weight_initializer,
+                                  in_units=units, prefix="out_")
+
+    def hybrid_forward(self, F, x):
+        b, t = x.shape[0], x.shape[1]
+        h = self._num_heads
+        d = self._units // h
+        qkv = self.qkv(x)                        # [B, T, 3C]
+        qkv = F.reshape(qkv, shape=(b, t, 3, h, d))
+        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))  # [3, B, H, T, D]
+        q = F.reshape(F.slice_axis(qkv, axis=0, begin=0, end=1),
+                      shape=(b, h, t, d))
+        k = F.reshape(F.slice_axis(qkv, axis=0, begin=1, end=2),
+                      shape=(b, h, t, d))
+        v = F.reshape(F.slice_axis(qkv, axis=0, begin=2, end=3),
+                      shape=(b, h, t, d))
+        o = getattr(F, "_contrib_flash_attention")(
+            q, k, v, causal=self._causal)         # [B, H, T, D]
+        o = F.reshape(F.transpose(o, axes=(0, 2, 1, 3)),
+                      shape=(b, t, self._units))
+        return self.out_proj(o)
 
 
 class Flatten(HybridBlock):

@@ -361,6 +361,25 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     return out.reshape(b, h, tq, v.shape[3])
 
 
+def flash_forward_with_lse(q, k, v, causal=False, scale=None, block_q=128,
+                           block_k=128):
+    """Forward-only kernel call returning (out, lse) over [B,H,T,D].
+
+    ``lse = m + log l`` per query row — the merge quantity ring attention
+    needs to combine per-block results (parallel/ring_attention.py).  Not
+    differentiable; ring attention defines its own vjp around it.
+    """
+    b, h, tq, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    q3 = q.reshape(b * h, tq, d)
+    k3 = k.reshape(b * h, k.shape[2], k.shape[3])
+    v3 = v.reshape(b * h, v.shape[2], v.shape[3])
+    out, lse = _flash_fwd(q3, k3, v3, float(scale), bool(causal),
+                          int(block_q), int(block_k))
+    return (out.reshape(b, h, tq, v.shape[3]),
+            lse.reshape(b, h, tq, 1))
+
+
 def flash_attention_reference(q, k, v, causal=False, scale=None):
     """O(T²) jnp oracle for tests."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5

@@ -88,22 +88,158 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
     return out.astype(q.dtype)
 
 
+def _ring_flash_fwd_local(q, k, v, axis, causal, scale):
+    """Ring forward whose per-block attention is the Pallas flash kernel
+    (ops/pallas/flash_attention.py) instead of jnp einsums: each hop runs
+    the fused kernel on (q_local, k_block, v_block) getting (out, lse),
+    and blocks merge by log-sum-exp — the O(T²) score matrix never exists
+    in HBM and the MXU work happens inside the kernel.
+
+    Returns (out, lse_total) — lse_total is the flash-backward residual.
+    """
+    from ..ops.pallas.flash_attention import flash_forward_with_lse
+    n = lax.axis_size(axis)  # static: mesh axis sizes are trace-time ints
+    idx = lax.axis_index(axis)
+
+    o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m = jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+    k_blk, v_blk = k, v
+    # unrolled: n is the static mesh-axis size, so step (and the
+    # diagonal's causal flag) stay Python values; only src is traced
+    for step in range(n):
+        src = (idx - step) % n
+        o_b, lse_b = flash_forward_with_lse(
+            q, k_blk, v_blk, causal=(causal and step == 0), scale=scale)
+        if causal and step > 0:
+            # later blocks are fully visible iff strictly earlier in the
+            # sequence; otherwise fully masked
+            visible = (src < idx)[None, None, None, None]
+            lse_b = jnp.where(visible, lse_b, _NEG_INF)
+        m_new = jnp.maximum(jnp.maximum(m, lse_b), _NEG_INF)
+        c1 = jnp.exp(m - m_new)
+        c2 = jnp.exp(lse_b - m_new)
+        o = o * c1 + o_b.astype(jnp.float32) * c2
+        l = l * c1 + c2
+        m = m_new
+        if step < n - 1:
+            k_blk = collectives.ring_permute(k_blk, axis, 1)
+            v_blk = collectives.ring_permute(v_blk, axis, 1)
+    l_safe = jnp.maximum(l, 1e-20)
+    out = (o / l_safe).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+def _ring_flash_bwd_local(q, k, v, out, lse, g, axis, causal, scale):
+    """Blockwise ring backward from saved (out, lse) — the flash-backward
+    recurrence at ring-block granularity: per hop, recompute this block's
+    probabilities from lse (no second forward pass, no O(T_local×T_global)
+    residuals), accumulate dq locally, and rotate per-block dk/dv around
+    the ring in lock-step with k/v so each lands home after n hops."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    delta = jnp.sum(gf * of, axis=-1, keepdims=True)  # [B,H,Tq,1]
+
+    dq = jnp.zeros_like(qf)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    k_blk, v_blk = k, v
+    tq, tk = q.shape[2], k.shape[2]
+    for step in range(n):
+        src = (idx - step) % n
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            if step == 0:
+                s = s + _causal_bias(0, 0, tq, tk)
+            else:
+                visible = (src < idx)[None, None, None, None]
+                s = jnp.where(visible, s, _NEG_INF)
+        p = jnp.exp(s - lse)                      # true softmax probs
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+        ds = p * (dp - delta) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        # rotate K/V and their gradient accumulators together; after the
+        # full circle each dk/dv block is back on its owner
+        k_blk = collectives.ring_permute(k_blk, axis, 1)
+        v_blk = collectives.ring_permute(v_blk, axis, 1)
+        dk = collectives.ring_permute(dk, axis, 1)
+        dv = collectives.ring_permute(dv, axis, 1)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash_local(q, k, v, axis, causal, scale):
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    out, _ = _ring_flash_fwd_local(q, k, v, axis, causal, scale)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis, causal, scale):
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _ring_flash_fwd_local(q, k, v, axis, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis, causal, scale, res, g):
+    q, k, v, out, lse = res
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _ring_flash_bwd_local(q, k, v, out, lse, g, axis, causal, scale)
+
+
+_ring_flash_local.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def default_attention_impl():
+    """Resolve the attention implementation.
+
+    MXTPU_ATTENTION_IMPL=flash|xla overrides; otherwise "flash" (the
+    Pallas kernel) on a TPU backend and "xla" (plain jnp online softmax)
+    elsewhere — off-TPU the kernel would run in the Pallas interpreter,
+    and host processes contaminated by the axon sitecustomize cannot even
+    trace it (see tests/test_flash_attention.py); clean CPU processes can
+    opt in with the env var, which the subprocess driver does.
+    """
+    import os
+    impl = os.environ.get("MXTPU_ATTENTION_IMPL")
+    if impl in ("flash", "xla"):
+        return impl
+    return "flash" if jax.default_backend() == "tpu" else "xla"
+
+
 def ring_attention(q, k, v, mesh=None, axis=AXIS_SP, causal=False,
-                   scale=None, batch_axis=None):
+                   scale=None, batch_axis=None, impl=None):
     """Sequence-parallel attention.
 
     With ``mesh`` given, q/k/v are global [B,H,T,D] arrays and the call is
     wrapped in shard_map with T sharded over ``axis``.  With ``mesh=None``
     the caller is already inside shard_map/pjit and q/k/v are local blocks.
     ``batch_axis`` names an additional mesh axis sharding dim 0 (compose
-    with dp in one program).
+    with dp in one program).  ``impl``: "flash" runs each hop's block
+    attention in the Pallas kernel; "xla" keeps the plain jnp
+    online-softmax step; None resolves via `default_attention_impl`.
     """
+    if impl is None:
+        impl = default_attention_impl()
+    if impl == "flash":
+        local = functools.partial(_ring_flash_local, axis=axis,
+                                  causal=causal, scale=scale)
+    else:
+        local = functools.partial(_ring_attention_local, axis=axis,
+                                  causal=causal, scale=scale)
     if mesh is None:
-        return _ring_attention_local(q, k, v, axis, causal, scale)
+        return local(q, k, v)
     spec = P(batch_axis, None, axis, None)
-    fn = functools.partial(_ring_attention_local, axis=axis, causal=causal,
-                           scale=scale)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(lambda a, b, c: local(a, b, c), mesh=mesh,
+                     in_specs=(spec, spec, spec),
                      out_specs=spec, check_rep=False)(q, k, v)
 
 

@@ -17,6 +17,8 @@ def test_flash_attention_kernels():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
     r = subprocess.run(
         [sys.executable,
          os.path.join(REPO, "tests", "flash_attention_driver.py")],

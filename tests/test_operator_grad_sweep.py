@@ -304,12 +304,147 @@ C("sp_UpSampling_bilinear", "UpSampling",
   params={"scale": 2, "sample_type": "bilinear", "num_filter": 2,
           "num_args": 1}, rtol=2e-2)
 
+# -- more elementwise / shape ops -------------------------------------------
+C("bin__maximum", "_maximum", [("lhs", (3, 4), "any"),
+                               ("rhs", (3, 4), "any")])
+C("bin__minimum", "_minimum", [("lhs", (3, 4), "any"),
+                               ("rhs", (3, 4), "any")])
+C("bin__mod", "_mod", [("lhs", (3, 4), "pos"), ("rhs", (3, 4), "gt1")])
+C("bin__pow", "_pow", [("lhs", (3, 4), "pos"), ("rhs", (3, 4), "unit")])
+C("bin_elemwise_hypot", "elemwise_hypot",
+  [("lhs", (3, 4), "pos"), ("rhs", (3, 4), "pos")])
+C("scalar__mod_scalar", "_mod_scalar", [(D, (3, 4), "pos")],
+  params={"scalar": 1.7})
+C("scalar__rmod_scalar", "_rmod_scalar", [(D, (3, 4), "gt1")],
+  params={"scalar": 5.3})
+C("bc_broadcast_mod", "broadcast_mod",
+  [("lhs", (3, 4), "pos"), ("rhs", (1, 4), "gt1")])
+C("shape_broadcast_axes", "broadcast_axes", [(D, (1, 3, 1), "any")],
+  params={"axis": (0, 2), "size": (2, 4)})
+C("shape_broadcast_to", "broadcast_to", [(D, (1, 3, 1), "any")],
+  params={"shape": (2, 3, 4)})
+C("red__square_sum", "_square_sum", [(D, (3, 4), "any")],
+  params={"axis": 1})
+C("shape_SliceChannel", "SliceChannel", [(D, (2, 6), "any")],
+  params={"num_outputs": 2, "axis": 1})
+C("bin_ElementWiseSum", "ElementWiseSum",
+  [("arg0", (3, 4), "any"), ("arg1", (3, 4), "any"),
+   ("arg2", (3, 4), "any")], params={"num_args": 3})
+C("shape_pick", "pick",
+  [(D, (4, 5), "any"), ("index", (4,), "int:5")], fixed=("index",))
+C("shape_zeros_like", "zeros_like", [(D, (3, 4), "any")])
+C("shape_ones_like", "ones_like", [(D, (3, 4), "any")])
+C("sp_SpatialTransformer", "SpatialTransformer",
+  [(D, (1, 2, 5, 5), "any"), ("loc", (1, 6), "unit")],
+  params={"transform_type": "affine", "sampler_type": "bilinear",
+          "target_shape": (4, 4)}, rtol=3e-2, atol=1e-3)
+C("sp_Correlation", "Correlation",
+  [("data1", (1, 2, 5, 5), "any"), ("data2", (1, 2, 5, 5), "any")],
+  params={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+          "stride2": 1, "pad_size": 1}, rtol=2e-2)
+C("sp_ROIPooling", "ROIPooling",
+  [(D, (1, 2, 8, 8), "any"), ("rois", (2, 5), "int:4")],
+  params={"pooled_size": (2, 2), "spatial_scale": 1.0}, fixed=("rois",))
+
 # -- outputs / losses (custom-grad semantics verified separately) -----------
 C("out_MakeLoss", "MakeLoss", [(D, (3, 4), "pos")])
 C("out_smooth_l1", "smooth_l1", [(D, (3, 4), "any")],
   params={"scalar": 1.0})
 C("out_softmax_cross_entropy", "softmax_cross_entropy",
   [(D, (3, 4), "any"), ("label", (3,), "int:4")], fixed=("label",))
+
+#: registry OpDefs with no finite-difference case, and why.  The
+#: completeness guard below fails when a newly-registered op appears in
+#: neither CASES nor this table.
+SKIP_REASONS = {
+    "BlockGrad": "zero-grad by definition; explicit test below",
+    "Dropout": "rng-dependent mask; explicit semantics test below",
+    "Custom": "python callback op; gradients tested in test_custom_op.py",
+    "RNN": "scan-based fused op; gradients tested in test_rnn.py",
+    "Softmax": "SoftmaxOutput's backward IS (p - label), not the vjp of "
+               "its forward (reference softmax_output-inl.h); semantics "
+               "pinned in test_operator.py/test_module.py trainings",
+    "LinearRegressionOutput": "custom loss-grad (out - label) semantics, "
+                              "pinned in test_operator.py",
+    "LogisticRegressionOutput": "custom loss-grad semantics, "
+                                "pinned in test_operator.py",
+    "MAERegressionOutput": "custom loss-grad sign(out - label) semantics",
+    "SVMOutput": "custom margin-grad semantics, pinned in test_operator.py",
+    "IdentityAttachKLSparseReg": "identity fwd with regularizer side-grad",
+    "_CrossDeviceCopy": "identity placement op",
+    "_contrib_CTCLoss": "dynamic-programming loss; oracle-tested in "
+                        "test_contrib.py",
+    "_contrib_fft": "complex-interleaved output; fwd oracle in "
+                    "test_contrib.py",
+    "_contrib_ifft": "complex-interleaved input; fwd oracle in "
+                     "test_contrib.py",
+    "_contrib_count_sketch": "hash-projection; fwd oracle in "
+                             "test_contrib.py",
+    "_contrib_quantize": "int8 output, non-differentiable",
+    "_contrib_dequantize": "int8 input, non-differentiable",
+    "_contrib_flash_attention": "kernel custom_vjp; gradients oracle-"
+                                "tested in flash_attention_driver.py and "
+                                "test_attention_op.py",
+    "MultiBoxPrior": "anchor generation, input-independent",
+    "MultiBoxTarget": "matching/assignment, non-differentiable",
+    "MultiBoxDetection": "nms decode, non-differentiable",
+    "Proposal": "nms + rounding, non-differentiable (oracle in "
+                "test_rcnn_ops.py)",
+    "MultiProposal": "nms + rounding, non-differentiable",
+    "PSROIPooling": "integer binning w.r.t. rois; data-grad oracle in "
+                    "test_rcnn_ops.py",
+    "DeformableConvolution": "oracle-tested in test_rcnn_ops.py",
+    "DeformablePSROIPooling": "oracle-tested in test_rcnn_ops.py",
+    "argmax": "integer output, zero grad",
+    "argmin": "integer output, zero grad",
+    "argmax_channel": "integer output, zero grad",
+    "argsort": "permutation output, zero grad",
+    "topk": "index/selection output; value-mode grad is gather (covered "
+            "by sort case semantics)",
+    "_arange": "no tensor inputs",
+    "_full": "no tensor inputs",
+    "_ones": "no tensor inputs",
+    "_zeros": "no tensor inputs",
+    # comparisons: boolean outputs, zero grad everywhere
+    **{n: "boolean output, zero grad" for n in
+       ["_equal", "_not_equal", "_greater", "_greater_equal", "_lesser",
+        "_lesser_equal", "_equal_scalar", "_not_equal_scalar",
+        "_greater_scalar", "_greater_equal_scalar", "_lesser_scalar",
+        "_lesser_equal_scalar", "broadcast_equal", "broadcast_not_equal",
+        "broadcast_greater", "broadcast_greater_equal", "broadcast_lesser",
+        "broadcast_lesser_equal"]},
+    # random samplers: distribution params, not differentiable draws
+    **{n: "random draw, non-differentiable" for n in
+       ["_random_uniform", "_random_normal", "_random_gamma",
+        "_random_exponential", "_random_poisson",
+        "_random_negative_binomial",
+        "_random_generalized_negative_binomial", "sample_uniform",
+        "sample_normal", "sample_gamma", "sample_exponential",
+        "sample_poisson", "sample_multinomial"]},
+    # optimizer update kernels: semantics tested in test_optimizer.py
+    **{n: "optimizer update kernel, tested in test_optimizer.py" for n in
+       ["sgd_update", "sgd_mom_update", "mp_sgd_update",
+        "mp_sgd_mom_update", "adam_update", "rmsprop_update",
+        "rmspropalex_update", "ftrl_update"]},
+}
+
+
+def test_sweep_covers_entire_registry():
+    """Every registered OpDef is either in CASES or SKIP_REASONS — a new
+    op cannot silently dodge gradient coverage."""
+    covered = {id(registry.get_op(c.op)) for c in CASES}
+    skipped = set()
+    for name in SKIP_REASONS:
+        skipped.add(id(registry.get_op(name)))
+    missing = []
+    seen = set()
+    for name, op in registry._OP_REGISTRY.items():
+        if id(op) in covered or id(op) in skipped or id(op) in seen:
+            continue
+        seen.add(id(op))
+        missing.append(name)
+    assert not missing, (
+        "ops with neither a gradient case nor a skip reason: %s" % missing)
 
 
 _seen = set()
