@@ -54,8 +54,11 @@ def bench_attention():
     steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
     platform = jax.devices()[0].platform
     device_kind = jax.devices()[0].device_kind
-    if platform == "cpu" and "BENCH_ATTN_T" not in os.environ:
-        t, steps = 512, 2
+    if platform == "cpu":
+        if "BENCH_ATTN_T" not in os.environ:
+            t = 512
+        if "BENCH_STEPS" not in os.environ:
+            steps = 2
 
     key = jax.random.PRNGKey(0)
     dt = jnp.bfloat16 if platform != "cpu" else jnp.float32
@@ -93,9 +96,98 @@ def bench_attention():
     print(json.dumps(result))
 
 
+def _synthetic_rec(n_images, edge, path):
+    """Write an ImageNet-shaped synthetic .rec (JPEG-encoded random
+    images) once; reruns reuse it.  Plays tools/im2rec.py's role without
+    needing an image folder."""
+    import numpy as np
+    from mxnet_tpu import recordio
+
+    if os.path.exists(path):
+        return path
+    from PIL import Image
+    import io as pyio
+    rng = np.random.RandomState(0)
+    # write to a temp name, rename only on completion — an interrupted
+    # generation must not leave a truncated .rec a later run benchmarks
+    rec_tmp = path + ".partial"
+    idx_final = path[:-4] + ".idx"
+    idx_tmp = idx_final + ".partial"
+    rec = recordio.MXIndexedRecordIO(idx_tmp, rec_tmp, "w")
+    try:
+        for i in range(n_images):
+            img = rng.randint(0, 256, (edge, edge, 3), np.uint8)
+            buf = pyio.BytesIO()
+            Image.fromarray(img).save(buf, format="JPEG", quality=90)
+            header = recordio.IRHeader(0, float(i % 1000), i, 0)
+            rec.write_idx(i, recordio.pack(header, buf.getvalue()))
+        rec.close()
+        os.replace(rec_tmp, path)
+        os.replace(idx_tmp, idx_final)
+    except BaseException:
+        rec.close()
+        for f in (rec_tmp, idx_tmp):
+            if os.path.exists(f):
+                os.remove(f)
+        raise
+    return path
+
+
+def bench_pipeline():
+    """BENCH_MODE=pipeline: native input-pipeline throughput.
+
+    Measures the C++ decode+augment pipeline (src/mxtpu/image_iter.cc)
+    standalone — JPEG decode, 224 random crop, mirror, mean/std — the
+    denominator for 'does IO sustain training' (PERF.md; the reference
+    benchmarked the same via `--test-io 1`, example/image-classification/
+    common/fit.py)."""
+    import time as _time
+    import numpy as np
+    import mxnet_tpu as mx
+
+    n_images = int(os.environ.get("BENCH_PIPE_IMAGES", "2000"))
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    threads = int(os.environ.get("BENCH_PIPE_THREADS", "8"))
+    epochs = int(os.environ.get("BENCH_PIPE_EPOCHS", "3"))
+    cache = os.environ.get("BENCH_PIPE_REC",
+                           "/tmp/mxtpu_bench_synth_%d.rec" % n_images)
+    _synthetic_rec(n_images, 256, cache)
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=cache, data_shape=(3, 224, 224), batch_size=batch,
+        shuffle=True, rand_crop=True, rand_mirror=True,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        std_r=58.4, std_g=57.12, std_b=57.38,
+        preprocess_threads=threads, prefetch_buffer=8)
+    # warm epoch (thread pool spin-up, file cache)
+    n = 0
+    for b in it:
+        n += batch
+    t0 = _time.perf_counter()
+    total = 0
+    for _ in range(epochs):
+        it.reset()
+        for b in it:
+            np.asarray(b.data[0]._data[0, 0, 0])  # pull one value
+            total += batch
+    dt = _time.perf_counter() - t0
+    img_s = total / dt
+    train_img_s = float(os.environ.get("BENCH_PIPE_TRAIN_IMG_S", "2235"))
+    print(json.dumps({
+        "metric": "input_pipeline_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s (jpeg decode + 224 crop/mirror/norm, %d threads, "
+                "bs %d)" % (threads, batch),
+        "vs_baseline": round(img_s / train_img_s, 3),
+    }))
+
+
 def main():
     if os.environ.get("BENCH_MODE") == "attention":
         bench_attention()
+        return
+    if os.environ.get("BENCH_MODE") == "pipeline":
+        bench_pipeline()
         return
     # bs 128 is the measured single-chip sweet spot on v5e (PERF.md:
     # 2379 img/s vs 2263 at bs 256, 2114 at bs 512)

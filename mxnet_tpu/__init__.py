@@ -53,5 +53,8 @@ from .monitor import Monitor
 from . import visualization
 from . import visualization as viz
 from . import gluon
+from . import config
+from . import predictor
+from .predictor import Predictor
 
 __version__ = "0.1.0"

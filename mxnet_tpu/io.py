@@ -23,12 +23,13 @@ import threading
 from collections import namedtuple
 
 import numpy as _np
+import jax.numpy as jnp
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "ImageRecordIter",
+           "ImageRecordIter", "LibSVMIter",
            "PrefetchingIter", "MNISTIter", "CSVIter"]
 
 
@@ -441,6 +442,107 @@ class CSVIter(NDArrayIter):
         super().__init__(
             data, label, batch_size=batch_size,
             last_batch_handle="pad" if round_batch else "discard")
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format sparse iterator (reference src/io/iter_libsvm.cc:67).
+
+    Parses ``label idx:val idx:val ...`` lines into CSR batches: each
+    batch's data is a CSRNDArray of shape (batch, *data_shape) backed by a
+    masked-dense buffer (ndarray/sparse.py design), labels come from the
+    leading token or a companion libsvm file (``label_libsvm``).
+    """
+
+    def __init__(self, data_libsvm, data_shape, batch_size, label_libsvm=None,
+                 label_shape=None, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(int(s) for s in (
+            data_shape if isinstance(data_shape, (tuple, list))
+            else (data_shape,)))
+        ncol = 1
+        for s in self._data_shape:
+            ncol *= s
+        self._ncol = ncol
+        # O(nnz) storage: per-row (indices, values) pairs; densify only
+        # the current batch in next() (the format exists because the
+        # dense matrix doesn't fit)
+        self._rows, labels = self._parse(data_libsvm)
+        self._label_shape = ()
+        if label_libsvm is not None:
+            lrows, _ = self._parse(label_libsvm)
+            lcol = 1
+            for s in (label_shape or (1,)):
+                lcol *= int(s)
+            dense_l = _np.zeros((len(lrows), lcol), _np.float32)
+            for r, (li, lv) in enumerate(lrows):
+                dense_l[r, li] = lv
+            if label_shape and lcol > 1:
+                labels = dense_l
+                self._label_shape = tuple(int(s) for s in label_shape)
+            else:
+                labels = dense_l[:, 0]
+        self._label = _np.asarray(labels, _np.float32)
+        self._round_batch = round_batch
+        self._cursor = 0
+
+    @staticmethod
+    def _parse(path):
+        """→ ([(idx_array, val_array) per row], [leading labels])."""
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                toks = line.split()
+                start = 0
+                if ":" not in toks[0]:
+                    labels.append(float(toks[0]))
+                    start = 1
+                else:
+                    labels.append(0.0)
+                idx = _np.array([int(t.split(":")[0])
+                                 for t in toks[start:]], _np.int64)
+                val = _np.array([float(t.split(":")[1])
+                                 for t in toks[start:]], _np.float32)
+                rows.append((idx, val))
+        return rows, labels
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label",
+                         (self.batch_size,) + self._label_shape)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from .ndarray import sparse as _sparse
+        n = len(self._rows)
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        take = list(range(self._cursor, min(end, n)))
+        pad = 0
+        if end > n:
+            if not self._round_batch:
+                raise StopIteration
+            pad = end - n
+            take += list(range(pad))
+        self._cursor = end
+        batch = _np.zeros((self.batch_size, self._ncol), _np.float32)
+        for r, src in enumerate(take):
+            idx, val = self._rows[src]
+            batch[r, idx] = val
+        batch = batch.reshape((self.batch_size,) + self._data_shape)
+        data = _sparse.csr_matrix(batch) if len(self._data_shape) == 1 \
+            else _sparse.CSRNDArray(jnp.asarray(batch))
+        label = array(self._label[take])
+        return DataBatch(data=[data], label=[label], pad=pad)
 
 
 class ImageRecordIter(DataIter):

@@ -23,6 +23,14 @@ __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
            "zeros"]
 
 
+def live_row_mask(data):
+    """Boolean (rows,) mask of rows with any nonzero — THE liveness
+    predicate of the masked-dense row_sparse representation; every
+    consumer (.indices/.data here, the lazy optimizer updates in
+    ops/optimizer_ops.py) must share this definition."""
+    return jnp.any(data.reshape(data.shape[0], -1) != 0, axis=1)
+
+
 class BaseSparseNDArray(NDArray):
     __slots__ = ()
 
@@ -46,16 +54,15 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
-        dense = self.asnumpy()
-        nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0,
-                               axis=1))[0]
-        return array(nz.astype(_np.int64), dtype="int64")
+        # device-side: only the boolean row mask is synchronized to size
+        # the result; the data buffer never leaves the device
+        nz = jnp.nonzero(live_row_mask(self._data))[0]
+        return NDArray(nz.astype(jnp.int64), self._ctx)
 
     @property
     def data(self):
-        dense = self.asnumpy()
-        idx = self.indices.asnumpy().astype(_np.int64)
-        return array(dense[idx])
+        nz = jnp.nonzero(live_row_mask(self._data))[0]
+        return NDArray(jnp.take(self._data, nz, axis=0), self._ctx)
 
     def tostype(self, stype):
         if stype == "row_sparse":

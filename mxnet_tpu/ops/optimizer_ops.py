@@ -24,23 +24,42 @@ def _rescale(grad, rescale_grad, clip_gradient):
     return grad
 
 
+def _live_rows(grad):
+    """Rows the (masked-dense row_sparse) gradient actually touches —
+    the lazy-update predicate the reference evaluated over the sparse
+    gradient's idx array (src/operator/optimizer_op.cc SGDUpdateRsp).
+    Shares the liveness definition with RowSparseNDArray.indices."""
+    from ..ndarray.sparse import live_row_mask
+    return live_row_mask(grad).reshape((-1,) + (1,) * (grad.ndim - 1))
+
+
 @register_op("sgd_update", arg_names=("weight", "grad"),
              param_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
-                             "clip_gradient": -1.0})
+                             "clip_gradient": -1.0, "lazy_update": False})
 def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
-                clip_gradient=-1.0):
-    grad = _rescale(grad, rescale_grad, clip_gradient)
-    return weight - lr * (grad + wd * weight)
+                clip_gradient=-1.0, lazy_update=False):
+    g = _rescale(grad, rescale_grad, clip_gradient)
+    new_w = weight - lr * (g + wd * weight)
+    if lazy_update:
+        # rows absent from the gradient stay untouched — including their
+        # weight-decay term, matching the reference's sparse sgd_update
+        return jnp.where(_live_rows(grad), new_w, weight)
+    return new_w
 
 
 @register_op("sgd_mom_update", arg_names=("weight", "grad", "mom"),
              num_outputs=2,
              param_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
-                             "rescale_grad": 1.0, "clip_gradient": -1.0})
+                             "rescale_grad": 1.0, "clip_gradient": -1.0,
+                             "lazy_update": False})
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
-                    rescale_grad=1.0, clip_gradient=-1.0):
-    grad = _rescale(grad, rescale_grad, clip_gradient)
-    new_mom = momentum * mom - lr * (grad + wd * weight)
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
+    g = _rescale(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    if lazy_update:
+        live = _live_rows(grad)
+        new_mom = jnp.where(live, new_mom, mom)
+        return jnp.where(live, weight + new_mom, weight), new_mom
     return weight + new_mom, new_mom
 
 
@@ -72,13 +91,21 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
              num_outputs=3,
              param_defaults={"lr": 0.001, "beta1": 0.9, "beta2": 0.999,
                              "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
-                             "clip_gradient": -1.0})
+                             "clip_gradient": -1.0, "lazy_update": False})
 def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
-    grad = _rescale(grad, rescale_grad, clip_gradient) + wd * weight
-    new_mean = beta1 * mean + (1 - beta1) * grad
-    new_var = beta2 * var + (1 - beta2) * jnp.square(grad)
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=False):
+    g = _rescale(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
     new_weight = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    if lazy_update:
+        # reference AdamUpdateRsp: m/v/w advance only on rows the sparse
+        # gradient carries
+        live = _live_rows(grad)
+        return (jnp.where(live, new_weight, weight),
+                jnp.where(live, new_mean, mean),
+                jnp.where(live, new_var, var))
     return new_weight, new_mean, new_var
 
 

@@ -136,14 +136,24 @@ def _clip(x, bound):
     return x
 
 
+def _is_lazy(grad):
+    """Row-sparse gradients get the reference's lazy update: rows the
+    gradient doesn't carry are untouched (src/operator/optimizer_op.cc
+    SGDUpdateRsp/AdamUpdateRsp)."""
+    from .ndarray.sparse import RowSparseNDArray
+    return isinstance(grad, RowSparseNDArray)
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum + optional fp16 master weights
-    (reference optimizer.py:335)."""
+    (reference optimizer.py:335).  ``lazy_update`` (default True, as in
+    the reference) applies sparse gradients lazily."""
 
-    def __init__(self, momentum=0.0, **kwargs):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.multi_precision and weight.dtype == numpy.float16:
@@ -161,8 +171,10 @@ class SGD(Optimizer):
         self._update_count(index)
         kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                       clip_gradient=(self.clip_gradient
-                                     if self.clip_gradient else -1.0))
+                                     if self.clip_gradient else -1.0),
+                      lazy_update=self.lazy_update and _is_lazy(grad))
         if isinstance(state, tuple):  # multi-precision
+            kwargs.pop("lazy_update")  # mp path is dense-only (reference)
             mom, weight32 = state
             if mom is not None:
                 out = mp_sgd_mom_update(weight, grad, mom, weight32,
@@ -257,11 +269,12 @@ class Adam(Optimizer):
     """Adam with the reference's bias-corrected lr (optimizer.py:595)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, **kwargs):
+                 epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (zeros(weight.shape, dtype=weight.dtype),
@@ -280,7 +293,8 @@ class Adam(Optimizer):
                           beta2=self.beta2, epsilon=self.epsilon, wd=wd,
                           rescale_grad=self.rescale_grad,
                           clip_gradient=(self.clip_gradient
-                                         if self.clip_gradient else -1.0))
+                                         if self.clip_gradient else -1.0),
+                          lazy_update=self.lazy_update and _is_lazy(grad))
         weight._set_data(out[0]._data)
         mean._set_data(out[1]._data)
         var._set_data(out[2]._data)

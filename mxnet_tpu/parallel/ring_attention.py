@@ -208,8 +208,8 @@ def default_attention_impl():
     trace it (see tests/test_flash_attention.py); clean CPU processes can
     opt in with the env var, which the subprocess driver does.
     """
-    import os
-    impl = os.environ.get("MXTPU_ATTENTION_IMPL")
+    from ..config import flag
+    impl = flag("MXTPU_ATTENTION_IMPL")
     if impl in ("flash", "xla"):
         return impl
     return "flash" if jax.default_backend() == "tpu" else "xla"

@@ -1,0 +1,106 @@
+"""Deployment-shaped inference entry.
+
+The analogue of the reference's C predict API
+(/root/reference/include/mxnet/c_predict_api.h,
+src/c_api/c_predict_api.cc): load a symbol JSON + a .params blob, bind a
+forward-only executor for fixed input shapes, then set input → forward →
+get output, with zero training machinery (no labels, no gradients, no
+optimizer).  The compiled program is cached per input shape, so repeated
+`forward` calls are single XLA executions — the deployment story the C
+API existed for.
+
+    pred = Predictor.from_checkpoint("resnet", 0, {"data": (1, 3, 224, 224)})
+    pred.forward(data=batch)
+    probs = pred.get_output(0)
+
+`Predictor(symbol_json_str, param_bytes, ...)` mirrors MXPredCreate's
+buffer-based signature for serving stacks that ship bytes, not files.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import context as ctx_mod
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray.utils import load_frombuffer
+from .symbol import load_json
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    def __init__(self, symbol_json, param_bytes, input_shapes, ctx=None,
+                 type_dict=None):
+        """symbol_json: JSON string; param_bytes: reference-format .params
+        bytes (arg:/aux: prefixed); input_shapes: {name: shape}
+        (MXPredCreate's input_keys/input_shape_* pair)."""
+        if ctx is None:
+            ctx = ctx_mod.current_context()
+        self._ctx = ctx
+        self._symbol = load_json(symbol_json)
+        params = load_frombuffer(param_bytes) if param_bytes else {}
+        arg_params, aux_params = {}, {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+        self._input_names = list(input_shapes.keys())
+        self._exec = self._symbol.simple_bind(
+            ctx, grad_req="null", type_dict=type_dict,
+            **{k: tuple(v) for k, v in input_shapes.items()})
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=True)
+        self._outputs = None
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, ctx=None,
+                        type_dict=None):
+        """Load `prefix-symbol.json` + `prefix-%04d.params` (the
+        two-artifact contract, reference python/mxnet/model.py:340)."""
+        with open("%s-symbol.json" % prefix) as f:
+            sym_json = f.read()
+        with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
+            param_bytes = f.read()
+        return cls(sym_json, param_bytes, input_shapes, ctx=ctx,
+                   type_dict=type_dict)
+
+    def set_input(self, name, value):
+        """MXPredSetInput: stage one named input."""
+        if name not in self._input_names:
+            raise MXNetError("unknown input %r; declared inputs: %s"
+                             % (name, self._input_names))
+        arr = value if isinstance(value, nd.NDArray) else nd.array(value)
+        self._exec.arg_dict[name]._set_data(arr._data)
+
+    def forward(self, **inputs):
+        """MXPredForward: run the compiled forward program."""
+        for name, value in inputs.items():
+            self.set_input(name, value)
+        self._outputs = self._exec.forward(is_train=False)
+        return self._outputs
+
+    def get_output(self, index=0):
+        """MXPredGetOutput: fetch output `index` as numpy."""
+        if self._outputs is None:
+            raise MXNetError("call forward() before get_output()")
+        return self._outputs[index].asnumpy()
+
+    @property
+    def output_shapes(self):
+        return [tuple(o.shape) for o in (self._outputs or [])]
+
+    def reshape(self, input_shapes):
+        """MXPredReshape: rebind for new input shapes, keeping weights."""
+        kwargs = {k: tuple(v) for k, v in input_shapes.items()}
+        self._exec = self._exec.reshape(**kwargs)
+        self._input_names = list(input_shapes.keys())
+        self._outputs = None
+
+    def predict(self, data, input_name=None):
+        """One-call convenience: set the (single) input, forward, return
+        output 0 — the c_predict_api quick path."""
+        name = input_name or self._input_names[0]
+        self.forward(**{name: data})
+        return self.get_output(0)

@@ -109,6 +109,36 @@ def _upsampling_hint(shapes, params):
 _register("UpSampling", _upsampling_hint)
 
 
+def _softmax_output_label_hint(shapes, params):
+    # forward-only binds (Predictor) omit the label; its shape follows
+    # from data (reference softmax_output-inl.h SoftmaxOutputProp
+    # InferShape): (b,) default, (b, x, y, ...) for multi_output,
+    # data.shape for preserve_shape
+    data = shapes.get("data")
+    if data is None:
+        return {}
+    if params.get("preserve_shape"):
+        return {"label": tuple(data)}
+    if params.get("multi_output"):
+        return {"label": (data[0],) + tuple(data[2:])}
+    return {"label": tuple(data[:-1]) if len(data) > 1 else (data[0],)}
+
+
+_register("SoftmaxOutput", _softmax_output_label_hint)
+_register("SVMOutput", lambda shapes, params: (
+    {"label": (shapes["data"][0],)} if shapes.get("data") else {}))
+
+
+def _regression_label_hint(shapes, params):
+    data = shapes.get("data")
+    return {"label": tuple(data)} if data is not None else {}
+
+
+for _name in ("LinearRegressionOutput", "LogisticRegressionOutput",
+              "MAERegressionOutput"):
+    _register(_name, _regression_label_hint)
+
+
 def _rnn_hint(shapes, params):
     data = shapes.get("data")
     if data is None:

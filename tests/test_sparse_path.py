@@ -1,0 +1,197 @@
+"""Real sparse path: lazy row-sparse optimizer updates, LibSVMIter, and
+device-side sparse accessors (round-3, VERDICT item 8).
+
+Oracle strategy mirrors the reference's sparse optimizer tests
+(tests/python/unittest/test_optimizer.py test_sparse_sgd): a row-sparse
+gradient applied lazily must (a) exactly match the dense update on rows
+the gradient carries and (b) leave every other row — including its
+weight-decay shrinkage and momentum/mean/var state — untouched.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _row_sparse_grad(shape, live_rows, seed=0):
+    rng = np.random.RandomState(seed)
+    data = rng.randn(len(live_rows), *shape[1:]).astype(np.float32)
+    return sparse.row_sparse_array(
+        (data, np.asarray(live_rows, np.int64)), shape=shape)
+
+
+def test_sgd_lazy_update_touches_only_live_rows():
+    shape = (6, 4)
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(*shape).astype(np.float32)
+    live = [1, 4]
+    grad = _row_sparse_grad(shape, live)
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           rescale_grad=1.0)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(w0.copy())
+    upd(0, grad, w)
+    w1 = w.asnumpy()
+
+    dense_opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                                 rescale_grad=1.0, lazy_update=False)
+    dupd = mx.optimizer.get_updater(dense_opt)
+    wd_ = nd.array(w0.copy())
+    dupd(0, nd.array(grad.asnumpy()), wd_)
+    w_dense = wd_.asnumpy()
+
+    for r in range(shape[0]):
+        if r in live:
+            np.testing.assert_allclose(w1[r], w_dense[r], rtol=1e-6,
+                                       err_msg="live row %d" % r)
+        else:
+            np.testing.assert_array_equal(w1[r], w0[r])
+
+    # momentum state advanced only on live rows
+    mom = upd.states[0].asnumpy()
+    for r in range(shape[0]):
+        if r not in live:
+            np.testing.assert_array_equal(mom[r], np.zeros(shape[1:]))
+        else:
+            assert np.abs(mom[r]).sum() > 0
+
+
+def test_adam_lazy_update_matches_dense_on_live_rows():
+    shape = (5, 3)
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(*shape).astype(np.float32)
+    live = [0, 3]
+    grad = _row_sparse_grad(shape, live, seed=3)
+
+    lazy = mx.optimizer.Adam(learning_rate=0.01, wd=0.1)
+    dense = mx.optimizer.Adam(learning_rate=0.01, wd=0.1,
+                              lazy_update=False)
+    ul, ud = mx.optimizer.get_updater(lazy), mx.optimizer.get_updater(dense)
+    wl, wdn = nd.array(w0.copy()), nd.array(w0.copy())
+    for step in range(3):
+        ul(0, grad, wl)
+        ud(0, nd.array(grad.asnumpy()), wdn)
+    a, b = wl.asnumpy(), wdn.asnumpy()
+    for r in range(shape[0]):
+        if r in live:
+            np.testing.assert_allclose(a[r], b[r], rtol=1e-5,
+                                       err_msg="live row %d" % r)
+        else:
+            np.testing.assert_array_equal(a[r], w0[r])
+
+
+def test_embedding_training_matches_dense_oracle():
+    """SGD over an embedding table: applying the batch's row-sparse grad
+    lazily equals the dense update restricted to touched rows, and
+    training converges the same on those rows."""
+    vocab, dim, = 10, 4
+    rng = np.random.RandomState(4)
+    table0 = rng.randn(vocab, dim).astype(np.float32)
+    tgt = rng.randn(vocab, dim).astype(np.float32)
+    ids = np.array([2, 7, 2, 5], np.int64)
+
+    def grad_for(table):
+        # d/dW of mean squared error on the looked-up rows
+        g = np.zeros_like(table)
+        for i in ids:
+            g[i] += 2 * (table[i] - tgt[i])
+        return g
+
+    w_lazy = nd.array(table0.copy())
+    w_dense = nd.array(table0.copy())
+    opt_l = mx.optimizer.SGD(learning_rate=0.1)
+    opt_d = mx.optimizer.SGD(learning_rate=0.1, lazy_update=False)
+    ul, ud = mx.optimizer.get_updater(opt_l), mx.optimizer.get_updater(opt_d)
+    for _ in range(5):
+        gl = grad_for(w_lazy.asnumpy())
+        ul(0, sparse.row_sparse_array(
+            (gl[sorted(set(ids))], np.array(sorted(set(ids)), np.int64)),
+            shape=(vocab, dim)), w_lazy)
+        ud(0, nd.array(grad_for(w_dense.asnumpy())), w_dense)
+    a, b = w_lazy.asnumpy(), w_dense.asnumpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    touched = sorted(set(ids))
+    np.testing.assert_allclose(a[touched], b[touched], rtol=1e-6)
+    untouched = [r for r in range(vocab) if r not in touched]
+    np.testing.assert_array_equal(a[untouched], table0[untouched])
+
+
+def test_sparse_accessors_device_side():
+    rs = sparse.row_sparse_array(
+        (np.array([[1., 2.], [3., 4.]], np.float32),
+         np.array([1, 3], np.int64)), shape=(5, 2))
+    idx = rs.indices
+    assert isinstance(idx._data.__class__.__module__, str)
+    np.testing.assert_array_equal(idx.asnumpy(), [1, 3])
+    np.testing.assert_array_equal(rs.data.asnumpy(),
+                                  [[1., 2.], [3., 4.]])
+    # accessors return jax arrays (no silent numpy fallback)
+    import jax
+    assert isinstance(idx._data, jax.Array)
+    assert isinstance(rs.data._data, jax.Array)
+
+
+def test_libsvm_iter(tmp_path):
+    f = tmp_path / "train.libsvm"
+    f.write_text("\n".join([
+        "1 0:1.5 3:2.0",
+        "0 1:0.5",
+        "1 2:3.0 3:1.0",
+        "0 0:2.5",
+    ]) + "\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(f), data_shape=(4,),
+                          batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr"
+    np.testing.assert_array_equal(
+        b0.data[0].asnumpy(),
+        [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_array_equal(b0.label[0].asnumpy(), [1, 0])
+    # second epoch after reset
+    it.reset()
+    again = list(it)
+    assert len(again) == 2
+    # csr parts round-trip
+    np.testing.assert_array_equal(b0.data[0].indices.asnumpy(), [0, 3, 1])
+    np.testing.assert_array_equal(b0.data[0].indptr.asnumpy(), [0, 2, 3])
+
+
+def test_libsvm_iter_label_file_multidim(tmp_path):
+    f = tmp_path / "d.libsvm"
+    f.write_text("0 0:1.0\n0 1:2.0\n")
+    lf = tmp_path / "l.libsvm"
+    lf.write_text("0:0.1 2:0.3\n1:0.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(f), data_shape=(2,),
+                          label_libsvm=str(lf), label_shape=(3,),
+                          batch_size=2)
+    assert it.provide_label[0].shape == (2, 3)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.label[0].asnumpy(),
+                               [[0.1, 0, 0.3], [0, 0.5, 0]], rtol=1e-6)
+
+
+def test_libsvm_iter_padding(tmp_path):
+    f = tmp_path / "odd.libsvm"
+    f.write_text("1 0:1.0\n0 1:1.0\n1 2:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(f), data_shape=(3,),
+                          batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 1
+
+
+def test_row_sparse_pull_uses_sparse_retain():
+    kv = mx.kv.create("local")
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    kv.init("emb", w)
+    out = sparse.zeros("row_sparse", (4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(
+        np.array([0, 2], np.int64)))
+    got = out.asnumpy()
+    np.testing.assert_array_equal(got[0], [0, 1, 2])
+    np.testing.assert_array_equal(got[2], [6, 7, 8])
+    np.testing.assert_array_equal(got[1], np.zeros(3))

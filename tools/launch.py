@@ -38,7 +38,17 @@ def _free_port():
     return port
 
 
-def launch_local(args, cmd):
+def _run_local_once(args, cmd, attempt):
+    """One job attempt: spawn N workers, watch for failures.
+
+    Failure detection (the collective-era replacement for ps-lite's
+    server heartbeat/recovery hooks, reference src/kvstore/
+    kvstore_dist.h:59-62): a worker dying strands its peers inside a
+    collective, so the launcher — not the survivors — detects the death,
+    tears the whole job down, and reports the failed rank.  Recovery is
+    full job restart from checkpoints (launch_local --max-restarts).
+    """
+    import time
     port = args.port or _free_port()
     coordinator = "127.0.0.1:%d" % port
     procs = []
@@ -49,6 +59,7 @@ def launch_local(args, cmd):
             "MXTPU_COORDINATOR": coordinator,
             "MXTPU_NUM_WORKERS": str(args.num_workers),
             "MXTPU_WORKER_RANK": str(rank),
+            "MXTPU_RESTART_ATTEMPT": str(attempt),
             # reference env contract (dmlc_tracker) for script compat
             "DMLC_ROLE": "worker",
             "DMLC_NUM_WORKER": str(args.num_workers),
@@ -59,18 +70,48 @@ def launch_local(args, cmd):
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
         procs.append(subprocess.Popen(cmd, env=env))
-    code = 0
     try:
-        for p in procs:
-            p.wait()
-            code = code or p.returncode
+        while True:
+            running = False
+            for rank, p in enumerate(procs):
+                rc = p.poll()
+                if rc is None:
+                    running = True
+                elif rc != 0:
+                    # one worker died — peers may be stranded in a
+                    # collective; kill the job
+                    print("launch.py: worker %d exited with %d; "
+                          "terminating remaining workers" % (rank, rc),
+                          file=sys.stderr, flush=True)
+                    for q in procs:
+                        if q.poll() is None:
+                            q.kill()
+                    for q in procs:
+                        q.wait()
+                    return rank, rc
+            if not running:
+                return None, 0
+            time.sleep(0.2)
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGINT)
         for p in procs:
             p.wait()
-        code = 1
-    return code
+        return -1, 1
+
+
+def launch_local(args, cmd):
+    for attempt in range(args.max_restarts + 1):
+        failed_rank, rc = _run_local_once(args, cmd, attempt)
+        if failed_rank is None:
+            return 0
+        if failed_rank == -1 or attempt == args.max_restarts:
+            return rc or 1
+        print("launch.py: restarting job from checkpoints "
+              "(attempt %d/%d) after worker %d failure"
+              % (attempt + 1, args.max_restarts, failed_rank),
+              file=sys.stderr, flush=True)
+    return 1
 
 
 def launch_ssh(args, cmd):
@@ -117,6 +158,11 @@ def main(argv=None):
     parser.add_argument("--cpu-fake-devices", action="store_true",
                         help="force JAX_PLATFORMS=cpu in workers (local "
                         "fake-cluster testing)")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="restart the whole job this many times when "
+                        "a worker dies (workers resume from their own "
+                        "checkpoints; MXTPU_RESTART_ATTEMPT tells them "
+                        "which attempt is running)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command for launching the program")
     args = parser.parse_args(argv)
