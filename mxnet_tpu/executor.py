@@ -6,9 +6,13 @@ Where the reference built a backward graph (nnvm Gradient pass), planned
 memory, and pushed cached engine ops per node (RunOps :1421), this executor
 traces the whole Symbol into ONE JAX function and jit-compiles it:
 
-- forward      → jitted graph evaluation (XLA fusion ≈ PlanMemory+bulking)
-- backward     → jitted forward+vjp program (gradient pass ≈ jax.vjp);
-                 XLA CSEs the recomputed forward when both run in one step
+- forward      → jitted graph evaluation (XLA fusion ≈ PlanMemory+bulking);
+                 a training forward runs under jax.vjp and keeps its
+                 residuals (the reference's data_entry_ activations)
+- backward     → applies the saved vjp residuals (backward-only work);
+                 without a preceding training forward it falls back to a
+                 fused forward+vjp program
+- forward_backward → one fused jitted fwd+bwd program (the fit hot path)
 - aux states   → threaded functionally and written back (BatchNorm stats)
 - grad_req     → write / add / null per argument, as in the reference
 
@@ -64,6 +68,8 @@ class Executor:
         self.outputs = []
         self._fwd_cache = {}
         self._grad_fn = None
+        self._lin_fns = None
+        self._saved_vjp = None
         self._shardings = self._build_shardings() if mesh is not None else {}
         self._plan = self._build_plan()
 
@@ -111,9 +117,13 @@ class Executor:
 
         # ctx_group model parallelism (reference: nnvm PlaceDevice pass +
         # _CrossDeviceCopy, graph_executor.cc:309-395).  TPU-native: each
-        # group's ctx resolves to a device, and jax.device_put inside the
-        # traced program becomes a placement constraint — XLA partitions
-        # the one program across devices instead of inserting copy ops.
+        # group's ctx resolves to a device and jax.device_put at the
+        # group cut moves the activation; ops after the cut follow their
+        # data (JAX computation-follows-data).  This requires EAGER
+        # execution — inside jit, device_put is only a hint this JAX
+        # version ignores — so multi-device group binds run the graph
+        # op-by-op (self._staged); single-device binds keep the fused
+        # one-program jit path.
         placement = {}
         if self._group2ctx:
             for node in nodes:
@@ -121,6 +131,32 @@ class Executor:
                 if grp and grp in self._group2ctx:
                     placement[id(node)] = \
                         self._group2ctx[grp].jax_device()
+        in_play = set(placement.values())
+        if in_play:
+            in_play.add(self._ctx.jax_device())
+        self._staged = len(in_play) > 1
+        # static per-node device assignment for staged mode: a node runs
+        # on its group's device, else follows its first placed input
+        # (vars default to the bind ctx) — computed from graph structure
+        # so the eager path never inspects runtime values (tracers under
+        # jax.vjp have no .devices())
+        node_dev = {}
+        if self._staged:
+            default_dev = self._ctx.jax_device()
+            for node in nodes:
+                dev = placement.get(id(node))
+                if dev is None:
+                    if node.is_var:
+                        dev = default_dev
+                    else:
+                        for inp, _ in node.inputs:
+                            if node_dev.get(id(inp)) is not None:
+                                dev = node_dev[id(inp)]
+                                break
+                        dev = dev or default_dev
+                node_dev[id(node)] = dev
+
+        staged = self._staged
 
         def graph_fn(arg_vals, aux_vals, rng, train, tap=None):
             """tap(node, vis_outputs) is called per node when set — used by
@@ -138,6 +174,14 @@ class Executor:
                     vals[id(node)] = [v]
                     continue
                 inputs = [vals[id(inp)][idx] for inp, idx in node.inputs]
+                if staged and inputs:
+                    # eager cross-device cut: align every input onto the
+                    # node's statically-assigned device — the
+                    # _CrossDeviceCopy the reference inserted.  device_put
+                    # to the same device is a no-op; on tracers (under
+                    # jax.vjp) it records the transfer.
+                    target = node_dev[id(node)]
+                    inputs = [jax.device_put(x, target) for x in inputs]
                 params = dict(node.params)
                 if node.op.takes_train:
                     params["_train"] = train
@@ -172,34 +216,71 @@ class Executor:
         fn = self._fwd_cache.get(train)
         if fn is None:
             plan = self._plan
-            fn = jax.jit(functools.partial(plan, train=train))
+            fn = functools.partial(plan, train=train)
+            if not self._staged:
+                # staged (multi-device ctx_group) binds run eagerly:
+                # jit would collapse placement onto one device
+                fn = jax.jit(fn)
             self._fwd_cache[train] = fn
         return fn
+
+    def _diff_names(self):
+        return tuple(sorted(
+            n for n, r in self._grad_req.items() if r != "null"
+            and n in self.arg_dict))
+
+    def _vjp_forward(self, arg_vals, aux_vals, rng):
+        """Run the training forward under jax.vjp → (outs, new_aux, vjp).
+        The single construction both the split path (_make_lin_fns) and
+        the fused grad program (_make_grad_fn) build on."""
+        plan = self._plan
+        diff_names = self._diff_names()
+        fixed = {k: v for k, v in arg_vals.items() if k not in diff_names}
+
+        def f(diff_args):
+            merged = dict(fixed)
+            merged.update(diff_args)
+            outs, new_aux = plan(merged, aux_vals, rng, True)
+            return tuple(outs), new_aux
+
+        diff_args = {k: arg_vals[k] for k in diff_names}
+        outs, vjp, new_aux = jax.vjp(f, diff_args, has_aux=True)
+        return outs, new_aux, vjp
+
+    def _make_lin_fns(self):
+        """Two-part train program for the split forward()/backward() path:
+        forward runs once and carries its vjp residuals across the jit
+        boundary (jax.vjp returns a tree_util.Partial — a pytree of
+        residual arrays), backward just applies them.  The reference kept
+        forward activations alive in the executor for exactly this
+        (graph_executor.cc data_entry_); rounds 1-2 recomputed the whole
+        forward inside backward instead."""
+        if getattr(self, "_lin_fns", None) is not None:
+            return self._lin_fns
+
+        def fwd_lin(arg_vals, aux_vals, rng):
+            return self._vjp_forward(arg_vals, aux_vals, rng)
+
+        def bwd_apply(vjp, ograds):
+            return vjp(tuple(ograds))[0]
+
+        if not self._staged:
+            fwd_lin = jax.jit(fwd_lin)
+            bwd_apply = jax.jit(bwd_apply)
+        self._lin_fns = (fwd_lin, bwd_apply)
+        return self._lin_fns
 
     def _make_grad_fn(self):
         if self._grad_fn is not None:
             return self._grad_fn
-        plan = self._plan
-        diff_names = tuple(sorted(
-            n for n, r in self._grad_req.items() if r != "null"
-            and n in self.arg_dict))
 
-        @jax.jit
         def grad_fn(arg_vals, aux_vals, rng, ograds):
-            fixed = {k: v for k, v in arg_vals.items()
-                     if k not in diff_names}
-
-            def f(diff_args):
-                merged = dict(fixed)
-                merged.update(diff_args)
-                outs, new_aux = plan(merged, aux_vals, rng, True)
-                return tuple(outs), new_aux
-
-            diff_args = {k: arg_vals[k] for k in diff_names}
-            outs, vjp, new_aux = jax.vjp(f, diff_args, has_aux=True)
+            outs, new_aux, vjp = self._vjp_forward(arg_vals, aux_vals, rng)
             grads = vjp(tuple(ograds))[0]
             return outs, new_aux, grads
 
+        if not self._staged:
+            grad_fn = jax.jit(grad_fn)
         self._grad_fn = grad_fn
         return grad_fn
 
@@ -254,8 +335,17 @@ class Executor:
                 v._data if isinstance(v, NDArray) else jnp.asarray(v))
         rng = _random.next_key()
         self._last_rng = rng
+        self._saved_vjp = None
         if self._monitor_callback is not None and self._monitor_all:
             outs, new_aux = self._forward_interpret(bool(is_train), rng)
+        elif is_train and any(r != "null" for r in self._grad_req.values()):
+            # training forward keeps its vjp residuals so a following
+            # backward() applies them instead of re-running the forward
+            fwd_lin, _ = self._make_lin_fns()
+            with _profiler._timed("executor_forward") as t:
+                outs, new_aux, self._saved_vjp = fwd_lin(
+                    self._raw_args(), self._raw_aux(), rng)
+                t.sync_arrays = outs
         else:
             with _profiler._timed("executor_forward") as t:
                 outs, new_aux = self._fwd(bool(is_train))(
@@ -274,7 +364,6 @@ class Executor:
         from . import profiler as _profiler
         if all(r == "null" for r in self._grad_req.values()):
             return
-        grad_fn = self._make_grad_fn()
         if out_grads is None:
             ograds = [jnp.ones(o.shape, o._data.dtype) for o in self.outputs]
         else:
@@ -282,16 +371,25 @@ class Executor:
                 out_grads = [out_grads]
             ograds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                       for g in out_grads]
-        rng = getattr(self, "_last_rng", None)
-        if rng is None:
-            from . import random as _random
-            rng = _random.next_key()
-        with _profiler._timed("executor_backward") as t:
-            outs, new_aux, grads = grad_fn(self._raw_args(),
-                                           self._raw_aux(),
-                                           rng, tuple(ograds))
-            t.sync_arrays = list(grads.values()) + list(outs)
-        self.outputs = [NDArray(o, self._ctx) for o in outs]
+        if self._saved_vjp is not None:
+            # residuals saved by the training forward — backward-only work
+            _, bwd_apply = self._make_lin_fns()
+            with _profiler._timed("executor_backward") as t:
+                grads = bwd_apply(self._saved_vjp, tuple(ograds))
+                t.sync_arrays = list(grads.values())
+            self._saved_vjp = None
+        else:
+            grad_fn = self._make_grad_fn()
+            rng = getattr(self, "_last_rng", None)
+            if rng is None:
+                from . import random as _random
+                rng = _random.next_key()
+            with _profiler._timed("executor_backward") as t:
+                outs, new_aux, grads = grad_fn(self._raw_args(),
+                                               self._raw_aux(),
+                                               rng, tuple(ograds))
+                t.sync_arrays = list(grads.values()) + list(outs)
+            self.outputs = [NDArray(o, self._ctx) for o in outs]
         for name, g in grads.items():
             req = self._grad_req.get(name, "null")
             if req == "null":
@@ -308,6 +406,7 @@ class Executor:
         """Fused train step: one compiled program for fwd+bwd+aux update."""
         from . import random as _random
         from . import profiler as _profiler
+        self._saved_vjp = None  # residuals from any earlier split forward
         for k, v in kwargs.items():
             self.arg_dict[k]._set_data(
                 v._data if isinstance(v, NDArray) else jnp.asarray(v))

@@ -128,3 +128,72 @@ def test_unregistered_custom_op_raises():
     x = mx.nd.ones((2, 2))
     with pytest.raises(Exception):
         mx.nd.Custom(x, op_type="never_registered_xyz")
+
+
+_FWD_CALLS = {"n": 0}
+
+
+@mx.operator.register("fwdcounter")
+class FwdCounterProp(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return FwdCounter()
+
+
+class FwdCounter(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        _FWD_CALLS["n"] += 1
+        self.assign(out_data[0], req[0], in_data[0][:] * 1.0)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0][:])
+
+
+def test_split_forward_backward_runs_forward_once():
+    """The split forward()/backward() path must not re-execute the
+    forward program inside backward (round-3 fix: forward saves its vjp
+    residuals across the jit boundary).  The custom op's host callback
+    counts true device-program executions."""
+    from mxnet_tpu import nd
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, op_type="fwdcounter")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    x = nd.array(np.ones((2, 4), np.float32))
+    exe = net.simple_bind(mx.cpu(), data=(2, 4))
+    exe.forward(is_train=True, data=x)   # compile + run
+    exe.backward([nd.ones((2, 3))])
+    _FWD_CALLS["n"] = 0
+    exe.forward(is_train=True, data=x)   # cached program
+    exe.backward([nd.ones((2, 3))])
+    assert _FWD_CALLS["n"] == 1, \
+        "forward executed %d times for one fwd+bwd" % _FWD_CALLS["n"]
+
+
+def test_forward_backward_clears_split_residuals():
+    """Mixing entry points on one executor must not leak residuals:
+    forward(x1) → forward_backward(x2) → backward() takes x2's gradient,
+    not x1's (round-3 review finding)."""
+    from mxnet_tpu import nd
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    net = mx.sym.FullyConnected(data, w, no_bias=True, num_hidden=1)
+    exe = net.bind(mx.cpu(),
+                   args={"data": nd.ones((1, 2)),
+                         "w": nd.ones((1, 2))},
+                   args_grad={"w": nd.zeros((1, 2))},
+                   grad_req={"data": "null", "w": "write"})
+    x1 = nd.array(np.array([[1.0, 1.0]], np.float32))
+    x2 = nd.array(np.array([[5.0, 5.0]], np.float32))
+    exe.forward(is_train=True, data=x1)        # saves residuals for x1
+    exe.forward_backward(data=x2)              # fused path: grad wrt x2
+    np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), [[5.0, 5.0]])
+    exe.backward([nd.ones((1, 1))])            # must recompute, not reuse
+    np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), [[5.0, 5.0]])

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training worker processes.
+
+Port of /root/reference/tools/kill-mxnet.py: the reference pkill'd
+python processes running a given program across a hostfile via ssh.
+Same shape here — local by default, per-host over ssh with a hostfile —
+matching tools/launch.py's worker model (no server processes exist).
+
+Usage:
+  python tools/kill-mxnet.py                 # local workers
+  python tools/kill-mxnet.py train.py        # local, matching program
+  python tools/kill-mxnet.py -H hosts train.py   # over ssh
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def _local_pids(pattern):
+    out = subprocess.run(["ps", "-eo", "pid,command"], capture_output=True,
+                         text=True).stdout
+    pids = []
+    me = os.getpid()
+    for line in out.splitlines()[1:]:
+        parts = line.strip().split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid, cmd = int(parts[0]), parts[1]
+        if pid == me:
+            continue
+        # a worker: python process carrying the launcher's env contract
+        # isn't visible in ps; match on the program like the reference did
+        if "python" in cmd and pattern in cmd and "kill-mxnet" not in cmd:
+            pids.append(pid)
+    return pids
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="kill distributed workers (reference tools/kill-mxnet.py)")
+    parser.add_argument("program", nargs="?", default="",
+                        help="match processes whose command contains this "
+                        "(default: any MXTPU worker python)")
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="kill on every host in this file via ssh")
+    args = parser.parse_args(argv)
+    pattern = args.program or "MXTPU"
+
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+        for host in hosts:
+            subprocess.run(
+                ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                 "pkill -f %s || true" % (args.program or "MXTPU")])
+            print("kill-mxnet: signalled workers on %s" % host)
+        return 0
+
+    pids = _local_pids(pattern)
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print("kill-mxnet: SIGTERM %d" % pid)
+        except ProcessLookupError:
+            pass
+    if not pids:
+        print("kill-mxnet: no matching workers")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
