@@ -22,9 +22,39 @@ import functools
 import json
 import os
 import sys
+import threading
 import time
 
 BASELINE_IMG_S = 109.0  # 1x K80, bs 32, reference README
+
+
+def _install_init_watchdog():
+    """The axon tunnel can wedge hard: jax.devices() then blocks forever
+    (observed mid-round-3, PERF.md §1 note).  A hung benchmark is worse
+    than a failed one — if backend init doesn't complete in
+    BENCH_INIT_TIMEOUT seconds, report the outage and exit nonzero."""
+    timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "600"))
+    if timeout <= 0:
+        return None
+    done = threading.Event()
+
+    def _watch():
+        if not done.wait(timeout):
+            print(json.dumps({
+                "metric": "resnet50_train_images_per_sec",
+                "value": 0.0,
+                "unit": "img/s (measurement unavailable)",
+                "vs_baseline": 0.0,
+                "error": "TPU backend init timed out after %.0fs — "
+                         "tunnel unavailable; see PERF.md §1 for the "
+                         "last measured numbers and methodology"
+                         % timeout,
+            }), flush=True)
+            os._exit(3)
+
+    t = threading.Thread(target=_watch, daemon=True)
+    t.start()
+    return done
 
 # nominal dense bf16 peak FLOP/s by device kind (for the MFU report)
 PEAK_FLOPS = {
@@ -197,10 +227,13 @@ def main():
     image = int(os.environ.get("BENCH_IMAGE", "224"))
 
     import numpy as np
+    watchdog_done = _install_init_watchdog()
     import jax
     import jax.numpy as jnp
 
     platform = jax.devices()[0].platform
+    if watchdog_done is not None:
+        watchdog_done.set()  # backend up; disarm
     device_kind = jax.devices()[0].device_kind
     if platform == "cpu" and "BENCH_BATCH" not in os.environ:
         batch, steps = 16, 4  # keep the CPU smoke test fast

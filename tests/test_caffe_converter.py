@@ -1,0 +1,144 @@
+"""Caffe converter: prototxt → Symbol and wire-encoded caffemodel →
+params.  The caffemodel fixture is hand-encoded protobuf wire bytes
+built from the public caffe.proto field numbers — independent of the
+converter's own reader — pinning the decode path the same way the
+checkpoint fixtures pin the V2 binary."""
+import os
+import struct
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from caffe_converter import convert_model, convert_symbol  # noqa: E402
+
+PROTOTXT = """
+name: "TinyNet"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "fc1"
+  inner_product_param { num_output: 2 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc1" top: "prob" }
+"""
+
+
+def test_convert_symbol_builds_and_runs():
+    sym, inputs = convert_symbol(PROTOTXT)
+    assert inputs == ["data"]
+    args = sym.list_arguments()
+    assert "conv1_weight" in args and "fc1_weight" in args
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(1, 3, 8, 8))
+    out = exe.forward(data=nd.ones((1, 3, 8, 8)))
+    assert out[0].shape == (1, 2)
+    np.testing.assert_allclose(out[0].asnumpy().sum(), 1.0, rtol=1e-5)
+
+
+# -- hand-built wire encoding (caffe.proto numbers) -------------------------
+
+def _tag(fnum, wtype):
+    return _varint((fnum << 3) | wtype)
+
+
+def _varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _ld(fnum, payload):
+    return _tag(fnum, 2) + _varint(len(payload)) + payload
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    shape = b"".join(_tag(1, 0) + _varint(d) for d in arr.shape)
+    data = arr.tobytes()
+    return _ld(7, shape) + _ld(5, data)   # shape=7, packed data=5
+
+
+def _layer(name, ltype, blobs):
+    msg = _ld(1, name.encode()) + _ld(2, ltype.encode())
+    for b in blobs:
+        msg += _ld(7, _blob(b))           # LayerParameter.blobs = 7
+    return _ld(100, msg)                  # NetParameter.layer = 100
+
+
+def test_convert_model_decodes_wire(tmp_path):
+    w = np.arange(4 * 3 * 3 * 3, dtype=np.float32).reshape(4, 3, 3, 3)
+    b = np.array([0.5, -0.5, 1.0, 0.0], np.float32)
+    fcw = np.ones((2, 16), np.float32)
+    mean = np.array([1.0, 2.0], np.float32)
+    var = np.array([3.0, 4.0], np.float32)
+    factor = np.array([2.0], np.float32)
+    blob = (_layer("conv1", "Convolution", [w, b]) +
+            _layer("fc1", "InnerProduct", [fcw]) +
+            _layer("bn1", "BatchNorm", [mean, var, factor]) +
+            _layer("scale1", "Scale", [np.array([1.5, 2.5], np.float32)]))
+    f = tmp_path / "net.caffemodel"
+    f.write_bytes(blob)
+    args, auxs = convert_model(str(f), output_prefix=str(tmp_path / "cv"))
+    np.testing.assert_array_equal(args["conv1_weight"], w)
+    np.testing.assert_array_equal(args["conv1_bias"], b)
+    np.testing.assert_array_equal(args["fc1_weight"], fcw)
+    np.testing.assert_allclose(auxs["bn1_moving_mean"], mean / 2.0)
+    np.testing.assert_allclose(auxs["bn1_moving_var"], var / 2.0)
+    # Scale following BatchNorm stores gamma/beta under the BN's name
+    # (the Symbol's BatchNorm learns them; Scale maps to identity)
+    np.testing.assert_array_equal(args["bn1_gamma"], [1.5, 2.5])
+    assert "scale1_gamma" not in args
+    # the written artifact is reference-format binary and loads back
+    loaded = nd.load(str(tmp_path / "cv-0000.params"))
+    np.testing.assert_array_equal(loaded["arg:conv1_weight"].asnumpy(), w)
+    np.testing.assert_allclose(loaded["aux:bn1_moving_var"].asnumpy(),
+                               var / 2.0)
+
+
+def test_converted_net_runs_with_converted_weights(tmp_path):
+    """Full path: prototxt + caffemodel → Module forward."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.1
+    b = np.zeros(4, np.float32)
+    fcw = rng.randn(2, 64).astype(np.float32) * 0.1
+    fcb = np.zeros(2, np.float32)
+    blob = (_layer("conv1", "Convolution", [w, b]) +
+            _layer("fc1", "InnerProduct", [fcw, fcb]))
+    f = tmp_path / "net.caffemodel"
+    f.write_bytes(blob)
+    sym, _ = convert_symbol(PROTOTXT)
+    args, auxs = convert_model(str(f))
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 8, 8))
+    exe.copy_params_from({k: nd.array(v) for k, v in args.items()},
+                         allow_extra_params=True)
+    out = exe.forward(data=nd.array(rng.randn(2, 3, 8, 8)
+                                    .astype(np.float32)))
+    assert out[0].shape == (2, 2)
+    assert np.isfinite(out[0].asnumpy()).all()

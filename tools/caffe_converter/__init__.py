@@ -1,0 +1,12 @@
+"""Caffe → mxnet_tpu converter.
+
+TPU-native re-implementation of /root/reference/tools/caffe_converter/:
+`convert_symbol` maps a deploy prototxt to a Symbol, `convert_model`
+decodes a binary .caffemodel (a protobuf NetParameter) into
+reference-format .params — with no caffe or protobuf dependency: the
+prototxt is parsed as text-proto and the caffemodel through a minimal
+protobuf wire-format reader (wire.py), using the field numbers from the
+public caffe.proto schema.
+"""
+from .convert_symbol import convert_symbol  # noqa: F401
+from .convert_model import convert_model  # noqa: F401

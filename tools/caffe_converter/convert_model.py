@@ -1,0 +1,114 @@
+"""Binary .caffemodel → reference-format .params.
+
+Walks the protobuf wire format directly (wire.py) using the public
+caffe.proto field numbers: NetParameter.layer = 100 (LayerParameter:
+name = 1, type = 2, blobs = 7) with the V1 fallback NetParameter.layers
+= 2 (V1LayerParameter: name = 4, blobs = 6); BlobProto: data = 5
+(packed float), shape = 7 (BlobShape.dim = 1), legacy dims num/channels/
+height/width = 1-4.  Weight-layout conversion: caffe InnerProduct
+weights are (out, in) like FullyConnected; Convolution weights are
+(out, in/group, kh, kw) in both; caffe BatchNorm blobs are
+(mean, var, scale_factor) → moving_mean/var divided by the factor.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from . import wire
+
+__all__ = ["convert_model"]
+
+
+def _blob_array(blob_bytes):
+    f = wire.decode_fields(blob_bytes)
+    if 5 in f:
+        data = []
+        for chunk in f[5]:
+            if isinstance(chunk, (bytes, bytearray)):
+                data.extend(wire.packed_floats(chunk))
+            else:  # unpacked fixed32 comes through as raw 4-byte values
+                data.append(chunk)
+        arr = np.asarray(data, np.float32)
+    else:
+        arr = np.zeros((0,), np.float32)
+    if 7 in f:
+        shape_fields = wire.decode_fields(f[7][0])
+        dims = [int(d) for d in shape_fields.get(1, [])]
+    else:
+        dims = [int(f.get(i, [0])[0]) for i in (1, 2, 3, 4)]
+        dims = [d for d in dims if d] or [arr.size]
+    return arr.reshape(dims)
+
+
+def _layers(model_bytes):
+    net = wire.decode_fields(model_bytes)
+    out = []
+    for raw in net.get(100, []):      # LayerParameter
+        f = wire.decode_fields(raw)
+        name = f.get(1, [b""])[0].decode("utf-8")
+        ltype = f.get(2, [b""])[0].decode("utf-8")
+        blobs = [_blob_array(b) for b in f.get(7, [])]
+        out.append((name, ltype, blobs))
+    for raw in net.get(2, []):        # V1LayerParameter
+        f = wire.decode_fields(raw)
+        name = f.get(4, [b""])[0].decode("utf-8")
+        ltype = str(f.get(5, [0])[0])
+        blobs = [_blob_array(b) for b in f.get(6, [])]
+        out.append((name, ltype, blobs))
+    return out
+
+
+def convert_model(caffemodel_fname, output_prefix=None, epoch=0):
+    """→ (arg_params, aux_params) dicts of numpy arrays; with
+    output_prefix also writes `prefix-%04d.params` in the reference
+    binary format (loadable by mx.model.load_checkpoint)."""
+    with open(caffemodel_fname, "rb") as f:
+        model_bytes = f.read()
+    arg_params, aux_params = {}, {}
+    prev_bn = None
+    for name, ltype, blobs in _layers(model_bytes):
+        if not blobs:
+            continue
+        if ltype == "BatchNorm":
+            mean, var = blobs[0], blobs[1]
+            factor = float(blobs[2].reshape(-1)[0]) if len(blobs) > 2 \
+                else 1.0
+            scale = 1.0 / factor if factor else 1.0
+            aux_params[name + "_moving_mean"] = mean.reshape(-1) * scale
+            aux_params[name + "_moving_var"] = var.reshape(-1) * scale
+            prev_bn = name
+            continue
+        if ltype == "Scale":
+            # caffe splits BN into BatchNorm (stats) + Scale (gamma/beta);
+            # the Symbol's BatchNorm learns gamma/beta itself, so a Scale
+            # following a BatchNorm stores under the BN layer's name
+            # (the reference converter does the same rename)
+            target = prev_bn if prev_bn is not None else name
+            arg_params[target + "_gamma"] = blobs[0].reshape(-1)
+            if len(blobs) > 1:
+                arg_params[target + "_beta"] = blobs[1].reshape(-1)
+            prev_bn = None
+            continue
+        prev_bn = None
+        if ltype == "PReLU":
+            arg_params[name + "_gamma"] = blobs[0].reshape(-1)
+        else:
+            # Convolution/Deconvolution/InnerProduct: blob0 weight,
+            # blob1 bias — layouts already match the framework's ops
+            arg_params[name + "_weight"] = blobs[0]
+            if len(blobs) > 1:
+                arg_params[name + "_bias"] = blobs[1].reshape(-1)
+    if output_prefix:
+        import mxnet_tpu as mx
+        save = {"arg:%s" % k: mx.nd.array(v)
+                for k, v in arg_params.items()}
+        save.update({"aux:%s" % k: mx.nd.array(v)
+                     for k, v in aux_params.items()})
+        mx.nd.save("%s-%04d.params" % (output_prefix, epoch), save)
+    return arg_params, aux_params

@@ -1,0 +1,210 @@
+"""Deploy-prototxt → Symbol.
+
+Original mapping of the caffe layer zoo the reference converter covered
+(/root/reference/tools/caffe_converter/convert_symbol.py): Convolution,
+Deconvolution, InnerProduct, Pooling (MAX/AVE, caffe's ceil-mode →
+pooling_convention='full'), ReLU/TanH/Sigmoid/PReLU, LRN, Dropout,
+Softmax(WithLoss), Flatten, Concat, Eltwise (sum/prod/max),
+BatchNorm(+Scale folded), Crop, Reshape, AbsVal, Split.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from .prototxt import parse_prototxt, as_list
+
+__all__ = ["convert_symbol"]
+
+
+def _ints(v, default=None, n=2):
+    vals = as_list(v)
+    if not vals:
+        vals = [default]
+    if len(vals) == 1:
+        vals = vals * n
+    return tuple(int(x) for x in vals[:n])
+
+
+def _conv_args(p):
+    kh, kw = None, None
+    if "kernel_h" in p:
+        kh, kw = int(p["kernel_h"]), int(p["kernel_w"])
+    else:
+        kh, kw = _ints(p.get("kernel_size"), 1)
+    if "stride_h" in p:
+        sh, sw = int(p["stride_h"]), int(p["stride_w"])
+    else:
+        sh, sw = _ints(p.get("stride"), 1)
+    if "pad_h" in p:
+        ph, pw = int(p["pad_h"]), int(p["pad_w"])
+    else:
+        ph, pw = _ints(p.get("pad"), 0)
+    dil = _ints(p.get("dilation"), 1)
+    return (kh, kw), (sh, sw), (ph, pw), dil
+
+
+def convert_symbol(prototxt_fname_or_text):
+    """→ (symbol, input_names).  Accepts a path or the prototxt text."""
+    import mxnet_tpu as mx
+
+    if os.path.exists(prototxt_fname_or_text):
+        with open(prototxt_fname_or_text) as f:
+            text = f.read()
+    else:
+        text = prototxt_fname_or_text
+    net = parse_prototxt(text)
+    layers = as_list(net.get("layer") or net.get("layers"))
+
+    tops = {}
+    inputs = []
+    for name in as_list(net.get("input")):
+        tops[name] = mx.sym.Variable(name)
+        inputs.append(name)
+
+    def get(bname):
+        if bname not in tops:
+            tops[bname] = mx.sym.Variable(bname)
+            inputs.append(bname)
+        return tops[bname]
+
+    for layer in layers:
+        ltype = layer.get("type")
+        name = layer.get("name", "layer%d" % len(tops))
+        bottoms = as_list(layer.get("bottom"))
+        top_names = as_list(layer.get("top")) or [name]
+
+        if ltype == "Input":
+            for t in top_names:
+                tops[t] = mx.sym.Variable(t)
+                inputs.append(t)
+            continue
+        if ltype in ("Convolution", "Deconvolution"):
+            p = layer.get("convolution_param", {})
+            kernel, stride, pad, dil = _conv_args(p)
+            op = mx.sym.Convolution if ltype == "Convolution" \
+                else mx.sym.Deconvolution
+            out = op(get(bottoms[0]), name=name, kernel=kernel,
+                     stride=stride, pad=pad, dilate=dil,
+                     num_filter=int(p.get("num_output", 0)),
+                     num_group=int(p.get("group", 1)),
+                     no_bias=not p.get("bias_term", True))
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                get(bottoms[0]), name=name,
+                num_hidden=int(p.get("num_output", 0)),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "Pooling":
+            p = layer.get("pooling_param", {})
+            # caffe PoolMethod: 0 MAX, 1 AVE, 2 STOCHASTIC (no SUM);
+            # stochastic approximated by max, as in the reference
+            pool = {0: "max", "MAX": "max", 1: "avg", "AVE": "avg",
+                    2: "max", "STOCHASTIC": "max"}[p.get("pool", "MAX")]
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(get(bottoms[0]), name=name,
+                                     kernel=(1, 1), global_pool=True,
+                                     pool_type=pool)
+            else:
+                kernel, stride, pad, _ = _conv_args(p)
+                # caffe pooling output size uses ceil → 'full'
+                out = mx.sym.Pooling(get(bottoms[0]), name=name,
+                                     kernel=kernel, stride=stride,
+                                     pad=pad, pool_type=pool,
+                                     pooling_convention="full")
+        elif ltype == "ReLU":
+            out = mx.sym.Activation(get(bottoms[0]), name=name,
+                                    act_type="relu")
+        elif ltype == "TanH":
+            out = mx.sym.Activation(get(bottoms[0]), name=name,
+                                    act_type="tanh")
+        elif ltype == "Sigmoid":
+            out = mx.sym.Activation(get(bottoms[0]), name=name,
+                                    act_type="sigmoid")
+        elif ltype == "PReLU":
+            out = mx.sym.LeakyReLU(get(bottoms[0]), name=name,
+                                   act_type="prelu")
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = mx.sym.LRN(get(bottoms[0]), name=name,
+                             alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)),
+                             knorm=float(p.get("k", 1.0)),
+                             nsize=int(p.get("local_size", 5)))
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = mx.sym.Dropout(get(bottoms[0]), name=name,
+                                 p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            out = mx.sym.SoftmaxOutput(get(bottoms[0]), name=name)
+        elif ltype == "Flatten":
+            out = mx.sym.Flatten(get(bottoms[0]), name=name)
+        elif ltype == "Concat":
+            p = layer.get("concat_param", {})
+            out = mx.sym.Concat(*[get(b) for b in bottoms], name=name,
+                                dim=int(p.get("axis",
+                                              p.get("concat_dim", 1))),
+                                num_args=len(bottoms))
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = p.get("operation", "SUM")
+            coeff = [float(c) for c in as_list(p.get("coeff"))]
+            if coeff and any(c != 1.0 for c in coeff):
+                raise NotImplementedError(
+                    "Eltwise coeff %s (layer %r): weighted sums are not "
+                    "supported — rewrite as explicit scale layers"
+                    % (coeff, name))
+            syms = [get(b) for b in bottoms]
+            out = syms[0]
+            for s in syms[1:]:
+                if op in ("SUM", 1):
+                    out = out + s
+                elif op in ("PROD", 0):
+                    out = out * s
+                else:
+                    out = mx.sym.broadcast_maximum(out, s)
+        elif ltype == "BatchNorm":
+            p = layer.get("batch_norm_param", {})
+            out = mx.sym.BatchNorm(
+                get(bottoms[0]), name=name,
+                eps=float(p.get("eps", 1e-5)), fix_gamma=False,
+                use_global_stats=bool(p.get("use_global_stats", True)))
+        elif ltype == "Scale":
+            # caffe pairs BatchNorm with a Scale layer; BatchNorm here
+            # already learns gamma/beta, so Scale folds into identity
+            out = mx.sym.identity(get(bottoms[0]), name=name)
+        elif ltype == "Crop":
+            out = mx.sym.Crop(get(bottoms[0]), get(bottoms[1]),
+                              name=name, num_args=2)
+        elif ltype == "Reshape":
+            p = layer.get("reshape_param", {}).get("shape", {})
+            dims = tuple(int(d) for d in as_list(p.get("dim")))
+            out = mx.sym.Reshape(get(bottoms[0]), name=name, shape=dims)
+        elif ltype == "AbsVal":
+            out = mx.sym.abs(get(bottoms[0]), name=name)
+        elif ltype in ("Split", "Accuracy", "Silence"):
+            out = get(bottoms[0]) if bottoms else None
+        else:
+            raise NotImplementedError(
+                "caffe layer type %r (layer %r) is not supported"
+                % (ltype, name))
+        if out is not None:
+            for t in top_names:
+                tops[t] = out
+
+    # output = last layer top that produced a symbol (Silence/Accuracy
+    # tails have no top)
+    last = None
+    for layer in reversed(layers):
+        for t in as_list(layer.get("top")):
+            if t in tops:
+                last = t
+                break
+        if last:
+            break
+    if last is None:
+        raise ValueError("prototxt defines no output layer")
+    return tops[last], inputs

@@ -1,0 +1,119 @@
+"""Text-proto (prototxt) parser.
+
+Parses Caffe's text format into nested dicts: `key: value` scalars and
+`name { ... }` sub-messages; repeated keys collect into lists.  No
+schema — the converter reads the keys it knows.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["parse_prototxt"]
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+        (?P<comment>\#[^\n]*) |
+        (?P<brace>[{}]) |
+        (?P<key>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<sep>:)? |
+        (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*') |
+        (?P<number>-?\d+\.?\d*(?:[eE][+-]?\d+)?) |
+        (?P<punct>[,;])
+    )""", re.VERBOSE)
+
+
+def _tokens(text):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m or m.end() == pos:
+            if text[pos:].strip():
+                raise ValueError("prototxt parse error at: %r"
+                                 % text[pos:pos + 40])
+            return
+        pos = m.end()
+        if m.group("comment") is not None or m.group("punct") is not None:
+            continue
+        if m.group("key") is not None:
+            # m.lastgroup would report 'sep' when the colon matched too
+            yield ("key" if m.group("sep") else "bare"), m.group("key")
+        elif m.group("brace") is not None:
+            yield "brace", m.group("brace")
+        elif m.group("string") is not None:
+            yield "string", m.group("string")
+        else:
+            yield "number", m.group("number")
+
+
+def _coerce(tok_type, tok):
+    if tok_type == "string":
+        return tok[1:-1]
+    if tok_type == "number":
+        f = float(tok)
+        return int(f) if f.is_integer() and "." not in tok \
+            and "e" not in tok.lower() else f
+    # bare identifier: bool or enum name
+    if tok == "true":
+        return True
+    if tok == "false":
+        return False
+    return tok
+
+
+def _add(msg, key, value):
+    if key in msg:
+        cur = msg[key]
+        if not isinstance(cur, list):
+            msg[key] = [cur]
+        msg[key].append(value)
+    else:
+        msg[key] = value
+
+
+def parse_prototxt(text):
+    stack = [{}]
+    pending_key = None
+    toks = list(_tokens(text))
+    i = 0
+    while i < len(toks):
+        t, v = toks[i]
+        if t in ("key", "bare"):
+            j = i + 1
+            if j < len(toks) and toks[j][0] == "brace" and toks[j][1] == "{":
+                sub = {}
+                _add(stack[-1], v, sub)
+                stack.append(sub)
+                i = j + 1
+                continue
+            if t == "key":
+                pending_key = v
+                i += 1
+                continue
+            # bare identifier not opening a block: an enum/bool value
+            if pending_key is None:
+                raise ValueError("bare token %r with no key" % v)
+            _add(stack[-1], pending_key, _coerce("bare", v))
+            pending_key = None
+            i += 1
+            continue
+        if t == "brace":
+            if v == "}":
+                stack.pop()
+                if not stack:
+                    raise ValueError("unbalanced braces")
+            i += 1
+            continue
+        # value token following `key:`
+        if pending_key is None:
+            raise ValueError("value %r with no key" % v)
+        _add(stack[-1], pending_key, _coerce(t, v))
+        pending_key = None
+        i += 1
+    if len(stack) != 1:
+        raise ValueError("unbalanced braces at EOF")
+    return stack[0]
+
+
+def as_list(value):
+    if value is None:
+        return []
+    return value if isinstance(value, list) else [value]

@@ -1,0 +1,67 @@
+"""Minimal protobuf wire-format reader.
+
+Decodes a serialized message into {field_number: [values]} without any
+schema compilation: varints stay ints, length-delimited fields stay raw
+bytes (the caller descends into sub-messages it knows, per the public
+caffe.proto field numbers), fixed32 floats are returned raw for the
+caller to unpack.  Enough to walk NetParameter → layer → blobs → data.
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ["decode_fields", "varint", "packed_floats", "floats"]
+
+
+def varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def decode_fields(buf):
+    """→ {field_number: [value, ...]} for one message's bytes.
+
+    wire type 0 → int; 1 → 8 raw bytes; 2 → bytes; 5 → 4 raw bytes.
+    """
+    fields = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = varint(buf, pos)
+        fnum, wtype = key >> 3, key & 7
+        if wtype == 0:
+            val, pos = varint(buf, pos)
+        elif wtype == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wtype == 2:
+            ln, pos = varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wtype == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d (field %d)"
+                             % (wtype, fnum))
+        fields.setdefault(fnum, []).append(val)
+    return fields
+
+
+def packed_floats(raw):
+    """Length-delimited packed repeated float → list[float]."""
+    return list(struct.unpack("<%df" % (len(raw) // 4), raw))
+
+
+def floats(values):
+    """Repeated (non-packed) fixed32 float values → list[float]."""
+    return [struct.unpack("<f", v)[0] for v in values]
