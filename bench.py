@@ -27,8 +27,11 @@ import time
 
 BASELINE_IMG_S = 109.0  # 1x K80, bs 32, reference README
 
+_WATCHDOG_DONE = None  # set by _install_init_watchdog; modes disarm it
 
-def _install_init_watchdog():
+
+def _install_init_watchdog(metric="resnet50_train_images_per_sec",
+                           unit="img/s"):
     """The axon tunnel can wedge hard: jax.devices() then blocks forever
     (observed mid-round-3, PERF.md §1 note).  A hung benchmark is worse
     than a failed one — if backend init doesn't complete in
@@ -41,9 +44,9 @@ def _install_init_watchdog():
     def _watch():
         if not done.wait(timeout):
             print(json.dumps({
-                "metric": "resnet50_train_images_per_sec",
+                "metric": metric,
                 "value": 0.0,
-                "unit": "img/s (measurement unavailable)",
+                "unit": "%s (measurement unavailable)" % unit,
                 "vs_baseline": 0.0,
                 "error": "TPU backend init timed out after %.0fs — "
                          "tunnel unavailable; see PERF.md §1 for the "
@@ -54,7 +57,14 @@ def _install_init_watchdog():
 
     t = threading.Thread(target=_watch, daemon=True)
     t.start()
-    return done
+    global _WATCHDOG_DONE
+    _WATCHDOG_DONE = done
+
+
+def _disarm_watchdog():
+    """Call once the jax backend has answered — the hang risk is over."""
+    if _WATCHDOG_DONE is not None:
+        _WATCHDOG_DONE.set()
 
 # nominal dense bf16 peak FLOP/s by device kind (for the MFU report)
 PEAK_FLOPS = {
@@ -83,6 +93,7 @@ def bench_attention():
                   (("B", 4), ("H", 16), ("T", 4096), ("D", 128)))
     steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
     platform = jax.devices()[0].platform
+    _disarm_watchdog()
     device_kind = jax.devices()[0].device_kind
     if platform == "cpu":
         if "BENCH_ATTN_T" not in os.environ:
@@ -173,7 +184,11 @@ def bench_pipeline():
     common/fit.py)."""
     import time as _time
     import numpy as np
+    import jax
     import mxnet_tpu as mx
+
+    jax.devices()  # backend init is the hang risk; prove it then disarm
+    _disarm_watchdog()
 
     n_images = int(os.environ.get("BENCH_PIPE_IMAGES", "2000"))
     batch = int(os.environ.get("BENCH_BATCH", "128"))
@@ -213,10 +228,16 @@ def bench_pipeline():
 
 
 def main():
-    if os.environ.get("BENCH_MODE") == "attention":
+    mode = os.environ.get("BENCH_MODE")
+    metric, unit = {
+        "attention": ("flash_attention_train_tflops", "TFLOP/s"),
+        "pipeline": ("input_pipeline_images_per_sec", "img/s"),
+    }.get(mode, ("resnet50_train_images_per_sec", "img/s"))
+    _install_init_watchdog(metric, unit)
+    if mode == "attention":
         bench_attention()
         return
-    if os.environ.get("BENCH_MODE") == "pipeline":
+    if mode == "pipeline":
         bench_pipeline()
         return
     # bs 128 is the measured single-chip sweet spot on v5e (PERF.md:
@@ -227,13 +248,11 @@ def main():
     image = int(os.environ.get("BENCH_IMAGE", "224"))
 
     import numpy as np
-    watchdog_done = _install_init_watchdog()
     import jax
     import jax.numpy as jnp
 
     platform = jax.devices()[0].platform
-    if watchdog_done is not None:
-        watchdog_done.set()  # backend up; disarm
+    _disarm_watchdog()
     device_kind = jax.devices()[0].device_kind
     if platform == "cpu" and "BENCH_BATCH" not in os.environ:
         batch, steps = 16, 4  # keep the CPU smoke test fast

@@ -1,0 +1,154 @@
+"""2-bit gradient compression, TPU-native.
+
+The v0.11 reference tree exposes no gradient-compression API (it landed
+upstream right after this snapshot, as ``kvstore.set_gradient_compression``
+with the 2-bit scheme); this framework implements that surface for real
+rather than warning it away.  Scheme (matching the upstream semantics):
+
+each worker keeps a per-key *residual* ``r``; for every element::
+
+    v = g + r
+    send  +threshold  if v >=  threshold   (code 1)
+    send  -threshold  if v <= -threshold   (code 2)
+    send   0          otherwise            (code 0)
+    r' = v - sent
+
+so quantization error is carried into the next step and the update is
+unbiased over time.
+
+TPU-native design: quantize + residual update + bit-packing is ONE jitted
+XLA program on the local device (no host round-trip); the cross-worker
+exchange moves packed ``uint8`` codes — 4 elements per byte, 16x smaller
+than fp32 — over the worker mesh; decode-and-sum across workers is a
+second jitted program whose worker-axis reduction XLA lowers to the
+collective.  The reference-era design shipped quantized blobs through
+ps-lite servers; here the "server sum" is the same psum that carries the
+uncompressed path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TwoBitCompression", "create_compressor"]
+
+_SHIFTS = (0, 2, 4, 6)  # 4 two-bit codes per byte
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _compress_step(flat_grad, residual, threshold):
+    """codes+residual in one fused program; returns (packed uint8, r')."""
+    v = flat_grad.astype(jnp.float32) + residual
+    pos = v >= threshold
+    neg = v <= -threshold
+    codes = jnp.where(pos, jnp.uint8(1), jnp.where(neg, jnp.uint8(2),
+                                                   jnp.uint8(0)))
+    sent = jnp.where(pos, threshold, jnp.where(neg, -threshold, 0.0))
+    new_residual = v - sent
+    n = codes.shape[0]
+    n4 = -(-n // 4) * 4
+    codes = jnp.pad(codes, (0, n4 - n)).reshape(n4 // 4, 4)
+    packed = (codes[:, 0] | (codes[:, 1] << 2) |
+              (codes[:, 2] << 4) | (codes[:, 3] << 6))
+    return packed, new_residual
+
+
+def _decode(packed, threshold, size):
+    """packed uint8 (..., nbytes) -> float32 values (..., size)."""
+    bits = (packed[..., None] >> jnp.array(_SHIFTS, dtype=jnp.uint8)) & 3
+    flat = bits.reshape(bits.shape[:-2] + (-1,))[..., :size]
+    return jnp.where(flat == 1, threshold,
+                     jnp.where(flat == 2, -threshold, 0.0))
+
+
+_decode_jit = jax.jit(_decode, static_argnums=(2,))
+
+
+class TwoBitCompression:
+    """2-bit quantization with on-device residuals.
+
+    One instance serves a whole KVStore; residuals are keyed by the
+    caller.  All state lives on device as float32.
+    """
+
+    type = "2bit"
+
+    def __init__(self, threshold=0.5):
+        threshold = float(threshold)
+        if threshold <= 0:
+            raise ValueError("2bit compression threshold must be > 0, got %s"
+                             % threshold)
+        self.threshold = threshold
+        self._residuals = {}
+        self._decode_sum_jit = None
+
+    # -- local (single-process) path ------------------------------------
+    def compress(self, key, data):
+        """Quantize ``data`` (a jax.Array) against key's residual.
+
+        Returns packed uint8 codes of shape (ceil(size/4),); the residual
+        for ``key`` is updated in place (on device, donated buffer).
+        """
+        flat = data.reshape(-1)
+        res = self._residuals.get(key)
+        if res is None or res.shape != flat.shape:
+            res = jnp.zeros(flat.shape, jnp.float32)
+        packed, new_res = _compress_step(flat, res,
+                                         jnp.float32(self.threshold))
+        self._residuals[key] = new_res
+        return packed
+
+    def decompress(self, packed, shape, dtype):
+        size = int(np.prod(shape)) if shape else 1
+        vals = _decode_jit(packed, jnp.float32(self.threshold), size)
+        return vals.reshape(shape).astype(dtype)
+
+    def quantize_local(self, key, data):
+        """compress+decompress for the non-distributed store: the merged
+        gradient is replaced by its quantized image, residual carried."""
+        packed = self.compress(key, data)
+        return self.decompress(packed, data.shape, data.dtype)
+
+    # -- distributed path ------------------------------------------------
+    def allreduce(self, keys, raws, gather):
+        """Sum each worker's quantized contribution across the mesh.
+
+        Wire format per key: (num_workers, ceil(size/4)) uint8 — each
+        process contributes its packed row via ``gather`` (the KVStore's
+        worker-mesh scaffold, kvstore.py:_worker_gather); a single jitted
+        program decodes every row and sums over the worker axis (XLA
+        emits the collective), returning replicated float sums.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        packed = [self.compress(k, x) for k, x in zip(keys, raws)]
+        mesh, packed_globals = gather(packed)
+        metas = [(tuple(x.shape), x.dtype,
+                  int(np.prod(x.shape)) if x.ndim else 1) for x in raws]
+        sizes = tuple(m[2] for m in metas)
+        if self._decode_sum_jit is None:
+            def _decode_sum(xs, threshold, sizes):
+                return tuple(
+                    jnp.sum(_decode(x, threshold, s), axis=0)
+                    for x, s in zip(xs, sizes))
+            self._decode_sum_jit = jax.jit(
+                _decode_sum, static_argnums=(2,),
+                out_shardings=NamedSharding(mesh, P()))
+        summed = self._decode_sum_jit(tuple(packed_globals),
+                                      jnp.float32(self.threshold), sizes)
+        return [s.reshape(shape).astype(dtype).addressable_data(0)
+                for s, (shape, dtype, _) in zip(summed, metas)]
+
+
+def create_compressor(params):
+    """Build a compressor from ``set_gradient_compression`` params."""
+    params = dict(params or {})
+    ctype = params.pop("type", "none")
+    if ctype in (None, "none"):
+        return None
+    if ctype == "2bit":
+        return TwoBitCompression(threshold=params.pop("threshold", 0.5))
+    raise ValueError("unsupported gradient compression type %r "
+                     "(supported: 'none', '2bit')" % (ctype,))

@@ -122,6 +122,131 @@ def test_convert_model_decodes_wire(tmp_path):
                                var / 2.0)
 
 
+SCALE_PROTOTXT = """
+name: "ScaleNet"
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 4 dim: 4 }
+layer {
+  name: "scale1"
+  type: "Scale"
+  bottom: "data"
+  top: "scale1"
+  scale_param { bias_term: true }
+}
+layer { name: "relu1" type: "ReLU" bottom: "scale1" top: "relu1" }
+"""
+
+
+def test_standalone_scale_layer(tmp_path):
+    """A Scale NOT preceded by BatchNorm keeps its learned gamma/beta as
+    a per-channel broadcast (it must not silently fold to identity)."""
+    sym, inputs = convert_symbol(SCALE_PROTOTXT)
+    args = sym.list_arguments()
+    assert "scale1_gamma" in args and "scale1_beta" in args
+    gamma = np.array([2.0, -1.0], np.float32)
+    beta = np.array([0.5, 0.25], np.float32)
+    blob = _layer("scale1", "Scale", [gamma, beta])
+    f = tmp_path / "scale.caffemodel"
+    f.write_bytes(blob)
+    cargs, cauxs = convert_model(str(f))
+    np.testing.assert_array_equal(cargs["scale1_gamma"], gamma)
+    np.testing.assert_array_equal(cargs["scale1_beta"], beta)
+    np.testing.assert_array_equal(cauxs["scale1_moving_var"], [1.0, 1.0])
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(1, 2, 4, 4))
+    exe.copy_params_from({k: nd.array(v) for k, v in cargs.items()},
+                         {k: nd.array(v) for k, v in cauxs.items()})
+    x = np.ones((1, 2, 4, 4), np.float32)
+    out = exe.forward(data=nd.array(x))[0].asnumpy()
+    want = np.maximum(x * gamma.reshape(1, 2, 1, 1) +
+                      beta.reshape(1, 2, 1, 1), 0.0)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+V1_PROTOTXT = """
+name: "LegacyNet"
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 8
+input_dim: 8
+layers {
+  name: "conv1"
+  type: CONVOLUTION
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 }
+}
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers {
+  name: "fc1"
+  type: INNER_PRODUCT
+  bottom: "conv1"
+  top: "fc1"
+  inner_product_param { num_output: 2 }
+}
+layers { name: "prob" type: SOFTMAX bottom: "fc1" top: "prob" }
+"""
+
+
+def test_scale_not_folded_across_intervening_layer(tmp_path):
+    """BN -> in-place ReLU -> Scale: the Scale must stay standalone (its
+    bottom is the ReLU's product, not the BN's), matching convert_symbol's
+    dataflow pairing."""
+    def _layer_with_io(name, ltype, blobs, bottoms, tops):
+        msg = _ld(1, name.encode()) + _ld(2, ltype.encode())
+        for b in bottoms:
+            msg += _ld(3, b.encode())
+        for t in tops:
+            msg += _ld(4, t.encode())
+        for b in blobs:
+            msg += _ld(7, _blob(b))
+        return _ld(100, msg)
+
+    mean = np.zeros(2, np.float32)
+    var = np.ones(2, np.float32)
+    gamma = np.array([3.0, 4.0], np.float32)
+    blob = (_layer_with_io("bn1", "BatchNorm", [mean, var], ["x"], ["x"]) +
+            _layer_with_io("relu1", "ReLU", [], ["x"], ["x"]) +
+            _layer_with_io("sc1", "Scale", [gamma], ["x"], ["x"]))
+    f = tmp_path / "bnrelu.caffemodel"
+    f.write_bytes(blob)
+    args, auxs = convert_model(str(f))
+    # gamma lands under the Scale's own name, with frozen unit stats
+    np.testing.assert_array_equal(args["sc1_gamma"], gamma)
+    assert "bn1_gamma" not in args
+    np.testing.assert_array_equal(auxs["sc1_moving_var"], [1.0, 1.0])
+    # adjacent in-place BN+Scale still folds
+    blob2 = (_layer_with_io("bn1", "BatchNorm", [mean, var], ["x"], ["x"]) +
+             _layer_with_io("sc1", "Scale", [gamma], ["x"], ["x"]))
+    f2 = tmp_path / "bnscale.caffemodel"
+    f2.write_bytes(blob2)
+    args2, auxs2 = convert_model(str(f2))
+    np.testing.assert_array_equal(args2["bn1_gamma"], gamma)
+    assert "sc1_gamma" not in args2
+
+
+def test_v1_enum_prototxt_converts():
+    """Legacy `layers { type: CONVOLUTION }` deploy files (original
+    AlexNet/CaffeNet era) map through the V1 enum-name table."""
+    sym, inputs = convert_symbol(V1_PROTOTXT)
+    assert inputs == ["data"]
+    args = sym.list_arguments()
+    assert "conv1_weight" in args and "fc1_weight" in args
+
+
+def test_truncated_caffemodel_reports_clearly(tmp_path):
+    w = np.arange(8, dtype=np.float32)
+    blob = _layer("conv1", "Convolution", [w])
+    f = tmp_path / "trunc.caffemodel"
+    f.write_bytes(blob[:-3])  # cut mid-blob
+    try:
+        convert_model(str(f))
+    except ValueError as e:
+        assert "truncated" in str(e) or "corrupt" in str(e)
+    else:
+        raise AssertionError("truncated file did not raise")
+
+
 def test_converted_net_runs_with_converted_weights(tmp_path):
     """Full path: prototxt + caffemodel → Module forward."""
     rng = np.random.RandomState(0)

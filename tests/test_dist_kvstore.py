@@ -82,10 +82,10 @@ def test_dist_kvstore_bandwidth_two_processes(tmp_path):
     assert (tmp_path / "bw_0").exists() and (tmp_path / "bw_1").exists()
 
 
-def test_gradient_compression_warns(caplog):
-    import logging
+def test_gradient_compression_installs_compressor():
     import mxnet_tpu as mx
     kv = mx.kv.create("device")
-    with caplog.at_level(logging.WARNING):
-        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
-    assert any("compression" in r.message for r in caplog.records)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.25})
+    assert kv._compressor is not None and kv._compressor.threshold == 0.25
+    kv.set_gradient_compression({"type": "none"})
+    assert kv._compressor is None

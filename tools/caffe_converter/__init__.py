@@ -8,5 +8,16 @@ prototxt is parsed as text-proto and the caffemodel through a minimal
 protobuf wire-format reader (wire.py), using the field numbers from the
 public caffe.proto schema.
 """
+import os as _os
+import sys as _sys
+
+# the converter imports mxnet_tpu lazily; make the repo root importable
+# when the tool is run straight from a checkout
+try:
+    import mxnet_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), "..", ".."))
+
 from .convert_symbol import convert_symbol  # noqa: F401
 from .convert_model import convert_model  # noqa: F401

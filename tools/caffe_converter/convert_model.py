@@ -12,13 +12,7 @@ weights are (out, in) like FullyConnected; Convolution weights are
 """
 from __future__ import annotations
 
-import os
-import sys
-
 import numpy as np
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "..", ".."))
 
 from . import wire
 
@@ -28,13 +22,22 @@ __all__ = ["convert_model"]
 def _blob_array(blob_bytes):
     f = wire.decode_fields(blob_bytes)
     if 5 in f:
-        data = []
+        chunks = []
         for chunk in f[5]:
-            if isinstance(chunk, (bytes, bytearray)):
-                data.extend(wire.packed_floats(chunk))
-            else:  # unpacked fixed32 comes through as raw 4-byte values
-                data.append(chunk)
-        arr = np.asarray(data, np.float32)
+            # packed (wire type 2) and unpacked (wire type 5) fixed32
+            # both arrive as raw bytes from decode_fields
+            if not isinstance(chunk, (bytes, bytearray)):
+                raise ValueError(
+                    "blob data field has unexpected varint encoding "
+                    "(corrupt caffemodel?)")
+            if len(chunk) % 4:
+                raise ValueError(
+                    "blob float data length %d is not a multiple of 4 "
+                    "(file corrupt or truncated)" % len(chunk))
+            chunks.append(np.frombuffer(chunk, "<f4"))
+        # near zero-copy: real caffemodels hold tens of millions of floats
+        arr = np.concatenate(chunks) if len(chunks) > 1 else \
+            np.array(chunks[0], np.float32)
     else:
         arr = np.zeros((0,), np.float32)
     if 7 in f:
@@ -46,7 +49,18 @@ def _blob_array(blob_bytes):
     return arr.reshape(dims)
 
 
+# V1LayerParameter.LayerType enum values (public caffe.proto) → V2 names
+V1_LAYER_TYPES = {
+    1: "Accuracy", 3: "Concat", 4: "Convolution", 5: "Data",
+    6: "Dropout", 8: "Flatten", 14: "InnerProduct", 15: "LRN",
+    17: "Pooling", 18: "ReLU", 19: "Sigmoid", 20: "Softmax",
+    21: "SoftmaxWithLoss", 22: "Split", 23: "TanH", 25: "Eltwise",
+    33: "Slice", 35: "AbsVal", 36: "Silence", 39: "Deconvolution",
+}
+
+
 def _layers(model_bytes):
+    """→ [(name, ltype, blobs, bottoms, tops)] for V2 and V1 messages."""
     net = wire.decode_fields(model_bytes)
     out = []
     for raw in net.get(100, []):      # LayerParameter
@@ -54,13 +68,18 @@ def _layers(model_bytes):
         name = f.get(1, [b""])[0].decode("utf-8")
         ltype = f.get(2, [b""])[0].decode("utf-8")
         blobs = [_blob_array(b) for b in f.get(7, [])]
-        out.append((name, ltype, blobs))
+        bottoms = [b.decode("utf-8") for b in f.get(3, [])]
+        tops = [t.decode("utf-8") for t in f.get(4, [])]
+        out.append((name, ltype, blobs, bottoms, tops))
     for raw in net.get(2, []):        # V1LayerParameter
         f = wire.decode_fields(raw)
         name = f.get(4, [b""])[0].decode("utf-8")
-        ltype = str(f.get(5, [0])[0])
+        code = int(f.get(5, [0])[0])
+        ltype = V1_LAYER_TYPES.get(code, str(code))
         blobs = [_blob_array(b) for b in f.get(6, [])]
-        out.append((name, ltype, blobs))
+        bottoms = [b.decode("utf-8") for b in f.get(2, [])]
+        tops = [t.decode("utf-8") for t in f.get(3, [])]
+        out.append((name, ltype, blobs, bottoms, tops))
     return out
 
 
@@ -72,7 +91,15 @@ def convert_model(caffemodel_fname, output_prefix=None, epoch=0):
         model_bytes = f.read()
     arg_params, aux_params = {}, {}
     prev_bn = None
-    for name, ltype, blobs in _layers(model_bytes):
+    bn_by_top = {}  # tensor name -> BN layer that last wrote it
+    for name, ltype, blobs, bottoms, tops in _layers(model_bytes):
+        if ltype not in ("BatchNorm", "Scale"):
+            # any intervening layer — even a parameter-free in-place
+            # ReLU — breaks BN↔Scale pairing, exactly as convert_symbol's
+            # made_by tracking does
+            prev_bn = None
+            for t in tops:
+                bn_by_top.pop(t, None)
         if not blobs:
             continue
         if ltype == "BatchNorm":
@@ -83,19 +110,31 @@ def convert_model(caffemodel_fname, output_prefix=None, epoch=0):
             aux_params[name + "_moving_mean"] = mean.reshape(-1) * scale
             aux_params[name + "_moving_var"] = var.reshape(-1) * scale
             prev_bn = name
+            for t in tops:
+                bn_by_top[t] = name
             continue
         if ltype == "Scale":
             # caffe splits BN into BatchNorm (stats) + Scale (gamma/beta);
             # the Symbol's BatchNorm learns gamma/beta itself, so a Scale
-            # following a BatchNorm stores under the BN layer's name
-            # (the reference converter does the same rename)
-            target = prev_bn if prev_bn is not None else name
+            # whose bottom IS a BatchNorm output stores under the BN
+            # layer's name (matching convert_symbol's dataflow pairing);
+            # file-order adjacency is the fallback when the caffemodel
+            # carries no bottom fields
+            bn_target = bn_by_top.get(bottoms[0]) if bottoms else prev_bn
+            target = bn_target if bn_target is not None else name
             arg_params[target + "_gamma"] = blobs[0].reshape(-1)
             if len(blobs) > 1:
                 arg_params[target + "_beta"] = blobs[1].reshape(-1)
+            if bn_target is None:
+                # standalone Scale converts to BatchNorm with frozen unit
+                # statistics (convert_symbol.py); supply them explicitly
+                c = arg_params[target + "_gamma"].shape[0]
+                aux_params[target + "_moving_mean"] = np.zeros(c, np.float32)
+                aux_params[target + "_moving_var"] = np.ones(c, np.float32)
             prev_bn = None
+            for t in tops:
+                bn_by_top.pop(t, None)
             continue
-        prev_bn = None
         if ltype == "PReLU":
             arg_params[name + "_gamma"] = blobs[0].reshape(-1)
         else:

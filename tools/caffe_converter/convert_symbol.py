@@ -10,14 +10,23 @@ BatchNorm(+Scale folded), Crop, Reshape, AbsVal, Split.
 from __future__ import annotations
 
 import os
-import sys
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "..", ".."))
 
 from .prototxt import parse_prototxt, as_list
 
 __all__ = ["convert_symbol"]
+
+# V1 prototxts spell layer types as enum names (`layers { type: RELU }`);
+# normalize to the V2 strings the dispatch below uses
+V1_TYPE_NAMES = {
+    "ABSVAL": "AbsVal", "ACCURACY": "Accuracy", "CONCAT": "Concat",
+    "CONVOLUTION": "Convolution", "DECONVOLUTION": "Deconvolution",
+    "DROPOUT": "Dropout", "ELTWISE": "Eltwise", "FLATTEN": "Flatten",
+    "INNER_PRODUCT": "InnerProduct", "LRN": "LRN", "POOLING": "Pooling",
+    "PRELU": "PReLU", "RELU": "ReLU", "RESHAPE": "Reshape",
+    "SIGMOID": "Sigmoid", "SILENCE": "Silence", "SLICE": "Slice",
+    "SOFTMAX": "Softmax", "SOFTMAX_LOSS": "SoftmaxWithLoss",
+    "SPLIT": "Split", "TANH": "TanH",
+}
 
 
 def _ints(v, default=None, n=2):
@@ -60,6 +69,7 @@ def convert_symbol(prototxt_fname_or_text):
     layers = as_list(net.get("layer") or net.get("layers"))
 
     tops = {}
+    made_by = {}  # top name -> layer type that produced it
     inputs = []
     for name in as_list(net.get("input")):
         tops[name] = mx.sym.Variable(name)
@@ -73,6 +83,7 @@ def convert_symbol(prototxt_fname_or_text):
 
     for layer in layers:
         ltype = layer.get("type")
+        ltype = V1_TYPE_NAMES.get(ltype, ltype)
         name = layer.get("name", "layer%d" % len(tops))
         bottoms = as_list(layer.get("bottom"))
         top_names = as_list(layer.get("top")) or [name]
@@ -173,9 +184,32 @@ def convert_symbol(prototxt_fname_or_text):
                 eps=float(p.get("eps", 1e-5)), fix_gamma=False,
                 use_global_stats=bool(p.get("use_global_stats", True)))
         elif ltype == "Scale":
-            # caffe pairs BatchNorm with a Scale layer; BatchNorm here
-            # already learns gamma/beta, so Scale folds into identity
-            out = mx.sym.identity(get(bottoms[0]), name=name)
+            if made_by.get(bottoms[0]) == "BatchNorm":
+                # caffe pairs BatchNorm with a Scale layer; BatchNorm here
+                # already learns gamma/beta, so Scale folds into identity
+                # (convert_model renames its blobs under the BN layer)
+                out = mx.sym.identity(get(bottoms[0]), name=name)
+            else:
+                # standalone Scale: per-channel (axis=1) learned
+                # gamma*x+beta.  That is exactly BatchNorm with frozen
+                # unit statistics (mean=0, var=1, eps=0), which also
+                # names its params {name}_gamma/{name}_beta — matching
+                # what convert_model stores for the Scale blobs.  A
+                # scale_param without bias_term leaves beta at its
+                # zero default.
+                p = layer.get("scale_param", {})
+                if len(bottoms) > 1:
+                    raise NotImplementedError(
+                        "Scale layer %r with a second bottom supplying "
+                        "the scale values is not supported — only "
+                        "learned per-channel scales" % name)
+                if int(p.get("axis", 1)) != 1:
+                    raise NotImplementedError(
+                        "Scale layer %r with axis=%s: only the channel "
+                        "axis (1) is supported" % (name, p.get("axis")))
+                out = mx.sym.BatchNorm(
+                    get(bottoms[0]), name=name, eps=0.0,
+                    fix_gamma=False, use_global_stats=True)
         elif ltype == "Crop":
             out = mx.sym.Crop(get(bottoms[0]), get(bottoms[1]),
                               name=name, num_args=2)
@@ -194,6 +228,7 @@ def convert_symbol(prototxt_fname_or_text):
         if out is not None:
             for t in top_names:
                 tops[t] = out
+                made_by[t] = ltype
 
     # output = last layer top that produced a symbol (Silence/Accuracy
     # tails have no top)

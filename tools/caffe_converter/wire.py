@@ -8,15 +8,16 @@ caller to unpack.  Enough to walk NetParameter → layer → blobs → data.
 """
 from __future__ import annotations
 
-import struct
-
-__all__ = ["decode_fields", "varint", "packed_floats", "floats"]
+__all__ = ["decode_fields", "varint"]
 
 
 def varint(buf, pos):
     result = 0
     shift = 0
     while True:
+        if pos >= len(buf):
+            raise ValueError("truncated protobuf: varint runs past end of "
+                             "buffer (file corrupt or partially downloaded)")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -51,17 +52,14 @@ def decode_fields(buf):
             val = buf[pos:pos + 4]
             pos += 4
         else:
+            val = None
+        if wtype in (1, 2, 5) and pos > n:
+            raise ValueError(
+                "truncated protobuf: field %d (wire type %d) needs %d "
+                "bytes past end of buffer (file corrupt or partially "
+                "downloaded)" % (fnum, wtype, pos - n))
+        if val is None:
             raise ValueError("unsupported wire type %d (field %d)"
                              % (wtype, fnum))
         fields.setdefault(fnum, []).append(val)
     return fields
-
-
-def packed_floats(raw):
-    """Length-delimited packed repeated float → list[float]."""
-    return list(struct.unpack("<%df" % (len(raw) // 4), raw))
-
-
-def floats(values):
-    """Repeated (non-packed) fixed32 float values → list[float]."""
-    return [struct.unpack("<f", v)[0] for v in values]
