@@ -111,15 +111,22 @@ def bench_attention():
         def loss(q, k, v):
             return flash_attention(q, k, v, causal=True).astype(
                 jnp.float32).sum()
-        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
-        return l, grads
+        l, (dq, dk, dv) = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+            q, k, v)
+        # reduce grads to ONE scalar output: keeps the backward live
+        # (returning l alone lets XLA dead-code-eliminate it) without
+        # shipping 48 MB of gradient outputs through the device tunnel
+        # every step, which dominates and destabilizes the measurement
+        gs = (dq.astype(jnp.float32).sum() + dk.astype(jnp.float32).sum()
+              + dv.astype(jnp.float32).sum())
+        return l, gs
 
-    l, _ = step(q, k, v)
-    np.asarray(l)                       # completion barrier (PERF.md §1)
+    l, gs = step(q, k, v)
+    np.asarray(gs)                      # completion barrier (PERF.md §1)
     t0 = time.perf_counter()
     for _ in range(steps):
-        l, grads = step(q, k, v)
-    np.asarray(l)
+        l, gs = step(q, k, v)
+    np.asarray(gs)
     dtime = time.perf_counter() - t0
     # causal halves the score matrix work
     flops = 3.5 * 4 * b * h * t * t * d / 2 * steps

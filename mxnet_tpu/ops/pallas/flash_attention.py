@@ -343,8 +343,8 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512):
     """Fused attention over [B, H, T, D] tensors.
 
     Memory O(T) per program instead of O(T²); differentiable (flash
@@ -361,8 +361,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     return out.reshape(b, h, tq, v.shape[3])
 
 
-def flash_forward_with_lse(q, k, v, causal=False, scale=None, block_q=128,
-                           block_k=128):
+def flash_forward_with_lse(q, k, v, causal=False, scale=None, block_q=512,
+                           block_k=512):
     """Forward-only kernel call returning (out, lse) over [B,H,T,D].
 
     ``lse = m + log l`` per query row — the merge quantity ring attention

@@ -225,6 +225,19 @@ def test_scale_not_folded_across_intervening_layer(tmp_path):
     assert "sc1_gamma" not in args2
 
 
+def test_standalone_scale_without_bias_gets_zero_beta(tmp_path):
+    """scale_param without bias_term → the symbol's BatchNorm still lists
+    a beta arg; convert_model must synthesize zeros for strict loading."""
+    gamma = np.array([1.5, 2.5], np.float32)
+    blob = _layer("sc1", "Scale", [gamma])
+    f = tmp_path / "nobias.caffemodel"
+    f.write_bytes(blob)
+    args, auxs = convert_model(str(f))
+    np.testing.assert_array_equal(args["sc1_gamma"], gamma)
+    np.testing.assert_array_equal(args["sc1_beta"], [0.0, 0.0])
+    np.testing.assert_array_equal(auxs["sc1_moving_var"], [1.0, 1.0])
+
+
 def test_v1_enum_prototxt_converts():
     """Legacy `layers { type: CONVOLUTION }` deploy files (original
     AlexNet/CaffeNet era) map through the V1 enum-name table."""

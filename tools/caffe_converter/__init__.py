@@ -8,14 +8,14 @@ prototxt is parsed as text-proto and the caffemodel through a minimal
 protobuf wire-format reader (wire.py), using the field numbers from the
 public caffe.proto schema.
 """
+import importlib.util as _ilu
 import os as _os
 import sys as _sys
 
 # the converter imports mxnet_tpu lazily; make the repo root importable
-# when the tool is run straight from a checkout
-try:
-    import mxnet_tpu  # noqa: F401
-except ImportError:
+# when the tool is run straight from a checkout (find_spec only — do not
+# initialize the framework/JAX just to probe importability)
+if _ilu.find_spec("mxnet_tpu") is None:
     _sys.path.insert(0, _os.path.join(
         _os.path.dirname(_os.path.abspath(__file__)), "..", ".."))
 
