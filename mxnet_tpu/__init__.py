@@ -56,5 +56,6 @@ from . import gluon
 from . import config
 from . import predictor
 from .predictor import Predictor
+from . import plugin
 
 __version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""Interop plugins.
+
+The reference grew a plugin tree (/root/reference/plugin/): torch
+(torch_module.cc / torch_criterion.cc — run Torch nn modules and losses
+as operators), caffe (converter — ours lives in tools/caffe_converter),
+warpctc (ours is the builtin _contrib_CTCLoss), opencv (ours is the
+native C++ image pipeline, src/mxtpu/).  This package provides the torch
+interop for the PyTorch era: wrap a ``torch.nn.Module`` as a
+differentiable op/Gluon block (host callback — an escape hatch, not a
+TPU fast path), and convert torch state dicts to framework params.
+
+Everything degrades gracefully when torch is not installed; importing
+this package never requires it.
+"""
+from . import torch_plugin  # noqa: F401
+from .torch_plugin import (TorchOp, TorchBlock, TorchCriterion,  # noqa: F401
+                           convert_torch_module)

@@ -1,0 +1,379 @@
+"""Run PyTorch modules inside the framework, and convert their weights.
+
+Reference parity: plugin/torch (torch_module-inl.h / torch_criterion-inl.h
+run Lua-Torch nn modules and criterions as operators inside the engine).
+The 2025 equivalent wraps ``torch.nn.Module``: forward runs as a
+``jax.pure_callback`` on the host CPU inside the XLA program, backward is
+a second callback into ``torch.autograd`` — the same host-callback design
+as mx.operator.CustomOp (operator.py).  This is an interop escape hatch,
+not a TPU fast path: every call round-trips device→host→device.
+
+``convert_torch_module`` is the torch analogue of tools/caffe_converter:
+walk ``named_modules`` and emit framework-named arg/aux params
+(Conv2d/Linear → {name}_weight/_bias, BatchNorm → {name}_gamma/_beta +
+moving stats) so a torch state dict initializes the matching Gluon or
+Symbol network.
+
+torch stays optional: importing this module works without it; using any
+entry point raises a clear error.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["TorchOp", "TorchBlock", "TorchCriterion",
+           "convert_torch_module"]
+
+
+def _require_torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:
+        raise ImportError(
+            "mxnet_tpu.plugin torch interop requires pytorch, which is "
+            "not installed in this environment") from e
+
+
+def _to_numpy(a):
+    return np.asarray(a, dtype=np.float32)
+
+
+class _OpDescriptor:
+    """Minimal op-shaped object for the autograd tape: record_op needs
+    ``.name`` (VJP-cache key) and ``.jitted(**params)`` (the replayable
+    forward) — see autograd.py:record_op and ndarray.py:465."""
+
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+
+    def jitted(self, **params):
+        return self._fn
+
+
+class _TorchRunner:
+    """Host-side execution of one torch module: forward and vjp.
+
+    Parameters are passed explicitly on every call (so JAX sees them as
+    differentiable inputs and our optimizers own the training state);
+    the torch module is just the compute recipe.
+    """
+
+    def __init__(self, module, n_inputs):
+        self.torch = _require_torch()
+        self.module = module
+        self.n_inputs = n_inputs
+        self.pnames = [n for n, _ in module.named_parameters()]
+        self._out_shape_cache = {}
+
+    def _load_params(self, param_arrays, requires_grad):
+        torch = self.torch
+        with torch.no_grad():
+            for (name, p), a in zip(self.module.named_parameters(),
+                                    param_arrays):
+                p.copy_(torch.from_numpy(_to_numpy(a)))
+                p.requires_grad_(requires_grad)
+                p.grad = None
+
+    def forward_host(self, *arrays):
+        torch = self.torch
+        xs = [torch.from_numpy(_to_numpy(a))
+              for a in arrays[:self.n_inputs]]
+        self._load_params(arrays[self.n_inputs:], requires_grad=False)
+        with torch.no_grad():
+            y = self.module(*xs)
+        return _to_numpy(y.detach().numpy())
+
+    def vjp_host(self, *arrays_and_cotangent):
+        torch = self.torch
+        *arrays, g = arrays_and_cotangent
+        xs = [torch.from_numpy(_to_numpy(a)).requires_grad_(True)
+              for a in arrays[:self.n_inputs]]
+        self._load_params(arrays[self.n_inputs:], requires_grad=True)
+        y = self.module(*xs)
+        y.backward(torch.from_numpy(_to_numpy(g)))
+        grads = [x.grad if x.grad is not None else torch.zeros_like(x)
+                 for x in xs]
+        grads += [p.grad if p.grad is not None
+                  else self.torch.zeros_like(p)
+                  for _, p in self.module.named_parameters()]
+        out = tuple(_to_numpy(gr.detach().numpy()) for gr in grads)
+        for _, p in self.module.named_parameters():
+            p.grad = None
+        return out
+
+    def out_shape(self, in_shapes):
+        """Dry-run the torch module on zeros to learn the output shape
+        (host, eager, cached per input-shape tuple)."""
+        key = tuple(map(tuple, in_shapes))
+        if key not in self._out_shape_cache:
+            torch = self.torch
+            xs = [torch.zeros(*s) for s in in_shapes]
+            with torch.no_grad():
+                y = self.module(*xs)
+            self._out_shape_cache[key] = tuple(y.shape)
+        return self._out_shape_cache[key]
+
+    def param_values(self):
+        return [_to_numpy(p.detach().numpy())
+                for _, p in self.module.named_parameters()]
+
+
+class TorchOp:
+    """A ``torch.nn.Module`` as a differentiable JAX/framework op.
+
+    ``op(x, ...)`` runs the module's forward on host CPU and is
+    differentiable with respect to both the inputs and (optionally
+    supplied) parameter arrays::
+
+        op = TorchOp(torch_net)
+        y = op(x)                       # params read from the torch module
+        y = op(x, params=plist)         # params as explicit jax arrays
+
+    reference plugin/torch/torch_module-inl.h ran TorchModule the same
+    way: inputs + flattened torch parameters in, output out.
+    """
+
+    def __init__(self, module, n_inputs=1):
+        import jax
+        self._runner = _TorchRunner(module, n_inputs)
+        self._n_inputs = n_inputs
+
+        runner = self._runner
+
+        @jax.custom_vjp
+        def fn(*args):
+            return _callback_fwd(*args)
+
+        def _callback_fwd(*args):
+            import jax
+            import jax.numpy as jnp
+            out_shape = runner.out_shape([a.shape
+                                          for a in args[:n_inputs]])
+            return jax.pure_callback(
+                runner.forward_host,
+                jax.ShapeDtypeStruct(out_shape, jnp.float32), *args)
+
+        def fn_fwd(*args):
+            return _callback_fwd(*args), args
+
+        def fn_bwd(res, g):
+            import jax
+            import jax.numpy as jnp
+            specs = tuple(jax.ShapeDtypeStruct(a.shape, jnp.float32)
+                          for a in res)
+            return jax.pure_callback(runner.vjp_host, specs, *res, g)
+
+        fn.defvjp(fn_fwd, fn_bwd)
+        self._fn = fn
+        self._desc = _OpDescriptor("_plugin_torch_op_%x" % id(self), fn)
+
+    @property
+    def param_names(self):
+        return list(self._runner.pnames)
+
+    def param_values(self):
+        """Current torch parameter values as numpy arrays."""
+        return self._runner.param_values()
+
+    def __call__(self, *inputs, params=None):
+        from ..ndarray.ndarray import NDArray
+        from .. import autograd as _ag
+        import jax.numpy as jnp
+        if params is None:
+            params = [jnp.asarray(v) for v in self._runner.param_values()]
+        all_in = list(inputs) + list(params)
+        if not any(isinstance(x, NDArray) for x in all_in):
+            raw = [jnp.asarray(x) for x in all_in]
+            return self._fn(*raw)
+        # NDArray path: execute, then tape-record like a registry op so
+        # loss.backward() reaches both inputs and Parameter grads
+        nd_inputs, raw = [], []
+        for x in all_in:
+            if isinstance(x, NDArray):
+                nd_inputs.append(x)
+                raw.append(x._data)
+            else:
+                arr = jnp.asarray(x)
+                nd_inputs.append(NDArray(arr))
+                raw.append(arr)
+        ctx = nd_inputs[0]._ctx
+        out = NDArray(self._fn(*raw), ctx)
+        if _ag.is_recording():
+            _ag.record_op(self._desc, {}, nd_inputs, [out],
+                          raw_inputs=tuple(raw))
+        return out
+
+
+class TorchBlock:
+    """Gluon Block wrapping a torch module; its parameters are real
+    Gluon Parameters, so ``Trainer`` and checkpointing work unchanged.
+
+    ::
+
+        net = mx.gluon.nn.Sequential()
+        net.add(TorchBlock(torch_feature_extractor))
+        net.add(mx.gluon.nn.Dense(10))
+    """
+
+    def __new__(cls, module, n_inputs=1, prefix=None, params=None):
+        # subclass Block lazily so importing the plugin never imports
+        # gluon (and thus jax) as a side effect
+        from ..gluon.block import Block
+
+        class _TorchBlockImpl(Block):
+            def __init__(self, module, n_inputs, prefix, params):
+                super().__init__(prefix=prefix, params=params)
+                self._op = TorchOp(module, n_inputs=n_inputs)
+                self._pkeys = []
+                for name, value in zip(self._op.param_names,
+                                       self._op.param_values()):
+                    key = name.replace(".", "_")
+                    p = self.params.get(key, shape=value.shape,
+                                        init=_from_value(value))
+                    self._pkeys.append(key)
+                    self._reg_params[key] = p
+
+            def forward(self, *inputs):
+                plist = [self.params.get(k).data() for k in self._pkeys]
+                return self._op(*inputs, params=plist)
+
+        _TorchBlockImpl.__name__ = "TorchBlock"
+        return _TorchBlockImpl(module, n_inputs, prefix, params)
+
+
+def _from_value(value):
+    """An Initializer that sets a parameter to a fixed array (the torch
+    module's current weights)."""
+    from ..initializer import Initializer
+
+    class _FromValue(Initializer):
+        def _init_weight(self, name, arr):
+            self._set(arr, np.asarray(value, dtype=np.float32))
+
+    return _FromValue()
+
+
+class TorchCriterion:
+    """A torch loss module as an output head (reference
+    plugin/torch/torch_criterion-inl.h): ``crit(pred, label)`` returns
+    the scalar loss, differentiable with respect to ``pred`` only."""
+
+    def __init__(self, loss_module):
+        torch = _require_torch()
+        self._torch = torch
+        self._loss = loss_module
+
+        import jax
+
+        outer = self
+
+        @jax.custom_vjp
+        def fn(pred, label):
+            return outer._fwd_cb(pred, label)
+
+        def fn_fwd(pred, label):
+            return outer._fwd_cb(pred, label), (pred, label)
+
+        def fn_bwd(res, g):
+            import jax
+            import jax.numpy as jnp
+            pred, label = res
+            spec = jax.ShapeDtypeStruct(pred.shape, jnp.float32)
+            dpred = jax.pure_callback(outer._bwd_host, spec, pred, label, g)
+            return dpred, jnp.zeros_like(label)
+
+        fn.defvjp(fn_fwd, fn_bwd)
+        self._fn = fn
+        self._desc = _OpDescriptor("_plugin_torch_criterion_%x" % id(self),
+                                   fn)
+
+    def _fwd_cb(self, pred, label):
+        import jax
+        import jax.numpy as jnp
+        return jax.pure_callback(
+            self._fwd_host, jax.ShapeDtypeStruct((), jnp.float32),
+            pred, label)
+
+    def _fwd_host(self, pred, label):
+        torch = self._torch
+        with torch.no_grad():
+            l = self._loss(torch.from_numpy(_to_numpy(pred)),
+                           torch.from_numpy(_to_numpy(label)))
+        return _to_numpy(l.detach().numpy())
+
+    def _bwd_host(self, pred, label, g):
+        torch = self._torch
+        p = torch.from_numpy(_to_numpy(pred)).requires_grad_(True)
+        l = self._loss(p, torch.from_numpy(_to_numpy(label)))
+        l.backward(torch.from_numpy(_to_numpy(g)))
+        return _to_numpy(p.grad.detach().numpy())
+
+    def __call__(self, pred, label):
+        from ..ndarray.ndarray import NDArray
+        from .. import autograd as _ag
+        import jax.numpy as jnp
+        praw = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+        lraw = label._data if isinstance(label, NDArray) \
+            else jnp.asarray(label)
+        out = self._fn(praw, lraw)
+        if isinstance(pred, NDArray):
+            out_nd = NDArray(out, pred._ctx)
+            if _ag.is_recording():
+                label_nd = label if isinstance(label, NDArray) \
+                    else NDArray(lraw)
+                _ag.record_op(self._desc, {}, [pred, label_nd], [out_nd],
+                              raw_inputs=(praw, lraw))
+            return out_nd
+        return out
+
+
+# -- weight conversion ---------------------------------------------------
+
+_TORCH_PARAM_MAP = {
+    # torch attr -> (framework suffix, is_aux)
+    "weight": ("weight", False),
+    "bias": ("bias", False),
+}
+_TORCH_NORM_MAP = {
+    "weight": ("gamma", False),
+    "bias": ("beta", False),
+    "running_mean": ("moving_mean", True),
+    "running_var": ("moving_var", True),
+}
+
+
+def convert_torch_module(module, prefix=""):
+    """→ (arg_params, aux_params) numpy dicts with framework naming.
+
+    Walks ``named_modules``; norm layers map weight/bias/running stats to
+    gamma/beta/moving_*, everything else keeps weight/bias.  Module path
+    dots become underscores: ``features.0.weight`` → ``features_0_weight``.
+    Layout notes: torch Conv2d weights are (out, in/groups, kh, kw) and
+    Linear weights (out, in) — both already match Convolution /
+    FullyConnected, so arrays convert value-exact with no transpose.
+    """
+    torch = _require_torch()
+    norm_types = (torch.nn.BatchNorm1d, torch.nn.BatchNorm2d,
+                  torch.nn.BatchNorm3d, torch.nn.InstanceNorm1d,
+                  torch.nn.InstanceNorm2d, torch.nn.InstanceNorm3d,
+                  torch.nn.LayerNorm, torch.nn.GroupNorm)
+    arg_params, aux_params = {}, {}
+    for mod_name, sub in module.named_modules():
+        is_norm = isinstance(sub, norm_types)
+        table = _TORCH_NORM_MAP if is_norm else _TORCH_PARAM_MAP
+        state = dict(sub.named_parameters(recurse=False))
+        state.update(dict(sub.named_buffers(recurse=False)))
+        for attr, tensor in state.items():
+            if attr not in table:
+                if attr == "num_batches_tracked":
+                    continue
+                suffix, is_aux = attr, False
+            else:
+                suffix, is_aux = table[attr]
+            base = (prefix + mod_name).replace(".", "_")
+            key = ("%s_%s" % (base, suffix)) if base else suffix
+            dst = aux_params if is_aux else arg_params
+            dst[key] = _to_numpy(tensor.detach().numpy())
+    return arg_params, aux_params

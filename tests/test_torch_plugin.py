@@ -1,0 +1,152 @@
+"""Torch interop plugin: module-as-op, gluon block, criterion, converter.
+
+Reference parity target: plugin/torch (torch_module / torch_criterion
+ran Lua-Torch modules as operators); here the subject is torch.nn.
+All tests are skipped cleanly when torch is absent.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.plugin import (TorchOp, TorchBlock, TorchCriterion,  # noqa: E402
+                              convert_torch_module)
+
+
+def _small_torch_net(seed=0):
+    torch.manual_seed(seed)
+    return torch.nn.Sequential(
+        torch.nn.Linear(6, 5),
+        torch.nn.Tanh(),
+        torch.nn.Linear(5, 3),
+    )
+
+
+def test_torch_op_forward_matches_eager():
+    net = _small_torch_net()
+    op = TorchOp(net)
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    got = np.asarray(op(nd.array(x)).asnumpy())
+    with torch.no_grad():
+        want = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_torch_op_gradients_match_autograd():
+    import jax
+    import jax.numpy as jnp
+    net = _small_torch_net(1)
+    op = TorchOp(net)
+    x = np.random.RandomState(1).randn(2, 6).astype(np.float32)
+    params = [jnp.asarray(v) for v in op.param_values()]
+
+    def loss(x, params):
+        return op(x, params=params).sum()
+
+    gx, gp = jax.grad(loss, argnums=(0, 1))(jnp.asarray(x), params)
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    lt = net(xt).sum()
+    lt.backward()
+    np.testing.assert_allclose(np.asarray(gx), xt.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    torch_grads = [p.grad.numpy() for _, p in net.named_parameters()]
+    for got, want in zip(gp, torch_grads):
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_torch_block_trains_with_gluon_trainer():
+    net = _small_torch_net(2)
+    block = TorchBlock(net)
+    block.collect_params().initialize(ctx=mx.cpu())
+    trainer = mx.gluon.Trainer(block.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    x = nd.array(np.random.RandomState(2).randn(8, 6).astype(np.float32))
+    before = {k: v.data().asnumpy().copy()
+              for k, v in block.collect_params().items()}
+    with mx.autograd.record():
+        y = block(x)
+        loss = (y ** 2).mean()
+    loss.backward()
+    trainer.step(8)
+    changed = [k for k, v in block.collect_params().items()
+               if not np.allclose(v.data().asnumpy(), before[k])]
+    assert changed, "no torch-backed parameter was updated"
+    # initial values came from the torch module itself
+    got0 = before[sorted(before)[0]]
+    assert np.isfinite(got0).all()
+
+
+def test_torch_block_forward_matches_torch():
+    net = _small_torch_net(3)
+    block = TorchBlock(net)
+    block.collect_params().initialize(ctx=mx.cpu())
+    x = np.random.RandomState(3).randn(5, 6).astype(np.float32)
+    got = block(nd.array(x)).asnumpy()
+    with torch.no_grad():
+        want = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_torch_criterion_matches_loss_and_grad():
+    import jax
+    import jax.numpy as jnp
+    crit = TorchCriterion(torch.nn.MSELoss())
+    rng = np.random.RandomState(4)
+    pred = rng.randn(6, 3).astype(np.float32)
+    label = rng.randn(6, 3).astype(np.float32)
+    got = np.asarray(crit(jnp.asarray(pred), jnp.asarray(label)))
+    want = torch.nn.MSELoss()(torch.from_numpy(pred),
+                              torch.from_numpy(label)).item()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    g = jax.grad(lambda p: crit(p, jnp.asarray(label)))(jnp.asarray(pred))
+    pt = torch.from_numpy(pred).requires_grad_(True)
+    torch.nn.MSELoss()(pt, torch.from_numpy(label)).backward()
+    np.testing.assert_allclose(np.asarray(g), pt.grad.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
+class _ConvNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        torch.manual_seed(5)
+        self.conv = torch.nn.Conv2d(3, 4, 3, padding=1)
+        self.bn = torch.nn.BatchNorm2d(4)
+        self.fc = torch.nn.Linear(4 * 8 * 8, 2)
+
+    def forward(self, x):
+        y = torch.relu(self.bn(self.conv(x)))
+        return self.fc(y.reshape(y.shape[0], -1))
+
+
+def test_convert_torch_module_weights_load_and_match():
+    tnet = _ConvNet().eval()
+    # nudge running stats away from init so the test is meaningful
+    with torch.no_grad():
+        tnet.bn.running_mean += 0.3
+        tnet.bn.running_var *= 1.7
+    args, auxs = convert_torch_module(tnet)
+    assert set(args) == {"conv_weight", "conv_bias", "bn_gamma", "bn_beta",
+                         "fc_weight", "fc_bias"}
+    assert set(auxs) == {"bn_moving_mean", "bn_moving_var"}
+
+    data = mx.sym.Variable("data")
+    y = mx.sym.Convolution(data, name="conv", num_filter=4, kernel=(3, 3),
+                           pad=(1, 1))
+    y = mx.sym.BatchNorm(y, name="bn", fix_gamma=False,
+                         use_global_stats=True, eps=1e-5)
+    y = mx.sym.Activation(y, act_type="relu")
+    y = mx.sym.Flatten(y)
+    y = mx.sym.FullyConnected(y, name="fc", num_hidden=2)
+    exe = y.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 8, 8))
+    exe.copy_params_from({k: nd.array(v) for k, v in args.items()},
+                         {k: nd.array(v) for k, v in auxs.items()})
+    x = np.random.RandomState(5).randn(2, 3, 8, 8).astype(np.float32)
+    got = exe.forward(data=nd.array(x))[0].asnumpy()
+    with torch.no_grad():
+        want = tnet(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)

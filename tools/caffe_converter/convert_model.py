@@ -125,14 +125,14 @@ def convert_model(caffemodel_fname, output_prefix=None, epoch=0):
             arg_params[target + "_gamma"] = blobs[0].reshape(-1)
             if len(blobs) > 1:
                 arg_params[target + "_beta"] = blobs[1].reshape(-1)
+            # the symbol's BatchNorm (BN-paired or standalone) always
+            # lists a beta arg; a Scale without a bias blob (bias_term
+            # defaults false) must still produce one for strict loading
+            c = arg_params[target + "_gamma"].shape[0]
+            arg_params.setdefault(target + "_beta", np.zeros(c, np.float32))
             if bn_target is None:
                 # standalone Scale converts to BatchNorm with frozen unit
-                # statistics (convert_symbol.py); supply them — and a zero
-                # beta when the Scale has no bias blob, since the symbol's
-                # BatchNorm always lists one
-                c = arg_params[target + "_gamma"].shape[0]
-                arg_params.setdefault(target + "_beta",
-                                      np.zeros(c, np.float32))
+                # statistics (convert_symbol.py); supply them explicitly
                 aux_params[target + "_moving_mean"] = np.zeros(c, np.float32)
                 aux_params[target + "_moving_var"] = np.ones(c, np.float32)
             prev_bn = None
