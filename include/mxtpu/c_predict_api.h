@@ -1,0 +1,99 @@
+/*
+ * C predict ABI for the TPU-native framework.
+ *
+ * Shape-compatible with the reference inference surface
+ * (reference include/mxnet/c_predict_api.h: MXPredCreate /
+ * MXPredCreatePartialOut / MXPredGetOutputShape / MXPredSetInput /
+ * MXPredForward / MXPredGetOutput / MXPredFree) so C/C++/FFI serving
+ * stacks written against it recompile against this header.  The
+ * implementation (src/mxtpu/c_predict_api.cc) drives the framework's
+ * Predictor through CPython: embedded when the caller is a plain C
+ * process, attached via the GIL when loaded into an existing Python
+ * process.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef uint32_t mxt_uint;
+typedef void *PredictorHandle;
+
+/* Last error message for the calling thread ("" when none). */
+const char *MXPredGetLastError(void);
+
+/*
+ * Create a predictor from a symbol JSON string and a parameter blob
+ * (the bytes of a `prefix-0000.params` file, reference V2 binary or
+ * npz).  Input shapes arrive CSR-style: input_shape_indptr has
+ * num_input_nodes+1 entries delimiting each input's dims inside
+ * input_shape_data.
+ * dev_type: 1 = cpu, 2 = gpu (mapped to the accelerator), per the
+ * reference's enum; dev_id selects the device.
+ * Returns 0 on success, -1 on failure (see MXPredGetLastError).
+ */
+int MXPredCreate(const char *symbol_json_str,
+                 const void *param_bytes,
+                 int param_size,
+                 int dev_type, int dev_id,
+                 mxt_uint num_input_nodes,
+                 const char **input_keys,
+                 const mxt_uint *input_shape_indptr,
+                 const mxt_uint *input_shape_data,
+                 PredictorHandle *out);
+
+/* Same, but the outputs are the named internal layers (e.g. a feature
+ * layer for extraction) instead of the symbol's heads. */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes,
+                           int param_size,
+                           int dev_type, int dev_id,
+                           mxt_uint num_input_nodes,
+                           const char **input_keys,
+                           const mxt_uint *input_shape_indptr,
+                           const mxt_uint *input_shape_data,
+                           mxt_uint num_output_nodes,
+                           const char **output_keys,
+                           PredictorHandle *out);
+
+/* Output `index`'s shape; *shape_data stays owned by the predictor and
+ * is valid until the next call on the same handle. */
+int MXPredGetOutputShape(PredictorHandle handle,
+                         mxt_uint index,
+                         mxt_uint **shape_data,
+                         mxt_uint *shape_ndim);
+
+/* Stage `size` floats for the named input. */
+int MXPredSetInput(PredictorHandle handle,
+                   const char *key,
+                   const float *data,
+                   mxt_uint size);
+
+/* Run the compiled forward program on the staged inputs. */
+int MXPredForward(PredictorHandle handle);
+
+/* Copy output `index` into data (size = element count, must match). */
+int MXPredGetOutput(PredictorHandle handle,
+                    mxt_uint index,
+                    float *data,
+                    mxt_uint size);
+
+/* Rebind for new input shapes, keeping the loaded weights. */
+int MXPredReshape(mxt_uint num_input_nodes,
+                  const char **input_keys,
+                  const mxt_uint *input_shape_indptr,
+                  const mxt_uint *input_shape_data,
+                  PredictorHandle handle,
+                  PredictorHandle *out);
+
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

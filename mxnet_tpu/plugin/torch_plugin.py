@@ -39,6 +39,11 @@ def _to_numpy(a):
     return np.asarray(a, dtype=np.float32)
 
 
+import itertools as _itertools
+
+_OP_COUNTER = _itertools.count()
+
+
 class _OpDescriptor:
     """Minimal op-shaped object for the autograd tape: record_op needs
     ``.name`` (VJP-cache key) and ``.jitted(**params)`` (the replayable
@@ -61,8 +66,12 @@ class _TorchRunner:
     """
 
     def __init__(self, module, n_inputs):
+        import copy
         self.torch = _require_torch()
-        self.module = module
+        # private copy: forward/backward write parameter values and
+        # requires_grad flags into the module they run, and the caller's
+        # module must never be clobbered as a side effect
+        self.module = copy.deepcopy(module)
         self.n_inputs = n_inputs
         self.pnames = [n for n, _ in module.named_parameters()]
         self._out_shape_cache = {}
@@ -133,6 +142,10 @@ class TorchOp:
 
     reference plugin/torch/torch_module-inl.h ran TorchModule the same
     way: inputs + flattened torch parameters in, output out.
+
+    The op snapshots the module at construction (deep copy): later
+    mutations of the caller's module are not seen, and the caller's
+    module is never written to.
     """
 
     def __init__(self, module, n_inputs=1):
@@ -167,7 +180,10 @@ class TorchOp:
 
         fn.defvjp(fn_fwd, fn_bwd)
         self._fn = fn
-        self._desc = _OpDescriptor("_plugin_torch_op_%x" % id(self), fn)
+        # unique-forever name: the autograd VJP cache keys on it, and a
+        # recycled id() would silently replay another module's backward
+        self._desc = _OpDescriptor(
+            "_plugin_torch_op_%d" % next(_OP_COUNTER), fn)
 
     @property
     def param_names(self):
@@ -245,10 +261,15 @@ class TorchBlock:
 
 def _from_value(value):
     """An Initializer that sets a parameter to a fixed array (the torch
-    module's current weights)."""
+    module's current weights) regardless of its name — bypassing the
+    suffix dispatch that would send *_bias/*_gamma/*_beta to the
+    zeros/ones defaults."""
     from ..initializer import Initializer
 
     class _FromValue(Initializer):
+        def __call__(self, desc, arr):
+            self._set(arr, np.asarray(value, dtype=np.float32))
+
         def _init_weight(self, name, arr):
             self._set(arr, np.asarray(value, dtype=np.float32))
 
@@ -282,12 +303,18 @@ class TorchCriterion:
             pred, label = res
             spec = jax.ShapeDtypeStruct(pred.shape, jnp.float32)
             dpred = jax.pure_callback(outer._bwd_host, spec, pred, label, g)
-            return dpred, jnp.zeros_like(label)
+            if jnp.issubdtype(label.dtype, jnp.integer) or \
+                    label.dtype == jnp.bool_:
+                # integer primals take float0 cotangents under custom_vjp
+                dlabel = np.zeros(label.shape, jax.dtypes.float0)
+            else:
+                dlabel = jnp.zeros_like(label)
+            return dpred, dlabel
 
         fn.defvjp(fn_fwd, fn_bwd)
         self._fn = fn
-        self._desc = _OpDescriptor("_plugin_torch_criterion_%x" % id(self),
-                                   fn)
+        self._desc = _OpDescriptor(
+            "_plugin_torch_criterion_%d" % next(_OP_COUNTER), fn)
 
     def _fwd_cb(self, pred, label):
         import jax
@@ -296,17 +323,25 @@ class TorchCriterion:
             self._fwd_host, jax.ShapeDtypeStruct((), jnp.float32),
             pred, label)
 
+    def _label_tensor(self, label):
+        # keep the label's dtype: CrossEntropyLoss and friends require
+        # integer (Long) targets; widen int32 (the jax default) to int64
+        lab = np.ascontiguousarray(label)
+        if lab.dtype.kind in "iu":
+            lab = lab.astype(np.int64)
+        return self._torch.from_numpy(lab)
+
     def _fwd_host(self, pred, label):
         torch = self._torch
         with torch.no_grad():
             l = self._loss(torch.from_numpy(_to_numpy(pred)),
-                           torch.from_numpy(_to_numpy(label)))
+                           self._label_tensor(label))
         return _to_numpy(l.detach().numpy())
 
     def _bwd_host(self, pred, label, g):
         torch = self._torch
         p = torch.from_numpy(_to_numpy(pred)).requires_grad_(True)
-        l = self._loss(p, torch.from_numpy(_to_numpy(label)))
+        l = self._loss(p, self._label_tensor(label))
         l.backward(torch.from_numpy(_to_numpy(g)))
         return _to_numpy(p.grad.detach().numpy())
 

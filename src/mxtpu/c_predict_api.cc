@@ -1,0 +1,387 @@
+// C predict ABI over the framework's Python Predictor.
+//
+// Reference parity: src/c_api/c_predict_api.cc bound the C surface to the
+// C++ executor; here the executor IS an XLA program owned by Python
+// (mxnet_tpu/predictor.py), so this translation unit embeds CPython and
+// drives it.  Two supported hosts:
+//   - plain C/C++ process: first MXPredCreate initializes the
+//     interpreter (and releases the GIL between calls);
+//   - an existing Python process loading this .so via ctypes/dlopen:
+//     Py_IsInitialized() is already true and every entry point attaches
+//     with PyGILState_Ensure.
+// All entry points return 0 on success, -1 on failure with the message
+// available from MXPredGetLastError().
+
+#include "../../include/mxtpu/c_predict_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+// Python-side shim: keeps this file free of the numpy C API — buffers
+// cross the boundary as bytes.
+const char *kShimSource = R"PY(
+import os as _os
+import sys as _sys
+
+# embedded-interpreter hosts have no site package for the framework;
+# MXTPU_HOME points at the repo/install root
+_home = _os.environ.get("MXTPU_HOME")
+if _home and _home not in _sys.path:
+    _sys.path.insert(0, _home)
+
+import numpy as _np
+
+from mxnet_tpu.predictor import Predictor as _Predictor
+from mxnet_tpu import context as _ctx
+
+
+class CPredictor(object):
+    def __init__(self, sym_json, param_bytes, names, shapes,
+                 dev_type, dev_id, output_names=None):
+        ctx = _ctx.cpu(dev_id) if dev_type == 1 else _ctx.tpu(dev_id)
+        self.shapes = {n: tuple(int(d) for d in s)
+                       for n, s in zip(names, shapes)}
+        import mxnet_tpu.symbol as _sym
+        symbol = _sym.load_json(sym_json)
+        if output_names:
+            internals = symbol.get_internals()
+            outs = [internals[o if o.endswith("_output") else o + "_output"]
+                    for o in output_names]
+            symbol = outs[0] if len(outs) == 1 else _sym.Group(outs)
+            sym_json = symbol.tojson()
+        self.pred = _Predictor(sym_json, param_bytes, self.shapes, ctx=ctx)
+        _, out_shapes, _ = self.pred._symbol.infer_shape(**self.shapes)
+        self.out_shapes = [tuple(int(d) for d in s) for s in out_shapes]
+        self.staged = {}
+
+    def set_input(self, key, buf):
+        if key not in self.shapes:
+            raise ValueError("unknown input %r; declared: %s"
+                             % (key, sorted(self.shapes)))
+        arr = _np.frombuffer(buf, _np.float32).reshape(self.shapes[key])
+        self.pred.set_input(key, arr)
+
+    def forward(self):
+        self.pred._outputs = self.pred._exec.forward(is_train=False)
+        self.out_shapes = [tuple(int(d) for d in o.shape)
+                           for o in self.pred._outputs]
+
+    def get_output(self, index):
+        out = self.pred.get_output(index)
+        return _np.ascontiguousarray(out, _np.float32).tobytes()
+
+    def reshape(self, names, shapes):
+        # reference MXPredReshape returns a NEW handle and leaves the
+        # old one fully usable: clone the Predictor around a re-bound
+        # executor instead of mutating the original
+        clone = CPredictor.__new__(CPredictor)
+        clone.shapes = {n: tuple(int(d) for d in s)
+                        for n, s in zip(names, shapes)}
+        newpred = _Predictor.__new__(_Predictor)
+        newpred._ctx = self.pred._ctx
+        newpred._symbol = self.pred._symbol
+        newpred._input_names = list(clone.shapes)
+        newpred._exec = self.pred._exec.reshape(**clone.shapes)
+        newpred._outputs = None
+        clone.pred = newpred
+        _, out_shapes, _ = newpred._symbol.infer_shape(**clone.shapes)
+        clone.out_shapes = [tuple(int(d) for d in s) for s in out_shapes]
+        return clone
+)PY";
+
+struct Handle {
+  PyObject *obj;                       // CPredictor instance
+  std::vector<mxt_uint> shape_buf;     // backing for MXPredGetOutputShape
+};
+
+PyObject *g_shim_module = nullptr;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) g_last_error = msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Ensure the interpreter exists and return with the GIL held.
+bool ensure_python(PyGILState_STATE *gil) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by initialization so PyGILState_Ensure
+    // below works uniformly for every thread including this one
+    PyEval_SaveThread();
+  }
+  *gil = PyGILState_Ensure();
+  if (g_shim_module == nullptr) {
+    PyObject *mod = PyModule_New("_mxtpu_c_predict");
+    if (mod == nullptr) { set_error_from_python(); return false; }
+    PyObject *globals = PyModule_GetDict(mod);
+    PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+    PyObject *res = PyRun_String(kShimSource, Py_file_input, globals,
+                                 globals);
+    if (res == nullptr) {
+      set_error_from_python();
+      Py_DECREF(mod);
+      return false;
+    }
+    Py_DECREF(res);
+    g_shim_module = mod;
+  }
+  return true;
+}
+
+PyObject *build_shapes(mxt_uint n, const char **keys,
+                       const mxt_uint *indptr, const mxt_uint *data,
+                       PyObject **names_out) {
+  PyObject *names = PyList_New(n);
+  PyObject *shapes = PyList_New(n);
+  for (mxt_uint i = 0; i < n; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(keys[i]));
+    mxt_uint ndim = indptr[i + 1] - indptr[i];
+    PyObject *shape = PyTuple_New(ndim);
+    for (mxt_uint d = 0; d < ndim; ++d) {
+      PyTuple_SetItem(shape, d,
+                      PyLong_FromUnsignedLong(data[indptr[i] + d]));
+    }
+    PyList_SetItem(shapes, i, shape);
+  }
+  *names_out = names;
+  return shapes;
+}
+
+int create_impl(const char *symbol_json_str, const void *param_bytes,
+                int param_size, int dev_type, int dev_id,
+                mxt_uint num_input_nodes, const char **input_keys,
+                const mxt_uint *input_shape_indptr,
+                const mxt_uint *input_shape_data,
+                mxt_uint num_output_nodes, const char **output_keys,
+                PredictorHandle *out) {
+  PyGILState_STATE gil;
+  if (!ensure_python(&gil)) {
+    if (Py_IsInitialized()) PyGILState_Release(gil);
+    return -1;
+  }
+  int rc = -1;
+  PyObject *names = nullptr;
+  PyObject *shapes = build_shapes(num_input_nodes, input_keys,
+                                  input_shape_indptr, input_shape_data,
+                                  &names);
+  PyObject *outputs = Py_None;
+  Py_INCREF(Py_None);
+  if (num_output_nodes > 0) {
+    Py_DECREF(outputs);
+    outputs = PyList_New(num_output_nodes);
+    for (mxt_uint i = 0; i < num_output_nodes; ++i) {
+      PyList_SetItem(outputs, i, PyUnicode_FromString(output_keys[i]));
+    }
+  }
+  PyObject *cls = PyObject_GetAttrString(g_shim_module, "CPredictor");
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *obj = nullptr;
+  if (cls != nullptr && params != nullptr) {
+    obj = PyObject_CallFunction(cls, "sOOOiiO", symbol_json_str, params,
+                                names, shapes, dev_type, dev_id, outputs);
+  }
+  if (obj == nullptr) {
+    set_error_from_python();
+  } else {
+    Handle *h = new Handle();
+    h->obj = obj;
+    *out = h;
+    rc = 0;
+  }
+  Py_XDECREF(cls);
+  Py_XDECREF(params);
+  Py_XDECREF(names);
+  Py_XDECREF(shapes);
+  Py_XDECREF(outputs);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXPredGetLastError(void) { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mxt_uint num_input_nodes, const char **input_keys,
+                 const mxt_uint *input_shape_indptr,
+                 const mxt_uint *input_shape_data, PredictorHandle *out) {
+  return create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                     dev_id, num_input_nodes, input_keys,
+                     input_shape_indptr, input_shape_data, 0, nullptr,
+                     out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mxt_uint num_input_nodes,
+                           const char **input_keys,
+                           const mxt_uint *input_shape_indptr,
+                           const mxt_uint *input_shape_data,
+                           mxt_uint num_output_nodes,
+                           const char **output_keys,
+                           PredictorHandle *out) {
+  return create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                     dev_id, num_input_nodes, input_keys,
+                     input_shape_indptr, input_shape_data,
+                     num_output_nodes, output_keys, out);
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mxt_uint index,
+                         mxt_uint **shape_data, mxt_uint *shape_ndim) {
+  Handle *h = static_cast<Handle *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *shapes = PyObject_GetAttrString(h->obj, "out_shapes");
+  PyObject *shape =
+      shapes ? PySequence_GetItem(shapes, static_cast<Py_ssize_t>(index))
+             : nullptr;
+  if (shape == nullptr) {
+    set_error_from_python();
+  } else {
+    Py_ssize_t ndim = PySequence_Size(shape);
+    h->shape_buf.resize(static_cast<size_t>(ndim));
+    for (Py_ssize_t d = 0; d < ndim; ++d) {
+      PyObject *v = PySequence_GetItem(shape, d);
+      h->shape_buf[static_cast<size_t>(d)] =
+          static_cast<mxt_uint>(PyLong_AsUnsignedLong(v));
+      Py_XDECREF(v);
+    }
+    *shape_data = h->shape_buf.data();
+    *shape_ndim = static_cast<mxt_uint>(ndim);
+    rc = 0;
+  }
+  Py_XDECREF(shape);
+  Py_XDECREF(shapes);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, mxt_uint size) {
+  Handle *h = static_cast<Handle *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size) * 4);
+  PyObject *res =
+      buf ? PyObject_CallMethod(h->obj, "set_input", "sO", key, buf)
+          : nullptr;
+  if (res == nullptr) {
+    set_error_from_python();
+  } else {
+    rc = 0;
+  }
+  Py_XDECREF(res);
+  Py_XDECREF(buf);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Handle *h = static_cast<Handle *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *res = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (res == nullptr) {
+    set_error_from_python();
+  } else {
+    rc = 0;
+  }
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mxt_uint index, float *data,
+                    mxt_uint size) {
+  Handle *h = static_cast<Handle *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *res = PyObject_CallMethod(h->obj, "get_output", "I", index);
+  if (res == nullptr) {
+    set_error_from_python();
+  } else {
+    char *raw = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(res, &raw, &len) == 0) {
+      if (len != static_cast<Py_ssize_t>(size) * 4) {
+        g_last_error = "MXPredGetOutput: size mismatch (got " +
+                       std::to_string(len / 4) + " elements, caller asked " +
+                       std::to_string(size) + ")";
+      } else {
+        memcpy(data, raw, static_cast<size_t>(len));
+        rc = 0;
+      }
+    } else {
+      set_error_from_python();
+    }
+  }
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredReshape(mxt_uint num_input_nodes, const char **input_keys,
+                  const mxt_uint *input_shape_indptr,
+                  const mxt_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  Handle *h = static_cast<Handle *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *names = nullptr;
+  PyObject *shapes = build_shapes(num_input_nodes, input_keys,
+                                  input_shape_indptr, input_shape_data,
+                                  &names);
+  PyObject *obj =
+      PyObject_CallMethod(h->obj, "reshape", "OO", names, shapes);
+  if (obj == nullptr) {
+    set_error_from_python();
+  } else {
+    Handle *nh = new Handle();
+    nh->obj = obj;
+    *out = nh;
+    rc = 0;
+  }
+  Py_XDECREF(names);
+  Py_XDECREF(shapes);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Handle *h = static_cast<Handle *>(handle);
+  if (h == nullptr) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->obj);
+  PyGILState_Release(gil);
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

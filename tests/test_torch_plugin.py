@@ -91,6 +91,58 @@ def test_torch_block_forward_matches_torch():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_torch_block_initial_values_match_torch_exactly():
+    """All params — including biases, which the default initializer
+    suffix-dispatch would zero — start at the torch module's values."""
+    net = _small_torch_net(7)
+    want = {n.replace(".", "_"): p.detach().numpy().copy()
+            for n, p in net.named_parameters()}
+    block = TorchBlock(net)
+    block.collect_params().initialize(ctx=mx.cpu())
+    got = {k.split("_", 1)[1] if "_" in k else k: v.data().asnumpy()
+           for k, v in block.collect_params().items()}
+    for name, val in want.items():
+        hits = [v for k, v in block.collect_params().items()
+                if k.endswith(name)]
+        assert hits, "param %s missing" % name
+        np.testing.assert_allclose(hits[0].data().asnumpy(), val,
+                                   rtol=1e-6)
+
+
+def test_torch_op_does_not_clobber_user_module():
+    net = _small_torch_net(8)
+    before = [p.detach().numpy().copy() for _, p in net.named_parameters()]
+    req_before = [p.requires_grad for _, p in net.named_parameters()]
+    op = TorchOp(net)
+    import jax.numpy as jnp
+    x = jnp.zeros((2, 6), jnp.float32)
+    op(x, params=[jnp.zeros_like(jnp.asarray(v))
+                  for v in op.param_values()])
+    after = [p.detach().numpy() for _, p in net.named_parameters()]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+    assert [p.requires_grad for _, p in net.named_parameters()] == req_before
+
+
+def test_torch_criterion_integer_labels_cross_entropy():
+    import jax
+    import jax.numpy as jnp
+    crit = TorchCriterion(torch.nn.CrossEntropyLoss())
+    rng = np.random.RandomState(9)
+    pred = rng.randn(5, 4).astype(np.float32)
+    label = rng.randint(0, 4, size=(5,)).astype(np.int32)
+    got = np.asarray(crit(jnp.asarray(pred), jnp.asarray(label)))
+    want = torch.nn.CrossEntropyLoss()(
+        torch.from_numpy(pred), torch.from_numpy(label.astype(np.int64)))
+    np.testing.assert_allclose(got, want.item(), rtol=1e-5)
+    g = jax.grad(lambda p: crit(p, jnp.asarray(label)))(jnp.asarray(pred))
+    pt = torch.from_numpy(pred).requires_grad_(True)
+    torch.nn.CrossEntropyLoss()(
+        pt, torch.from_numpy(label.astype(np.int64))).backward()
+    np.testing.assert_allclose(np.asarray(g), pt.grad.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
 def test_torch_criterion_matches_loss_and_grad():
     import jax
     import jax.numpy as jnp
