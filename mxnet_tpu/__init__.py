@@ -57,5 +57,6 @@ from . import config
 from . import predictor
 from .predictor import Predictor
 from . import plugin
+from . import rtc
 
 __version__ = "0.1.0"

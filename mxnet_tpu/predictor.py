@@ -98,6 +98,25 @@ class Predictor:
         self._input_names = list(input_shapes.keys())
         self._outputs = None
 
+    def clone_reshaped(self, input_shapes):
+        """A NEW predictor bound for ``input_shapes`` that shares nothing
+        mutable with this one (the C ABI's MXPredReshape contract: the
+        original handle stays fully usable).  Weights are copied from the
+        live executor, so params set after construction carry over."""
+        kwargs = {k: tuple(v) for k, v in input_shapes.items()}
+        clone = Predictor.__new__(Predictor)
+        clone._ctx = self._ctx
+        clone._symbol = self._symbol
+        clone._input_names = list(input_shapes.keys())
+        clone._exec = self._symbol.simple_bind(self._ctx, grad_req="null",
+                                               **kwargs)
+        weights = {k: v for k, v in self._exec.arg_dict.items()
+                   if k not in input_shapes}
+        clone._exec.copy_params_from(weights, dict(self._exec.aux_dict),
+                                     allow_extra_params=True)
+        clone._outputs = None
+        return clone
+
     def predict(self, data, input_name=None):
         """One-call convenience: set the (single) input, forward, return
         output 0 — the c_predict_api quick path."""

@@ -17,6 +17,7 @@
 #include <Python.h>
 
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -59,19 +60,15 @@ class CPredictor(object):
         self.pred = _Predictor(sym_json, param_bytes, self.shapes, ctx=ctx)
         _, out_shapes, _ = self.pred._symbol.infer_shape(**self.shapes)
         self.out_shapes = [tuple(int(d) for d in s) for s in out_shapes]
-        self.staged = {}
 
     def set_input(self, key, buf):
-        if key not in self.shapes:
-            raise ValueError("unknown input %r; declared: %s"
-                             % (key, sorted(self.shapes)))
+        # self.shapes[key] raises KeyError for unknown inputs
         arr = _np.frombuffer(buf, _np.float32).reshape(self.shapes[key])
         self.pred.set_input(key, arr)
 
     def forward(self):
-        self.pred._outputs = self.pred._exec.forward(is_train=False)
-        self.out_shapes = [tuple(int(d) for d in o.shape)
-                           for o in self.pred._outputs]
+        outs = self.pred.forward()
+        self.out_shapes = [tuple(int(d) for d in o.shape) for o in outs]
 
     def get_output(self, index):
         out = self.pred.get_output(index)
@@ -79,19 +76,13 @@ class CPredictor(object):
 
     def reshape(self, names, shapes):
         # reference MXPredReshape returns a NEW handle and leaves the
-        # old one fully usable: clone the Predictor around a re-bound
-        # executor instead of mutating the original
+        # old one fully usable; Predictor.clone_reshaped shares nothing
+        # mutable with the original
         clone = CPredictor.__new__(CPredictor)
         clone.shapes = {n: tuple(int(d) for d in s)
                         for n, s in zip(names, shapes)}
-        newpred = _Predictor.__new__(_Predictor)
-        newpred._ctx = self.pred._ctx
-        newpred._symbol = self.pred._symbol
-        newpred._input_names = list(clone.shapes)
-        newpred._exec = self.pred._exec.reshape(**clone.shapes)
-        newpred._outputs = None
-        clone.pred = newpred
-        _, out_shapes, _ = newpred._symbol.infer_shape(**clone.shapes)
+        clone.pred = self.pred.clone_reshaped(clone.shapes)
+        _, out_shapes, _ = clone.pred._symbol.infer_shape(**clone.shapes)
         clone.out_shapes = [tuple(int(d) for d in s) for s in out_shapes]
         return clone
 )PY";
@@ -121,14 +112,20 @@ void set_error_from_python() {
   Py_XDECREF(tb);
 }
 
+std::once_flag g_init_once;
+
 // Ensure the interpreter exists and return with the GIL held.
 bool ensure_python(PyGILState_STATE *gil) {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    // release the GIL acquired by initialization so PyGILState_Ensure
-    // below works uniformly for every thread including this one
-    PyEval_SaveThread();
-  }
+  // once_flag: two C threads racing into their first MXPredCreate must
+  // not both run Py_InitializeEx (the GIL only exists afterwards)
+  std::call_once(g_init_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization so PyGILState_Ensure
+      // below works uniformly for every thread including this one
+      PyEval_SaveThread();
+    }
+  });
   *gil = PyGILState_Ensure();
   if (g_shim_module == nullptr) {
     PyObject *mod = PyModule_New("_mxtpu_c_predict");

@@ -170,6 +170,23 @@ def test_c_predict_ctypes_roundtrip(tmp_path):
         handle, 0, out2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         out2.size) == 0
     np.testing.assert_allclose(out2, out, rtol=1e-6)
+    # same-shape reshape must not alias buffers: staging input on the
+    # clone then re-running the old handle must reproduce its old output
+    same = ctypes.c_void_p()
+    assert lib.MXPredReshape(1, keys, indptr, shape, handle,
+                             ctypes.byref(same)) == 0
+    other = np.full((2, 5), 9.0, np.float32)
+    assert lib.MXPredSetInput(
+        same, b"data",
+        other.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        other.size) == 0
+    assert lib.MXPredForward(handle) == 0  # old handle, old staged input
+    out_again = np.zeros(6, np.float32)
+    assert lib.MXPredGetOutput(
+        handle, 0, out_again.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_again.size) == 0
+    np.testing.assert_allclose(out_again, out2, rtol=1e-6)
+    lib.MXPredFree(same)
     lib.MXPredFree(handle4)
     lib.MXPredFree(handle)
 
