@@ -1,0 +1,131 @@
+"""DCGAN on synthetic image data (reference example/gan/dcgan.py shape).
+
+Two Gluon networks trained adversarially — exercises alternating
+generator/discriminator updates, transposed convolutions, BatchNorm in
+both train and inference modes, and custom per-network Trainers.
+
+Usage: python dcgan.py --steps 30 --batch-size 8 --image-size 32
+"""
+import argparse
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn, Trainer
+
+
+def build_generator(ngf, nc):
+    net = nn.Sequential(prefix="gen_")
+    with net.name_scope():
+        # z (B, nz, 1, 1) -> (B, nc, 32, 32)
+        net.add(nn.Conv2DTranspose(ngf * 4, 4, 1, 0, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2DTranspose(ngf * 2, 4, 2, 1, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2DTranspose(nc, 4, 2, 1, use_bias=False))
+        net.add(nn.Activation("tanh"))
+    return net
+
+
+def build_discriminator(ndf):
+    net = nn.Sequential(prefix="disc_")
+    with net.name_scope():
+        net.add(nn.Conv2D(ndf, 4, 2, 1, use_bias=False))
+        net.add(nn.LeakyReLU(0.2))
+        net.add(nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.LeakyReLU(0.2))
+        net.add(nn.Conv2D(ndf * 4, 4, 2, 1, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.LeakyReLU(0.2))
+        net.add(nn.Conv2D(1, 4, 1, 0, use_bias=False))
+        net.add(nn.Flatten())
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--nz", type=int, default=16)
+    ap.add_argument("--ngf", type=int, default=16)
+    ap.add_argument("--ndf", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    args = ap.parse_args()
+
+    assert args.image_size == 32, "this config generates 32x32"
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    nc = 1
+
+    gen = build_generator(args.ngf, nc)
+    disc = build_discriminator(args.ndf)
+    gen.collect_params().initialize(
+        mx.init.Normal(0.02), ctx=mx.current_context())
+    disc.collect_params().initialize(
+        mx.init.Normal(0.02), ctx=mx.current_context())
+    trainer_g = Trainer(gen.collect_params(), "adam",
+                        {"learning_rate": args.lr, "beta1": 0.5})
+    trainer_d = Trainer(disc.collect_params(), "adam",
+                        {"learning_rate": args.lr, "beta1": 0.5})
+    sce = mx.gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    # "real" data: blobs with structure (centered gaussians)
+    def real_batch():
+        yy, xx = np.mgrid[0:32, 0:32].astype(np.float32)
+        c = rng.uniform(8, 24, (args.batch_size, 2)).astype(np.float32)
+        img = np.exp(-(((xx - c[:, :1, None]) ** 2 +
+                        (yy - c[:, 1:, None]) ** 2) / 40.0))
+        return nd.array(img[:, None] * 2 - 1)
+
+    real_label = nd.ones((args.batch_size,))
+    fake_label = nd.zeros((args.batch_size,))
+    dl_hist, gl_hist = [], []
+    for step in range(args.steps):
+        z = nd.array(rng.randn(args.batch_size, args.nz, 1, 1)
+                     .astype(np.float32))
+        data = real_batch()
+        # -- discriminator: real up, fake down
+        with mx.autograd.record():
+            out_real = disc(data)
+            loss_real = sce(out_real, real_label)
+            fake = gen(z)
+            out_fake = disc(fake.detach())
+            loss_fake = sce(out_fake, fake_label)
+            loss_d = loss_real + loss_fake
+        loss_d.backward()
+        trainer_d.step(args.batch_size)
+        # -- generator: make fakes read as real
+        with mx.autograd.record():
+            fake = gen(z)
+            out = disc(fake)
+            loss_g = sce(out, real_label)
+        loss_g.backward()
+        trainer_g.step(args.batch_size)
+        dl_hist.append(float(loss_d.mean().asnumpy()))
+        gl_hist.append(float(loss_g.mean().asnumpy()))
+        if step % 10 == 0 or step == args.steps - 1:
+            print("step %d  loss_d %.4f  loss_g %.4f"
+                  % (step, dl_hist[-1], gl_hist[-1]))
+
+    sample = gen(nd.array(rng.randn(2, args.nz, 1, 1).astype(np.float32)))
+    print("generated sample shape", sample.shape)
+    assert sample.shape == (2, nc, 32, 32)
+    assert np.isfinite(dl_hist).all() and np.isfinite(gl_hist).all()
+    print("dcgan done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Custom numpy operator (reference example/numpy-ops/custom_softmax.py
+shape): a softmax output head written as a mx.operator.CustomOp — python
+forward/backward over numpy running inside the compiled graph via host
+callback — trained on a synthetic problem through the Module API.
+
+Usage: python custom_softmax.py --steps 60
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    # callbacks run on the HOST inside the compiled program: everything
+    # here is numpy (in_data/out_data are host views, .asnumpy() is free)
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], e / e.sum(axis=1, keepdims=True))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # SoftmaxOutput semantics: gradient is (p - onehot(label))
+        p = out_data[0].asnumpy().copy()
+        y = in_data[1].asnumpy().astype(int)
+        p[np.arange(y.shape[0]), y] -= 1.0
+        self.assign(in_grad[0], req[0], p / y.shape[0])
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 3).astype(np.float32)
+    X = rng.randn(args.batch_size * 4, 8).astype(np.float32)
+    Y = (X @ W).argmax(axis=1).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=3)
+    net = mx.sym.Custom(fc, label, op_type="numpy_softmax", name="softmax")
+
+    train_iter = mx.io.NDArrayIter(X, Y, args.batch_size, shuffle=True,
+                                   label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(train_iter, num_epoch=max(1, args.steps // 4),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 2))
+    score = mod.score(train_iter, mx.metric.Accuracy())
+    acc = dict(score)["accuracy"]
+    print("final train accuracy %.3f" % acc)
+    assert acc > 0.8, acc
+    print("custom numpy softmax done")
+
+
+if __name__ == "__main__":
+    main()

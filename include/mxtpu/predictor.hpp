@@ -1,0 +1,132 @@
+/*
+ * Header-only C++ wrapper over the C predict ABI (c_predict_api.h) —
+ * the analogue of the reference's cpp-package for the deployment path:
+ * RAII handle ownership, std::vector IO, exceptions instead of return
+ * codes.
+ *
+ *   mxtpu::Predictor pred(symbol_json, param_blob,
+ *                         {{"data", {1, 3, 224, 224}}});
+ *   pred.SetInput("data", pixels);
+ *   pred.Forward();
+ *   std::vector<float> probs = pred.GetOutput(0);
+ */
+#ifndef MXTPU_PREDICTOR_HPP_
+#define MXTPU_PREDICTOR_HPP_
+
+#include <cstdint>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_predict_api.h"
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+namespace detail {
+inline void check(int rc, const char *call) {
+  if (rc != 0) {
+    throw Error(std::string(call) + ": " + MXPredGetLastError());
+  }
+}
+}  // namespace detail
+
+class Predictor {
+ public:
+  using Shape = std::vector<mxt_uint>;
+  using NamedShapes = std::vector<std::pair<std::string, Shape>>;
+
+  enum DevType { kCPU = 1, kAccelerator = 2 };
+
+  Predictor(const std::string &symbol_json, const std::string &param_bytes,
+            const NamedShapes &input_shapes, int dev_type = kCPU,
+            int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mxt_uint> indptr{0};
+    std::vector<mxt_uint> data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mxt_uint>(data.size()));
+    }
+    detail::check(
+        MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                     static_cast<int>(param_bytes.size()), dev_type, dev_id,
+                     static_cast<mxt_uint>(keys.size()), keys.data(),
+                     indptr.data(), data.data(), &handle_),
+        "MXPredCreate");
+  }
+
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+
+  Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+
+  ~Predictor() {
+    if (handle_ != nullptr) MXPredFree(handle_);
+  }
+
+  void SetInput(const std::string &key, const std::vector<float> &values) {
+    detail::check(
+        MXPredSetInput(handle_, key.c_str(), values.data(),
+                       static_cast<mxt_uint>(values.size())),
+        "MXPredSetInput");
+  }
+
+  void Forward() { detail::check(MXPredForward(handle_), "MXPredForward"); }
+
+  Shape GetOutputShape(mxt_uint index = 0) const {
+    mxt_uint *dims = nullptr;
+    mxt_uint ndim = 0;
+    detail::check(MXPredGetOutputShape(handle_, index, &dims, &ndim),
+                  "MXPredGetOutputShape");
+    return Shape(dims, dims + ndim);
+  }
+
+  std::vector<float> GetOutput(mxt_uint index = 0) const {
+    Shape shape = GetOutputShape(index);
+    mxt_uint size = std::accumulate(shape.begin(), shape.end(), mxt_uint(1),
+                                    std::multiplies<mxt_uint>());
+    std::vector<float> out(size);
+    detail::check(MXPredGetOutput(handle_, index, out.data(), size),
+                  "MXPredGetOutput");
+    return out;
+  }
+
+ private:
+  explicit Predictor(PredictorHandle h) : handle_(h) {}
+
+ public:
+  /* A NEW predictor for new input shapes; this one stays usable. */
+  Predictor Reshape(const NamedShapes &input_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mxt_uint> indptr{0};
+    std::vector<mxt_uint> data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mxt_uint>(data.size()));
+    }
+    PredictorHandle out = nullptr;
+    detail::check(
+        MXPredReshape(static_cast<mxt_uint>(keys.size()), keys.data(),
+                      indptr.data(), data.data(), handle_, &out),
+        "MXPredReshape");
+    return Predictor(out);
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_PREDICTOR_HPP_

@@ -119,8 +119,17 @@ class _HostArray(object):
         return self._arr[idx]
 
     def __setitem__(self, idx, value):
-        self._arr[idx] = _np.asarray(
-            value._arr if isinstance(value, _HostArray) else value)
+        if isinstance(value, _HostArray):
+            value = value._arr
+        elif hasattr(value, "_data"):
+            # a device NDArray: np.asarray on it would re-enter JAX
+            # dispatch from inside the host callback and deadlock the
+            # executing program — fail loudly instead
+            raise MXNetError(
+                "CustomOp callbacks run on the host inside the compiled "
+                "program; write numpy arrays (use .asnumpy() values), "
+                "not device NDArrays")
+        self._arr[idx] = _np.asarray(value)
 
     def asnumpy(self):
         return self._arr

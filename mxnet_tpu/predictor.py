@@ -47,6 +47,7 @@ class Predictor:
             elif k.startswith("aux:"):
                 aux_params[k[4:]] = v
         self._input_names = list(input_shapes.keys())
+        self._type_dict = dict(type_dict) if type_dict else None
         self._exec = self._symbol.simple_bind(
             ctx, grad_req="null", type_dict=type_dict,
             **{k: tuple(v) for k, v in input_shapes.items()})
@@ -108,12 +109,22 @@ class Predictor:
         clone._ctx = self._ctx
         clone._symbol = self._symbol
         clone._input_names = list(input_shapes.keys())
+        clone._type_dict = self._type_dict
         clone._exec = self._symbol.simple_bind(self._ctx, grad_req="null",
+                                               type_dict=self._type_dict,
                                                **kwargs)
-        weights = {k: v for k, v in self._exec.arg_dict.items()
-                   if k not in input_shapes}
-        clone._exec.copy_params_from(weights, dict(self._exec.aux_dict),
-                                     allow_extra_params=True)
+        # weights transfer device-side, no host round-trip; jax buffers
+        # are immutable, so sharing them is safe — set_input/_set_data
+        # rebind pointers, never write through
+        for k, v in self._exec.arg_dict.items():
+            if k in input_shapes or k not in clone._exec.arg_dict:
+                continue
+            dst = clone._exec.arg_dict[k]
+            dst._set_data(v._data.astype(dst._data.dtype))
+        for k, v in self._exec.aux_dict.items():
+            if k in clone._exec.aux_dict:
+                dst = clone._exec.aux_dict[k]
+                dst._set_data(v._data.astype(dst._data.dtype))
         clone._outputs = None
         return clone
 

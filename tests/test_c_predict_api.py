@@ -240,6 +240,78 @@ int main(int argc, char **argv) {
 """
 
 
+CPP_DRIVER = r"""
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include "mxtpu/predictor.hpp"
+
+static std::string slurp(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char **argv) {
+  (void)argc;
+  mxtpu::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                        {{"data", {2, 5}}});
+  std::vector<float> x(10);
+  for (int i = 0; i < 10; ++i) x[i] = 0.1f * i - 0.5f;
+  pred.SetInput("data", x);
+  pred.Forward();
+  auto shape = pred.GetOutputShape(0);
+  if (shape != mxtpu::Predictor::Shape{2, 3}) return 1;
+  auto out = pred.GetOutput(0);
+  double total = 0;
+  for (float v : out) { std::cout << v << " "; total += v; }
+  std::cout << std::endl;
+
+  // Reshape: new handle at batch 4; old keeps working
+  auto big = pred.Reshape({{"data", {4, 5}}});
+  big.SetInput("data", std::vector<float>(20, 0.25f));
+  big.Forward();
+  if (big.GetOutputShape(0) != mxtpu::Predictor::Shape{4, 3}) return 1;
+  pred.Forward();
+
+  // error surfaces as an exception, not a crash
+  try {
+    pred.SetInput("nope", x);
+    return 1;
+  } catch (const mxtpu::Error &e) {
+    if (std::string(e.what()).find("nope") == std::string::npos) return 1;
+  }
+  return (total > 1.99 && total < 2.01) ? 0 : 1;
+}
+"""
+
+
+@pytest.mark.slow
+def test_cpp_package_wrapper(tmp_path):
+    """The cpp-package analogue: RAII C++ wrapper (predictor.hpp) over
+    the C ABI, compiled and run standalone."""
+    _build_lib()
+    prefix, _, _ = _save_checkpoint(tmp_path)
+    src = tmp_path / "driver.cpp"
+    src.write_text(CPP_DRIVER)
+    exe = tmp_path / "cppdriver"
+    r = subprocess.run(
+        ["g++", "-std=c++17", str(src), "-I", os.path.join(REPO, "include"),
+         "-L", os.path.dirname(LIB), "-lmxtpu_predict",
+         "-Wl,-rpath," + os.path.dirname(LIB), "-o", str(exe)],
+        capture_output=True)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_HOME"] = REPO
+    r = subprocess.run(
+        [str(exe), "%s-symbol.json" % prefix, "%s-0000.params" % prefix],
+        capture_output=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout.decode() + r.stderr.decode())[-800:]
+
+
 @pytest.mark.slow
 def test_c_predict_embedded_interpreter(tmp_path):
     """Compile a real C program against the ABI and run it standalone —

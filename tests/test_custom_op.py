@@ -197,3 +197,43 @@ def test_forward_backward_clears_split_residuals():
     np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), [[5.0, 5.0]])
     exe.backward([nd.ones((1, 1))])            # must recompute, not reuse
     np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), [[5.0, 5.0]])
+
+
+def test_device_ndarray_write_in_callback_raises():
+    """Writing a device NDArray inside a CustomOp callback would re-enter
+    JAX dispatch from the host callback and deadlock; it must raise a
+    clear error instead (operator.py:_HostArray.__setitem__)."""
+    import mxnet_tpu as mx
+    import numpy as np
+
+    class BadOp(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        mx.nd.array(np.ones(in_data[0].shape,
+                                            np.float32)))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            pass
+
+    @mx.operator.register("bad_device_write_op")
+    class BadOpProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return BadOp()
+
+    x = mx.nd.ones((2, 3))
+    try:
+        mx.nd.Custom(x, op_type="bad_device_write_op").asnumpy()
+    except Exception as e:
+        assert "numpy" in str(e) or "host" in str(e), e
+    else:
+        raise AssertionError("device write inside callback did not raise")

@@ -59,3 +59,15 @@ def test_model_parallel_lstm_smoke():
                 "15", "--num-hidden", "32", "--num-embed", "16",
                 "--seq-len", "8"])
     assert "MODEL PARALLEL LSTM OK" in out
+
+
+def test_dcgan_smoke():
+    out = _run(os.path.join(EX, "gan"),
+               ["dcgan.py", "--steps", "8", "--batch-size", "4"])
+    assert "dcgan done" in out
+
+
+def test_numpy_ops_custom_softmax():
+    out = _run(os.path.join(EX, "numpy-ops"),
+               ["custom_softmax.py", "--steps", "40"])
+    assert "custom numpy softmax done" in out

@@ -353,6 +353,58 @@ C("out_smooth_l1", "smooth_l1", [(D, (3, 4), "any")],
 C("out_softmax_cross_entropy", "softmax_cross_entropy",
   [(D, (3, 4), "any"), ("label", (3,), "int:4")], fixed=("label",))
 
+# -- odd shapes: singleton dims, batch-1, primes, reshape codes -------------
+C("odd_fc_batch1", "FullyConnected",
+  [(D, (1, 7), "any"), ("weight", (3, 7), "any")],
+  params={"num_hidden": 3, "no_bias": True})
+C("odd_conv_1x1", "Convolution",
+  [(D, (1, 3, 5, 5), "any"), ("weight", (2, 3, 1, 1), "any")],
+  params={"kernel": (1, 1), "num_filter": 2, "no_bias": True})
+C("odd_conv_rect_kernel", "Convolution",
+  [(D, (1, 2, 7, 5), "any"), ("weight", (3, 2, 5, 1), "any")],
+  params={"kernel": (5, 1), "num_filter": 3, "no_bias": True})
+C("odd_sum_size1", "sum", [(D, (1,), "any")])
+C("odd_softmax_len1", "softmax", [(D, (3, 1), "any")])
+C("odd_transpose_singletons", "transpose", [(D, (1, 5, 1), "any")],
+  params={"axes": (2, 1, 0)})
+C("odd_broadcast_both_sides", "broadcast_mul",
+  [("lhs", (1, 4, 1), "any"), ("rhs", (3, 1, 2), "any")])
+C("odd_concat_axis0", "Concat",
+  [("a0", (1, 3), "any"), ("a1", (4, 3), "any")],
+  params={"dim": 0, "num_args": 2})
+C("odd_pool_nonsquare", "Pooling", [(D, (1, 1, 7, 5), "any")],
+  params={"kernel": (3, 2), "stride": (2, 3), "pool_type": "max"})
+C("odd_prime_dot", "dot",
+  [("lhs", (7, 11), "any"), ("rhs", (11, 5), "any")])
+C("odd_batch_dot_b1", "batch_dot",
+  [("lhs", (1, 3, 4), "any"), ("rhs", (1, 4, 2), "any")])
+C("odd_reshape_code0", "Reshape", [(D, (2, 3, 4), "any")],
+  params={"shape": (0, -1)})
+C("odd_reshape_m2", "Reshape", [(D, (2, 3, 4), "any")],
+  params={"shape": (-2,)})
+C("odd_reshape_m3", "Reshape", [(D, (2, 3, 4), "any")],
+  params={"shape": (-3, 4)})
+C("odd_embedding_single", "Embedding",
+  [(D, (1, 1), "int:3"), ("weight", (3, 2), "any")],
+  params={"input_dim": 3, "output_dim": 2}, fixed=(D,))
+C("odd_tile_rank_up", "tile", [(D, (2,), "any")], params={"reps": (3, 2)})
+C("odd_expand_last", "expand_dims", [(D, (3,), "any")],
+  params={"axis": -1})
+C("odd_slice_axis_neg", "slice_axis", [(D, (4, 6), "any")],
+  params={"axis": -1, "begin": 2, "end": 5})
+C("odd_max_all_axes", "max", [(D, (2, 3, 4), "any")])
+C("odd_bn_batch1", "BatchNorm",
+  [(D, (1, 2, 3, 3), "any"), ("gamma", (2,), "pos"),
+   ("beta", (2,), "any")],
+  params={"fix_gamma": False, "use_global_stats": True}, rtol=5e-2,
+  atol=5e-4,
+  aux={"moving_mean": ((2,), "unit"), "moving_var": ((2,), "pos")})
+C("odd_deconv_odd_in", "Deconvolution",
+  [(D, (1, 2, 3, 5), "any"), ("weight", (2, 1, 3, 3), "any")],
+  params={"kernel": (3, 3), "num_filter": 1, "no_bias": True})
+C("odd_take_dup_indices", "take",
+  [("a", (4, 2), "any"), ("indices", (6,), "int:4")], fixed=("indices",))
+
 #: registry OpDefs with no finite-difference case, and why.  The
 #: completeness guard below fails when a newly-registered op appears in
 #: neither CASES nor this table.
@@ -507,55 +559,117 @@ def test_blockgrad_stops_gradient():
     np.testing.assert_array_equal(g.asnumpy(), np.zeros((3, 4)))
 
 
-# -- grad_req='add' accumulation through the executor -----------------------
-@pytest.mark.parametrize("op,params", [
-    ("tanh", {}), ("FullyConnected", {"num_hidden": 3}),
-])
-def test_grad_req_add(op, params):
+# -- generic executor run over a Case (for grad_req / dtype sweeps) ---------
+_CASE_BY_ID = {c.cid: c for c in CASES}
+
+
+def _run_case_executor(case, dtype, grad_req):
+    """Build the case's symbol and bind it at ``dtype``; returns
+    (executor, grads) with grads as live NDArrays — snapshot with
+    .asnumpy().copy() before re-running.  _fwd_bwd drives the actual
+    forward+backward passes."""
     from mxnet_tpu import nd
     r = rng(0)
-    x = r.uniform(-1, 1, (2, 4))
-    data = mx.sym.Variable("data")
-    if op == "FullyConnected":
-        w = mx.sym.Variable("weight")
-        sym = getattr(mx.sym, op)(data, w, no_bias=True, **params)
-        args = {"data": nd.array(x),
-                "weight": nd.array(r.uniform(-1, 1, (3, 4)))}
-    else:
-        sym = getattr(mx.sym, op)(data, **params)
-        args = {"data": nd.array(x)}
-    grads = {k: nd.zeros(v.shape) for k, v in args.items()}
-    exe = sym.bind(mx.cpu(), args=args, args_grad=grads, grad_req="add")
-    exe.forward(is_train=True)
-    exe.backward([nd.ones(o.shape) for o in exe.outputs])
+    syms = {name: mx.sym.Variable(name) for name, _, _ in case.inputs}
+    out = getattr(mx.sym, case.op)(
+        *[syms[n] for n, _, _ in case.inputs], **case.params)
+    args = {name: nd.array(_sample(domain, shape, r).astype(dtype),
+                           dtype=dtype)
+            for name, shape, domain in case.inputs}
+    grads = {name: nd.zeros(shape, dtype=dtype)
+             for name, shape, _ in case.inputs
+             if name not in case.fixed and name not in case.ignore}
+    req = {name: (grad_req if name in grads else "null")
+           for name, _, _ in case.inputs}
+    exe = out.bind(mx.cpu(), args=args, args_grad=grads, grad_req=req)
+    return exe, grads
+
+
+def _fwd_bwd(exe, dtype):
+    from mxnet_tpu import nd
+    outs = exe.forward(is_train=True)
+    exe.backward([nd.ones(o.shape, dtype=dtype) for o in outs])
+    return [o.asnumpy() for o in outs]
+
+
+#: representative cross-section for the accumulation sweep (no-aux cases)
+ADD_REQ_IDS = [
+    "unary_tanh", "unary_exp", "bin_elemwise_mul", "bc_broadcast_add",
+    "bin_dot", "bin_batch_dot", "scalar__mul_scalar", "red_sum",
+    "red_mean_ax", "shape_transpose", "shape_reshape", "shape_slice",
+    "shape_take", "shape_concat", "shape_SliceChannel", "nn_fc",
+    "nn_conv2d", "nn_deconv2d", "nn_pool_max", "nn_pool_avg",
+    "nn_act_relu", "nn_leaky", "nn_softmax", "nn_log_softmax",
+    "nn_L2Norm", "nn_LRN", "seq_SequenceReverse", "la_gemm2",
+    "sp_BilinearSampler", "odd_conv_1x1", "odd_broadcast_both_sides",
+]
+
+
+@pytest.mark.parametrize("cid", ADD_REQ_IDS)
+def test_grad_req_add_sweep(cid):
+    """grad_req='add' (the reference kAddTo): running fwd+bwd twice must
+    exactly double every accumulated gradient."""
+    case = _CASE_BY_ID[cid]
+    exe, grads = _run_case_executor(case, np.float32, "add")
+    _fwd_bwd(exe, np.float32)
     g1 = {k: v.asnumpy().copy() for k, v in grads.items()}
-    exe.forward(is_train=True)
-    exe.backward([nd.ones(o.shape) for o in exe.outputs])
+    _fwd_bwd(exe, np.float32)
+    assert grads, cid
     for k in grads:
         np.testing.assert_allclose(grads[k].asnumpy(), 2 * g1[k],
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
 
 
-# -- dtype coverage: float32 forward/backward consistency vs float64 --------
-@pytest.mark.parametrize("op,domain", [
-    ("tanh", "any"), ("exp", "any"), ("sqrt", "pos"), ("sigmoid", "any"),
-    ("softmax", "any"),
-])
-def test_dtype_consistency(op, domain):
-    from mxnet_tpu import nd
-    r = rng(0)
-    x = _sample(domain, (3, 4), r)
-    data = mx.sym.Variable("data")
-    sym = getattr(mx.sym, op)(data)
-    outs = {}
+#: cross-section for dtype consistency: f32 fwd/bwd tracks f64
+DTYPE_IDS = [
+    "unary_tanh", "unary_exp", "unary_sqrt", "unary_sigmoid",
+    "nn_softmax", "nn_log_softmax", "bin_dot", "nn_fc", "nn_conv2d",
+    "nn_pool_avg", "red_sum", "red_norm", "bc_broadcast_mul",
+    "la_gemm2", "shape_clip",
+]
+
+
+@pytest.mark.parametrize("cid", DTYPE_IDS)
+def test_dtype_consistency(cid):
+    case = _CASE_BY_ID[cid]
+    results = {}
     for dt in (np.float32, np.float64):
-        args = {"data": nd.array(x.astype(dt), dtype=dt)}
-        grads = {"data": nd.zeros((3, 4), dtype=dt)}
-        exe = sym.bind(mx.cpu(), args=args, args_grad=grads)
-        exe.forward(is_train=True)
-        exe.backward([nd.ones(o.shape, dtype=dt) for o in exe.outputs])
-        outs[dt] = (exe.outputs[0].asnumpy(), grads["data"].asnumpy())
-    np.testing.assert_allclose(outs[np.float32][0], outs[np.float64][0],
-                               rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(outs[np.float32][1], outs[np.float64][1],
-                               rtol=1e-5, atol=1e-6)
+        exe, grads = _run_case_executor(case, dt, "write")
+        outs = _fwd_bwd(exe, dt)
+        results[dt] = (outs, {k: v.asnumpy() for k, v in grads.items()})
+    for o32, o64 in zip(results[np.float32][0], results[np.float64][0]):
+        np.testing.assert_allclose(o32, o64, rtol=1e-4, atol=1e-5)
+    for k in results[np.float32][1]:
+        np.testing.assert_allclose(results[np.float32][1][k],
+                                   results[np.float64][1][k],
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+#: half-precision forward sanity: bf16/f16 track f32 within half-precision
+#: tolerance (the bench trains bf16; ops must not silently upcast-crash)
+HALF_IDS = ["unary_tanh", "nn_softmax", "bin_dot", "nn_fc", "nn_conv2d",
+            "red_sum", "bc_broadcast_mul"]
+
+
+@pytest.mark.parametrize("cid", HALF_IDS)
+@pytest.mark.parametrize("half", ["float16", "bfloat16"])
+def test_half_precision_forward(cid, half):
+    import jax.numpy as jnp
+    from mxnet_tpu import nd
+    case = _CASE_BY_ID[cid]
+    r = rng(0)
+    syms = {name: mx.sym.Variable(name) for name, _, _ in case.inputs}
+    out = getattr(mx.sym, case.op)(
+        *[syms[n] for n, _, _ in case.inputs], **case.params)
+    loc64 = {name: _sample(domain, shape, r)
+             for name, shape, domain in case.inputs}
+    dt = jnp.bfloat16 if half == "bfloat16" else np.float16
+    outs = {}
+    for tag, cast in (("half", dt), ("f32", np.float32)):
+        args = {k: nd.NDArray(jnp.asarray(v).astype(cast))
+                for k, v in loc64.items()}
+        exe = out.bind(mx.cpu(), args=args, grad_req="null")
+        outs[tag] = np.asarray(exe.forward(is_train=False)[0]._data,
+                               dtype=np.float32)
+    np.testing.assert_allclose(outs["half"], outs["f32"], rtol=5e-2,
+                               atol=5e-2)
