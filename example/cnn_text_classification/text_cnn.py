@@ -1,0 +1,97 @@
+"""CNN for sentence classification (reference
+example/cnn_text_classification/text_cnn.py shape — the Kim-2014
+architecture): embedding -> parallel convolutions of widths 3/4/5 over
+the token axis -> max-over-time pooling -> concat -> dropout -> softmax.
+Trained on a synthetic keyword-detection task through the Module API.
+
+Usage: python text_cnn.py --num-epochs 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_symbol(vocab_size, num_embed, seq_len, filter_sizes, num_filter,
+                 num_classes, dropout):
+    data = mx.sym.Variable("data")            # (B, seq_len) token ids
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    # (B, 1, seq_len, num_embed): the token axis is the conv height
+    conv_in = mx.sym.Reshape(embed, shape=(0, 1, seq_len, num_embed))
+    pooled = []
+    for fs in filter_sizes:
+        conv = mx.sym.Convolution(conv_in, kernel=(fs, num_embed),
+                                  num_filter=num_filter,
+                                  name="conv%d" % fs)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pool = mx.sym.Pooling(act, pool_type="max",
+                              kernel=(seq_len - fs + 1, 1))
+        pooled.append(pool)
+    concat = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Reshape(concat,
+                       shape=(0, num_filter * len(filter_sizes)))
+    if dropout > 0:
+        h = mx.sym.Dropout(h, p=dropout)
+    fc = mx.sym.FullyConnected(h, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(fc, label, name="softmax")
+
+
+def synthetic_sentences(n, vocab_size, seq_len, num_classes, rng):
+    """Label = which of the class-keyword tokens appears in the
+    sentence (token k is the keyword for class k)."""
+    X = rng.randint(num_classes, vocab_size, size=(n, seq_len))
+    y = rng.randint(0, num_classes, size=n)
+    pos = rng.randint(0, seq_len, size=n)
+    X[np.arange(n), pos] = y          # plant the keyword
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=20)
+    ap.add_argument("--vocab-size", type=int, default=100)
+    ap.add_argument("--num-embed", type=int, default=16)
+    ap.add_argument("--num-filter", type=int, default=8)
+    ap.add_argument("--num-classes", type=int, default=4)
+    ap.add_argument("--dropout", type=float, default=0.25)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, y = synthetic_sentences(1024, args.vocab_size, args.seq_len,
+                               args.num_classes, rng)
+    Xv, yv = synthetic_sentences(256, args.vocab_size, args.seq_len,
+                                 args.num_classes, rng)
+
+    sym = build_symbol(args.vocab_size, args.num_embed, args.seq_len,
+                       (3, 4, 5), args.num_filter, args.num_classes,
+                       args.dropout)
+    train = mx.io.NDArrayIter(X, y, args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(Xv, yv, args.batch_size,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(sym, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       16))
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    print("validation accuracy %.3f" % acc)
+    assert acc > 0.6, acc
+    print("text cnn done")
+
+
+if __name__ == "__main__":
+    main()

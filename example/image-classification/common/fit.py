@@ -108,12 +108,19 @@ def add_fit_args(parser):
     train.add_argument("--monitor", dest="monitor", type=int, default=0,
                        help="log network parameters every N iters if "
                        "larger than 0")
+    train.add_argument("--gc-type", type=str, default="none",
+                       help="gradient compression: none or 2bit")
+    train.add_argument("--gc-threshold", type=float, default=0.5,
+                       help="2bit gradient compression threshold")
     return train
 
 
 def fit(args, network, data_loader, **kwargs):
     """Train `network` on `data_loader(args, kv)` (reference fit.py:214)."""
     kv = mx.kv.create(args.kv_store)
+    if getattr(args, "gc_type", "none") != "none":
+        kv.set_gradient_compression({"type": args.gc_type,
+                                     "threshold": args.gc_threshold})
     head = "%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s"
     logging.basicConfig(level=logging.DEBUG, format=head)
     logging.info("start with arguments %s", args)

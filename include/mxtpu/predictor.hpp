@@ -48,13 +48,9 @@ class Predictor {
             const NamedShapes &input_shapes, int dev_type = kCPU,
             int dev_id = 0) {
     std::vector<const char *> keys;
-    std::vector<mxt_uint> indptr{0};
+    std::vector<mxt_uint> indptr;
     std::vector<mxt_uint> data;
-    for (const auto &kv : input_shapes) {
-      keys.push_back(kv.first.c_str());
-      data.insert(data.end(), kv.second.begin(), kv.second.end());
-      indptr.push_back(static_cast<mxt_uint>(data.size()));
-    }
+    PackShapes(input_shapes, &keys, &indptr, &data);
     detail::check(
         MXPredCreate(symbol_json.c_str(), param_bytes.data(),
                      static_cast<int>(param_bytes.size()), dev_type, dev_id,
@@ -108,13 +104,9 @@ class Predictor {
   /* A NEW predictor for new input shapes; this one stays usable. */
   Predictor Reshape(const NamedShapes &input_shapes) const {
     std::vector<const char *> keys;
-    std::vector<mxt_uint> indptr{0};
+    std::vector<mxt_uint> indptr;
     std::vector<mxt_uint> data;
-    for (const auto &kv : input_shapes) {
-      keys.push_back(kv.first.c_str());
-      data.insert(data.end(), kv.second.begin(), kv.second.end());
-      indptr.push_back(static_cast<mxt_uint>(data.size()));
-    }
+    PackShapes(input_shapes, &keys, &indptr, &data);
     PredictorHandle out = nullptr;
     detail::check(
         MXPredReshape(static_cast<mxt_uint>(keys.size()), keys.data(),
@@ -124,6 +116,20 @@ class Predictor {
   }
 
  private:
+  /* NamedShapes -> the C ABI's (keys, CSR indptr, flat dims) triple.
+   * The key c_str pointers borrow from input_shapes — keep it alive. */
+  static void PackShapes(const NamedShapes &input_shapes,
+                         std::vector<const char *> *keys,
+                         std::vector<mxt_uint> *indptr,
+                         std::vector<mxt_uint> *data) {
+    indptr->push_back(0);
+    for (const auto &kv : input_shapes) {
+      keys->push_back(kv.first.c_str());
+      data->insert(data->end(), kv.second.begin(), kv.second.end());
+      indptr->push_back(static_cast<mxt_uint>(data->size()));
+    }
+  }
+
   PredictorHandle handle_ = nullptr;
 };
 

@@ -72,10 +72,20 @@ _register("BENCH_DTYPE", "", str,
           "bench.py: bfloat16|float32 (default bfloat16 on TPU).")
 _register("BENCH_MODE", "", str,
           "bench.py: '' = ResNet-50 throughput; 'attention' = flash "
-          "attention TFLOP/s micro-benchmark.")
+          "attention TFLOP/s micro-benchmark; 'pipeline' = native input "
+          "pipeline img/s.")
 _register("BENCH_COST_ANALYSIS", 0, int,
           "bench.py: 1 = FLOPs from XLA cost analysis (slow AOT compile "
           "through the axon tunnel) instead of the analytic count.")
+_register("BENCH_INIT_TIMEOUT", 600, float,
+          "bench.py: seconds before a hung backend init is reported and "
+          "the process exits nonzero (0 disables the watchdog).")
+_register("BENCH_PIPE_THREADS", 8, int,
+          "bench.py pipeline mode: decode/augment thread-pool size.")
+_register("BENCH_PIPE_IMAGES", 2000, int,
+          "bench.py pipeline mode: synthetic .rec image count.")
+_register("BENCH_PIPE_EPOCHS", 3, int,
+          "bench.py pipeline mode: timed epochs over the .rec.")
 
 #: reference knobs with no counterpart here, and where the concern went.
 #: (docs/how_to/env_var.md names; listed so migrating users can grep.)

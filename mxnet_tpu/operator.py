@@ -42,7 +42,8 @@ class CustomOp(object):
         (reference operator.py:450)."""
         if req == "null":
             return
-        elif req in ("write", "inplace"):
+        _reject_device_value(src)  # before any arithmetic coerces it
+        if req in ("write", "inplace"):
             dst[:] = src
         elif req == "add":
             dst[:] = dst[:] + src  # noqa: E203 — NDArray in-place add
@@ -105,6 +106,17 @@ def get_entry(op_type):
     return prop_cls
 
 
+def _reject_device_value(value):
+    """Device NDArrays must never enter host-callback arithmetic: numpy
+    would coerce them element-by-element, re-entering JAX dispatch from
+    inside the executing program and deadlocking it."""
+    if hasattr(value, "_data") and not isinstance(value, _HostArray):
+        raise MXNetError(
+            "CustomOp callbacks run on the host inside the compiled "
+            "program; write numpy arrays (use .asnumpy() values), "
+            "not device NDArrays")
+
+
 class _HostArray(object):
     """Tiny NDArray-alike handed to CustomOp callbacks: supports
     [:] read/write, asnumpy, shape/dtype — enough for the reference's
@@ -121,14 +133,8 @@ class _HostArray(object):
     def __setitem__(self, idx, value):
         if isinstance(value, _HostArray):
             value = value._arr
-        elif hasattr(value, "_data"):
-            # a device NDArray: np.asarray on it would re-enter JAX
-            # dispatch from inside the host callback and deadlock the
-            # executing program — fail loudly instead
-            raise MXNetError(
-                "CustomOp callbacks run on the host inside the compiled "
-                "program; write numpy arrays (use .asnumpy() values), "
-                "not device NDArrays")
+        else:
+            _reject_device_value(value)
         self._arr[idx] = _np.asarray(value)
 
     def asnumpy(self):

@@ -206,9 +206,14 @@ def test_device_ndarray_write_in_callback_raises():
     import mxnet_tpu as mx
     import numpy as np
 
+    import os
+
     class BadOp(mx.operator.CustomOp):
         def forward(self, is_train, req, in_data, out_data, aux):
-            self.assign(out_data[0], req[0],
+            # 'add' mode exercises the assign arithmetic path, which
+            # must reject the device array BEFORE numpy coerces it
+            mode = os.environ.get("BAD_OP_REQ", "write")
+            self.assign(out_data[0], mode,
                         mx.nd.array(np.ones(in_data[0].shape,
                                             np.float32)))
 
@@ -230,10 +235,16 @@ def test_device_ndarray_write_in_callback_raises():
         def create_operator(self, ctx, shapes, dtypes):
             return BadOp()
 
+    import os
     x = mx.nd.ones((2, 3))
-    try:
-        mx.nd.Custom(x, op_type="bad_device_write_op").asnumpy()
-    except Exception as e:
-        assert "numpy" in str(e) or "host" in str(e), e
-    else:
-        raise AssertionError("device write inside callback did not raise")
+    for mode in ("write", "add"):
+        os.environ["BAD_OP_REQ"] = mode
+        try:
+            mx.nd.Custom(x, op_type="bad_device_write_op").asnumpy()
+        except Exception as e:
+            assert "numpy" in str(e) or "host" in str(e), (mode, e)
+        else:
+            raise AssertionError(
+                "device write inside callback did not raise (%s)" % mode)
+        finally:
+            os.environ.pop("BAD_OP_REQ", None)

@@ -61,6 +61,27 @@ def test_model_parallel_lstm_smoke():
     assert "MODEL PARALLEL LSTM OK" in out
 
 
+def test_train_mnist_gradient_compression():
+    out = _run(os.path.join(EX, "image-classification"),
+               ["train_mnist.py", "--num-epochs", "2", "--num-examples",
+                "1200", "--network", "mlp", "--data-dir", "/nonexistent",
+                "--gc-type", "2bit", "--gc-threshold", "0.002",
+                "--lr", "0.5"])
+    assert "Train-accuracy" in out
+    # compressed training still learns: last logged accuracy well above
+    # chance (10 classes)
+    import re
+    accs = [float(m) for m in
+            re.findall(r"Train-accuracy=([0-9.]+)", out)]
+    assert accs and accs[-1] > 0.3, accs
+
+
+def test_text_cnn_learns():
+    out = _run(os.path.join(EX, "cnn_text_classification"),
+               ["text_cnn.py", "--num-epochs", "2"])
+    assert "text cnn done" in out
+
+
 def test_dcgan_smoke():
     out = _run(os.path.join(EX, "gan"),
                ["dcgan.py", "--steps", "8", "--batch-size", "4"])
