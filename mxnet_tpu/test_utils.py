@@ -181,3 +181,62 @@ def check_consistency(fn, ctx_list=None, rtol=1e-4, atol=1e-5):
             results.append(_as_numpy(fn()))
     for r in results[1:]:
         np.testing.assert_allclose(results[0], r, rtol=rtol, atol=atol)
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None):
+    """Compare a symbol's forward outputs against expected arrays
+    (reference test_utils.py:744 signature)."""
+    from . import nd
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    args = {k: nd.array(_as_numpy(v)) for k, v in location.items()}
+    aux = {k: nd.array(_as_numpy(v))
+           for k, v in (aux_states or {}).items()} or None
+    exe = sym.bind(ctx, args=args, grad_req="null", aux_states=aux)
+    outs = exe.forward(is_train=False)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    assert len(expected) == len(outs), \
+        "expected %d outputs, symbol has %d" % (len(expected), len(outs))
+    for out, want in zip(outs, expected):
+        np.testing.assert_allclose(
+            out.asnumpy(), _as_numpy(want), rtol=rtol, atol=get_atol(atol))
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """Compare a symbol's backward input-gradients against expected
+    arrays (reference test_utils.py:809 signature)."""
+    from . import nd
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(arg_names, expected))
+    args = {k: nd.array(_as_numpy(v)) for k, v in location.items()}
+    grads = {k: nd.zeros(_as_numpy(v).shape) for k, v in location.items()}
+    aux = {k: nd.array(_as_numpy(v))
+           for k, v in (aux_states or {}).items()} or None
+    exe = sym.bind(ctx, args=args, args_grad=grads, grad_req=grad_req,
+                   aux_states=aux)
+    outs = exe.forward(is_train=True)
+    if out_grads is None:
+        ograds = [nd.ones(o.shape) for o in outs]
+    elif isinstance(out_grads, dict):
+        ograds = [nd.array(_as_numpy(out_grads[k]))
+                  for k in sym.list_outputs()]
+    else:
+        ograds = [nd.array(_as_numpy(g)) for g in out_grads]
+    exe.backward(ograds)
+    for name, want in expected.items():
+        np.testing.assert_allclose(
+            grads[name].asnumpy(), _as_numpy(want), rtol=rtol,
+            atol=get_atol(atol),
+            err_msg="backward mismatch for %s" % name)
+    return {k: v.asnumpy() for k, v in grads.items()}

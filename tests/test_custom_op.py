@@ -235,7 +235,6 @@ def test_device_ndarray_write_in_callback_raises():
         def create_operator(self, ctx, shapes, dtypes):
             return BadOp()
 
-    import os
     x = mx.nd.ones((2, 3))
     for mode in ("write", "add"):
         os.environ["BAD_OP_REQ"] = mode

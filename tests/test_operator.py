@@ -341,3 +341,22 @@ def test_lrn_l2norm_instancenorm():
     inorm = nd.InstanceNorm(nd.array(x), nd.ones((4,)), nd.zeros((4,)))
     np.testing.assert_allclose(inorm.asnumpy().mean(axis=(2, 3)),
                                np.zeros((2, 4)), atol=1e-5)
+
+
+def test_check_symbolic_helpers():
+    """check_symbolic_forward/backward (reference test_utils.py:744,809)
+    — the helpers downstream op tests are written against."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                      check_symbolic_backward)
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    sym = mx.sym.tanh(data)
+    check_symbolic_forward(sym, [x], [np.tanh(x)])
+    check_symbolic_backward(sym, [x], [np.ones_like(x)],
+                            [1 - np.tanh(x) ** 2], rtol=1e-5, atol=1e-6)
+    # dict-style location/expected and default out_grads
+    check_symbolic_backward(sym, {"data": x}, None,
+                            {"data": 1 - np.tanh(x) ** 2}, rtol=1e-5,
+                            atol=1e-6)

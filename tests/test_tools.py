@@ -91,3 +91,22 @@ def test_launch_local_spawns_workers(tmp_path):
     for rank in range(3):
         p = tmp_path / ("out_%d.txt" % rank)
         assert p.exists() and p.read_text() == "3"
+
+
+def test_ipynb2md(tmp_path):
+    import json
+    import subprocess
+    import sys
+    nb = {"cells": [
+        {"cell_type": "markdown", "source": ["# Title\n", "text"]},
+        {"cell_type": "code", "source": ["print(1+1)"],
+         "outputs": [{"text": ["2\n"]}]},
+    ], "nbformat": 4}
+    src = tmp_path / "nb.ipynb"
+    src.write_text(json.dumps(nb))
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "ipynb2md.py"),
+                        str(src)], capture_output=True)
+    assert r.returncode == 0, r.stderr.decode()
+    md = (tmp_path / "nb.md").read_text()
+    assert "# Title" in md and "```python" in md and "2" in md

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Convert a Jupyter notebook to markdown (reference tools/ipynb2md.py).
+
+Dependency-free: walks the .ipynb JSON directly — markdown cells pass
+through, code cells become fenced python blocks, text outputs become
+plain fenced blocks.
+
+Usage: python ipynb2md.py notebook.ipynb [-o notebook.md]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def convert(ipynb_path):
+    with open(ipynb_path) as f:
+        nb = json.load(f)
+    lines = []
+    for cell in nb.get("cells", []):
+        src = "".join(cell.get("source", []))
+        ctype = cell.get("cell_type")
+        if ctype == "markdown":
+            lines.append(src)
+        elif ctype == "code":
+            lines.append("```python\n%s\n```" % src.rstrip("\n"))
+            outs = []
+            for out in cell.get("outputs", []):
+                if "text" in out:
+                    outs.append("".join(out["text"]))
+                elif "data" in out and "text/plain" in out["data"]:
+                    outs.append("".join(out["data"]["text/plain"]))
+            if outs:
+                lines.append("```\n%s\n```" % "".join(outs).rstrip("\n"))
+    return "\n\n".join(lines) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("input")
+    ap.add_argument("-o", "--output")
+    args = ap.parse_args()
+    out = args.output or os.path.splitext(args.input)[0] + ".md"
+    md = convert(args.input)
+    with open(out, "w") as f:
+        f.write(md)
+    print("wrote %s (%d bytes)" % (out, len(md)))
+
+
+if __name__ == "__main__":
+    main()
