@@ -1,0 +1,101 @@
+"""Torch interop demo (reference example/torch/ shape, PyTorch era).
+
+Three flows from mxnet_tpu.plugin:
+1. a torch feature extractor as a Gluon block inside a mixed net,
+   trained end-to-end by a Gluon Trainer;
+2. a torch loss as the training criterion;
+3. converting a torch state dict into framework params and running the
+   equivalent Symbol net output-exact.
+
+Usage: python torch_interop.py --steps 80
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+try:
+    import torch
+except ImportError:
+    print("pytorch is not installed; torch interop demo skipped")
+    sys.exit(0)
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.plugin import TorchBlock, TorchCriterion, convert_torch_module
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=80)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    torch.manual_seed(0)
+    rng = np.random.RandomState(0)
+
+    # -- 1+2: hybrid net + torch criterion -----------------------------
+    tfeat = torch.nn.Sequential(torch.nn.Linear(6, 24), torch.nn.GELU())
+    net = mx.gluon.nn.Sequential()
+    with net.name_scope():
+        net.add(TorchBlock(tfeat))
+        net.add(mx.gluon.nn.Dense(3))
+    net.collect_params().initialize(ctx=mx.cpu())
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.02})
+    crit = TorchCriterion(torch.nn.CrossEntropyLoss())
+
+    W = rng.randn(6, 3).astype(np.float32)
+    X = rng.randn(args.batch_size * 8, 6).astype(np.float32)
+    Y = (X @ W).argmax(axis=1).astype(np.int32)
+
+    losses = []
+    for step in range(args.steps):
+        idx = rng.randint(0, X.shape[0], args.batch_size)
+        xb, yb = nd.array(X[idx]), nd.array(Y[idx], dtype=np.int32)
+        with mx.autograd.record():
+            logits = net(xb)
+            loss = crit(logits, yb)
+        loss.backward()
+        trainer.step(args.batch_size)
+        losses.append(float(loss.asnumpy()))
+        if step % 20 == 0 or step == args.steps - 1:
+            print("step %d  ce %.4f" % (step, losses[-1]))
+    pred = net(nd.array(X)).asnumpy().argmax(axis=1)
+    acc = (pred == Y).mean()
+    print("hybrid net train accuracy %.3f" % acc)
+    assert acc > 0.8, acc
+
+    # -- 3: state-dict conversion --------------------------------------
+    class TorchNet(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = torch.nn.Linear(6, 10)
+            self.fc2 = torch.nn.Linear(10, 3)
+
+        def forward(self, x):
+            return self.fc2(torch.tanh(self.fc1(x)))
+
+    tnet = TorchNet().eval()
+    arg_params, aux_params = convert_torch_module(tnet)
+    data = mx.sym.Variable("data")
+    y = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    y = mx.sym.Activation(y, act_type="tanh")
+    y = mx.sym.FullyConnected(y, name="fc2", num_hidden=3)
+    exe = y.simple_bind(mx.cpu(), grad_req="null", data=(4, 6))
+    exe.copy_params_from({k: nd.array(v) for k, v in arg_params.items()})
+    x = rng.randn(4, 6).astype(np.float32)
+    got = exe.forward(data=nd.array(x))[0].asnumpy()
+    with torch.no_grad():
+        want = tnet(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    print("state-dict conversion output-exact")
+    print("torch interop done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,17 @@
 """Flash attention as Pallas TPU kernels (fwd + bwd), with custom VJP.
 
-Design (standard two-pass scheme, Dao et al.):
-- forward: grid over (batch·heads, q-blocks); each program streams K/V
-  blocks through VMEM with an online-softmax (m, l) accumulator — the
-  T×T score matrix never exists; saves out + logsumexp for backward.
-- backward: dq kernel (grid over q-blocks) and dk/dv kernel (grid over
-  k-blocks) recompute P = exp(S - lse) blockwise on the MXU.
+Design (standard two-pass scheme, Dao et al., TPU grid-streamed):
+- forward: grid (batch·heads, q-blocks, k-blocks) with the k axis as the
+  sequential innermost dimension — Pallas pipelines each K/V block
+  HBM→VMEM while the online-softmax (o, m, l) state lives in VMEM
+  scratch across the sweep.  VMEM use is O(block), independent of
+  sequence length (T=512k compiles the same program as T=4k); the T×T
+  score matrix never exists.  Saves out + logsumexp for backward.
+- backward: dq kernel (grid ..., q-blocks, k-blocks) and dk/dv kernel
+  (grid ..., k-blocks, q-blocks) recompute P = exp(S - lse) blockwise on
+  the MXU, accumulating into VMEM scratch the same way.
+- causal masking skips fully-masked blocks via pl.when on the grid
+  coordinates.
 
 All matmuls run with preferred_element_type=float32 (MXU accumulates in
 fp32 even for bf16 inputs).  Off-TPU the same kernels run under the
@@ -60,46 +66,59 @@ def _q_bounds_mask(q_off, bq, bk, tq):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k, q_off_base, tk_true):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc,
+                l_acc, *, scale, causal, tk_true):
+    """One (q-block, k-block) step; the k dimension is the grid's
+    innermost (sequential) axis, so K/V stream HBM->VMEM one block at a
+    time — VMEM use is O(block), independent of sequence length — while
+    the online-softmax state lives in VMEM scratch across the k sweep."""
     pl = _pl()
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
     bq = q_ref.shape[1]
-    d = q_ref.shape[2]
-    tk = k_ref.shape[1]
-    nk = pl.cdiv(tk, block_k)
+    bk = k_ref.shape[1]
+    q_off = qi * bq
+    k_off = ki * bk
 
-    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
-    q_off = q_off_base + qi * bq
+    @pl.when(ki == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
 
-    def body(step, carry):
-        o, m, l = carry
-        k = k_ref[0, pl.ds(step * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(step * block_k, block_k), :].astype(jnp.float32)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (bq, bk)
-        mask = _kv_bounds_mask(step * block_k, bq, block_k, tk_true)
+        mask = _kv_bounds_mask(k_off, bq, bk, tk_true)
         if causal:
-            mask &= _causal_mask(q_off, step * block_k, bq, block_k)
+            mask &= _causal_mask(q_off, k_off, bq, bk)
         s = jnp.where(mask, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        m_prev = m_acc[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
+        corr = jnp.exp(m_prev - m_new)
+        l_acc[...] = l_acc[...] * corr + p.sum(axis=-1, keepdims=True)
+        o_acc[...] = o_acc[...] * corr + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        o_new = o * corr + pv
-        return o_new, m_new, l_new
+        m_acc[...] = m_new
 
-    o0 = jnp.zeros((bq, v_ref.shape[2]), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    o, m, l = lax.fori_loop(0, nk, body, (o0, m0, l0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)  # (bq, 1)
+    if causal:
+        # blocks fully above the diagonal contribute nothing; skip them
+        pl.when(k_off <= q_off + bq - 1)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        l_safe = jnp.maximum(l_acc[...], 1e-30)
+        o_ref[0] = (o_acc[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_acc[...] + jnp.log(l_safe)
 
 
 def _pad_to(x, axis, mult):
@@ -133,29 +152,38 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
                               tk_true=tk)
 
 
+def _scratch(shape):
+    """VMEM scratch allocation (accumulators carried across the grid's
+    sequential innermost dimension)."""
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, jnp.float32)
+
+
 def _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k, tk_true):
     pl = _pl()
     bh, tq, d = q.shape
     tk = k.shape[1]
     dv = v.shape[2]
-    grid = (bh, pl.cdiv(tq, block_q))
+    grid = (bh, pl.cdiv(tq, block_q), pl.cdiv(tk, block_k))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k, q_off_base=0, tk_true=tk_true),
+                          tk_true=tk_true),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk, dv), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, dv), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, dv), q.dtype),
             jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
         ],
+        scratch_shapes=[_scratch((block_q, dv)), _scratch((block_q, 1)),
+                        _scratch((block_q, 1))],
         interpret=_use_interpret(),
     )(q, k, v)
     return out, lse
@@ -166,92 +194,113 @@ def _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k, tk_true):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale, causal, block_k, tk_true):
+                   dq_ref, dq_acc, *, scale, causal, tk_true):
+    """dq for one (q-block, k-block) grid step; K/V stream via the
+    sequential innermost grid axis, dq accumulates in VMEM scratch."""
     pl = _pl()
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
     bq = q_ref.shape[1]
-    tk = k_ref.shape[1]
-    nk = pl.cdiv(tk, block_k)
-
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]      # (bq, 1)
-    delta = delta_ref[0]  # (bq, 1)
+    bk = k_ref.shape[1]
     q_off = qi * bq
+    k_off = ki * bk
 
-    def body(step, dq):
-        k = k_ref[0, pl.ds(step * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(step * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]      # (bq, 1)
+        delta = delta_ref[0]  # (bq, 1)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        mask = _kv_bounds_mask(step * block_k, bq, block_k, tk_true)
+        mask = _kv_bounds_mask(k_off, bq, bk, tk_true)
         if causal:
-            mask &= _causal_mask(q_off, step * block_k, bq, block_k)
+            mask &= _causal_mask(q_off, k_off, bq, bk)
         s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dq_step = jax.lax.dot_general(
+        dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dq + dq_step * scale
+            preferred_element_type=jnp.float32) * scale
 
-    dq = lax.fori_loop(0, nk, body,
-                       jnp.zeros((bq, q_ref.shape[2]), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(k_off <= q_off + bq - 1)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, tq_true):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    tq_true):
+    """dk/dv for one (k-block, q-block) grid step; Q/dO/lse/delta stream
+    via the sequential innermost grid axis."""
     pl = _pl()
     ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
     bk = k_ref.shape[1]
-    tq = q_ref.shape[1]
-    nq = pl.cdiv(tq, block_q)
-
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    bq = q_ref.shape[1]
     k_off = ki * bk
+    q_off = qi * bq
 
-    def body(step, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(step * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(step * block_q, block_q), :].astype(
-            jnp.float32)
-        lse = lse_ref[0, pl.ds(step * block_q, block_q), :]
-        delta = delta_ref[0, pl.ds(step * block_q, block_q), :]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _accumulate():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bk)
-        # padded/clamped q rows (tq % block_q: pl.ds clamps, duplicating
-        # the tail rows) must contribute zero to dk/dv
-        mask = _q_bounds_mask(step * block_q, block_q, bk, tq_true)
+        # padded q rows (tq % block_q) must contribute zero to dk/dv
+        mask = _q_bounds_mask(q_off, bq, bk, tq_true)
         if causal:
-            mask &= _causal_mask(step * block_q, k_off, block_q, bk)
+            mask &= _causal_mask(q_off, k_off, bq, bk)
         s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)
         p = jnp.where(mask, p, 0.0)
         # dv += P^T @ dO
-        dv_step = jax.lax.dot_general(
+        dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)  # (bq, bk)
-        dk_step = jax.lax.dot_general(
+        dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk + dk_step * scale, dv + dv_step
+            preferred_element_type=jnp.float32) * scale
 
-    dk0 = jnp.zeros((bk, k_ref.shape[2]), jnp.float32)
-    dv0 = jnp.zeros((bk, v_ref.shape[2]), jnp.float32)
-    dk, dv = lax.fori_loop(0, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # a k-block sees only q rows at or below the diagonal
+        pl.when(q_off + bq - 1 >= k_off)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(res, g, scale, causal, block_q, block_k):
@@ -267,9 +316,9 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    # pad every pl.ds-streamed operand to its block multiple (clamped
-    # dynamic-slice starts would silently shift the window otherwise);
-    # kernels mask on the true lengths, outputs are sliced back
+    # pad every block-streamed operand to its block multiple (partial
+    # final blocks would read out of range otherwise); kernels mask on
+    # the true lengths, outputs are sliced back
     kp = _pad_to(k, 1, block_k)
     vp = _pad_to(v, 1, block_k)
     qp = _pad_to(q, 1, block_q)
@@ -281,41 +330,44 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, tk_true=tk),
-        grid=(bh, pl.cdiv(tq, block_q)),
+                          tk_true=tk),
+        grid=(bh, pl.cdiv(tq, block_q), tkp // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tkp, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tkp, dv_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, dv_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[_scratch((block_q, d))],
         interpret=_use_interpret(),
     )(q, kp, vp, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, tq_true=tq),
-        grid=(bh, pl.cdiv(tk, block_k)),
+                          tq_true=tq),
+        grid=(bh, pl.cdiv(tk, block_k), tqp // block_q),
         in_specs=[
-            pl.BlockSpec((1, tqp, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dv_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tqp, dv_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tqp, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tqp, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dv_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[_scratch((block_k, d)),
+                        _scratch((block_k, dv_dim))],
         interpret=_use_interpret(),
     )(qp, k, v, dop, lsep, deltap)
     return dq, dk, dv

@@ -88,6 +88,14 @@ def test_dcgan_smoke():
     assert "dcgan done" in out
 
 
+def test_torch_interop_example():
+    import pytest
+    pytest.importorskip("torch")
+    out = _run(os.path.join(EX, "torch"),
+               ["torch_interop.py", "--steps", "50"])
+    assert "torch interop done" in out
+
+
 def test_numpy_ops_custom_softmax():
     out = _run(os.path.join(EX, "numpy-ops"),
                ["custom_softmax.py", "--steps", "40"])
