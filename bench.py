@@ -330,12 +330,19 @@ def main():
             diff_params, aux_params, mom, x, y, jax.random.fold_in(key, i))
     np.asarray(loss)  # completion barrier (see module docstring)
 
+    # BENCH_PROFILE=<dir>: capture an xplane/trace of the timed loop for
+    # tensorboard / xprof analysis (the profiler story for perf work)
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     for i in range(steps):
         diff_params, aux_params, mom, loss = train_step(
             diff_params, aux_params, mom, x, y, jax.random.fold_in(key, i))
     np.asarray(loss)  # forces the whole donated-param chain
     dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     img_s = batch * steps / dt
     result = {

@@ -77,6 +77,9 @@ _register("BENCH_MODE", "", str,
 _register("BENCH_COST_ANALYSIS", 0, int,
           "bench.py: 1 = FLOPs from XLA cost analysis (slow AOT compile "
           "through the axon tunnel) instead of the analytic count.")
+_register("BENCH_PROFILE", "", str,
+          "bench.py: directory to write a jax.profiler trace of the "
+          "timed loop (tensorboard-compatible); empty disables.")
 _register("BENCH_INIT_TIMEOUT", 600, float,
           "bench.py: seconds before a hung backend init is reported and "
           "the process exits nonzero (0 disables the watchdog).")

@@ -183,17 +183,17 @@ def check_consistency(fn, ctx_list=None, rtol=1e-4, atol=1e-5):
         np.testing.assert_allclose(results[0], r, rtol=rtol, atol=atol)
 
 
-def _bind_location(sym, location, aux_states, ctx, with_grads,
-                   grad_req="write"):
+def _bind_location(sym, location, aux_states, ctx, grad_req):
     """Shared setup for check_symbolic_forward/backward: normalize the
-    location to a dict and build bound args/grads/aux."""
+    location to a dict and build bound args/grads/aux.  grad_req "null"
+    binds without gradient buffers."""
     from . import nd
     arg_names = sym.list_arguments()
     if isinstance(location, (list, tuple)):
         location = dict(zip(arg_names, location))
     args = {k: nd.array(_as_numpy(v)) for k, v in location.items()}
-    grads = {k: nd.zeros(_as_numpy(v).shape)
-             for k, v in location.items()} if with_grads else None
+    grads = None if grad_req == "null" else \
+        {k: nd.zeros(_as_numpy(v).shape) for k, v in location.items()}
     aux = {k: nd.array(_as_numpy(v))
            for k, v in (aux_states or {}).items()} or None
     exe = sym.bind(ctx, args=args, args_grad=grads, grad_req=grad_req,
@@ -206,8 +206,7 @@ def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
     """Compare a symbol's forward outputs against expected arrays
     (reference test_utils.py:744 signature)."""
     ctx = ctx or default_context()
-    exe, _ = _bind_location(sym, location, aux_states, ctx,
-                            with_grads=False, grad_req="null")
+    exe, _ = _bind_location(sym, location, aux_states, ctx, "null")
     outs = exe.forward(is_train=False)
     if isinstance(expected, dict):
         expected = [expected[k] for k in sym.list_outputs()]
@@ -228,8 +227,7 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
     ctx = ctx or default_context()
     if isinstance(expected, (list, tuple)):
         expected = dict(zip(sym.list_arguments(), expected))
-    exe, grads = _bind_location(sym, location, aux_states, ctx,
-                                with_grads=True, grad_req=grad_req)
+    exe, grads = _bind_location(sym, location, aux_states, ctx, grad_req)
     outs = exe.forward(is_train=True)
     if out_grads is None:
         ograds = [nd.ones(o.shape) for o in outs]

@@ -110,3 +110,14 @@ def test_ipynb2md(tmp_path):
     assert r.returncode == 0, r.stderr.decode()
     md = (tmp_path / "nb.md").read_text()
     assert "# Title" in md and "```python" in md and "2" in md
+
+
+def test_bandwidth_compressed_kvstore_mode():
+    sys.path.insert(0, os.path.join(REPO, "tools", "bandwidth"))
+    import measure
+    res = measure.measure_kvstore("device", size_mb=4.0, num_arrays=4,
+                                  iters=2, warmup=1, gc_type="2bit")
+    assert res["gc_type"] == "2bit"
+    # 4 MB of fp32 = 1e6 elements -> 0.25e6 bytes of 2-bit codes
+    assert res["wire_bytes_per_push"] == res["total_mb"] * 1e6 // 4 // 4
+    assert res["GBps"] > 0

@@ -73,14 +73,21 @@ def measure(num_devices=0, size_mb=256.0, num_arrays=30, iters=10,
 
 
 def measure_kvstore(kv_type="dist_sync", size_mb=64.0, num_arrays=10,
-                    iters=10, warmup=2, dtype="float32"):
+                    iters=10, warmup=2, dtype="float32",
+                    gc_type="none", gc_threshold=0.5):
     """Time KVStore push+pull per key batch — the user-facing path the
     reference README benchmarked (push grads, pull weights, ~11 GB/s on
-    2 GPUs).  Run under tools/launch.py -n 2 for the dist path."""
+    2 GPUs).  Run under tools/launch.py -n 2 for the dist path.
+    gc_type='2bit' measures the quantized push path (pull still moves
+    uncompressed weights; single-process stores quantize semantics only
+    — the wire numbers are meaningful for dist stores)."""
     import numpy as np
     import mxnet_tpu as mx
 
     kv = mx.kv.create(kv_type)
+    if gc_type != "none":
+        kv.set_gradient_compression({"type": gc_type,
+                                     "threshold": gc_threshold})
     itemsize = np.dtype(dtype).itemsize
     per_array = max(1, int(size_mb * 1e6 / num_arrays / itemsize))
     keys = [str(i) for i in range(num_arrays)]
@@ -104,10 +111,17 @@ def measure_kvstore(kv_type="dist_sync", size_mb=64.0, num_arrays=10,
         roundtrip()
         times.append(time.perf_counter() - t0)
     t = min(times)
-    return {"kv_type": kv_type, "workers": kv.num_workers,
-            "num_keys": num_arrays, "total_mb": total_bytes / 1e6,
-            "time_s": t, "GBps": total_bytes / t / 1e9,
-            "per_key_GBps": total_bytes / num_arrays / t / 1e9}
+    res = {"kv_type": kv_type, "workers": kv.num_workers,
+           "num_keys": num_arrays, "total_mb": total_bytes / 1e6,
+           "time_s": t, "GBps": total_bytes / t / 1e9,
+           "per_key_GBps": total_bytes / num_arrays / t / 1e9}
+    if gc_type != "none":
+        res["gc_type"] = gc_type
+        # the push wire carries 2-bit codes: one byte per 4 ELEMENTS,
+        # independent of the uncompressed dtype's width
+        n_elements = total_bytes // np.dtype(dtype).itemsize
+        res["wire_bytes_per_push"] = n_elements // 4
+    return res
 
 
 def main(argv=None):
@@ -134,16 +148,22 @@ def main(argv=None):
                         help="measure through the KVStore API instead of "
                         "the raw mesh psum (e.g. 'device', 'dist_sync'; "
                         "run dist under tools/launch.py -n 2)")
+    parser.add_argument("--gc-type", default="none",
+                        help="gradient compression for the KVStore path "
+                        "(none or 2bit)")
     args = parser.parse_args(argv)
     if args.kv_store:
         res = measure_kvstore(args.kv_store, args.size_mb,
                               args.num_arrays, args.iters,
-                              dtype=args.dtype)
+                              dtype=args.dtype, gc_type=args.gc_type)
+        extra = " gc=%s push-wire=%.1f MB" % (
+            res["gc_type"], res["wire_bytes_per_push"] / 1e6) \
+            if args.gc_type != "none" else ""
         print("kv=%s workers=%d keys=%d total=%.1f MB time=%.4f s "
-              "agg=%.2f GB/s per-key=%.3f GB/s"
+              "agg=%.2f GB/s per-key=%.3f GB/s%s"
               % (res["kv_type"], res["workers"], res["num_keys"],
                  res["total_mb"], res["time_s"], res["GBps"],
-                 res["per_key_GBps"]))
+                 res["per_key_GBps"], extra))
         return res
     res = measure(args.devices, args.size_mb, args.num_arrays, args.iters,
                   dtype=args.dtype)
