@@ -335,14 +335,17 @@ def main():
     profile_dir = os.environ.get("BENCH_PROFILE")
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        diff_params, aux_params, mom, loss = train_step(
-            diff_params, aux_params, mom, x, y, jax.random.fold_in(key, i))
-    np.asarray(loss)  # forces the whole donated-param chain
-    dt = time.perf_counter() - t0
-    if profile_dir:
-        jax.profiler.stop_trace()
+    try:
+        t0 = time.perf_counter()
+        for i in range(steps):
+            diff_params, aux_params, mom, loss = train_step(
+                diff_params, aux_params, mom, x, y,
+                jax.random.fold_in(key, i))
+        np.asarray(loss)  # forces the whole donated-param chain
+        dt = time.perf_counter() - t0
+    finally:
+        if profile_dir:
+            jax.profiler.stop_trace()  # flush even when a step dies
 
     img_s = batch * steps / dt
     result = {

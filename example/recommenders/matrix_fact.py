@@ -1,0 +1,80 @@
+"""Matrix factorization recommender (reference example/recommenders/
+demo shape): user/item embeddings -> dot product -> rating regression,
+trained with Module.fit on synthetic low-rank ratings.
+
+Usage: python matrix_fact.py --num-epochs 8
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_symbol(num_users, num_items, factor):
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score_label")
+    u = mx.sym.Embedding(user, input_dim=num_users, output_dim=factor,
+                         name="user_embed")
+    i = mx.sym.Embedding(item, input_dim=num_items, output_dim=factor,
+                         name="item_embed")
+    pred = mx.sym.sum(u * i, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, score, name="lro")
+
+
+def synthetic_ratings(num_users, num_items, factor, n, rng):
+    """Low-rank ground truth + noise."""
+    U = rng.randn(num_users, factor).astype(np.float32) * 0.7
+    V = rng.randn(num_items, factor).astype(np.float32) * 0.7
+    users = rng.randint(0, num_users, n)
+    items = rng.randint(0, num_items, n)
+    scores = (U[users] * V[items]).sum(1) + 0.05 * rng.randn(n)
+    return (users.astype(np.float32), items.astype(np.float32),
+            scores.astype(np.float32))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-users", type=int, default=200)
+    ap.add_argument("--num-items", type=int, default=150)
+    ap.add_argument("--factor", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    users, items, scores = synthetic_ratings(
+        args.num_users, args.num_items, args.factor, 6000, rng)
+
+    train = mx.io.NDArrayIter(
+        {"user": users[:5000], "item": items[:5000]},
+        {"score_label": scores[:5000]}, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(
+        {"user": users[5000:], "item": items[5000:]},
+        {"score_label": scores[5000:]}, args.batch_size)
+
+    sym = build_symbol(args.num_users, args.num_items, args.factor)
+    mod = mx.mod.Module(sym, data_names=["user", "item"],
+                        label_names=["score_label"])
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Normal(0.1), eval_metric="rmse",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       40))
+    rmse = dict(mod.score(val, mx.metric.RMSE()))["rmse"]
+    print("validation rmse %.4f" % rmse)
+    # rank-8 truth with 0.05 noise: scores have std ~1.4, so an unfit
+    # model sits at ~1.4 RMSE; the fitted factors land far below
+    assert rmse < 0.7, rmse
+    print("matrix factorization done")
+
+
+if __name__ == "__main__":
+    main()

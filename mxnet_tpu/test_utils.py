@@ -228,6 +228,7 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
     if isinstance(expected, (list, tuple)):
         expected = dict(zip(sym.list_arguments(), expected))
     exe, grads = _bind_location(sym, location, aux_states, ctx, grad_req)
+    grads = grads if grads is not None else {}
     outs = exe.forward(is_train=True)
     if out_grads is None:
         ograds = [nd.ones(o.shape) for o in outs]
@@ -238,6 +239,10 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
         ograds = [nd.array(_as_numpy(g)) for g in out_grads]
     exe.backward(ograds)
     for name, want in expected.items():
+        if name not in grads:
+            raise ValueError(
+                "no gradient bound for %r (grad_req=%r): cannot compare "
+                "an expected backward value" % (name, grad_req))
         np.testing.assert_allclose(
             grads[name].asnumpy(), _as_numpy(want), rtol=rtol,
             atol=get_atol(atol),

@@ -76,6 +76,12 @@ def test_train_mnist_gradient_compression():
     assert accs and accs[-1] > 0.3, accs
 
 
+def test_matrix_factorization_learns():
+    out = _run(os.path.join(EX, "recommenders"),
+               ["matrix_fact.py", "--num-epochs", "10"], timeout=420)
+    assert "matrix factorization done" in out
+
+
 def test_text_cnn_learns():
     out = _run(os.path.join(EX, "cnn_text_classification"),
                ["text_cnn.py", "--num-epochs", "2"])

@@ -118,6 +118,7 @@ def test_bandwidth_compressed_kvstore_mode():
     res = measure.measure_kvstore("device", size_mb=4.0, num_arrays=4,
                                   iters=2, warmup=1, gc_type="2bit")
     assert res["gc_type"] == "2bit"
-    # 4 MB of fp32 = 1e6 elements -> 0.25e6 bytes of 2-bit codes
-    assert res["wire_bytes_per_push"] == res["total_mb"] * 1e6 // 4 // 4
+    # 4 MB of fp32 over 4 keys = 250k elements/key -> ceil/4 bytes each
+    per_key = int(res["total_mb"] * 1e6 / 4 / 4)
+    assert res["wire_bytes_per_push"] == 4 * (-(-per_key // 4))
     assert res["GBps"] > 0

@@ -117,10 +117,10 @@ def measure_kvstore(kv_type="dist_sync", size_mb=64.0, num_arrays=10,
            "per_key_GBps": total_bytes / num_arrays / t / 1e9}
     if gc_type != "none":
         res["gc_type"] = gc_type
-        # the push wire carries 2-bit codes: one byte per 4 ELEMENTS,
-        # independent of the uncompressed dtype's width
-        n_elements = total_bytes // np.dtype(dtype).itemsize
-        res["wire_bytes_per_push"] = n_elements // 4
+        # the push wire carries 2-bit codes packed PER KEY: each key
+        # contributes ceil(elements/4) bytes, independent of the
+        # uncompressed dtype's width
+        res["wire_bytes_per_push"] = num_arrays * (-(-per_array // 4))
     return res
 
 
@@ -151,11 +151,14 @@ def main(argv=None):
     parser.add_argument("--gc-type", default="none",
                         help="gradient compression for the KVStore path "
                         "(none or 2bit)")
+    parser.add_argument("--gc-threshold", type=float, default=0.5,
+                        help="2bit compression threshold")
     args = parser.parse_args(argv)
     if args.kv_store:
         res = measure_kvstore(args.kv_store, args.size_mb,
                               args.num_arrays, args.iters,
-                              dtype=args.dtype, gc_type=args.gc_type)
+                              dtype=args.dtype, gc_type=args.gc_type,
+                              gc_threshold=args.gc_threshold)
         extra = " gc=%s push-wire=%.1f MB" % (
             res["gc_type"], res["wire_bytes_per_push"] / 1e6) \
             if args.gc_type != "none" else ""
