@@ -25,7 +25,21 @@ import sys
 import threading
 import time
 
-BASELINE_IMG_S = 109.0  # 1x K80, bs 32, reference README
+# per-network reference baselines (1x K80 img/s) and fwd GMACs at 224²
+# (299² for inception_v3) — reference example/image-classification/
+# README.md:147-157,357; GMACs are the standard published counts
+NETWORKS = {
+    "resnet18_v1": (185.0, 1.82),
+    "resnet34_v1": (172.0, 3.67),
+    "resnet50_v1": (109.0, 4.089),
+    "resnet101_v1": (78.0, 7.80),
+    "resnet152_v1": (57.0, 11.51),
+    "inception_v3": (30.0, 5.73),
+    "alexnet": (457.0, 0.71),
+    "vgg16": (None, 15.47),
+    "densenet121": (None, 2.83),
+    "squeezenet1_0": (None, 0.82),
+}
 
 _WATCHDOG_DONE = None  # set by _install_init_watchdog; modes disarm it
 
@@ -59,6 +73,12 @@ def _install_init_watchdog(metric="resnet50_train_images_per_sec",
     t.start()
     global _WATCHDOG_DONE
     _WATCHDOG_DONE = done
+
+
+def _network_metric(network):
+    """'resnet50_v1' -> 'resnet50_train_images_per_sec' (the name the
+    driver has tracked since round 1)."""
+    return "%s_train_images_per_sec" % network.split("_v")[0]
 
 
 def _disarm_watchdog():
@@ -236,10 +256,14 @@ def bench_pipeline():
 
 def main():
     mode = os.environ.get("BENCH_MODE")
+    network = os.environ.get("BENCH_NETWORK", "resnet50_v1")
+    if network not in NETWORKS:
+        raise ValueError("BENCH_NETWORK must be one of %s, got %r"
+                         % (sorted(NETWORKS), network))
     metric, unit = {
         "attention": ("flash_attention_train_tflops", "TFLOP/s"),
         "pipeline": ("input_pipeline_images_per_sec", "img/s"),
-    }.get(mode, ("resnet50_train_images_per_sec", "img/s"))
+    }.get(mode, (_network_metric(network), "img/s"))
     _install_init_watchdog(metric, unit)
     if mode == "attention":
         bench_attention()
@@ -252,7 +276,8 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))
-    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    default_image = "299" if network == "inception_v3" else "224"
+    image = int(os.environ.get("BENCH_IMAGE", default_image))
 
     import numpy as np
     import jax
@@ -267,7 +292,7 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.gluon.block import functionalize
 
-    net = vision.resnet50_v1()
+    net = getattr(vision, network)(classes=1000)
     net.initialize()
     x0 = jnp.zeros((batch, 3, image, image), jnp.float32)
     fn, params = functionalize(net, x0, train=True)
@@ -323,7 +348,9 @@ def main():
         ca = ca[0] if isinstance(ca, list) else ca
         step_flops = float(ca.get("flops", 0.0)) or None
     else:
-        step_flops = 3 * 2 * 4.089e9 * batch * (image / 224.0) ** 2
+        base_image = 299.0 if network == "inception_v3" else 224.0
+        gmacs = NETWORKS[network][1]
+        step_flops = 3 * 2 * gmacs * 1e9 * batch * (image / base_image) ** 2
 
     for i in range(warmup):
         diff_params, aux_params, mom, loss = train_step(
@@ -348,12 +375,15 @@ def main():
             jax.profiler.stop_trace()  # flush even when a step dies
 
     img_s = batch * steps / dt
+    baseline = NETWORKS[network][0]
     result = {
-        "metric": "resnet50_train_images_per_sec",
+        "metric": _network_metric(network),
         "value": round(img_s, 2),
         "unit": "img/s (bs %d, %dx%d, %s, 1 %s device)" % (
             batch, image, image, bench_dtype, platform),
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        # null (not 0.0 — the watchdog's failure sentinel) when the
+        # reference README published no number for this network
+        "vs_baseline": round(img_s / baseline, 3) if baseline else None,
     }
     if step_flops:
         tflops = step_flops * steps / dt / 1e12

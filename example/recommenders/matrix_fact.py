@@ -72,9 +72,9 @@ def main():
                                                        40))
     rmse = dict(mod.score(val, mx.metric.RMSE()))["rmse"]
     print("validation rmse %.4f" % rmse)
-    # rank-8 truth with 0.05 noise: scores have std ~1.4, so an unfit
-    # model sits at ~1.4 RMSE; the fitted factors land far below
-    assert rmse < 0.9, rmse
+    # rank-8 truth with 0.05 noise: scores have std ~1.4, an unfit
+    # model sits there; the seeded 10-epoch default lands at ~0.64
+    assert rmse < 0.75, rmse
     print("matrix factorization done")
 
 

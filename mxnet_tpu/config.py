@@ -67,16 +67,22 @@ _register("MXTPU_CHECKPOINT_FORMAT", "binary", str,
 _register("BENCH_BATCH", 128, int, "bench.py: per-step batch size.")
 _register("BENCH_STEPS", 20, int, "bench.py: timed steps.")
 _register("BENCH_WARMUP", 3, int, "bench.py: warmup steps.")
-_register("BENCH_IMAGE", 224, int, "bench.py: image edge length.")
+_register("BENCH_IMAGE", 224, int,
+          "bench.py: image edge length (default 299 for inception_v3).")
 _register("BENCH_DTYPE", "", str,
           "bench.py: bfloat16|float32 (default bfloat16 on TPU).")
 _register("BENCH_MODE", "", str,
-          "bench.py: '' = ResNet-50 throughput; 'attention' = flash "
-          "attention TFLOP/s micro-benchmark; 'pipeline' = native input "
-          "pipeline img/s.")
+          "bench.py: '' = model-zoo training throughput (BENCH_NETWORK "
+          "selects the net); 'attention' = flash attention TFLOP/s "
+          "micro-benchmark; 'pipeline' = native input pipeline img/s.")
 _register("BENCH_COST_ANALYSIS", 0, int,
           "bench.py: 1 = FLOPs from XLA cost analysis (slow AOT compile "
           "through the axon tunnel) instead of the analytic count.")
+_register("BENCH_NETWORK", "resnet50_v1", str,
+          "bench.py: model_zoo network to train (resnet18/34/50/101/"
+          "152_v1, inception_v3, alexnet, vgg16, densenet121, "
+          "squeezenet1_0); per-network K80 baselines from the reference "
+          "README drive vs_baseline.")
 _register("BENCH_PROFILE", "", str,
           "bench.py: directory to write a jax.profiler trace of the "
           "timed loop (tensorboard-compatible); empty disables.")
