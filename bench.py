@@ -1,10 +1,11 @@
-"""Headline benchmark: ResNet-50 training throughput (img/s) on one chip.
+"""Headline benchmark: model-zoo training throughput (img/s) on one chip.
 
 Baseline (BASELINE.md): MXNet v0.11 ResNet-50 ImageNet at batch 32 on one
-K80 = 109 img/s (/root/reference/example/image-classification/README.md:147-157).
-Here: the same model family (gluon model_zoo ResNet-50 v1) compiled to one
-XLA program — forward, softmax-CE loss, backward, SGD+momentum update —
-per step, images 224x224x3.
+K80 = 109 img/s (/root/reference/example/image-classification/README.md:147-157);
+the NETWORKS table below carries every per-family K80 row from that README.
+Default: gluon model_zoo ResNet-50 v1 compiled to one XLA program —
+forward, softmax-CE loss, backward, SGD+momentum update — per step,
+images 224x224x3.  BENCH_NETWORK selects any other family.
 
 Timing methodology (round 3): the axon TPU tunnel's `block_until_ready`
 returns before device completion, so a device→host fetch of the final
