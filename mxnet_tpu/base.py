@@ -54,12 +54,23 @@ def _maybe_init_distributed():
             return
     except AttributeError:
         pass
+    if os.environ.get("MXTPU_RANK_FROM_MPI") == "1" and \
+            "MXTPU_WORKER_RANK" not in os.environ:
+        # mpi launcher (tools/launch.py --launcher mpi): adopt the rank
+        # mpirun assigned this process (and fill the reference-compat
+        # DMLC_WORKER_ID alongside, like the local/ssh launchers do)
+        for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "PMIX_RANK",
+                    "SLURM_PROCID"):
+            if var in os.environ:
+                os.environ["MXTPU_WORKER_RANK"] = os.environ[var]
+                os.environ.setdefault("DMLC_WORKER_ID", os.environ[var])
+                break
     try:
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(os.environ["MXTPU_NUM_WORKERS"]),
             process_id=int(os.environ["MXTPU_WORKER_RANK"]))
-    except RuntimeError as e:
+    except (RuntimeError, KeyError) as e:
         import logging
         logging.warning(
             "mxnet_tpu: could not join the distributed mesh at %s (%s); "

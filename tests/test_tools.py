@@ -122,3 +122,54 @@ def test_bandwidth_compressed_kvstore_mode():
     per_key = int(res["total_mb"] * 1e6 / 4 / 4)
     assert res["wire_bytes_per_push"] == 4 * (-(-per_key // 4))
     assert res["GBps"] > 0
+
+
+def test_launch_dry_run_ssh_and_mpi(tmp_path):
+    """--dry-run prints the exact remote commands (reference launch.py's
+    ssh/mpi tracker modes) without spawning anything."""
+    import subprocess
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("nodeA\nnodeB\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "ssh", "-H", str(hostfile),
+         "--dry-run", "--port", "39999", "python", "train.py"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-500:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("ssh")]
+    assert len(lines) == 2
+    assert "nodeA" in lines[0] and "nodeB" in lines[1]
+    assert "MXTPU_WORKER_RANK=0" in lines[0]
+    assert "MXTPU_WORKER_RANK=1" in lines[1]
+    assert "MXTPU_NUM_WORKERS=2" in lines[0]
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "--launcher", "mpi", "-H", str(hostfile),
+         "--dry-run", "--port", "39999", "python", "train.py"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-500:]
+    out = r.stdout.strip()
+    assert out.startswith("mpirun -np 4")
+    assert "MXTPU_RANK_FROM_MPI=1" in out and "train.py" in out
+
+
+def test_launch_dry_run_local_and_mpi_coordinator(tmp_path):
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "3", "--dry-run", "--port", "39998", "python", "t.py"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-400:]
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) == 3 and all("127.0.0.1:39998" in l for l in lines)
+    # mpi coordinator lives on the FIRST hostfile host (where rank 0 runs)
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("nodeX slots=4\nnodeY slots=4\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "8", "--launcher", "mpi", "-H", str(hostfile),
+         "--dry-run", "--port", "39998", "python", "t.py"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-400:]
+    assert "MXTPU_COORDINATOR=nodeX:39998" in r.stdout

@@ -101,6 +101,17 @@ def _run_local_once(args, cmd, attempt):
 
 
 def launch_local(args, cmd):
+    if args.dry_run:
+        port = args.port or _free_port()
+        for rank in range(args.num_workers):
+            envs = ("MXTPU_COORDINATOR=127.0.0.1:%d MXTPU_NUM_WORKERS=%d "
+                    "MXTPU_WORKER_RANK=%d DMLC_ROLE=worker "
+                    "DMLC_NUM_WORKER=%d DMLC_WORKER_ID=%d"
+                    % (port, args.num_workers, rank, args.num_workers,
+                       rank))
+            print("%s %s" % (envs,
+                             " ".join(shlex.quote(c) for c in cmd)))
+        return 0
     for attempt in range(args.max_restarts + 1):
         failed_rank, rc = _run_local_once(args, cmd, attempt)
         if failed_rank is None:
@@ -114,14 +125,15 @@ def launch_local(args, cmd):
     return 1
 
 
-def launch_ssh(args, cmd):
+def _ssh_commands(args, cmd):
+    """→ [ssh argv per worker] — one worker per hostfile entry."""
     assert args.hostfile, "--launcher ssh requires -H hostfile"
     with open(args.hostfile) as f:
         hosts = [h.strip() for h in f if h.strip()]
     hosts = (hosts * args.num_workers)[:args.num_workers]
     port = args.port or _free_port()
     coordinator = "%s:%d" % (socket.gethostname(), port)
-    procs = []
+    out = []
     for rank, host in enumerate(hosts):
         envs = ("MXTPU_COORDINATOR=%s MXTPU_NUM_WORKERS=%d "
                 "MXTPU_WORKER_RANK=%d DMLC_ROLE=worker DMLC_NUM_WORKER=%d "
@@ -130,14 +142,61 @@ def launch_ssh(args, cmd):
                    args.num_workers, rank))
         remote = "cd %s; %s %s" % (shlex.quote(os.getcwd()), envs,
                                    " ".join(shlex.quote(c) for c in cmd))
-        procs.append(subprocess.Popen(["ssh", "-o",
-                                       "StrictHostKeyChecking=no", host,
-                                       remote]))
+        out.append(["ssh", "-o", "StrictHostKeyChecking=no", host,
+                    remote])
+    return out
+
+
+def launch_ssh(args, cmd):
+    argvs = _ssh_commands(args, cmd)
+    if args.dry_run:
+        for argv in argvs:
+            print(" ".join(shlex.quote(a) for a in argv))
+        return 0
+    procs = [subprocess.Popen(argv) for argv in argvs]
     code = 0
     for p in procs:
         p.wait()
         code = code or p.returncode
     return code
+
+
+def _mpi_command(args, cmd):
+    """One mpirun invocation (Open MPI CLI: -x/--hostfile); ranks adopt
+    their mpirun-assigned rank at startup (base.py maps
+    OMPI_COMM_WORLD_RANK/PMI_RANK/... onto the worker-rank contract the
+    same way the reference's dmlc_tracker mpi mode rode mpirun,
+    reference tools/launch.py:70).
+
+    The coordinator must live where rank 0 runs: the first hostfile
+    host (mpirun fills hosts in order), else this host.  Pass --port
+    to pin a port known open on that host; _free_port() only checks
+    the launcher."""
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [h.split()[0] for h in f if h.strip()]
+        coord_host = hosts[0]
+    else:
+        coord_host = socket.gethostname()
+    port = args.port or _free_port()
+    coordinator = "%s:%d" % (coord_host, port)
+    argv = ["mpirun", "-np", str(args.num_workers)]
+    if args.hostfile:
+        argv += ["--hostfile", args.hostfile]
+    argv += ["-x", "MXTPU_COORDINATOR=%s" % coordinator,
+             "-x", "MXTPU_NUM_WORKERS=%d" % args.num_workers,
+             "-x", "MXTPU_RANK_FROM_MPI=1",
+             "-x", "DMLC_ROLE=worker",
+             "-x", "DMLC_NUM_WORKER=%d" % args.num_workers]
+    return argv + list(cmd)
+
+
+def launch_mpi(args, cmd):
+    argv = _mpi_command(args, cmd)
+    if args.dry_run:
+        print(" ".join(shlex.quote(a) for a in argv))
+        return 0
+    return subprocess.call(argv)
 
 
 def main(argv=None):
@@ -150,8 +209,11 @@ def main(argv=None):
                         "all-reduce design (kept for CLI compat)")
     parser.add_argument("-H", "--hostfile", default=None,
                         help="hostfile for ssh launcher")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print the launch commands/environment "
+                        "without running anything")
     parser.add_argument("--launcher", default="local",
-                        choices=["local", "ssh"],
+                        choices=["local", "ssh", "mpi"],
                         help="cluster type")
     parser.add_argument("--port", type=int, default=0,
                         help="coordinator port (0 = pick a free one)")
@@ -170,6 +232,8 @@ def main(argv=None):
     assert cmd, "no command given"
     if args.launcher == "local":
         return launch_local(args, cmd)
+    if args.launcher == "mpi":
+        return launch_mpi(args, cmd)
     return launch_ssh(args, cmd)
 
 
