@@ -1,0 +1,19 @@
+#!/bin/bash
+# TPU validation sequence after tunnel recovery. One process at a time,
+# generous timeouts, NEVER kill mid-run.
+set -x
+cd /root/repo
+
+# 1. new kernels at the standard shape (expect >= 36 TFLOP/s)
+BENCH_MODE=attention BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
+
+# 2. long context: T=32k now compiles with grid-streamed kernels
+BENCH_MODE=attention BENCH_ATTN_B=1 BENCH_ATTN_H=8 BENCH_ATTN_T=32768 \
+  BENCH_STEPS=3 python bench.py 2>&1 | grep -v WARNING | tail -1
+
+# 3. headline bench sanity
+python bench.py 2>&1 | grep -v WARNING | tail -1
+
+# 4. two more families for the per-network table
+BENCH_NETWORK=resnet152_v1 BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
+BENCH_NETWORK=inception_v3 BENCH_STEPS=10 BENCH_BATCH=64 python bench.py 2>&1 | grep -v WARNING | tail -1
