@@ -1,2 +1,4 @@
 """Model zoo (reference python/mxnet/gluon/model_zoo/__init__.py)."""
 from . import vision
+from . import gpt
+from .gpt import gpt2_tiny, gpt2_small, gpt2_medium, get_gpt

@@ -1,0 +1,134 @@
+"""GPT-2-class decoder language models — the transformer flagship.
+
+TPU-native addition: the 2017 reference predates attention entirely (its
+sequence story is bucketing, /root/reference/python/mxnet/module/
+bucketing_module.py), but a TPU framework's MFU headline lives in
+transformer matmuls, so the model zoo carries a decoder LM family built
+on the Pallas flash-attention kernel (ops/pallas/flash_attention.py)
+through the Gluon layer API (nn.FlashSelfAttention).
+
+Design notes (all MXU-motivated):
+- pre-LN residual blocks (stable in bf16 without warmup tricks);
+- gelu(tanh) MLP at 4x width — two large [T, d]x[d, 4d] matmuls XLA
+  tiles straight onto the systolic array;
+- weight-tied embedding/head: logits ride one [B·T, d] x [d, V]
+  FullyConnected against the embedding table, so the V-sized matmul
+  appears exactly once per step;
+- vocab padded to a multiple of 128 by the factory functions (lane
+  dimension of the MXU; 50257 → 50304 exactly like megatron-era configs).
+
+Weights save/load in the reference's V2 binary format like every other
+zoo model (ndarray/serialization.py), so the fine-tune workflow
+(example/language-model) round-trips through ``Module.load``.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["GPTBlock", "GPTLM", "get_gpt", "gpt2_tiny", "gpt2_small",
+           "gpt2_medium"]
+
+
+class GPTBlock(HybridBlock):
+    """One pre-LN transformer decoder block."""
+
+    def __init__(self, units, num_heads, mlp_ratio=4, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._dropout = dropout
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm(in_channels=units, prefix="ln1_")
+            self.attn = nn.FlashSelfAttention(units, num_heads,
+                                              causal=True,
+                                              in_units=units,
+                                              prefix="attn_")
+            self.ln2 = nn.LayerNorm(in_channels=units, prefix="ln2_")
+            self.fc1 = nn.Dense(mlp_ratio * units, flatten=False,
+                                in_units=units, prefix="fc1_")
+            self.fc2 = nn.Dense(units, flatten=False,
+                                in_units=mlp_ratio * units, prefix="fc2_")
+
+    def hybrid_forward(self, F, x):
+        h = self.attn(self.ln1(x))
+        if self._dropout:
+            h = F.Dropout(h, p=self._dropout)
+        x = x + h
+        h = self.fc2(F.Activation(self.fc1(self.ln2(x)),
+                                  act_type="gelu"))
+        if self._dropout:
+            h = F.Dropout(h, p=self._dropout)
+        return x + h
+
+
+class GPTLM(HybridBlock):
+    """Decoder-only LM: token + learned position embeddings, N blocks,
+    final LayerNorm, tied output head.
+
+    Input: int token ids [B, T] (T ≤ max_len); output: logits [B, T, V].
+    """
+
+    def __init__(self, vocab_size, num_layers, units, num_heads,
+                 max_len=1024, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._vocab = vocab_size
+        self._units = units
+        self._max_len = max_len
+        self._dropout = dropout
+        with self.name_scope():
+            self.wte = self.params.get("wte_weight",
+                                       shape=(vocab_size, units))
+            self.wpe = self.params.get("wpe_weight",
+                                       shape=(max_len, units))
+            self.blocks = nn.HybridSequential(prefix="h_")
+            with self.blocks.name_scope():
+                for _ in range(num_layers):
+                    self.blocks.add(GPTBlock(units, num_heads,
+                                             dropout=dropout))
+            self.ln_f = nn.LayerNorm(in_channels=units, prefix="lnf_")
+
+    def hybrid_forward(self, F, tokens, wte, wpe):
+        t = tokens.shape[1]
+        if t > self._max_len:
+            raise ValueError("sequence length %d exceeds max_len %d"
+                             % (t, self._max_len))
+        h = F.Embedding(tokens, wte, input_dim=self._vocab,
+                        output_dim=self._units)
+        h = h + F.slice_axis(wpe, axis=0, begin=0, end=t)
+        if self._dropout:
+            h = F.Dropout(h, p=self._dropout)
+        h = self.blocks(h)
+        h = self.ln_f(h)
+        # tied head: one [B·T, d] x [d, V] matmul against the embedding
+        return F.FullyConnected(h, wte, num_hidden=self._vocab,
+                                no_bias=True, flatten=False)
+
+
+def _pad_vocab(v, mult=128):
+    return (v + mult - 1) // mult * mult
+
+
+def get_gpt(num_layers, units, num_heads, vocab_size=50257, max_len=1024,
+            dropout=0.0, **kwargs):
+    """Build a GPTLM with the vocab padded to the MXU lane width."""
+    return GPTLM(_pad_vocab(vocab_size), num_layers, units, num_heads,
+                 max_len=max_len, dropout=dropout, **kwargs)
+
+
+def gpt2_tiny(**kwargs):
+    """2-layer test-scale config (CI / CPU oracle checks)."""
+    kwargs.setdefault("vocab_size", 256)
+    kwargs.setdefault("max_len", 128)
+    return get_gpt(2, 128, 4, **kwargs)
+
+
+def gpt2_small(**kwargs):
+    """124M-parameter class (12 x 768, 12 heads)."""
+    kwargs.setdefault("max_len", 2048)
+    return get_gpt(12, 768, 12, **kwargs)
+
+
+def gpt2_medium(**kwargs):
+    """350M-parameter class (24 x 1024, 16 heads)."""
+    kwargs.setdefault("max_len", 2048)
+    return get_gpt(24, 1024, 16, **kwargs)

@@ -14,7 +14,7 @@ from ..block import Block, HybridBlock
 from ...base import MXNetError
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Activation",
-           "FlashSelfAttention",
+           "FlashSelfAttention", "LayerNorm", "GELU",
            "Dropout", "BatchNorm", "LeakyReLU", "Embedding", "Flatten",
            "Lambda", "HybridLambda"]
 
@@ -269,6 +269,52 @@ class FlashSelfAttention(HybridBlock):
         o = F.reshape(F.transpose(o, axes=(0, 2, 1, 3)),
                       shape=(b, t, self._units))
         return self.out_proj(o)
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization over the last axis (TPU-native addition — the
+    2017 reference predates LayerNorm; statistics run in fp32 so bf16
+    transformer activations keep stable norms, ops/nn.py LayerNorm)."""
+
+    def __init__(self, epsilon=1e-5, axis=-1, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = self.params.get(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,),
+            init=_init_from_name(gamma_initializer),
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,),
+            init=_init_from_name(beta_initializer),
+            allow_deferred_init=True)
+
+    def infer_shape(self, x):
+        dim = x.shape[self._axis]
+        self.gamma.shape = (dim,)
+        self.beta.shape = (dim,)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis,
+                           eps=self._epsilon)
+
+    def __repr__(self):
+        return "LayerNorm(eps={}, axis={})".format(self._epsilon,
+                                                   self._axis)
+
+
+class GELU(HybridBlock):
+    """Gaussian error linear unit (tanh form; TPU-native addition)."""
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type="gelu")
+
+    def __repr__(self):
+        return "GELU"
 
 
 class Flatten(HybridBlock):

@@ -3,7 +3,8 @@
 Mirrors /root/reference/python/mxnet/ndarray/__init__.py.
 """
 from .ndarray import (NDArray, array, empty, zeros, ones, full, arange,
-                      concatenate, moveaxis, imperative_invoke, waitall)
+                      concatenate, moveaxis, imperative_invoke, waitall,
+                      onehot_encode, imdecode)
 from .utils import save, load
 from . import register as _register
 from .sparse import (BaseSparseNDArray, RowSparseNDArray, CSRNDArray,

@@ -29,7 +29,8 @@ from ..context import Context, current_context
 from ..ops import get_op
 
 __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
-           "concatenate", "moveaxis", "imperative_invoke", "waitall"]
+           "concatenate", "moveaxis", "imperative_invoke", "waitall",
+           "onehot_encode", "imdecode"]
 
 def _resolve_dtype(dtype):
     if dtype is None:
@@ -468,7 +469,12 @@ def imperative_invoke(op_name, inputs, params, out=None):
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
         for dst, src in zip(outs, outputs):
-            dst._set_data(src._data)
+            data = src._data
+            if dst._ctx != src._ctx:
+                # out= on another device is a cross-device copy (the
+                # reference engine moved the buffer; _copyto's contract)
+                data = jax.device_put(data, dst._ctx.jax_device())
+            dst._set_data(data)
         return out if isinstance(out, (list, tuple)) or len(outputs) > 1 \
             else outs[0]
     if len(outputs) == 1:
@@ -528,6 +534,47 @@ def full(shape, val, ctx=None, dtype=None):
     shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
     data = jnp.full(shape, val, dtype=_resolve_dtype(dtype))
     return NDArray(jax.device_put(data, ctx.jax_device()), ctx)
+
+
+def onehot_encode(indices, out):
+    """One-hot encoding indices into matrix out (deprecated in the
+    reference in favour of ``one_hot``; kept for parity —
+    /root/reference/python/mxnet/ndarray/ndarray.py:1453)."""
+    return imperative_invoke("_onehot_encode", (indices, out), {}, out=out)
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,
+             channels=3, mean=None):
+    """Decode an image byte string to CHW (deprecated reference API,
+    /root/reference/python/mxnet/ndarray/ndarray.py:2633 →
+    ndarray.cc Imdecode).  Host-side decode (PIL stands in for the
+    reference's OpenCV); crop via ``clip_rect``, optional ``mean``
+    subtraction, optional write into slice ``index`` of a 4-d ``out``."""
+    import io as _pyio
+    import numpy as _host_np
+    from PIL import Image as _Image
+
+    img = _Image.open(_pyio.BytesIO(
+        str_img if isinstance(str_img, bytes) else bytes(str_img)))
+    img = img.convert("L" if channels == 1 else "RGB")
+    arr = _host_np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    x0, y0, x1, y1 = clip_rect
+    if y1 - y0 > 0:
+        arr = arr[y0:y1, x0:x1]
+    chw = _host_np.moveaxis(arr, -1, 0).astype(
+        mean.dtype if mean is not None else "float32")
+    if mean is not None:
+        chw = chw - (mean.asnumpy() if isinstance(mean, NDArray) else mean)
+    result = array(chw)
+    if out is None:
+        return result
+    if out.ndim == 4:
+        out[index:index + 1] = result.reshape((1,) + chw.shape)
+    else:
+        out[:] = result
+    return out
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):

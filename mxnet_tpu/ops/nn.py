@@ -203,6 +203,13 @@ def _activation(data, act_type="relu"):
         return jax.nn.softplus(data)
     if act_type == "softsign":
         return jax.nn.soft_sign(data)
+    if act_type == "gelu":
+        # tanh approximation (the GPT-2 form); fused by XLA into the
+        # adjacent matmul — TPU-native addition, the 2017 reference's
+        # activation set predates gelu
+        return jax.nn.gelu(data, approximate=True)
+    if act_type == "gelu_erf":
+        return jax.nn.gelu(data, approximate=False)
     raise ValueError("unknown act_type %s" % act_type)
 
 
@@ -483,6 +490,24 @@ def _instance_norm(data, gamma, beta, eps=1e-3):
     bshape = (1, -1) + (1,) * (data.ndim - 2)
     return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) \
         + beta.reshape(bshape)
+
+
+@register_op("LayerNorm", arg_names=("data", "gamma", "beta"),
+             param_defaults={"axis": -1, "eps": 1e-5})
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    """Layer normalization over one axis.  TPU-native addition (the 2017
+    reference predates LayerNorm); statistics in at-least-fp32 (promote,
+    don't truncate fp64 tests) so the transformer path keeps MXU-friendly
+    bf16 activations with stable norms."""
+    x = data.astype(jnp.promote_types(data.dtype, jnp.float32))
+    mean = x.mean(axis=axis, keepdims=True)
+    var = jnp.square(x - mean).mean(axis=axis, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    y = y * gamma.astype(x.dtype).reshape(bshape) \
+        + beta.astype(x.dtype).reshape(bshape)
+    return y.astype(data.dtype)
 
 
 @register_op("L2Normalization", arg_names=("data",),

@@ -727,3 +727,52 @@ def _linalg_sumlogdiag(A):
 def _linalg_syrk(A, transpose=False, alpha=1.0):
     At = jnp.swapaxes(A, -1, -2)
     return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+# ---------------------------------------------------------------------------
+# Legacy NDArray functions (/root/reference/src/ndarray/ndarray.cc:1208-1240,
+# registered there via MXNET_REGISTER_NDARRAY_FUN rather than NNVM — the
+# OPDIFF scan covers both registries)
+# ---------------------------------------------------------------------------
+
+@register_op("_set_value", arg_names=("out",),
+             param_defaults={"src": 0.0})
+def _set_value(out, src=0.0):
+    """Fill with a scalar (ndarray.cc SetValueOp; backs ``arr[:] = x``)."""
+    return jnp.full_like(out, src)
+
+
+@register_op("_onehot_encode", arg_names=("indices", "out"))
+def _onehot_encode_op(indices, out):
+    """One-hot rows of ``out``'s shape from ``indices``
+    (ndarray.cc BinaryOp<ndarray::OneHotEncode>; public
+    ``mx.nd.onehot_encode``)."""
+    if indices.shape[0] != out.shape[0]:
+        raise ValueError(
+            "onehot_encode: indices length %d != out rows %d"
+            % (indices.shape[0], out.shape[0]))
+    return jax.nn.one_hot(indices.astype(jnp.int32), out.shape[1],
+                          dtype=out.dtype)
+
+
+@register_op("choose_element_0index", arg_names=("lhs", "rhs"))
+def _choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] (ndarray.cc MatChooseRowElem; 0-based
+    index)."""
+    return jnp.take_along_axis(
+        lhs, rhs.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register_op("fill_element_0index", arg_names=("lhs", "mhs", "rhs"))
+def _fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i] (ndarray.cc
+    MatFillRowElem)."""
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, rhs.astype(jnp.int32)].set(mhs.astype(lhs.dtype))
+
+
+@register_op("_copyto", arg_names=("data",))
+def _copyto(data):
+    """Identity copy (ndarray.cc CopyFromToSimple; device transfer is the
+    ``out=`` target's placement, handled by imperative_invoke)."""
+    return jnp.asarray(data)

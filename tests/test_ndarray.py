@@ -163,3 +163,76 @@ def test_sparse_facade():
     csr = sparse.csr_matrix(dense)
     assert csr.stype == "csr"
     np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 0, 3, 3, 6])
+
+
+def test_legacy_ndarray_funs():
+    """The MXNET_REGISTER_NDARRAY_FUN tail (reference
+    src/ndarray/ndarray.cc:1208-1240): onehot_encode,
+    choose/fill_element_0index, _set_value, _copyto."""
+    nd = mx.nd
+    idx = nd.array([1.0, 0.0, 2.0])
+    out = nd.zeros((3, 3))
+    ret = nd.onehot_encode(idx, out)
+    expect = np.zeros((3, 3), np.float32)
+    expect[[0, 1, 2], [1, 0, 2]] = 1
+    np.testing.assert_array_equal(out.asnumpy(), expect)
+    assert ret is out  # reference writes into out and returns it
+
+    lhs = nd.array(np.arange(12.0).reshape(3, 4))
+    rhs = nd.array([0.0, 3.0, 1.0])
+    np.testing.assert_array_equal(
+        nd.choose_element_0index(lhs, rhs).asnumpy(), [0.0, 7.0, 9.0])
+    mhs = nd.array([-1.0, -2.0, -3.0])
+    filled = nd.fill_element_0index(lhs, mhs, rhs).asnumpy()
+    ref = np.arange(12.0).reshape(3, 4)
+    ref[[0, 1, 2], [0, 3, 1]] = [-1, -2, -3]
+    np.testing.assert_array_equal(filled, ref)
+
+    a = nd.ones((2, 2))
+    nd._set_value(a, src=7.0, out=a)
+    np.testing.assert_array_equal(a.asnumpy(), np.full((2, 2), 7.0))
+    np.testing.assert_array_equal(nd._copyto(lhs).asnumpy(), lhs.asnumpy())
+
+
+def test_legacy_imdecode():
+    """nd.imdecode (deprecated reference API, ndarray.py:2633): CHW
+    decode, clip_rect crop, mean subtraction, 4-d out slice write."""
+    from PIL import Image
+    import io as pyio
+    nd = mx.nd
+    img = np.zeros((8, 6, 3), np.uint8)
+    img[:, :, 0] = 200  # red-ish constant so JPEG round-trips closely
+    buf = pyio.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=95)
+    raw = buf.getvalue()
+
+    d = nd.imdecode(raw)
+    assert d.shape == (3, 8, 6)
+    assert abs(float(d.asnumpy()[0].mean()) - 200) < 10
+
+    crop = nd.imdecode(raw, clip_rect=(1, 2, 5, 7))
+    assert crop.shape == (3, 5, 4)
+
+    mean = nd.ones((3, 8, 6)) * 100.0
+    sub = nd.imdecode(raw, mean=mean)
+    assert abs(float(sub.asnumpy()[0].mean()) - 100) < 10
+
+    out4 = nd.zeros((2, 3, 8, 6))
+    nd.imdecode(raw, out=out4, index=1)
+    assert float(np.abs(out4.asnumpy()[0]).sum()) == 0
+    assert float(out4.asnumpy()[1].sum()) != 0
+
+
+def test_copyto_out_cross_device():
+    """out= on another device must move the buffer (the reference
+    engine's cross-device copy path for _copyto)."""
+    import jax
+    if len(jax.devices()) < 2:
+        return
+    a = mx.nd.array([[1.0, 2.0]], ctx=mx.cpu(0))
+    b = mx.nd.zeros((1, 2), ctx=mx.cpu(1))
+    mx.nd._copyto(a, out=b)
+    assert b._ctx.device_id == 1
+    dev, = b._data.devices()
+    assert dev.id == 1
+    np.testing.assert_array_equal(b.asnumpy(), [[1.0, 2.0]])

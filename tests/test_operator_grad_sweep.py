@@ -404,12 +404,28 @@ C("odd_deconv_odd_in", "Deconvolution",
   params={"kernel": (3, 3), "num_filter": 1, "no_bias": True})
 C("odd_take_dup_indices", "take",
   [("a", (4, 2), "any"), ("indices", (6,), "int:4")], fixed=("indices",))
+C("layer_norm", "LayerNorm",
+  [(D, (2, 3, 4), "any"), ("gamma", (4,), "pos"), ("beta", (4,), "any")],
+  rtol=2e-2)
+C("layer_norm_axis1", "LayerNorm",
+  [(D, (2, 3, 4), "any"), ("gamma", (3,), "pos"), ("beta", (3,), "any")],
+  params={"axis": 1}, rtol=2e-2)
+C("choose_element_0index", "choose_element_0index",
+  [("lhs", (3, 4), "any"), ("rhs", (3,), "int:4")], fixed=("rhs",))
+C("fill_element_0index", "fill_element_0index",
+  [("lhs", (3, 4), "any"), ("mhs", (3,), "any"), ("rhs", (3,), "int:4")],
+  fixed=("rhs",))
+C("copyto", "_copyto", [(D, (2, 3), "any")])
 
 #: registry OpDefs with no finite-difference case, and why.  The
 #: completeness guard below fails when a newly-registered op appears in
 #: neither CASES nor this table.
 SKIP_REASONS = {
     "BlockGrad": "zero-grad by definition; explicit test below",
+    "_set_value": "scalar fill (ndarray.cc SetValueOp); output constant "
+                  "wrt the input array",
+    "_onehot_encode": "output depends on the out operand only through its "
+                      "shape; indices are integer",
     "Dropout": "rng-dependent mask; explicit semantics test below",
     "Custom": "python callback op; gradients tested in test_custom_op.py",
     "RNN": "scan-based fused op; gradients tested in test_rnn.py",
