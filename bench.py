@@ -78,8 +78,12 @@ def _install_init_watchdog(metric="resnet50_train_images_per_sec",
 
 def _network_metric(network):
     """'resnet50_v1' -> 'resnet50_train_images_per_sec' (the name the
-    driver has tracked since round 1)."""
-    return "%s_train_images_per_sec" % network.split("_v")[0]
+    driver has tracked since round 1).  Only the '_v1' family default is
+    stripped — 'inception_v3' keeps its version so the metric name
+    round-trips to the BENCH_NETWORK value (ADVICE r3)."""
+    if network.endswith("_v1"):
+        network = network[:-3]
+    return "%s_train_images_per_sec" % network
 
 
 def _disarm_watchdog():
@@ -162,6 +166,110 @@ def bench_attention():
     peak = PEAK_FLOPS.get(device_kind)
     if peak:
         result["mfu"] = round(flops / dtime / peak, 3)
+    print(json.dumps(result))
+
+
+GPT_CONFIGS = {"tiny": (2, 128, 4), "small": (12, 768, 12),
+               "medium": (24, 1024, 16)}
+
+
+def _gpt_metric():
+    cfg_name = os.environ.get("BENCH_GPT", "small")
+    if cfg_name not in GPT_CONFIGS:
+        raise ValueError("BENCH_GPT must be one of %s, got %r"
+                         % (sorted(GPT_CONFIGS), cfg_name))
+    return cfg_name, "gpt2_%s_train_tokens_per_sec" % cfg_name
+
+
+def bench_transformer():
+    """BENCH_MODE=transformer: GPT flagship training MFU.
+
+    Times the full causal-LM training step (fwd, softmax-CE over the
+    padded vocab, bwd, SGD+momentum, bf16 compute / fp32 master) of a
+    model-zoo GPT config.  This is the workload class TPUs are bought
+    for: MFU is the headline, tokens/s the throughput.  FLOPs: matmul
+    params contribute 6·N_matmul per token (fwd 2N + bwd 4N); attention
+    adds 3.5 · 4·T²·H·D / 2 (causal) per layer per sequence.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    cfg_name, metric = _gpt_metric()
+    n_layer, d_model, n_head = GPT_CONFIGS[cfg_name]
+
+    platform = jax.devices()[0].platform
+    _disarm_watchdog()
+    device_kind = jax.devices()[0].device_kind
+    on_cpu = platform == "cpu"
+    seq = int(os.environ.get("BENCH_SEQ", "128" if on_cpu else "2048"))
+    batch = int(os.environ.get("BENCH_BATCH", "2" if on_cpu else "8"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "2" if on_cpu else "20")))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "1" if on_cpu else "3")))
+    vocab = 50304 if not on_cpu else 512
+
+    from mxnet_tpu.gluon.model_zoo import gpt
+    from mxnet_tpu.gluon.block import functionalize
+
+    net = gpt.GPTLM(vocab, n_layer, d_model, n_head, max_len=seq)
+    net.initialize()
+    toks0 = jnp.zeros((batch, seq), jnp.int32)
+    fn, params = functionalize(net, toks0, train=True)
+    mom = [jnp.zeros_like(p) for p in params]
+
+    bench_dtype = os.environ.get(
+        "BENCH_DTYPE", "float32" if on_cpu else "bfloat16")
+    if bench_dtype not in ("bfloat16", "float32"):
+        raise ValueError("BENCH_DTYPE must be bfloat16 or float32, got %r"
+                         % bench_dtype)
+    cdt = jnp.bfloat16 if bench_dtype == "bfloat16" else jnp.float32
+
+    def loss_fn(ps, x, y):
+        cps = [p.astype(cdt) for p in ps]
+        (logits,), _ = fn(cps, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(ps, mom, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(ps, x, y)
+        new_mom = [0.9 * m - 3e-4 * g.astype(jnp.float32)
+                   for m, g in zip(mom, grads)]
+        new_ps = [p + m for p, m in zip(ps, new_mom)]
+        return new_ps, new_mom, loss
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.randint(key, (batch, seq), 0, vocab)
+    y = jnp.roll(x, -1, axis=1)
+
+    # analytic per-step training FLOPs: 6 FLOPs per matmul param per
+    # token (embedding/position tables do no matmul work; the tied head
+    # DOES matmul — count d·V once) + flash-attention score FLOPs
+    n_matmul = n_layer * 12 * d_model * d_model + d_model * vocab
+    attn = n_layer * 3.5 * 4 * seq * seq * d_model / 2
+    step_flops = (6 * n_matmul * seq + attn) * batch
+
+    for _ in range(warmup):
+        params, mom, loss = train_step(params, mom, x, y)
+    np.asarray(loss)  # completion barrier (PERF.md §1)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, mom, loss = train_step(params, mom, x, y)
+    np.asarray(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = batch * seq * steps / dt
+    result = {
+        "metric": metric,
+        "value": round(tok_s, 1),
+        "unit": "tok/s (bs %d, T %d, vocab %d, %s, 1 %s device)" % (
+            batch, seq, vocab, bench_dtype, platform),
+        "vs_baseline": None,  # no reference counterpart (2017, pre-attention)
+        "tflops": round(step_flops * steps / dt / 1e12, 1),
+    }
+    peak = PEAK_FLOPS.get(device_kind)
+    if peak:
+        result["mfu"] = round(step_flops * steps / dt / peak, 3)
     print(json.dumps(result))
 
 
@@ -264,6 +372,8 @@ def main():
     metric, unit = {
         "attention": ("flash_attention_train_tflops", "TFLOP/s"),
         "pipeline": ("input_pipeline_images_per_sec", "img/s"),
+        "transformer": (_gpt_metric()[1] if mode == "transformer"
+                        else "", "tok/s"),
     }.get(mode, (_network_metric(network), "img/s"))
     _install_init_watchdog(metric, unit)
     if mode == "attention":
@@ -271,6 +381,9 @@ def main():
         return
     if mode == "pipeline":
         bench_pipeline()
+        return
+    if mode == "transformer":
+        bench_transformer()
         return
     # bs 128 is the measured single-chip sweet spot on v5e (PERF.md:
     # 2379 img/s vs 2263 at bs 256, 2114 at bs 512)

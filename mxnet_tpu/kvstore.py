@@ -227,9 +227,17 @@ class KVStore:
         Gradients exchanged by ``push`` are quantized to
         {-threshold, 0, +threshold} with per-key on-device residuals;
         the distributed exchange moves packed 2-bit codes (16x smaller
-        than fp32) over the worker mesh."""
+        than fp32) over the worker mesh.
+
+        Idempotent: calling again with identical params keeps the live
+        compressor (rebuilding would silently discard the accumulated
+        error-feedback residuals mid-training, ADVICE r3)."""
         from .gradient_compression import create_compressor
-        self._compress_params = dict(compression_params)
+        params = dict(compression_params)
+        if getattr(self, "_compressor", None) is not None \
+                and params == self._compress_params:
+            return
+        self._compress_params = params
         self._compressor = create_compressor(self._compress_params)
 
     # -- distributed control -----------------------------------------------

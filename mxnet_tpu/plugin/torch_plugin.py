@@ -67,7 +67,13 @@ class _TorchRunner:
 
     def __init__(self, module, n_inputs):
         import copy
+        import threading
         self.torch = _require_torch()
+        # jax may invoke pure_callbacks concurrently (vmap batching,
+        # multi-threaded dispatch); param-load + execute must be atomic
+        # per runner or one call's weights leak into another's compute
+        # (ADVICE r3)
+        self._lock = threading.Lock()
         # private copy: forward/backward write parameter values and
         # requires_grad flags into the module they run, and the caller's
         # module must never be clobbered as a side effect
@@ -89,9 +95,10 @@ class _TorchRunner:
         torch = self.torch
         xs = [torch.from_numpy(_to_numpy(a))
               for a in arrays[:self.n_inputs]]
-        self._load_params(arrays[self.n_inputs:], requires_grad=False)
-        with torch.no_grad():
-            y = self.module(*xs)
+        with self._lock:
+            self._load_params(arrays[self.n_inputs:], requires_grad=False)
+            with torch.no_grad():
+                y = self.module(*xs)
         return _to_numpy(y.detach().numpy())
 
     def vjp_host(self, *arrays_and_cotangent):
@@ -99,17 +106,18 @@ class _TorchRunner:
         *arrays, g = arrays_and_cotangent
         xs = [torch.from_numpy(_to_numpy(a)).requires_grad_(True)
               for a in arrays[:self.n_inputs]]
-        self._load_params(arrays[self.n_inputs:], requires_grad=True)
-        y = self.module(*xs)
-        y.backward(torch.from_numpy(_to_numpy(g)))
-        grads = [x.grad if x.grad is not None else torch.zeros_like(x)
-                 for x in xs]
-        grads += [p.grad if p.grad is not None
-                  else self.torch.zeros_like(p)
-                  for _, p in self.module.named_parameters()]
-        out = tuple(_to_numpy(gr.detach().numpy()) for gr in grads)
-        for _, p in self.module.named_parameters():
-            p.grad = None
+        with self._lock:
+            self._load_params(arrays[self.n_inputs:], requires_grad=True)
+            y = self.module(*xs)
+            y.backward(torch.from_numpy(_to_numpy(g)))
+            grads = [x.grad if x.grad is not None else torch.zeros_like(x)
+                     for x in xs]
+            grads += [p.grad if p.grad is not None
+                      else self.torch.zeros_like(p)
+                      for _, p in self.module.named_parameters()]
+            out = tuple(_to_numpy(gr.detach().numpy()) for gr in grads)
+            for _, p in self.module.named_parameters():
+                p.grad = None
         return out
 
     def out_shape(self, in_shapes):

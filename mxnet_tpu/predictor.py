@@ -53,6 +53,12 @@ class Predictor:
             **{k: tuple(v) for k, v in input_shapes.items()})
         self._exec.copy_params_from(arg_params, aux_params,
                                     allow_extra_params=True)
+        # reshape-time validation targets real weights only — inputs and
+        # label variables (the reference's *_label naming convention)
+        # legitimately change shape with the batch
+        self._param_names = {
+            n for n in self._exec.arg_dict
+            if n not in self._input_names and not n.endswith("_label")}
         self._outputs = None
 
     @classmethod
@@ -93,8 +99,31 @@ class Predictor:
         return [tuple(o.shape) for o in (self._outputs or [])]
 
     def reshape(self, input_shapes):
-        """MXPredReshape: rebind for new input shapes, keeping weights."""
+        """MXPredReshape: rebind for new input shapes, keeping weights.
+
+        Validates like the reference's MXPredReshape: a param whose
+        inferred shape changes under the new input shapes (e.g. a
+        flatten→FC weight at a new spatial size) is an error — the
+        generic Executor.reshape would silently zero it."""
         kwargs = {k: tuple(v) for k, v in input_shapes.items()}
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
+            if name in kwargs or name not in self._param_names:
+                continue
+            cur = self._exec.arg_dict[name]
+            if tuple(cur.shape) != tuple(shape):
+                raise ValueError(
+                    "reshape: param %r changes shape %s -> %s under the "
+                    "new input shapes; rebuild the predictor instead"
+                    % (name, tuple(cur.shape), tuple(shape)))
+        for name, shape in zip(self._symbol.list_auxiliary_states(),
+                               aux_shapes):
+            cur = self._exec.aux_dict[name]
+            if tuple(cur.shape) != tuple(shape):
+                raise ValueError(
+                    "reshape: aux %r changes shape %s -> %s under the "
+                    "new input shapes" % (name, tuple(cur.shape),
+                                          tuple(shape)))
         self._exec = self._exec.reshape(**kwargs)
         self._input_names = list(input_shapes.keys())
         self._outputs = None
@@ -116,14 +145,30 @@ class Predictor:
         # weights transfer device-side, no host round-trip; jax buffers
         # are immutable, so sharing them is safe — set_input/_set_data
         # rebind pointers, never write through
+        clone._param_names = set(self._param_names)
         for k, v in self._exec.arg_dict.items():
             if k in input_shapes or k not in clone._exec.arg_dict:
                 continue
             dst = clone._exec.arg_dict[k]
+            if v._data.shape != dst._data.shape:
+                if k not in self._param_names:
+                    continue  # free variable (label): fresh zeros are fine
+                # reshape-time validation (the reference MXPredReshape
+                # errors when a param's inferred shape changes, ADVICE r3)
+                raise ValueError(
+                    "clone_reshaped: param %r changes shape %s -> %s "
+                    "under the new input shapes; rebuild the predictor "
+                    "instead" % (k, tuple(v._data.shape),
+                                 tuple(dst._data.shape)))
             dst._set_data(v._data.astype(dst._data.dtype))
         for k, v in self._exec.aux_dict.items():
             if k in clone._exec.aux_dict:
                 dst = clone._exec.aux_dict[k]
+                if v._data.shape != dst._data.shape:
+                    raise ValueError(
+                        "clone_reshaped: aux %r changes shape %s -> %s "
+                        "under the new input shapes" %
+                        (k, tuple(v._data.shape), tuple(dst._data.shape)))
                 dst._set_data(v._data.astype(dst._data.dtype))
         clone._outputs = None
         return clone
