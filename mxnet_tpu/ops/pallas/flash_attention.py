@@ -193,6 +193,32 @@ def _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k, tk_true):
 # backward
 # ---------------------------------------------------------------------------
 
+def _bwd_block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    mask, scale):
+    """Shared backward block math for one (q-block, k-block) pair:
+    recompute p = exp(S − lse) under ``mask`` and ds = p·(dO·Vᵀ − Δ).
+    All three backward kernels (dq, dk/dv, fused) consume these; the
+    explicit p zeroing handles rows whose lse is the padding sentinel
+    (exp(−inf − (−inf)) would be 1)."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]      # (bq, 1)
+    delta = delta_ref[0]  # (bq, 1)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    p = jnp.where(mask, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    return p, ds, q, k, do
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale, causal, tk_true):
     """dq for one (q-block, k-block) grid step; K/V stream via the
@@ -211,24 +237,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]      # (bq, 1)
-        delta = delta_ref[0]  # (bq, 1)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
         mask = _kv_bounds_mask(k_off, bq, bk, tk_true)
         if causal:
             mask &= _causal_mask(q_off, k_off, bq, bk)
-        s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse)  # (bq, bk)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        _, ds, _, k, _ = _bwd_block_p_ds(q_ref, k_ref, v_ref, do_ref,
+                                         lse_ref, delta_ref, mask, scale)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -263,30 +276,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _accumulate():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (bq, bk)
         # padded q rows (tq % block_q) must contribute zero to dk/dv
         mask = _q_bounds_mask(q_off, bq, bk, tq_true)
         if causal:
             mask &= _causal_mask(q_off, k_off, bq, bk)
-        s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse)
-        p = jnp.where(mask, p, 0.0)
+        p, ds, q, _, do = _bwd_block_p_ds(q_ref, k_ref, v_ref, do_ref,
+                                          lse_ref, delta_ref, mask, scale)
         # dv += P^T @ dO
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)  # (bq, bk)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -303,7 +302,138 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                      *, scale, causal, tq_true, tk_true):
+    """Fused backward: one grid pass (bh, k-blocks, q-blocks) computes
+    dq, dk AND dv.  Per (q,k) block pair the split kernels spend 7 MXU
+    matmuls (s and dp are computed twice); fusing shares them — 5
+    matmuls/pair, a 1.4x FLOP cut on the backward (the PERF.md §7 gap).
+
+    dk/dv accumulate in VMEM scratch across the sequential q sweep.  dq
+    blocks would be revisited once per outer k step, NON-consecutively —
+    which no TPU-grid accumulator expresses soundly (output revisits
+    don't reload, and input/output aliases snapshot their input) — so
+    each (k,q) step writes its dq contribution to its own fp32 partial
+    slot and the caller reduces over the nk axis.  Extra HBM traffic is
+    O(nk·Tq·D) written + read once, the same volume the split dq kernel
+    re-read k/v with."""
+    pl = _pl()
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
+    k_off = ki * bk
+    q_off = qi * bq
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # every slot is written exactly once; fully-skipped causal pairs
+    # still need their zero
+    dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+
+    def _accumulate():
+        # both bounds masks: padded q rows must not touch dk/dv, padded
+        # k columns must not touch dq (belt over the zero-pad brace)
+        mask = _q_bounds_mask(q_off, bq, bk, tq_true)
+        mask &= _kv_bounds_mask(k_off, bq, bk, tk_true)
+        if causal:
+            mask &= _causal_mask(q_off, k_off, bq, bk)
+        p, ds, q, k, do = _bwd_block_p_ds(q_ref, k_ref, v_ref, do_ref,
+                                          lse_ref, delta_ref, mask, scale)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        dq_ref[0, 0] = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(q_off + bq - 1 >= k_off)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused(res, g, scale, causal, block_q, block_k):
+    """Single-pass fused backward; dq comes out as nk fp32 partials
+    reduced by XLA after the kernel."""
+    pl = _pl()
+    q, k, v, out, lse = res
+    do = g
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    dv_dim = v.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    qp = _pad_to(q, 1, block_q)
+    dop = _pad_to(do, 1, block_q)
+    lsep = _pad_to(lse, 1, block_q)
+    deltap = _pad_to(delta, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    tqp = qp.shape[1]
+    tkp = kp.shape[1]
+    nk = tkp // block_k
+
+    dq_parts, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          tq_true=tq, tk_true=tk),
+        grid=(bh, nk, tqp // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, i, j: (i, b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nk, bh, tqp, d), jnp.float32),
+            jax.ShapeDtypeStruct(kp.shape, k.dtype),
+            jax.ShapeDtypeStruct(vp.shape, v.dtype),
+        ],
+        scratch_shapes=[_scratch((block_k, d)),
+                        _scratch((block_k, dv_dim))],
+        interpret=_use_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+    dq = dq_parts.sum(axis=0)[:, :tq].astype(q.dtype)
+    return dq, dk[:, :tk], dv[:, :tk]
+
+
+def _bwd_impl():
+    """MXTPU_FLASH_BWD=fused|split.  Default split — the measured
+    round-3 baseline; tools/tpu_validate.sh times both and the faster
+    one becomes the default once hardware-confirmed."""
+    import os
+    return os.environ.get("MXTPU_FLASH_BWD", "split")
+
+
 def _flash_bwd(res, g, scale, causal, block_q, block_k):
+    if _bwd_impl() == "fused":
+        return _flash_bwd_fused(res, g, scale, causal, block_q, block_k)
+    return _flash_bwd_split(res, g, scale, causal, block_q, block_k)
+
+
+def _flash_bwd_split(res, g, scale, causal, block_q, block_k):
     pl = _pl()
     q, k, v, out, lse = res
     do = g

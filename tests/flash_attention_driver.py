@@ -160,6 +160,19 @@ def check_op_and_layer_flash():
     os.environ.pop("MXTPU_ATTENTION_IMPL", None)
 
 
+def check_fused_backward():
+    """MXTPU_FLASH_BWD=fused runs the single-pass dq/dk/dv kernel; its
+    gradients must match the split kernels' and the reference —
+    including the padding, causal-skip, and ring paths."""
+    os.environ["MXTPU_FLASH_BWD"] = "fused"
+    try:
+        check_grads()
+        check_grads_odd_lengths()
+        check_ring_flash()
+    finally:
+        os.environ.pop("MXTPU_FLASH_BWD", None)
+
+
 if __name__ == "__main__":
     jax.config.update("jax_default_matmul_precision", "float32")
     check_forward()
@@ -169,4 +182,5 @@ if __name__ == "__main__":
     check_grads_odd_lengths()
     check_ring_flash()
     check_op_and_layer_flash()
+    check_fused_backward()
     print("FLASH_OK backend=%s" % jax.default_backend())

@@ -173,3 +173,20 @@ def test_launch_dry_run_local_and_mpi_coordinator(tmp_path):
         capture_output=True, text=True)
     assert r.returncode == 0, r.stderr[-400:]
     assert "MXTPU_COORDINATOR=nodeX:39998" in r.stdout
+
+
+def test_op_consistency_runner():
+    """The accelerator-vs-CPU sweep runner executes every pure forward
+    case and passes (degenerate accel==cpu here; tpu_validate.sh stage 6
+    runs it for real on the TPU host)."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["OP_CONSISTENCY_DTYPES"] = "float32"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_consistency.py")],
+        capture_output=True, text=True, env=env, timeout=540)
+    assert r.returncode == 0, r.stdout[-800:] + r.stderr[-400:]
+    assert "op_consistency: PASS" in r.stdout
+    assert "cases_ran=0" not in r.stdout

@@ -7,6 +7,10 @@ cd /root/repo
 # 1. new kernels at the standard shape (expect >= 36 TFLOP/s)
 BENCH_MODE=attention BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
 
+# 1b. fused vs split backward A/B (round 4: the faster one becomes the
+#     MXTPU_FLASH_BWD default)
+MXTPU_FLASH_BWD=fused BENCH_MODE=attention BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
+
 # 2. long context: T=32k now compiles with grid-streamed kernels
 BENCH_MODE=attention BENCH_ATTN_B=1 BENCH_ATTN_H=8 BENCH_ATTN_T=32768 \
   BENCH_STEPS=3 python bench.py 2>&1 | grep -v WARNING | tail -1
@@ -14,6 +18,13 @@ BENCH_MODE=attention BENCH_ATTN_B=1 BENCH_ATTN_H=8 BENCH_ATTN_T=32768 \
 # 3. headline bench sanity
 python bench.py 2>&1 | grep -v WARNING | tail -1
 
-# 4. two more families for the per-network table
+# 4. transformer flagship MFU (round 4; expect the MFU headline here)
+BENCH_MODE=transformer BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
+MXTPU_FLASH_BWD=fused BENCH_MODE=transformer BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
+
+# 5. two more families for the per-network table
 BENCH_NETWORK=resnet152_v1 BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
 BENCH_NETWORK=inception_v3 BENCH_STEPS=10 BENCH_BATCH=64 python bench.py 2>&1 | grep -v WARNING | tail -1
+
+# 6. TPU-vs-CPU op consistency sweep (round 4)
+python tools/op_consistency.py 2>&1 | tail -5
