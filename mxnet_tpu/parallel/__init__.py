@@ -44,4 +44,4 @@ from .ulysses import ulysses_attention
 from . import moe
 from .moe import MoELayer, moe_apply
 from . import pipeline
-from .pipeline import pipeline_apply
+from .pipeline import pipeline_apply, pipeline_apply_1f1b

@@ -88,3 +88,134 @@ def pipeline_apply(stage_params, microbatches, stage_fn, mesh=None,
 def _strip_stage_dim(stage_params, microbatches, stage_fn, axis):
     local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
     return _pipeline_local(local, microbatches, stage_fn, axis)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (PipeDream-flush) schedule
+# ---------------------------------------------------------------------------
+
+def _pipeline_1f1b_local(stage_params, microbatches, targets, stage_fn,
+                         loss_fn, axis):
+    """Explicit interleaved forward/backward pipeline (inside shard_map).
+
+    Round r, stage s (S stages, M microbatches):
+    - F-slot: forward microbatch ``m_f = r − s`` when 0 ≤ m_f < M; the
+      activation register carries y one hop s→s+1 between rounds.
+    - B-slot: backward microbatch ``m_b = r − 2(S−1) + s``; the cotangent
+      register carries dx one hop s+1→s.  The last stage seeds its own
+      backward from the loss vjp in the SAME round as the forward.
+    Backward recomputes the stage forward from the stashed INPUT
+    (per-stage activation checkpointing), so the stash holds at most
+    2(S−1) microbatch inputs — O(S), independent of M, where autodiff
+    over the GPipe loop retains all M (the 1F1B memory win; bubble is
+    the same 2(S−1)/M).  Total rounds: M + 2S − 2.
+
+    Returns (summed loss, grads pytree like stage_params).
+    """
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    n_micro = microbatches.shape[0]
+    stash_len = 2 * n_stages
+
+    probe = jax.eval_shape(stage_fn, stage_params, microbatches[0])
+    act = jnp.zeros(probe.shape, probe.dtype)        # fwd register
+    cot = jnp.zeros(probe.shape, jnp.float32)        # bwd register
+    stash = jnp.zeros((stash_len,) + probe.shape, probe.dtype)
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), stage_params)
+    loss_acc = jnp.zeros((), jnp.float32)
+
+    def tick(r, carry):
+        act, cot, stash, grads, loss_acc = carry
+
+        # ---- F-slot -----------------------------------------------------
+        m_f = r - stage
+        f_valid = jnp.logical_and(m_f >= 0, m_f < n_micro)
+        m_f_c = jnp.clip(m_f, 0, n_micro - 1)
+        feed = lax.dynamic_index_in_dim(microbatches, m_f_c, 0,
+                                        keepdims=False)
+        x = jnp.where(stage == 0, feed.astype(probe.dtype), act)
+        # stash the stage INPUT for the backward recompute
+        stash = lax.dynamic_update_index_in_dim(
+            stash,
+            jnp.where(f_valid, x,
+                      lax.dynamic_index_in_dim(stash, m_f_c % stash_len,
+                                               0, keepdims=False)),
+            m_f_c % stash_len, 0)
+        y = stage_fn(stage_params, x)
+
+        # last stage: loss + its cotangent for this same microbatch
+        tgt = lax.dynamic_index_in_dim(targets, m_f_c, 0, keepdims=False)
+        loss_m, loss_vjp = jax.vjp(lambda yy: loss_fn(yy, tgt), y)
+        (g_loss,) = loss_vjp(jnp.ones((), loss_m.dtype))
+        is_last = stage == n_stages - 1
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(is_last, f_valid),
+            loss_m.astype(jnp.float32), 0.0)
+
+        # ---- B-slot -----------------------------------------------------
+        m_b = r - 2 * (n_stages - 1) + stage
+        b_valid = jnp.logical_and(m_b >= 0, m_b < n_micro)
+        m_b_c = jnp.clip(m_b, 0, n_micro - 1)
+        x_b = lax.dynamic_index_in_dim(stash, m_b_c % stash_len, 0,
+                                       keepdims=False)
+        # on the last stage the backward microbatch IS this round's
+        # forward microbatch, so its loss cotangent seeds directly
+        g_in = jnp.where(is_last, g_loss.astype(jnp.float32), cot)
+        _, b_vjp = jax.vjp(stage_fn, stage_params, x_b)
+        dparams, dx = b_vjp(g_in.astype(probe.dtype))
+        grads = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(b_valid, d.astype(jnp.float32),
+                                       0.0),
+            grads, dparams)
+
+        # ---- communicate ------------------------------------------------
+        act = collectives.ring_permute(y, axis, 1)
+        cot = collectives.ring_permute(
+            jnp.where(b_valid, dx.astype(jnp.float32), 0.0), axis, -1)
+        return act, cot, stash, grads, loss_acc
+
+    _, _, _, grads, loss_acc = lax.fori_loop(
+        0, n_micro + 2 * n_stages - 2, tick,
+        (act, cot, stash, grads, loss_acc))
+    loss_total = collectives.broadcast_from(loss_acc, axis,
+                                            root=n_stages - 1)
+    return loss_total, grads
+
+
+def pipeline_apply_1f1b(stage_params, microbatches, targets, stage_fn,
+                        loss_fn, mesh=None, axis=AXIS_PP,
+                        batch_axis=None):
+    """1F1B training pipeline: returns (summed loss, per-stage grads).
+
+    ``stage_fn(params, x) -> y`` as in :func:`pipeline_apply`;
+    ``loss_fn(y, target) -> scalar`` is evaluated on the LAST stage's
+    output per microbatch.  ``targets``: [n_micro, mb, ...] replicated.
+    Gradients come back sharded like ``stage_params`` (leading stage
+    dim over ``axis``) and are exact — identical to autodiff through
+    the sequential composition of stages.
+    """
+    if mesh is None:
+        return _pipeline_1f1b_local(stage_params, microbatches, targets,
+                                    stage_fn, loss_fn, axis)
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params)
+    data_spec = (P(None, batch_axis) if batch_axis else P())
+
+    def fn(sp, mb, tg):
+        local = jax.tree_util.tree_map(lambda p: p[0], sp)
+        loss, grads = _pipeline_1f1b_local(local, mb, tg, stage_fn,
+                                           loss_fn, axis)
+        if batch_axis is not None:
+            # each batch shard computed its slice's loss/grads; the
+            # replicated out_specs promise the TOTAL — sum them
+            loss = lax.psum(loss, batch_axis)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, batch_axis), grads)
+        grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+        return loss, grads
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, data_spec, data_spec),
+        out_specs=(P(), param_specs),
+        check_rep=False)(stage_params, microbatches, targets)

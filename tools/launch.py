@@ -69,6 +69,11 @@ def _run_local_once(args, cmd, attempt):
         if args.cpu_fake_devices:
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
+        if args.local_device_count:
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = ("%s --xla_force_host_platform_device_count"
+                                "=%d" % (flags,
+                                         args.local_device_count)).strip()
         procs.append(subprocess.Popen(cmd, env=env))
     try:
         while True:
@@ -220,6 +225,10 @@ def main(argv=None):
     parser.add_argument("--cpu-fake-devices", action="store_true",
                         help="force JAX_PLATFORMS=cpu in workers (local "
                         "fake-cluster testing)")
+    parser.add_argument("--local-device-count", type=int, default=0,
+                        help="virtual devices per worker process "
+                        "(xla_force_host_platform_device_count; test "
+                        "multi-chip-per-host jobs without hardware)")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="restart the whole job this many times when "
                         "a worker dies (workers resume from their own "
