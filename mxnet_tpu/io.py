@@ -587,7 +587,10 @@ class ImageRecordIter(DataIter):
         self._np_data = _np.zeros((batch_size, c, h, w), dtype=_np.float32)
         self._np_label = _np.zeros((batch_size, self.label_width),
                                    dtype=_np.float32)
+        self._first_data = None
+        self._first_label = None
         self._pending = None
+        self._tail_pad = 0  # set after num_samples is known (below)
         self._eof = False
         if self._lib is not None:
             mean = (_ct.c_float * 3)(mean_r, mean_g, mean_b)
@@ -608,6 +611,8 @@ class ImageRecordIter(DataIter):
                                    resize, rand_crop, rand_mirror,
                                    (mean_r, mean_g, mean_b),
                                    (std_r, std_g, std_b))
+        rem = self.num_samples % self.batch_size
+        self._tail_pad = (self.batch_size - rem) if rem else 0
 
     # -- fallback path ----------------------------------------------------
     def _py_fallback_init(self, path_imgrec, path_imgidx, shuffle, seed,
@@ -752,6 +757,29 @@ class ImageRecordIter(DataIter):
             # discard-tail semantics: treat the short batch as the end
             self._eof = True
             return False
+        if self._pad:
+            # round_batch: the reference wraps the short batch with
+            # samples from the START of the epoch
+            # (src/io/iter_image_recordio_2.cc round_batch_), which is
+            # why its metrics ignored pad harmlessly; filling from the
+            # cached first batch keeps data/label rows consistent
+            # instead of leaving stale prior-batch rows
+            if self._first_data is not None:
+                self._np_data[n:] = self._first_data[:self._pad]
+                self._np_label[n:] = self._first_label[:self._pad]
+            else:
+                # dataset smaller than one batch: wrap this batch's own
+                # valid rows (still real, consistent sample/label pairs)
+                reps = -(-self._pad // n)
+                self._np_data[n:] = _np.concatenate(
+                    [self._np_data[:n]] * reps)[:self._pad]
+                self._np_label[n:] = _np.concatenate(
+                    [self._np_label[:n]] * reps)[:self._pad]
+        elif self._first_data is None and self._tail_pad:
+            # cache only the rows a tail batch will need (none when the
+            # dataset divides the batch size)
+            self._first_data = self._np_data[:self._tail_pad].copy()
+            self._first_label = self._np_label[:self._tail_pad].copy()
         return True
 
     def getdata(self):

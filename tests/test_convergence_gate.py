@@ -1,0 +1,101 @@
+"""Reference-scale convergence gate (VERDICT r4 #8).
+
+The reference CI trained CIFAR-10 to >=0.93 top-1 as a merge gate
+(/root/reference/Jenkinsfile:476 -> example/image-classification/
+test_score.py).  Zero-egress analogue: a 10-class 32x32 JPEG dataset
+with genuine visual structure (class = oriented stripe pattern + color
+cast + noise, undecidable from any single pixel) written as RecordIO,
+decoded and augmented by the NATIVE C++ pipeline, trained by a
+downscaled ResNet through Module(context=[8 devices]) SPMD — every
+layer of the production stack in one gate, with a real accuracy
+threshold.
+"""
+import io as pyio
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_image(cls, rng, edge=32):
+    """Class-dependent oriented stripes + color cast, heavy noise."""
+    yy, xx = np.mgrid[0:edge, 0:edge].astype(np.float32)
+    angle = cls * np.pi / 10.0
+    wave = np.sin((np.cos(angle) * xx + np.sin(angle) * yy)
+                  * (2 * np.pi / 8.0))
+    img = np.zeros((edge, edge, 3), np.float32)
+    cast = np.array([np.cos(cls * 0.7), np.sin(cls * 0.9),
+                     np.cos(cls * 1.3)]) * 0.25 + 0.5
+    for c in range(3):
+        img[:, :, c] = 0.5 + 0.35 * wave * cast[c]
+    img += rng.randn(edge, edge, 3) * 0.08
+    return (np.clip(img, 0, 1) * 255).astype(np.uint8)
+
+
+def _write_rec(path, n, rng, quality=90):
+    from PIL import Image
+    idx_path = path[:-4] + ".idx"
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    labels = rng.randint(0, 10, n)
+    for i in range(n):
+        buf = pyio.BytesIO()
+        Image.fromarray(_make_image(labels[i], rng)).save(
+            buf, format="JPEG", quality=quality)
+        header = recordio.IRHeader(0, float(labels[i]), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.getvalue()))
+    rec.close()
+    return labels
+
+
+@pytest.mark.slow
+def test_cifar_scale_convergence_gate(tmp_path):
+    rng = np.random.RandomState(0)
+    train_rec = str(tmp_path / "train.rec")
+    val_rec = str(tmp_path / "val.rec")
+    _write_rec(train_rec, 2000, rng)
+    _write_rec(val_rec, 400, rng)
+
+    # the native C++ pipeline decodes/augments (the gate covers IO too)
+    common = dict(data_shape=(3, 28, 28), batch_size=64,
+                  mean_r=127.5, mean_g=127.5, mean_b=127.5,
+                  std_r=60.0, std_g=60.0, std_b=60.0,
+                  preprocess_threads=4, prefetch_buffer=4)
+    # no rand_mirror: class identity is stripe ORIENTATION, and a
+    # horizontal flip maps angle th to pi-th — i.e. class c onto class
+    # 10-c — so mirroring would make the label set genuinely ambiguous
+    train = mx.io.ImageRecordIter(path_imgrec=train_rec, shuffle=True,
+                                  rand_crop=True, **common)
+    val = mx.io.ImageRecordIter(path_imgrec=val_rec, shuffle=False,
+                                **common)
+
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_resnet_sym", os.path.join(REPO, "example",
+                                    "image-classification", "symbols",
+                                    "resnet.py"))
+    resnet = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(resnet)
+    net = resnet.get_symbol(num_classes=10, num_layers=8,
+                            image_shape="3,28,28")
+
+    import jax
+    n_dev = len(jax.devices())
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(n_dev)])
+    np.random.seed(7)
+    mx.random.seed(7)
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            eval_metric="accuracy", num_epoch=12)
+    val.reset()
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    # the reference gate was 0.93 on real CIFAR after 300 epochs; this
+    # structured-synthetic gate must clear 0.90 in 12
+    assert acc >= 0.90, "convergence gate failed: top-1 %.3f" % acc

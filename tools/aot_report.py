@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""AOT cost/memory report — the fallback perf artifact when the TPU
+tunnel is unavailable (VERDICT r3 next-round item 1).
+
+For each headline workload the driver would time on hardware, this
+lowers the EXACT jitted training step the benchmark runs and reports:
+
+- the ANALYTIC FLOPs/step (the same formulas bench.py's MFU uses —
+  the honest denominator),
+- XLA's own HLO flop count as a crosscheck (CAVEAT: flops inside
+  Pallas kernels are invisible to HLO cost analysis, and CPU-lowered
+  "bytes accessed" reflects CPU fusion, not TPU — so no roofline is
+  derived from it),
+- projected v5e throughput at the efficiency levels the framework has
+  actually MEASURED (PERF.md): pessimistic/measured/optimistic MFU.
+
+Projections are scenarios, not measurements — PERF.md carries the real
+numbers.  Run: python tools/aot_report.py  (writes PERF_AOT.md)
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+V5E_PEAK_BF16 = 197e12     # dense bf16 FLOP/s
+
+# per-workload: (last measured note, analytic flops/step, MFU scenarios)
+def _resnet_flops(batch):
+    return 3 * 2 * 4.089e9 * batch          # bench.py NETWORKS formula
+
+def _attn_flops(b, h, t, d):
+    return 3.5 * 4 * b * h * t * t * d / 2  # causal fwd+bwd
+
+def _gpt_flops(batch, seq, n_layer=12, d_model=768, vocab=50304):
+    n_matmul = n_layer * 12 * d_model * d_model + d_model * vocab
+    return (6 * n_matmul * seq + n_layer * _attn_flops(1, 12, seq,
+                                                       64)) * batch
+
+MEASURED = {
+    # MFU scenarios are on the ANALYTIC-flop basis used below: PERF.md's
+    # 25.5% resnet row is XLA-flop basis (XLA counts ~8% under analytic,
+    # bench.py note) — 2235 img/s on analytic 24.5 GFLOP/img is 27.8%
+    "resnet50_bs128": ("2235 img/s, ~25.5% XLA-basis MFU (PERF.md r3)",
+                       (0.20, 0.278, 0.32)),
+    "flash_attention_fwd_bwd": ("fwd 36-40 TFLOP/s (~19% fwd+bwd, "
+                                "pre-rewrite kernels)",
+                                (0.15, 0.19, 0.30)),
+    "gpt2_small_T2048": ("never measured (round-4 addition)",
+                         (0.25, 0.35, 0.45)),
+}
+
+
+def _cost(lowered):
+    try:
+        ca = lowered.compile().cost_analysis()
+    except Exception:
+        ca = lowered.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    return float(ca.get("flops", 0.0)), float(
+        ca.get("bytes accessed", 0.0))
+
+
+def resnet_step():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.block import functionalize
+
+    batch = 128
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    x0 = jnp.zeros((batch, 3, 224, 224), jnp.float32)
+    fn, params = functionalize(net, x0, train=True)
+    n_aux = fn.num_aux
+    diff = params[:len(params) - n_aux]
+    aux = params[len(params) - n_aux:]
+    mom = [jnp.zeros_like(p) for p in diff]
+
+    def loss_fn(diff, aux, x, y):
+        cdiff = [p.astype(jnp.bfloat16) for p in diff]
+        (logits,), new_aux = fn(cdiff + list(aux), x.astype(jnp.bfloat16))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean(), \
+            new_aux
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(diff, aux, mom, x, y):
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(diff, aux, x, y)
+        new_mom = [0.9 * m - 0.05 * g.astype(jnp.float32)
+                   for m, g in zip(mom, grads)]
+        new_diff = [p + m for p, m in zip(diff, new_mom)]
+        return new_diff, list(new_aux), new_mom, loss
+
+    y = jnp.zeros((batch,), jnp.int32)
+    return step.lower(diff, aux, mom, x0, y), batch, "img"
+
+
+def attention_step():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, h, t, d = 4, 16, 4096, 128
+    q = jnp.zeros((b, h, t, d), jnp.bfloat16)
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+        l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l, sum(x.astype(jnp.float32).sum() for x in g)
+
+    return step.lower(q, q, q), b * h * t, "q-token"
+
+
+def gpt2_step():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon.model_zoo import gpt
+    from mxnet_tpu.gluon.block import functionalize
+
+    batch, seq, vocab = 8, 2048, 50304
+    net = gpt.GPTLM(vocab, 12, 768, 12, max_len=seq)
+    net.initialize()
+    toks = jnp.zeros((batch, seq), jnp.int32)
+    fn, params = functionalize(net, toks, train=True)
+    mom = [jnp.zeros_like(p) for p in params]
+
+    def loss_fn(ps, x, y):
+        cps = [p.astype(jnp.bfloat16) for p in ps]
+        (logits,), _ = fn(cps, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(ps, mom, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(ps, x, y)
+        new_mom = [0.9 * m - 3e-4 * g.astype(jnp.float32)
+                   for m, g in zip(mom, grads)]
+        return [p + m for p, m in zip(ps, new_mom)], new_mom, loss
+
+    return step.lower(params, mom, toks, toks), batch * seq, "token"
+
+
+WORKLOADS = [
+    ("resnet50_bs128", resnet_step, _resnet_flops(128)),
+    ("flash_attention_fwd_bwd", attention_step,
+     _attn_flops(4, 16, 4096, 128)),
+    ("gpt2_small_T2048", gpt2_step, _gpt_flops(8, 2048)),
+]
+
+
+def main():
+    rows = []
+    for name, build, analytic in WORKLOADS:
+        lowered, units, unit_name = build()
+        xla_flops, _ = _cost(lowered)
+        note, (lo, mid, hi) = MEASURED[name]
+        row = {
+            "workload": name,
+            "analytic_flops_per_step": analytic,
+            "xla_hlo_flops_per_step": xla_flops,
+            "xla_vs_analytic": (xla_flops / analytic) if analytic else None,
+            "unit": unit_name,
+            "units_per_step": units,
+            "last_measured": note,
+        }
+        for tag, mfu in (("pessimistic", lo), ("measured", mid),
+                         ("optimistic", hi)):
+            t = analytic / (mfu * V5E_PEAK_BF16)
+            row["projected_%s" % tag] = {
+                "mfu": mfu, "ms_per_step": round(t * 1e3, 2),
+                "%s_per_sec" % unit_name: round(units / t, 1)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    lines = [
+        "# AOT cost report (tunnel-outage fallback artifact)",
+        "",
+        "The EXACT jitted benchmark steps, lowered ahead-of-time (proof",
+        "they compile) with their analytic training FLOPs and projected",
+        "v5e throughput at measured-efficiency scenarios.  XLA's HLO",
+        "flop count is a crosscheck only: Pallas-kernel flops are",
+        "invisible to it, and CPU-lowered byte counts reflect CPU",
+        "fusion, so no roofline is derived.  PERF.md has the real",
+        "measurements.  Regenerate: `python tools/aot_report.py`.",
+        "",
+        "| workload | analytic GFLOP/step | XLA/analytic | proj @low "
+        "MFU | proj @measured MFU | proj @high MFU | last measured |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        u = r["unit"]
+
+        def fmt(tag):
+            p = r["projected_%s" % tag]
+            return "%.0f %s/s @%.0f%%" % (p["%s_per_sec" % u], u,
+                                          p["mfu"] * 100)
+        lines.append(
+            "| %s | %.1f | %.2f | %s | %s | %s | %s |"
+            % (r["workload"], r["analytic_flops_per_step"] / 1e9,
+               r["xla_vs_analytic"] or 0, fmt("pessimistic"),
+               fmt("measured"), fmt("optimistic"), r["last_measured"]))
+    lines.append("")
+    with open(os.path.join(REPO, "PERF_AOT.md"), "w") as f:
+        f.write("\n".join(lines))
+    print("wrote PERF_AOT.md")
+
+
+if __name__ == "__main__":
+    main()
