@@ -54,25 +54,20 @@ def batches(tokens, seq_len, batch_size, rng):
 
 def sample(net, stoi_chars, prompt_ids, n_new, max_len, temperature=0.8,
            seed=0):
-    """Sampling generation over a sliding context window (no KV cache in
-    the example; predictor-level caching is future work)."""
-    rng = np.random.RandomState(seed)
-    ctx_ids = list(prompt_ids)
-    for _ in range(n_new):
-        window = ctx_ids[-max_len:]
-        # fixed-shape forward (one compile): right-pad, read the logits
-        # at the last real position — causality ignores the tail
-        padded = np.zeros(max_len, np.int32)
-        padded[:len(window)] = window
-        x = mx.nd.array(padded[None], dtype="int32")
-        # slice off the MXU vocab padding: padded slots carry probability
-        # mass early in training and decode to no character
-        logits = net(x).asnumpy()[0, len(window) - 1][:len(stoi_chars)]
-        logits = logits / temperature
-        p = np.exp(logits - logits.max())
-        p = p / p.sum()
-        ctx_ids.append(int(rng.choice(len(p), p=p)))
-    return "".join(stoi_chars[i] for i in ctx_ids)
+    """KV-cache generation (gpt.generate): one jitted scan, O(T) per new
+    token.  Out-of-vocab MXU-padding tokens (possible at high
+    temperature early in training) render as '?'."""
+    from mxnet_tpu.gluon.model_zoo import gpt as gpt_mod
+    prompt = np.asarray(prompt_ids, np.int32)[None]
+    # long prompts: keep the most recent context that leaves room for
+    # n_new tokens inside the model's window
+    keep = max(1, min(prompt.shape[1], max_len - n_new))
+    prompt = prompt[:, -keep:]
+    n_new = min(n_new, max_len - prompt.shape[1])
+    out = gpt_mod.generate(net, prompt, n_new, temperature=temperature,
+                           seed=seed)[0]
+    return "".join(stoi_chars[i] if i < len(stoi_chars) else "?"
+                   for i in out)
 
 
 def main():

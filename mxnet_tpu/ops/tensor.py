@@ -293,9 +293,16 @@ def _square_sum(data, axis=None, keepdims=False, exclude=False):
     return jnp.sum(data * data, axis=ax, keepdims=bool(keepdims))
 
 
-@register_op("norm", arg_names=("data",))
-def _norm(data):
-    return jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,))
+@register_op("norm", arg_names=("data",),
+             param_defaults={"axis": None, "keepdims": False})
+def _norm(data, axis=None, keepdims=False):
+    """Reference v0.11 semantics: flatten-L2 returning shape (1,)
+    (broadcast_reduce_op_value.cc:226).  ``axis``/``keepdims`` are a
+    forward-compatible extension (the 1.x signature)."""
+    if axis is None and not keepdims:
+        return jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis,
+                            keepdims=bool(keepdims)))
 
 
 @register_op("argmax", arg_names=("data",),
