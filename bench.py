@@ -211,7 +211,10 @@ def bench_transformer():
     from mxnet_tpu.gluon.model_zoo import gpt
     from mxnet_tpu.gluon.block import functionalize
 
-    net = gpt.GPTLM(vocab, n_layer, d_model, n_head, max_len=seq)
+    # BENCH_REMAT=1: per-block rematerialisation (memory for FLOPs —
+    # lets T or batch grow past HBM; MFU denominator stays the same)
+    net = gpt.GPTLM(vocab, n_layer, d_model, n_head, max_len=seq,
+                    remat=os.environ.get("BENCH_REMAT") == "1")
     net.initialize()
     toks0 = jnp.zeros((batch, seq), jnp.int32)
     fn, params = functionalize(net, toks0, train=True)

@@ -59,11 +59,11 @@ def sample(net, stoi_chars, prompt_ids, n_new, max_len, temperature=0.8,
     temperature early in training) render as '?'."""
     from mxnet_tpu.gluon.model_zoo import gpt as gpt_mod
     prompt = np.asarray(prompt_ids, np.int32)[None]
-    # long prompts: keep the most recent context that leaves room for
-    # n_new tokens inside the model's window
-    keep = max(1, min(prompt.shape[1], max_len - n_new))
+    # fit the request into the model window, prompt first: keep the
+    # whole (recent) prompt, then generate as many tokens as still fit
+    keep = min(prompt.shape[1], max_len - 1)
     prompt = prompt[:, -keep:]
-    n_new = min(n_new, max_len - prompt.shape[1])
+    n_new = min(n_new, max_len - keep)
     out = gpt_mod.generate(net, prompt, n_new, temperature=temperature,
                            seed=seed)[0]
     return "".join(stoi_chars[i] if i < len(stoi_chars) else "?"

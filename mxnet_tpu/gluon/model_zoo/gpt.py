@@ -71,12 +71,13 @@ class GPTLM(HybridBlock):
     """
 
     def __init__(self, vocab_size, num_layers, units, num_heads,
-                 max_len=1024, dropout=0.0, **kwargs):
+                 max_len=1024, dropout=0.0, remat=False, **kwargs):
         super().__init__(**kwargs)
         self._vocab = vocab_size
         self._units = units
         self._max_len = max_len
         self._dropout = dropout
+        self._remat = remat
         with self.name_scope():
             self.wte = self.params.get("wte_weight",
                                        shape=(vocab_size, units))
@@ -99,7 +100,19 @@ class GPTLM(HybridBlock):
         h = h + F.slice_axis(wpe, axis=0, begin=0, end=t)
         if self._dropout:
             h = F.Dropout(h, p=self._dropout)
-        h = self.blocks(h)
+        if self._remat and not hasattr(h, "_data"):
+            # per-block rematerialisation: the backward recomputes each
+            # block's activations instead of keeping them in HBM —
+            # memory O(L·T·d) -> O(T·d) + one extra forward of FLOPs,
+            # the standard long-sequence trade.  Applies on the TRACED
+            # path only (hybrid values are jnp arrays there, which
+            # jax.checkpoint needs); the imperative NDArray path records
+            # op-by-op on the autograd tape, where remat has no meaning.
+            import jax
+            for blk in self.blocks._children:
+                h = jax.checkpoint(lambda x, b=blk: b(x))(h)
+        else:
+            h = self.blocks(h)
         h = self.ln_f(h)
         # tied head: one [B·T, d] x [d, V] matmul against the embedding
         return F.FullyConnected(h, wte, num_hidden=self._vocab,
@@ -267,10 +280,10 @@ def generate(net, prompt_ids, n_new, temperature=0.0, seed=0):
 
 
 def get_gpt(num_layers, units, num_heads, vocab_size=50257, max_len=1024,
-            dropout=0.0, **kwargs):
+            dropout=0.0, remat=False, **kwargs):
     """Build a GPTLM with the vocab padded to the MXU lane width."""
     return GPTLM(_pad_vocab(vocab_size), num_layers, units, num_heads,
-                 max_len=max_len, dropout=dropout, **kwargs)
+                 max_len=max_len, dropout=dropout, remat=remat, **kwargs)
 
 
 def gpt2_tiny(**kwargs):

@@ -186,3 +186,29 @@ def test_gpt_generate_sampled_deterministic():
     b = gpt.generate(net, prompt, 10, temperature=0.9, seed=4)
     np.testing.assert_array_equal(a, b)
     assert a.shape == (1, 13)
+
+
+def test_gpt_remat_identical_values_and_grads():
+    """remat=True must change memory, not math: loss and gradients
+    bit-compare against the non-remat net with shared weights."""
+    net = gpt.GPTLM(32, 2, 32, 4, max_len=16)
+    net.initialize(mx.init.Xavier())
+    toks = jnp.array(np.random.RandomState(3).randint(0, 32, (2, 16)),
+                     jnp.int32)
+    fn, params = functionalize(net, toks, train=True)
+    net._remat = True
+    net._cached_op = None  # force a fresh trace with remat on
+    fn_r, params_r = functionalize(net, toks, train=True)
+
+    def loss(f):
+        def go(ps):
+            (logits,), _ = f(ps, toks)
+            return jax.nn.log_softmax(logits, -1)[..., 0].mean()
+        return go
+
+    l, g = jax.value_and_grad(loss(fn))(params)
+    l_r, g_r = jax.value_and_grad(loss(fn_r))(params_r)
+    np.testing.assert_allclose(float(l), float(l_r), rtol=1e-6)
+    for a, b, n in zip(g, g_r, fn.param_names):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
