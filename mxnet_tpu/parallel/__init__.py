@@ -43,5 +43,7 @@ from . import ulysses
 from .ulysses import ulysses_attention
 from . import moe
 from .moe import MoELayer, moe_apply
+from . import gpt_spmd
+from .gpt_spmd import shard_gpt, gpt_param_spec
 from . import pipeline
 from .pipeline import pipeline_apply, pipeline_apply_1f1b

@@ -75,8 +75,21 @@ def make_train_step(loss_fn, mesh, optimizer_apply=None, optimizer_init=None,
         params = {k: jax.device_put(v, shardings[k])
                   for k, v in params.items()}
         state = optimizer_init(params)
-        state = jax.tree_util.tree_map(
-            lambda s: jax.device_put(s, NamedSharding(mesh, P())), state)
+
+        def place(sub):
+            # per-param state (momentum etc.) follows its param's
+            # sharding — a replicated momentum for a tp-sharded weight
+            # would force an all-gather every update
+            if isinstance(sub, dict) and set(sub) == set(params):
+                return {k: jax.device_put(v, shardings[k])
+                        for k, v in sub.items()}
+            return jax.tree_util.tree_map(
+                lambda s: jax.device_put(s, NamedSharding(mesh, P())),
+                sub)
+        state = {k: place(v) for k, v in state.items()} \
+            if isinstance(state, dict) else jax.tree_util.tree_map(
+                lambda s: jax.device_put(s, NamedSharding(mesh, P())),
+                state)
         return params, state
 
     def batch_sharding(batch):

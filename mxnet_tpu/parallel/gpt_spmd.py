@@ -1,0 +1,97 @@
+"""Mesh-parallel training recipe for the GPT flagship.
+
+A thin, name-rule layer over the package's existing machinery: the
+Megatron column/row split of each flagship block expressed as
+``sharding.PartitionRule``s, fed to ``sharding.shard_params`` and
+``data_parallel.make_train_step`` — the same builders every other model
+uses.  XLA GSPMD inserts the per-block all-reduces; no communication
+code in the model, and the identical ``functionalize``d Gluon forward
+runs single-chip and mesh-parallel.
+
+Sharding rules (weight layouts are FullyConnected's (out, in)):
+
+- ``attn_qkv_weight`` / ``fc1_weight``: column-parallel — OUT dim over
+  tp (each shard holds a head/ffn slice); their biases likewise.
+- ``attn_out_weight`` / ``fc2_weight``: row-parallel — IN dim over tp
+  (the following residual-add is the psum XLA inserts).
+- embeddings / layernorms / position table / row biases: replicated
+  (the tied-head [B·T, d] x [d, V] matmul batch-splits over dp).
+
+Long-context runs compose sp on top via ``parallel.ring_attention``
+(the dryrun's transformer pass shows the shard_map form); this module
+covers the dp x tp grid where XLA propagation alone suffices.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import data_parallel as _dp
+from . import sharding as _shd
+from .mesh import AXIS_DP, AXIS_TP
+from jax.sharding import PartitionSpec as P
+
+#: Megatron-style rules for the flagship's parameter names
+GPT_TP_RULES = _shd.make_sharding_rules(
+    (r"(attn_qkv|fc1)_weight$", P(AXIS_TP, None), 2),
+    (r"(attn_qkv|fc1)_bias$", P(AXIS_TP), 1),
+    (r"(attn_out|fc2)_weight$", P(None, AXIS_TP), 2),
+)
+
+
+def gpt_param_spec(name, val=None, tp_axis=AXIS_TP):
+    """PartitionSpec for one flagship parameter (by reference-suffix)."""
+    return _shd.spec_for(name, val, GPT_TP_RULES)
+
+
+def shard_gpt(fn, params, mesh):
+    """Place a functionalized GPT's param LIST on ``mesh`` per the
+    rules (divisibility falls back to replication, sharding.py)."""
+    placed = _shd.shard_params(dict(zip(fn.param_names, params)), mesh,
+                               rules=GPT_TP_RULES)
+    return [placed[n] for n in fn.param_names]
+
+
+def shard_batch(tokens, mesh, dp_axis=AXIS_DP):
+    """dp-shard a [B, T] token batch over the mesh."""
+    return jax.device_put(
+        tokens, _shd.named_sharding(mesh,
+                                    _shd.batch_spec(tokens.ndim, dp_axis)))
+
+
+def make_train_step(fn, mesh, lr=3e-4, momentum=0.9, wd=0.0,
+                    dp_axis=AXIS_DP, compute_dtype=None):
+    """Build (init_fn, step_fn) for flagship causal-LM training.
+
+    Rides ``data_parallel.make_train_step`` (same jit/donation/batch
+    placement path as every dp model) with ``GPT_TP_RULES`` as the
+    param rules.  ``fn`` is ``functionalize(net, toks, train=True)``.
+
+    - ``init_fn(param_list) -> (params_dict, opt_state)`` — params
+      tensor-sharded per the rules, optimizer state following them.
+    - ``step_fn(params_dict, opt_state, {"x": toks, "y": targets},
+      rng) -> (params_dict, opt_state, loss)`` — rng is threaded into
+      the forward, so dropout masks differ per step.
+    """
+    cdt = compute_dtype or jnp.float32
+    names = list(fn.param_names)
+
+    def loss_fn(params, batch, rng):
+        ps = [params[n].astype(cdt) for n in names]
+        (logits,), _ = fn(ps, batch["x"], rng=rng)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, batch["y"][..., None],
+                                    axis=-1).mean()
+
+    init_fn, step_fn = _dp.make_train_step(
+        loss_fn, mesh,
+        optimizer_apply=functools.partial(_dp.sgd_momentum_apply, lr=lr,
+                                          momentum=momentum, wd=wd),
+        param_rules=GPT_TP_RULES, dp_axis=dp_axis)
+
+    def init_list(param_list):
+        return init_fn(dict(zip(names, param_list)))
+
+    return init_list, step_fn
