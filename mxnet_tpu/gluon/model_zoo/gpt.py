@@ -123,6 +123,41 @@ def _pad_vocab(v, mult=128):
     return (v + mult - 1) // mult * mult
 
 
+def pack_sequences(docs, seq_len, pad_id=0):
+    """Pack variable-length token sequences into fixed [N, seq_len] rows
+    with segment ids — the TPU-first replacement for the reference's
+    bucketing (static shapes keep ONE compiled program; the flash
+    kernel's ``segment_ids`` mask keeps documents independent).
+
+    ``docs``: iterable of 1-d int token arrays.  Returns (tokens,
+    segments): int32 [N, seq_len] each.  Segments are 1-based per row;
+    0 marks padding (give the attention mask a pad id no real segment
+    uses and pad positions attend nothing real).
+    """
+    import numpy as np
+    rows, segs = [], []
+    cur = np.full(seq_len, pad_id, np.int32)
+    cur_seg = np.zeros(seq_len, np.int32)
+    pos, seg_id = 0, 1
+    for doc in docs:
+        doc = np.asarray(doc, np.int32)
+        while doc.size:
+            if pos == seq_len:
+                rows.append(cur); segs.append(cur_seg)
+                cur = np.full(seq_len, pad_id, np.int32)
+                cur_seg = np.zeros(seq_len, np.int32)
+                pos, seg_id = 0, 1
+            take = min(doc.size, seq_len - pos)
+            cur[pos:pos + take] = doc[:take]
+            cur_seg[pos:pos + take] = seg_id
+            pos += take
+            doc = doc[take:]
+        seg_id += 1
+    if pos:
+        rows.append(cur); segs.append(cur_seg)
+    return np.stack(rows), np.stack(segs)
+
+
 # ---------------------------------------------------------------------------
 # KV-cache incremental decoding
 # ---------------------------------------------------------------------------
@@ -180,8 +215,11 @@ def _decode_one(p, tok, pos, caches, n_heads):
         qkv = h @ lp["qkv_w"].T + lp["qkv_b"]          # [B, 3C]
         c = x.shape[-1]
         d = c // n_heads
-        qkv = qkv.reshape(b, 3, n_heads, d)
-        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, H, D]
+        # head-major fused layout [H, 3, D] (basic_layers.py)
+        qkv = qkv.reshape(b, n_heads, 3, d)
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]                               # [B, H, D]
         kc = lax.dynamic_update_index_in_dim(kc, k[:, :, None], pos, 2)
         vc = lax.dynamic_update_index_in_dim(vc, v[:, :, None], pos, 2)
         s = jnp.einsum("bhd,bhtd->bht", q, kc) / jnp.sqrt(

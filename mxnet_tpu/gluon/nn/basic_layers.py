@@ -256,8 +256,12 @@ class FlashSelfAttention(HybridBlock):
         h = self._num_heads
         d = self._units // h
         qkv = self.qkv(x)                        # [B, T, 3C]
-        qkv = F.reshape(qkv, shape=(b, t, 3, h, d))
-        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))  # [3, B, H, T, D]
+        # HEAD-MAJOR fused layout [H, 3, D]: a tensor-parallel column
+        # split of the qkv weight's out dim then lands on whole heads,
+        # so GSPMD propagates it into the attention (a [3, H, D] layout
+        # has indivisible major factor 3 and forces an all-gather)
+        qkv = F.reshape(qkv, shape=(b, t, h, 3, d))
+        qkv = F.transpose(qkv, axes=(3, 0, 2, 1, 4))  # [3, B, H, T, D]
         q = F.reshape(F.slice_axis(qkv, axis=0, begin=0, end=1),
                       shape=(b, h, t, d))
         k = F.reshape(F.slice_axis(qkv, axis=0, begin=1, end=2),

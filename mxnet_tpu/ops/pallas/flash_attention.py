@@ -66,13 +66,23 @@ def _q_bounds_mask(q_off, bq, bk, tq):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc,
-                l_acc, *, scale, causal, tk_true):
+def _fwd_kernel(*refs, scale, causal, tk_true, has_seg=False):
     """One (q-block, k-block) step; the k dimension is the grid's
     innermost (sequential) axis, so K/V stream HBM->VMEM one block at a
     time — VMEM use is O(block), independent of sequence length — while
-    the online-softmax state lives in VMEM scratch across the k sweep."""
+    the online-softmax state lives in VMEM scratch across the k sweep.
+
+    With ``has_seg`` two extra int32 refs carry per-position segment
+    ids (sequence packing: tokens attend within their segment only —
+    the TPU-first replacement for the reference's bucketing)."""
     pl = _pl()
+    if has_seg:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
+         o_acc, m_acc, l_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         o_acc, m_acc, l_acc) = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -97,6 +107,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc,
         mask = _kv_bounds_mask(k_off, bq, bk, tk_true)
         if causal:
             mask &= _causal_mask(q_off, k_off, bq, bk)
+        if has_seg:
+            mask &= _segment_mask(qs_ref, ks_ref)
         s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_acc[...]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -121,35 +133,52 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc,
         lse_ref[0] = m_acc[...] + jnp.log(l_safe)
 
 
-def _pad_to(x, axis, mult):
-    """Zero-pad axis up to a multiple of mult (pl.ds clamps out-of-range
-    block starts, silently shifting the window — aligned shapes + masks
-    keep the math exact)."""
+def _pad_to_val(x, axis, mult, val):
+    """Pad axis up to a multiple of mult with a constant (pl.ds clamps
+    out-of-range block starts, silently shifting the window — aligned
+    shapes + masks keep the math exact; segment ids pad with ids that
+    can never match a real segment)."""
     size = x.shape[axis]
     rem = size % mult
     if rem == 0:
         return x
     pad = [(0, 0)] * x.ndim
     pad[axis] = (0, mult - rem)
-    return jnp.pad(x, pad)
+    return jnp.pad(x, pad, constant_values=val)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _pad_to(x, axis, mult):
+    return _pad_to_val(x, axis, mult, 0)
+
+
+def _segment_mask(qs_ref, ks_ref):
+    """Packing mask: attend iff the q and k positions share a segment
+    (sibling of _causal_mask; refs are (1, block) int32)."""
+    return qs_ref[0][:, None] == ks_ref[0][None, :]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, qseg=None,
+               kseg=None, h=1):
     pl = _pl()
     bh, tq, d = q.shape
     tk = k.shape[1]
     dv = v.shape[2]
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
+    if kseg is not None:
+        kseg = _pad_to_val(kseg, 1, block_k, -1)
+    if qseg is not None:
+        qseg = _pad_to_val(qseg, 1, block_q, -2)
     if tk % block_k:
         # kernels mask on the padded length's tail via tk_true
         kp = _pad_to(k, 1, block_k)
         vp = _pad_to(v, 1, block_k)
         out, lse = _flash_fwd_aligned(q, kp, vp, scale, causal, block_q,
-                                      block_k, tk_true=tk)
+                                      block_k, tk_true=tk, qseg=qseg,
+                                      kseg=kseg, h=h)
         return out, lse
     return _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k,
-                              tk_true=tk)
+                              tk_true=tk, qseg=qseg, kseg=kseg, h=h)
 
 
 def _scratch(shape):
@@ -159,21 +188,33 @@ def _scratch(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
-def _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k, tk_true):
+def _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k, tk_true,
+                       qseg=None, kseg=None, h=1):
     pl = _pl()
     bh, tq, d = q.shape
     tk = k.shape[1]
     dv = v.shape[2]
+    has_seg = qseg is not None
     grid = (bh, pl.cdiv(tq, block_q), pl.cdiv(tk, block_k))
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        # seg ids are [B, T] (not duplicated per head): grid dim 0 is
+        # b*h, so the index map divides the head factor away
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j)),
+        ]
+        operands += [qseg, kseg]
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          tk_true=tk_true),
+                          tk_true=tk_true, has_seg=has_seg),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -185,7 +226,7 @@ def _flash_fwd_aligned(q, k, v, scale, causal, block_q, block_k, tk_true):
         scratch_shapes=[_scratch((block_q, dv)), _scratch((block_q, 1)),
                         _scratch((block_q, 1))],
         interpret=_use_interpret(),
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
@@ -219,11 +260,17 @@ def _bwd_block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     return p, ds, q, k, do
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, tk_true):
+def _bwd_dq_kernel(*refs, scale, causal, tk_true, has_seg=False):
     """dq for one (q-block, k-block) grid step; K/V stream via the
     sequential innermost grid axis, dq accumulates in VMEM scratch."""
     pl = _pl()
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref,
+         ks_ref, dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -240,6 +287,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = _kv_bounds_mask(k_off, bq, bk, tk_true)
         if causal:
             mask &= _causal_mask(q_off, k_off, bq, bk)
+        if has_seg:
+            mask &= _segment_mask(qs_ref, ks_ref)
         _, ds, _, k, _ = _bwd_block_p_ds(q_ref, k_ref, v_ref, do_ref,
                                          lse_ref, delta_ref, mask, scale)
         dq_acc[...] += jax.lax.dot_general(
@@ -256,12 +305,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    tq_true):
+def _bwd_dkv_kernel(*refs, scale, causal, tq_true, has_seg=False):
     """dk/dv for one (k-block, q-block) grid step; Q/dO/lse/delta stream
     via the sequential innermost grid axis."""
     pl = _pl()
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref,
+         ks_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qs_ref = ks_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -280,6 +334,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = _q_bounds_mask(q_off, bq, bk, tq_true)
         if causal:
             mask &= _causal_mask(q_off, k_off, bq, bk)
+        if has_seg:
+            mask &= _segment_mask(qs_ref, ks_ref)
         p, ds, q, _, do = _bwd_block_p_ds(q_ref, k_ref, v_ref, do_ref,
                                           lse_ref, delta_ref, mask, scale)
         # dv += P^T @ dO
@@ -302,9 +358,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-                      *, scale, causal, tq_true, tk_true):
+def _bwd_fused_kernel(*refs, scale, causal, tq_true, tk_true,
+                      has_seg=False):
     """Fused backward: one grid pass (bh, k-blocks, q-blocks) computes
     dq, dk AND dv.  Per (q,k) block pair the split kernels spend 7 MXU
     matmuls (s and dp are computed twice); fusing shares them — 5
@@ -319,6 +374,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     O(nk·Tq·D) written + read once, the same volume the split dq kernel
     re-read k/v with."""
     pl = _pl()
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref,
+         ks_ref, dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qs_ref = ks_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -343,6 +405,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask &= _kv_bounds_mask(k_off, bq, bk, tk_true)
         if causal:
             mask &= _causal_mask(q_off, k_off, bq, bk)
+        if has_seg:
+            mask &= _segment_mask(qs_ref, ks_ref)
         p, ds, q, k, do = _bwd_block_p_ds(q_ref, k_ref, v_ref, do_ref,
                                           lse_ref, delta_ref, mask, scale)
         dv_acc[...] += jax.lax.dot_general(
@@ -366,11 +430,11 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_fused(res, g, scale, causal, block_q, block_k):
+def _flash_bwd_fused(res, g, scale, causal, block_q, block_k, h=1):
     """Single-pass fused backward; dq comes out as nk fp32 partials
     reduced by XLA after the kernel."""
     pl = _pl()
-    q, k, v, out, lse = res
+    q, k, v, out, lse, qseg, kseg = _unpack_res(res)
     do = g
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -388,19 +452,29 @@ def _flash_bwd_fused(res, g, scale, causal, block_q, block_k):
     tqp = qp.shape[1]
     tkp = kp.shape[1]
     nk = tkp // block_k
+    has_seg = qseg is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [qp, kp, vp, dop, lsep, deltap]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, j)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, i)),
+        ]
+        operands += [_pad_to_val(qseg, 1, block_q, -2),
+                     _pad_to_val(kseg, 1, block_k, -1)]
 
     dq_parts, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                          tq_true=tq, tk_true=tk),
+                          tq_true=tq, tk_true=tk, has_seg=has_seg),
         grid=(bh, nk, tqp // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, i, j: (i, b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -414,7 +488,7 @@ def _flash_bwd_fused(res, g, scale, causal, block_q, block_k):
         scratch_shapes=[_scratch((block_k, d)),
                         _scratch((block_k, dv_dim))],
         interpret=_use_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*operands)
     dq = dq_parts.sum(axis=0)[:, :tq].astype(q.dtype)
     return dq, dk[:, :tk], dv[:, :tk]
 
@@ -427,15 +501,25 @@ def _bwd_impl():
     return os.environ.get("MXTPU_FLASH_BWD", "split")
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k):
+def _flash_bwd(res, g, scale, causal, block_q, block_k, h=1):
     if _bwd_impl() == "fused":
-        return _flash_bwd_fused(res, g, scale, causal, block_q, block_k)
-    return _flash_bwd_split(res, g, scale, causal, block_q, block_k)
+        return _flash_bwd_fused(res, g, scale, causal, block_q, block_k,
+                                h=h)
+    return _flash_bwd_split(res, g, scale, causal, block_q, block_k,
+                            h=h)
 
 
-def _flash_bwd_split(res, g, scale, causal, block_q, block_k):
-    pl = _pl()
+def _unpack_res(res):
+    """(q, k, v, out, lse[, qseg, kseg]) -> 7-tuple with None segs."""
+    if len(res) == 7:
+        return res
     q, k, v, out, lse = res
+    return q, k, v, out, lse, None, None
+
+
+def _flash_bwd_split(res, g, scale, causal, block_q, block_k, h=1):
+    pl = _pl()
+    q, k, v, out, lse, qseg, kseg = _unpack_res(res)
     do = g
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -457,37 +541,56 @@ def _flash_bwd_split(res, g, scale, causal, block_q, block_k):
     deltap = _pad_to(delta, 1, block_q)
     tkp = kp.shape[1]
     tqp = qp.shape[1]
+    has_seg = qseg is not None
+    qsegp = _pad_to_val(qseg, 1, block_q, -2) if has_seg else None
+    ksegp = _pad_to_val(kseg, 1, block_k, -1) if has_seg else None
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_ops = [q, kp, vp, do, lse, delta]
+    if has_seg:
+        dq_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j)),
+        ]
+        dq_ops += [qseg, ksegp]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          tk_true=tk),
+                          tk_true=tk, has_seg=has_seg),
         grid=(bh, pl.cdiv(tq, block_q), tkp // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[_scratch((block_q, d))],
         interpret=_use_interpret(),
-    )(q, kp, vp, do, lse, delta)
+    )(*dq_ops)
 
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+    ]
+    dkv_ops = [qp, k, v, dop, lsep, deltap]
+    if has_seg:
+        dkv_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, j)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, i)),
+        ]
+        dkv_ops += [qsegp, kseg]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          tq_true=tq),
+                          tq_true=tq, has_seg=has_seg),
         grid=(bh, pl.cdiv(tk, block_k), tqp // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
@@ -499,7 +602,7 @@ def _flash_bwd_split(res, g, scale, causal, block_q, block_k):
         scratch_shapes=[_scratch((block_k, d)),
                         _scratch((block_k, dv_dim))],
         interpret=_use_interpret(),
-    )(qp, k, v, dop, lsep, deltap)
+    )(*dkv_ops)
     return dq, dk, dv
 
 
@@ -525,21 +628,68 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _int_zero_tangent(x):
+    """The cotangent custom_vjp must return for an integer primal."""
+    import numpy as _np
+    return _np.zeros(x.shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_seg(q3, k3, v3, qseg, kseg, scale, causal, block_q, block_k,
+               h):
+    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                        qseg=qseg, kseg=kseg, h=h)
+    return out
+
+
+def _flash_seg_vjp_fwd(q3, k3, v3, qseg, kseg, scale, causal, block_q,
+                       block_k, h):
+    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                          qseg=qseg, kseg=kseg, h=h)
+    return out, (q3, k3, v3, out, lse, qseg, kseg)
+
+
+def _flash_seg_vjp_bwd(scale, causal, block_q, block_k, h, res, g):
+    dq, dk, dv = _flash_bwd(res, g, scale, causal, block_q, block_k,
+                            h=h)
+    qseg, kseg = res[5], res[6]
+    return dq, dk, dv, _int_zero_tangent(qseg), _int_zero_tangent(kseg)
+
+
+_flash_seg.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=512):
+                    block_k=512, segment_ids=None, kv_segment_ids=None):
     """Fused attention over [B, H, T, D] tensors.
 
     Memory O(T) per program instead of O(T²); differentiable (flash
     backward kernels).  Off-TPU backends run the same kernels in the
     Pallas interpreter.
+
+    ``segment_ids`` ([B, Tq] int32) enables SEQUENCE PACKING: tokens
+    attend only within their own segment — multiple short documents
+    share one fixed-shape row, the TPU-first replacement for the
+    reference's bucketing (python/mxnet/module/bucketing_module.py).
+    ``kv_segment_ids`` defaults to ``segment_ids`` (self-attention);
+    give it for cross-attention over packed keys.  Use a dedicated id
+    for padding tokens and they attend nothing/nobody.
     """
     b, h, tq, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     q3 = q.reshape(b * h, tq, d)
     k3 = k.reshape(b * h, k.shape[2], k.shape[3])
     v3 = v.reshape(b * h, v.shape[2], v.shape[3])
-    out = _flash(q3, k3, v3, float(scale), bool(causal), int(block_q),
-                 int(block_k))
+    if segment_ids is None:
+        out = _flash(q3, k3, v3, float(scale), bool(causal),
+                     int(block_q), int(block_k))
+    else:
+        if kv_segment_ids is None:
+            kv_segment_ids = segment_ids
+        qs = jnp.asarray(segment_ids, jnp.int32)
+        ks = jnp.asarray(kv_segment_ids, jnp.int32)
+        out = _flash_seg(q3, k3, v3, qs, ks, float(scale), bool(causal),
+                         int(block_q), int(block_k), int(h))
     return out.reshape(b, h, tq, v.shape[3])
 
 
@@ -562,7 +712,8 @@ def flash_forward_with_lse(q, k, v, causal=False, scale=None, block_q=512,
             lse.reshape(b, h, tq, 1))
 
 
-def flash_attention_reference(q, k, v, causal=False, scale=None):
+def flash_attention_reference(q, k, v, causal=False, scale=None,
+                              segment_ids=None, kv_segment_ids=None):
     """O(T²) jnp oracle for tests."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -571,6 +722,12 @@ def flash_attention_reference(q, k, v, causal=False, scale=None):
         tq, tk = q.shape[2], k.shape[2]
         mask = _causal_mask(0, 0, tq, tk)
         s = jnp.where(mask[None, None], s, _NEG_INF)
+    if segment_ids is not None:
+        if kv_segment_ids is None:
+            kv_segment_ids = segment_ids
+        seg = segment_ids[:, None, :, None] == \
+            kv_segment_ids[:, None, None, :]
+        s = jnp.where(seg, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)

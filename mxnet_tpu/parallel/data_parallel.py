@@ -76,18 +76,26 @@ def make_train_step(loss_fn, mesh, optimizer_apply=None, optimizer_init=None,
                   for k, v in params.items()}
         state = optimizer_init(params)
 
-        def place(sub):
+        def place_leaf(name, leaf):
             # per-param state (momentum etc.) follows its param's
             # sharding — a replicated momentum for a tp-sharded weight
-            # would force an all-gather every update
-            if isinstance(sub, dict) and set(sub) == set(params):
-                return {k: jax.device_put(v, shardings[k])
-                        for k, v in sub.items()}
+            # would force an all-gather every update.  Only leaves that
+            # mirror the param's shape qualify (Adafactor-style factored
+            # or scalar state stays replicated).
+            if name in params and \
+                    getattr(leaf, "shape", None) == params[name].shape:
+                return jax.device_put(leaf, shardings[name])
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+        def place(sub):
+            if isinstance(sub, dict):
+                return {k: place_leaf(k, v) if not isinstance(v, dict)
+                        else place(v) for k, v in sub.items()}
             return jax.tree_util.tree_map(
                 lambda s: jax.device_put(s, NamedSharding(mesh, P())),
                 sub)
-        state = {k: place(v) for k, v in state.items()} \
-            if isinstance(state, dict) else jax.tree_util.tree_map(
+        state = place(state) if isinstance(state, dict) else \
+            jax.tree_util.tree_map(
                 lambda s: jax.device_put(s, NamedSharding(mesh, P())),
                 state)
         return params, state

@@ -37,8 +37,11 @@ def _oracle_forward(params, toks, cfg):
         x = _np_layer_norm(h, p[pre + "ln1_gamma"], p[pre + "ln1_beta"])
         b, t, c = x.shape
         qkv = x @ p[pre + "qkv_w"].T + p[pre + "qkv_b"]
-        qkv = qkv.reshape(b, t, 3, n_heads, c // n_heads)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H,D]
+        # head-major fused layout [H, 3, D] (basic_layers.py)
+        qkv = qkv.reshape(b, t, n_heads, 3, c // n_heads)
+        q = qkv[:, :, :, 0]
+        k = qkv[:, :, :, 1]
+        v = qkv[:, :, :, 2]  # [B,T,H,D]
         q = np.moveaxis(q, 1, 2)
         k = np.moveaxis(k, 1, 2)
         v = np.moveaxis(v, 1, 2)
@@ -258,3 +261,24 @@ def test_gpt_spmd_dp_tp_matches_single_device():
     for n, a in zip(fn.param_names, p1):
         np.testing.assert_allclose(np.asarray(a), np.asarray(ps[n]),
                                    rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_pack_sequences():
+    """Packing: contiguous docs, fixed shapes, 0 = padding, documents
+    split across row boundaries get distinct continuation handling."""
+    docs = [np.arange(1, 6), np.arange(10, 13), np.arange(20, 29)]
+    toks, segs = gpt.pack_sequences(docs, 8, pad_id=0)
+    assert toks.shape == segs.shape and toks.shape[1] == 8
+    # every real token has a nonzero segment: the nonzero-segment count
+    # equals the total document token count, and padding is pad_id
+    assert (segs > 0).sum() == sum(len(d) for d in docs)
+    assert (toks[segs == 0] == 0).all()
+    # same row, different docs -> different segment ids
+    row0 = segs[0]
+    assert row0[0] != row0[5] or toks[0][5] == 0
+    # all tokens preserved in order within segments
+    flat = [toks[r][segs[r] == s]
+            for r in range(toks.shape[0])
+            for s in sorted(set(segs[r])) if s > 0]
+    joined = np.concatenate(flat)
+    assert np.array_equal(np.sort(joined), np.sort(np.concatenate(docs)))
