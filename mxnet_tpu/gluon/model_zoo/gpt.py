@@ -51,8 +51,11 @@ class GPTBlock(HybridBlock):
             self.fc2 = nn.Dense(units, flatten=False,
                                 in_units=mlp_ratio * units, prefix="fc2_")
 
-    def hybrid_forward(self, F, x):
-        h = self.attn(self.ln1(x))
+    def hybrid_forward(self, F, x, segments=None):
+        if segments is None:
+            h = self.attn(self.ln1(x))
+        else:
+            h = self.attn(self.ln1(x), segments)
         if self._dropout:
             h = F.Dropout(h, p=self._dropout)
         x = x + h
@@ -90,14 +93,32 @@ class GPTLM(HybridBlock):
                                              dropout=dropout))
             self.ln_f = nn.LayerNorm(in_channels=units, prefix="lnf_")
 
-    def hybrid_forward(self, F, tokens, wte, wpe):
+    def hybrid_forward(self, F, tokens, segments=None, wte=None,
+                       wpe=None):
         t = tokens.shape[1]
         if t > self._max_len:
             raise ValueError("sequence length %d exceeds max_len %d"
                              % (t, self._max_len))
         h = F.Embedding(tokens, wte, input_dim=self._vocab,
                         output_dim=self._units)
-        h = h + F.slice_axis(wpe, axis=0, begin=0, end=t)
+        if segments is None:
+            h = h + F.slice_axis(wpe, axis=0, begin=0, end=t)
+        else:
+            # packed rows: positions restart at each segment boundary so
+            # every document trains with the same wpe rows it would see
+            # standalone (segments are contiguous per row)
+            import jax.numpy as _jnp
+            idx = _jnp.arange(t)[None, :]
+            seg = segments if not hasattr(segments, "_data") \
+                else segments._data
+            change = _jnp.concatenate(
+                [_jnp.ones_like(seg[:, :1], dtype=bool),
+                 seg[:, 1:] != seg[:, :-1]], axis=1)
+            start = _jnp.maximum.accumulate(
+                _jnp.where(change, idx, 0), axis=1)
+            pos = (idx - start).astype(_jnp.int32)
+            h = h + F.Embedding(pos, wpe, input_dim=self._max_len,
+                                output_dim=self._units)
         if self._dropout:
             h = F.Dropout(h, p=self._dropout)
         if self._remat and not hasattr(h, "_data"):
@@ -110,9 +131,18 @@ class GPTLM(HybridBlock):
             # op-by-op on the autograd tape, where remat has no meaning.
             import jax
             for blk in self.blocks._children:
-                h = jax.checkpoint(lambda x, b=blk: b(x))(h)
-        else:
+                if segments is None:
+                    h = jax.checkpoint(lambda x, b=blk: b(x))(h)
+                else:
+                    h = jax.checkpoint(
+                        lambda x, s, b=blk: b(x, s))(h, segments)
+        elif segments is None:
             h = self.blocks(h)
+        else:
+            # packed rows: thread the segment ids into every block's
+            # attention (HybridSequential can't forward extra inputs)
+            for blk in self.blocks._children:
+                h = blk(h, segments)
         h = self.ln_f(h)
         # tied head: one [B·T, d] x [d, V] matmul against the embedding
         return F.FullyConnected(h, wte, num_hidden=self._vocab,

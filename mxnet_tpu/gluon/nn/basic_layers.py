@@ -251,7 +251,7 @@ class FlashSelfAttention(HybridBlock):
                                   weight_initializer=weight_initializer,
                                   in_units=units, prefix="out_")
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, segments=None):
         b, t = x.shape[0], x.shape[1]
         h = self._num_heads
         d = self._units // h
@@ -268,8 +268,13 @@ class FlashSelfAttention(HybridBlock):
                       shape=(b, h, t, d))
         v = F.reshape(F.slice_axis(qkv, axis=0, begin=2, end=3),
                       shape=(b, h, t, d))
-        o = getattr(F, "_contrib_flash_attention")(
-            q, k, v, causal=self._causal)         # [B, H, T, D]
+        attn = getattr(F, "_contrib_flash_attention")
+        if segments is None:
+            o = attn(q, k, v, causal=self._causal)    # [B, H, T, D]
+        else:
+            # sequence packing: [B, T] int ids, attend within-segment
+            o = attn(q, k, v, segments, causal=self._causal,
+                     use_segments=True)
         o = F.reshape(F.transpose(o, axes=(0, 2, 1, 3)),
                       shape=(b, t, self._units))
         return self.out_proj(o)

@@ -168,6 +168,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, qseg=None,
     if kseg is not None:
         kseg = _pad_to_val(kseg, 1, block_k, -1)
     if qseg is not None:
+        # q itself stays unpadded: Pallas block-pads non-divisible dims
+        # (interpret and Mosaic alike), so pre-padding qseg to the same
+        # multiple keeps rows aligned while giving the tail a sentinel
+        # id no real segment uses (tests: odd-length seg cases in
+        # flash_attention_driver.check_segment_packing)
         qseg = _pad_to_val(qseg, 1, block_q, -2)
     if tk % block_k:
         # kernels mask on the padded length's tail via tk_true
@@ -681,6 +686,11 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     k3 = k.reshape(b * h, k.shape[2], k.shape[3])
     v3 = v.reshape(b * h, v.shape[2], v.shape[3])
     if segment_ids is None:
+        if kv_segment_ids is not None:
+            raise ValueError(
+                "kv_segment_ids without segment_ids: packed keys need "
+                "query ids too (pass segment_ids=jnp.ones for unpacked "
+                "queries)")
         out = _flash(q3, k3, v3, float(scale), bool(causal),
                      int(block_q), int(block_k))
     else:
