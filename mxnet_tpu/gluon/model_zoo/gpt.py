@@ -266,8 +266,32 @@ def _decode_one(p, tok, pos, caches, n_heads):
     return x @ p["wte"].T, new_caches
 
 
+def _filter_logits(logits, top_k, top_p):
+    """Static top-k / nucleus filtering (jit-compatible: sort-based).
+    Callers pass TEMPERATURE-SCALED logits — the nucleus must be the
+    top_p mass of the actual sampling distribution."""
+    import jax
+    import jax.numpy as jnp
+    if top_k:
+        k = min(top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set whose mass >= top_p: keep entries whose cumsum
+        # BEFORE them is < top_p
+        keep_sorted = (cum - probs) < top_p
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return logits
+
+
 @functools.lru_cache(maxsize=32)
-def _decode_runner(n_heads, greedy, total, t0, t_max, n_layers, d):
+def _decode_runner(n_heads, greedy, total, t0, t_max, n_layers, d,
+                   top_k=0, top_p=0.0):
     """Build (once per static configuration) the jitted scan runner.
     Params, prompt, caches, key, and temperature are traced ARGUMENTS,
     so repeated generate() calls — and further training between them —
@@ -284,7 +308,8 @@ def _decode_runner(n_heads, greedy, total, t0, t_max, n_layers, d):
             nxt = logits.argmax(-1)
         else:
             key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits / temp, axis=-1)
+            scaled = _filter_logits(logits / temp, top_k, top_p)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
         nxt = nxt.astype(jnp.int32)
         # while in the prompt, the "generated" token is overridden by
         # the actual next prompt token (prefill rides the same scan)
@@ -308,7 +333,8 @@ def _decode_runner(n_heads, greedy, total, t0, t_max, n_layers, d):
     return run
 
 
-def generate(net, prompt_ids, n_new, temperature=0.0, seed=0):
+def generate(net, prompt_ids, n_new, temperature=0.0, seed=0, top_k=0,
+             top_p=0.0):
     """Autoregressive generation with a KV cache — O(T) per new token
     instead of the O(T²) full-context recompute.  One jitted
     ``lax.scan`` over decode steps (static shapes: the cache is
@@ -317,7 +343,8 @@ def generate(net, prompt_ids, n_new, temperature=0.0, seed=0):
 
     ``prompt_ids``: int array [B, T0]; returns int array
     [B, T0 + n_new].  temperature 0 = greedy; otherwise samples with
-    ``jax.random`` (deterministic per ``seed``).
+    ``jax.random`` (deterministic per ``seed``), optionally filtered to
+    the ``top_k`` highest logits and/or the ``top_p`` nucleus.
     """
     import numpy as np
     import jax
@@ -339,8 +366,11 @@ def generate(net, prompt_ids, n_new, temperature=0.0, seed=0):
     caches = [(jnp.zeros((bsz, n_heads, t_max, d), jnp.float32),
                jnp.zeros((bsz, n_heads, t_max, d), jnp.float32))
               for _ in range(n_layers)]
-    run = _decode_runner(n_heads, temperature <= 0, t0 + n_new - 1, t0,
-                         t_max, n_layers, d)
+    greedy = temperature <= 0
+    run = _decode_runner(n_heads, greedy, t0 + n_new - 1, t0,
+                         t_max, n_layers, d,
+                         0 if greedy else int(top_k),
+                         0.0 if greedy else float(top_p))
     toks = run(p, prompt, caches, jax.random.PRNGKey(seed),
                jnp.float32(max(temperature, 1e-6)))
     out = jnp.concatenate([prompt[:, :1].T, toks]).T  # [B, total+1]

@@ -317,3 +317,38 @@ def test_gpt_packed_training_independence():
         return -lp[..., 0].mean()
     g = jax.grad(loss)(params)
     assert all(np.isfinite(np.asarray(x)).all() for x in g)
+
+
+def test_gpt_generate_top_k_top_p():
+    """top_k=1 sampling must equal greedy; top_p must only ever emit
+    tokens inside the nucleus (checked against full-softmax ranks)."""
+    net = gpt.gpt2_tiny(vocab_size=16, max_len=32)
+    net.initialize(mx.init.Xavier())
+    prompt = np.zeros((2, 3), np.int32)
+    greedy = gpt.generate(net, prompt, 10)
+    k1 = gpt.generate(net, prompt, 10, temperature=0.7, top_k=1, seed=9)
+    np.testing.assert_array_equal(greedy, k1)
+
+    # top_p: every sampled token is within the nucleus of the model's
+    # own TEMPERATURE-SCALED distribution at that step (stepwise
+    # recompute); temp != 1 pins the filter-after-scaling order
+    for temp in (1.0, 0.6):
+        out = gpt.generate(net, prompt, 8, temperature=temp, top_p=0.5,
+                           seed=3)
+        ctx = prompt.copy()
+        for i in range(8):
+            logits = net(mx.nd.array(ctx,
+                                     dtype="int32")).asnumpy()[:, -1]
+            logits = logits / temp
+            for b in range(2):
+                probs = np.exp(logits[b] - logits[b].max())
+                probs /= probs.sum()
+                order = np.argsort(-probs)
+                cum = np.cumsum(probs[order])
+                nucleus = set(order[:int((cum < 0.5).sum()) + 1])
+                assert int(out[b, 3 + i]) in nucleus
+            ctx = np.concatenate([ctx, out[:, 3 + i:4 + i]], axis=1)
+    # top_k beyond the vocab degrades to full-vocab sampling, no error
+    big = gpt.generate(net, prompt, 4, temperature=1.0, top_k=500,
+                       seed=1)
+    assert big.shape == (2, 7)
