@@ -45,6 +45,18 @@ _register("MXTPU_WORKER_RANK", 0, int,
 _register("MXTPU_ATTENTION_IMPL", "", str,
           "'flash' forces the Pallas attention kernel, 'xla' the jnp "
           "online-softmax path; empty auto-selects (flash on TPU).")
+_register("MXTPU_FLASH_BWD", "split", str,
+          "flash-attention backward: 'split' = separate dq and dk/dv "
+          "kernels (measured round-3 baseline), 'fused' = single-pass "
+          "kernel sharing the s/dp matmuls (1.4x backward FLOP cut; "
+          "tools/tpu_validate.sh A/Bs both before it becomes default).")
+_register("MXTPU_FLASH_BWD_DQ_BYTES", 1 << 30, int,
+          "HBM cap for the fused backward's fp32 dq-partial buffer; the "
+          "k axis is chunked to stay under it (unbounded it grows "
+          "quadratically with T).  Falls back to 'split' when one "
+          "k-block slot exceeds the budget OR the budget would need "
+          ">16 sequential chunks — so a too-small budget silently "
+          "benchmarks split, not fused.")
 _register("MXNET_CPU_WORKER_NTHREADS", 1, int,
           "host-side worker threads for the Python image pipeline "
           "(image/image.py); the native pipeline uses "

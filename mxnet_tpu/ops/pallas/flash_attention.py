@@ -363,7 +363,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, tq_true, has_seg=False):
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_fused_kernel(*refs, scale, causal, tq_true, tk_true,
+def _bwd_fused_kernel(*refs, scale, causal, tq_true, tk_true, k_base=0,
                       has_seg=False):
     """Fused backward: one grid pass (bh, k-blocks, q-blocks) computes
     dq, dk AND dv.  Per (q,k) block pair the split kernels spend 7 MXU
@@ -377,7 +377,9 @@ def _bwd_fused_kernel(*refs, scale, causal, tq_true, tk_true,
     each (k,q) step writes its dq contribution to its own fp32 partial
     slot and the caller reduces over the nk axis.  Extra HBM traffic is
     O(nk·Tq·D) written + read once, the same volume the split dq kernel
-    re-read k/v with."""
+    re-read k/v with.  The caller bounds that partial buffer by chunking
+    the k axis (``k_base`` is this call's absolute k offset, so the
+    causal/bounds masks stay exact across chunks)."""
     pl = _pl()
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref,
@@ -391,7 +393,7 @@ def _bwd_fused_kernel(*refs, scale, causal, tq_true, tk_true,
     nq = pl.num_programs(2)
     bk = k_ref.shape[1]
     bq = q_ref.shape[1]
-    k_off = ki * bk
+    k_off = k_base + ki * bk
     q_off = qi * bq
 
     @pl.when(qi == 0)
@@ -435,9 +437,33 @@ def _bwd_fused_kernel(*refs, scale, causal, tq_true, tk_true,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _dq_partial_budget():
+    """HBM byte cap for the fused backward's dq partial buffer
+    (MXTPU_FLASH_BWD_DQ_BYTES, default in the config registry).
+    Unbounded, the buffer is O(nk·B·H·Tq·D) fp32 — quadratic in T —
+    which at T=32k B1 H8 D128 block 512 would be ~8.6 GB, most of a
+    v5e's 16 GB HBM."""
+    from mxnet_tpu import config
+    return int(config.flag("MXTPU_FLASH_BWD_DQ_BYTES"))
+
+
+#: Past this many k-chunks the fused path falls back to split: each
+#: chunk is a separately-traced pallas_call (compile size grows with the
+#: count) and re-reads all of q/do/lse/delta, eroding the shared-matmul
+#: FLOP win the fusion exists for.
+_MAX_DQ_CHUNKS = 16
+
+
 def _flash_bwd_fused(res, g, scale, causal, block_q, block_k, h=1):
-    """Single-pass fused backward; dq comes out as nk fp32 partials
-    reduced by XLA after the kernel."""
+    """Single-pass fused backward; dq comes out as fp32 partials reduced
+    by XLA after the kernel.  The k axis is chunked so at most
+    ``MXTPU_FLASH_BWD_DQ_BYTES`` of partials exist at once: each chunk
+    runs the fused kernel over its k-blocks (dk/dv for those blocks come
+    out final; dq contributions are reduced and accumulated across
+    chunks).  Falls back to split when even one k-block's partial slot
+    exceeds the budget (no memory advantage left) or when the budget
+    would need more than _MAX_DQ_CHUNKS sequential kernel launches
+    (compile size and q/do re-reads erode the fusion win)."""
     pl = _pl()
     q, k, v, out, lse, qseg, kseg = _unpack_res(res)
     do = g
@@ -446,6 +472,18 @@ def _flash_bwd_fused(res, g, scale, causal, block_q, block_k, h=1):
     dv_dim = v.shape[2]
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
+
+    # regime check BEFORE any padding/delta work so the fallback path
+    # computes nothing it throws away
+    tqp = -(-tq // block_q) * block_q
+    tkp = -(-tk // block_k) * block_k
+    nk = tkp // block_k
+    slot_bytes = bh * tqp * d * 4
+    chunk_nk = min(nk, _dq_partial_budget() // slot_bytes)
+    if chunk_nk < 1 or -(-nk // chunk_nk) > _MAX_DQ_CHUNKS:
+        return _flash_bwd_split(res, g, scale, causal, block_q, block_k,
+                                h=h)
+
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
     qp = _pad_to(q, 1, block_q)
@@ -454,47 +492,74 @@ def _flash_bwd_fused(res, g, scale, causal, block_q, block_k, h=1):
     deltap = _pad_to(delta, 1, block_q)
     kp = _pad_to(k, 1, block_k)
     vp = _pad_to(v, 1, block_k)
-    tqp = qp.shape[1]
-    tkp = kp.shape[1]
-    nk = tkp // block_k
     has_seg = qseg is not None
-    in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
-    ]
-    operands = [qp, kp, vp, dop, lsep, deltap]
-    if has_seg:
-        in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, j)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, i)),
-        ]
-        operands += [_pad_to_val(qseg, 1, block_q, -2),
-                     _pad_to_val(kseg, 1, block_k, -1)]
+    qsegp = _pad_to_val(qseg, 1, block_q, -2) if has_seg else None
+    ksegp = _pad_to_val(kseg, 1, block_k, -1) if has_seg else None
 
-    dq_parts, dk, dv = pl.pallas_call(
-        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                          tq_true=tq, tk_true=tk, has_seg=has_seg),
-        grid=(bh, nk, tqp // block_q),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, i, j: (i, b, j, 0)),
+    def _fused_call(kc, vc, ksegc, nk_c, k_base):
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dv_dim), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((nk, bh, tqp, d), jnp.float32),
-            jax.ShapeDtypeStruct(kp.shape, k.dtype),
-            jax.ShapeDtypeStruct(vp.shape, v.dtype),
-        ],
-        scratch_shapes=[_scratch((block_k, d)),
-                        _scratch((block_k, dv_dim))],
-        interpret=_use_interpret(),
-    )(*operands)
-    dq = dq_parts.sum(axis=0)[:, :tq].astype(q.dtype)
+            pl.BlockSpec((1, block_q, dv_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+        ]
+        operands = [qp, kc, vc, dop, lsep, deltap]
+        if has_seg:
+            in_specs += [
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, j)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, i)),
+            ]
+            operands += [qsegp, ksegc]
+        return pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale,
+                              causal=causal, tq_true=tq, tk_true=tk,
+                              k_base=k_base, has_seg=has_seg),
+            grid=(bh, nk_c, tqp // block_q),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, i, j: (i, b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, dv_dim),
+                             lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((nk_c, bh, tqp, d), jnp.float32),
+                jax.ShapeDtypeStruct((bh, nk_c * block_k, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, nk_c * block_k, dv_dim),
+                                     v.dtype),
+            ],
+            scratch_shapes=[_scratch((block_k, d)),
+                            _scratch((block_k, dv_dim))],
+            interpret=_use_interpret(),
+        )(*operands)
+
+    dq_acc = None
+    dk_chunks, dv_chunks = [], []
+    for start in range(0, nk, chunk_nk):
+        nk_c = min(chunk_nk, nk - start)
+        lo, hi = start * block_k, (start + nk_c) * block_k
+        if dq_acc is not None:
+            # chunk kernels share no data, so without this barrier XLA's
+            # scheduler could run them concurrently and keep several
+            # dq_parts buffers live at once — the byte cap must bound
+            # PEAK HBM, so chunk i+1 is made to depend on chunk i's
+            # reduced dq
+            qp, dq_acc = lax.optimization_barrier((qp, dq_acc))
+        dq_parts, dk_c, dv_c = _fused_call(
+            kp[:, lo:hi], vp[:, lo:hi],
+            ksegp[:, lo:hi] if has_seg else None, nk_c, k_base=lo)
+        dq_c = dq_parts.sum(axis=0)
+        dq_acc = dq_c if dq_acc is None else dq_acc + dq_c
+        dk_chunks.append(dk_c)
+        dv_chunks.append(dv_c)
+    dq = dq_acc[:, :tq].astype(q.dtype)
+    dk = (dk_chunks[0] if len(dk_chunks) == 1
+          else jnp.concatenate(dk_chunks, axis=1))
+    dv = (dv_chunks[0] if len(dv_chunks) == 1
+          else jnp.concatenate(dv_chunks, axis=1))
     return dq, dk[:, :tk], dv[:, :tk]
 
 
@@ -502,8 +567,8 @@ def _bwd_impl():
     """MXTPU_FLASH_BWD=fused|split.  Default split — the measured
     round-3 baseline; tools/tpu_validate.sh times both and the faster
     one becomes the default once hardware-confirmed."""
-    import os
-    return os.environ.get("MXTPU_FLASH_BWD", "split")
+    from mxnet_tpu import config
+    return config.flag("MXTPU_FLASH_BWD")
 
 
 def _flash_bwd(res, g, scale, causal, block_q, block_k, h=1):

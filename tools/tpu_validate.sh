@@ -8,12 +8,21 @@ cd /root/repo
 BENCH_MODE=attention BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
 
 # 1b. fused vs split backward A/B (round 4: the faster one becomes the
-#     MXTPU_FLASH_BWD default)
+#     MXTPU_FLASH_BWD default).  NOTE: T=4k numbers alone must not crown
+#     fused — its dq partials cost extra HBM (bounded at 1 GiB by
+#     MXTPU_FLASH_BWD_DQ_BYTES chunking, round 5); check stage 2b's
+#     long-T fused timing before flipping the default.
 MXTPU_FLASH_BWD=fused BENCH_MODE=attention BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
 
 # 2. long context: T=32k now compiles with grid-streamed kernels
 BENCH_MODE=attention BENCH_ATTN_B=1 BENCH_ATTN_H=8 BENCH_ATTN_T=32768 \
   BENCH_STEPS=3 python bench.py 2>&1 | grep -v WARNING | tail -1
+
+# 2b. fused backward at T=32k: dq-partial chunking must hold it inside
+#     the 1 GiB budget (pre-round-5 this shape wanted ~8.6 GB of
+#     partials and would have OOMed)
+MXTPU_FLASH_BWD=fused BENCH_MODE=attention BENCH_ATTN_B=1 BENCH_ATTN_H=8 \
+  BENCH_ATTN_T=32768 BENCH_STEPS=3 python bench.py 2>&1 | grep -v WARNING | tail -1
 
 # 3. headline bench sanity
 python bench.py 2>&1 | grep -v WARNING | tail -1
