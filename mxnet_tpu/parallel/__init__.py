@@ -46,4 +46,6 @@ from .moe import MoELayer, moe_apply
 from . import gpt_spmd
 from .gpt_spmd import shard_gpt, gpt_param_spec
 from . import pipeline
-from .pipeline import pipeline_apply, pipeline_apply_1f1b
+from .pipeline import (pipeline_apply, pipeline_apply_1f1b,
+                       pipeline_apply_1f1b_het, stage_param_shardings)
+from . import gpt_pp

@@ -1,0 +1,167 @@
+"""Pipeline-parallel recipe for the GPT flagship (1F1B over ``pp``).
+
+The reference's pipeline ancestor places layers on devices by hand
+(/root/reference/example/model-parallel-lstm/lstm.py:65-116); the
+TPU-native flagship form cuts a live :class:`~mxnet_tpu.gluon.model_zoo.
+gpt.GPTLM` into ``embed+blocks → blocks → blocks+head`` stages for
+:func:`~mxnet_tpu.parallel.pipeline.pipeline_apply_1f1b_het`.
+
+Two invariants the cut preserves:
+
+- **No forked math.** The per-block stage function is the
+  ``functionalize``d live :class:`GPTBlock` — the same traced graph the
+  sequential model runs — so the pipeline cannot drift from the model
+  (the embedding gather and the tied-head matmul, three lines each, are
+  the only re-expressed pieces, and the equality test pins them).
+- **Tied embeddings stay tied.** ``wte`` lives in BOTH the stage-0
+  embed component and the stage-(S-1) head component of the union
+  params; :func:`tie_wte_grad` sums the two slots' gradients —
+  Megatron's first↔last-stage embedding all-reduce, expressed as one
+  jnp add that GSPMD lowers to the collective.
+
+Dropout note: stage functions trace with a fixed rng, so build the net
+with ``dropout=0`` for pipeline training (per-stage rng threading is a
+possible extension; every other recipe in this package trains GPT with
+explicit rng via ``gpt_spmd.make_train_step``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["make_gpt_stages", "tie_wte_grad", "grads_by_name"]
+
+
+def _strip_block_idx(name):
+    """'h_gptblock3_attn_qkv_weight' -> 'attn_qkv_weight' (relative name
+    used to check that every block's param ordering matches block 0's)."""
+    _, _, rel = name.partition("gptblock")
+    return rel.split("_", 1)[1] if "_" in rel else rel
+
+
+def make_gpt_stages(net, n_stages, micro_batch, seq_len,
+                    compute_dtype=None):
+    """Cut an initialized GPTLM into ``n_stages`` 1F1B stages.
+
+    Returns ``(stage_params, stage_fns, wire, names)``:
+
+    - ``stage_params`` — union pytree, every leaf with leading stage dim
+      ``n_stages`` (shard it over ``pp``): ``{"embed": {wte, wpe}}``
+      real in slot 0, ``{"blocks": [leaf [S, lps, ...]]}`` real in every
+      slot, ``{"head": {"lnf": [...], "wte": ...}}`` real in slot S-1
+      (zeros elsewhere — each device stores each component once).
+    - ``stage_fns`` — per-stage callables for the het pipeline; stage 0
+      embeds the int token feed [mb, T], middle stages apply their block
+      chunk, the last adds final-LN + tied head and returns logits.
+    - ``wire`` — the [mb, T, d] boundary ShapeDtypeStruct.
+    - ``names`` — metadata for :func:`grads_by_name`.
+    """
+    from ..gluon.block import functionalize
+    cdt = compute_dtype or jnp.float32
+    blocks = list(net.blocks._children)
+    n_layers = len(blocks)
+    if n_layers % n_stages:
+        raise ValueError("num_layers %d not divisible by n_stages %d"
+                         % (n_layers, n_stages))
+    lps = n_layers // n_stages
+    units = net._units
+
+    h_ex = jnp.zeros((micro_batch, seq_len, units), cdt)
+    blk_fn, _ = functionalize(blocks[0], h_ex)
+    rel0 = [_strip_block_idx(n) for n in blk_fn.param_names]
+    blk_params, blk_names = [], []
+    for blk in blocks:
+        fn_i, params_i = functionalize(blk, h_ex)
+        rel_i = [_strip_block_idx(n) for n in fn_i.param_names]
+        if rel_i != rel0:
+            raise AssertionError(
+                "block param ordering diverged: %s vs %s" % (rel_i, rel0))
+        blk_params.append(params_i)
+        blk_names.append(list(fn_i.param_names))
+    # stack: one leaf [S, lps, ...] per block-param position
+    blocks_union = [
+        jnp.stack([jnp.stack([blk_params[s * lps + j][p]
+                              for j in range(lps)])
+                   for s in range(n_stages)])
+        for p in range(len(rel0))]
+
+    lnf_fn, lnf_params = functionalize(net.ln_f, h_ex)
+    wte = net.wte.data()._data
+    wpe = net.wpe.data()._data
+
+    def _slot(x, s):
+        """[S, ...] leaf that is ``x`` in slot s and zeros elsewhere."""
+        out = jnp.zeros((n_stages,) + x.shape, x.dtype)
+        return out.at[s].set(x)
+
+    stage_params = {
+        "embed": {"wte": _slot(wte, 0), "wpe": _slot(wpe, 0)},
+        "blocks": blocks_union,
+        "head": {"lnf": [_slot(p, n_stages - 1) for p in lnf_params],
+                 "wte": _slot(wte, n_stages - 1)},
+    }
+
+    def apply_chunk(blocks_local, h):
+        for j in range(lps):
+            ps = [leaf[j].astype(cdt) for leaf in blocks_local]
+            (h,), _ = blk_fn(ps, h)
+        return h
+
+    def _embed(local, feed):
+        e = local["embed"]
+        return e["wte"].astype(cdt)[feed] \
+            + e["wpe"].astype(cdt)[:seq_len]
+
+    def embed_stage(local, x, feed):
+        return apply_chunk(local["blocks"], _embed(local, feed))
+
+    def mid_stage(local, x, feed):
+        return apply_chunk(local["blocks"], x)
+
+    def head_stage(local, x, feed):
+        h = apply_chunk(local["blocks"], x)
+        hd = local["head"]
+        (h,), _ = lnf_fn([p.astype(cdt) for p in hd["lnf"]], h)
+        # tied head: [mb·T, d] x [d, V] against the embedding table
+        return h @ hd["wte"].astype(cdt).T
+
+    if n_stages == 1:
+        # degenerate single stage: embed -> head, whose chunk applies
+        # the (single) block stack exactly once
+        stage_fns = [lambda local, x, feed:
+                     head_stage(local, _embed(local, feed), feed)]
+    else:
+        stage_fns = ([embed_stage]
+                     + [mid_stage] * (n_stages - 2)
+                     + [head_stage])
+
+    wire = jax.ShapeDtypeStruct((micro_batch, seq_len, units), cdt)
+    names = {"blocks": blk_names, "lnf": list(lnf_fn.param_names),
+             "prefix": net.prefix, "lps": lps, "n_stages": n_stages}
+    return stage_params, stage_fns, wire, names
+
+
+def tie_wte_grad(grads):
+    """Total gradient of the tied embedding table: the embed copy's
+    (slot 0) plus the head copy's (slot S-1) — apply the SAME update to
+    both slots to keep the tie exact."""
+    return grads["embed"]["wte"][0] + grads["head"]["wte"][-1]
+
+
+def grads_by_name(grads, names):
+    """Flatten union-pytree grads back to the net's parameter names
+    (the sequential ``functionalize`` order's names), summing the two
+    tied-``wte`` slots.  For equality tests against single-device
+    autodiff and for feeding name-keyed optimizers."""
+    out = {}
+    prefix = names["prefix"]
+    out[prefix + "wte_weight"] = tie_wte_grad(grads)
+    out[prefix + "wpe_weight"] = grads["embed"]["wpe"][0]
+    for p, n in enumerate(names["lnf"]):
+        out[n] = grads["head"]["lnf"][p][-1]
+    lps = names["lps"]
+    for s in range(names["n_stages"]):
+        for j in range(lps):
+            for p, leaf in enumerate(grads["blocks"]):
+                out[names["blocks"][s * lps + j][p]] = leaf[s, j]
+    return out

@@ -183,6 +183,210 @@ def _pipeline_1f1b_local(stage_params, microbatches, targets, stage_fn,
     return loss_total, grads
 
 
+# ---------------------------------------------------------------------------
+# Heterogeneous-stage 1F1B
+# ---------------------------------------------------------------------------
+
+def _pipeline_1f1b_het_local(stage_params, microbatches, targets,
+                             stage_fns, loss_fn, wire, axis):
+    """1F1B whose stages may differ in function AND in input/output type.
+
+    The homogeneous schedule above requires every stage to map the same
+    activation shape to itself — which shuts out the transformer
+    flagship, whose first stage maps int tokens [mb, T] -> [mb, T, d]
+    and whose last maps [mb, T, d] -> loss.  Here only the INTER-stage
+    boundary ("the wire") must be uniform; the raw microbatch feed (read
+    by stage 0 alone) and the targets (read by the last stage's loss)
+    ride next to it:
+
+    - ``stage_fns[s](params, x_wire, feed) -> y_wire`` for s < S-1;
+      ``stage_fns[-1](params, x_wire, feed) -> model output`` (any
+      shape), consumed by ``loss_fn(output, target) -> scalar``.
+    - ``stage_params`` is a UNION pytree: every leaf keeps the leading
+      stage dim, and each stage's fn touches only the slots it owns
+      (e.g. the embedding tables live in slot 0's component, the head's
+      in slot S-1's).  The stage dispatch is one ``lax.switch`` on the
+      mesh position; vjp through the un-taken branches returns
+      structural zeros, so union gradients stay exact.
+    - ``wire``: ShapeDtypeStruct pytree of the boundary activation
+      (local microbatch shape when composing with a batch axis).
+
+    Schedule, stash discipline and exactness are identical to
+    :func:`_pipeline_1f1b_local`; the last stage seeds its backward with
+    the loss cotangent (loss_seed=1) instead of the wire register, whose
+    content it never reads.
+    """
+    n_stages = lax.axis_size(axis)
+    if len(stage_fns) != n_stages:
+        raise ValueError("got %d stage_fns for a %d-stage pipeline"
+                         % (len(stage_fns), n_stages))
+    stage = lax.axis_index(axis)
+    n_micro = microbatches.shape[0]
+    stash_len = 2 * n_stages
+    tmap = jax.tree_util.tree_map
+    is_last = stage == n_stages - 1
+
+    zeros_wire = tmap(lambda s: jnp.zeros(s.shape, s.dtype), wire)
+
+    def _mk_branch(s):
+        fn = stage_fns[s]
+        if s == n_stages - 1:
+            def br(params, x, feed, tgt):
+                out = fn(params, x, feed)
+                return zeros_wire, loss_fn(out, tgt).astype(jnp.float32)
+        else:
+            def br(params, x, feed, tgt):
+                return fn(params, x, feed), jnp.zeros((), jnp.float32)
+        return br
+
+    branches = [_mk_branch(s) for s in range(n_stages)]
+
+    def run_stage(params, x, feed, tgt):
+        return lax.switch(stage, branches, params, x, feed, tgt)
+
+    act = zeros_wire
+    cot = tmap(lambda s: jnp.zeros(s.shape, jnp.float32), wire)
+    stash = tmap(lambda s: jnp.zeros((stash_len,) + s.shape, s.dtype),
+                 wire)
+    grads = tmap(lambda p: jnp.zeros(p.shape, jnp.float32), stage_params)
+    loss_acc = jnp.zeros((), jnp.float32)
+
+    def tick(r, carry):
+        act, cot, stash, grads, loss_acc = carry
+
+        # ---- F-slot -----------------------------------------------------
+        m_f = r - stage
+        f_valid = jnp.logical_and(m_f >= 0, m_f < n_micro)
+        m_f_c = jnp.clip(m_f, 0, n_micro - 1)
+        feed = lax.dynamic_index_in_dim(microbatches, m_f_c, 0,
+                                        keepdims=False)
+        tgt = lax.dynamic_index_in_dim(targets, m_f_c, 0, keepdims=False)
+        slot_f = m_f_c % stash_len
+        stash = tmap(
+            lambda st, xx: lax.dynamic_update_index_in_dim(
+                st,
+                jnp.where(f_valid, xx,
+                          lax.dynamic_index_in_dim(st, slot_f, 0,
+                                                   keepdims=False)),
+                slot_f, 0),
+            stash, act)
+        y, loss_m = run_stage(stage_params, act, feed, tgt)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(is_last, f_valid), loss_m, 0.0)
+
+        # ---- B-slot -----------------------------------------------------
+        m_b = r - 2 * (n_stages - 1) + stage
+        b_valid = jnp.logical_and(m_b >= 0, m_b < n_micro)
+        m_b_c = jnp.clip(m_b, 0, n_micro - 1)
+        feed_b = lax.dynamic_index_in_dim(microbatches, m_b_c, 0,
+                                          keepdims=False)
+        tgt_b = lax.dynamic_index_in_dim(targets, m_b_c, 0, keepdims=False)
+        slot_b = m_b_c % stash_len
+        x_b = tmap(lambda st: lax.dynamic_index_in_dim(st, slot_b, 0,
+                                                       keepdims=False),
+                   stash)
+        _, b_vjp = jax.vjp(
+            lambda p, xx: run_stage(p, xx, feed_b, tgt_b),
+            stage_params, x_b)
+        # last stage: its forward register output is the zeros dummy —
+        # its real backward seed is the loss cotangent
+        cot_in = tmap(lambda c, w: jnp.where(is_last, 0.0, c)
+                      .astype(w.dtype), cot, wire)
+        loss_seed = jnp.where(is_last, 1.0, 0.0).astype(jnp.float32)
+        dparams, dx = b_vjp((cot_in, loss_seed))
+        grads = tmap(
+            lambda g, d: g + jnp.where(b_valid, d.astype(jnp.float32),
+                                       0.0),
+            grads, dparams)
+
+        # ---- communicate ------------------------------------------------
+        act = tmap(lambda yy: collectives.ring_permute(yy, axis, 1), y)
+        cot = tmap(
+            lambda d: collectives.ring_permute(
+                jnp.where(b_valid, d.astype(jnp.float32), 0.0), axis, -1),
+            dx)
+        return act, cot, stash, grads, loss_acc
+
+    _, _, _, grads, loss_acc = lax.fori_loop(
+        0, n_micro + 2 * n_stages - 2, tick,
+        (act, cot, stash, grads, loss_acc))
+    loss_total = collectives.broadcast_from(loss_acc, axis,
+                                            root=n_stages - 1)
+    return loss_total, grads
+
+
+def pipeline_apply_1f1b_het(stage_params, microbatches, targets,
+                            stage_fns, loss_fn, wire, mesh=None,
+                            axis=AXIS_PP, batch_axis=None):
+    """Heterogeneous-stage 1F1B over a mesh: (summed loss, union grads).
+
+    See :func:`_pipeline_1f1b_het_local` for the stage contract.  With
+    ``mesh`` given, union-param leaves are sharded on their leading
+    stage dim over ``axis`` and microbatches/targets on dim 1 over
+    ``batch_axis`` (pass ``wire`` at the LOCAL per-shard microbatch
+    shape in that case); grads come back sharded like ``stage_params``.
+    """
+    if mesh is None:
+        return _pipeline_1f1b_het_local(stage_params, microbatches,
+                                        targets, stage_fns, loss_fn,
+                                        wire, axis)
+
+    def local_call(local, mb, tg):
+        return _pipeline_1f1b_het_local(local, mb, tg, stage_fns,
+                                        loss_fn, wire, axis)
+    return _shardmap_1f1b(local_call, stage_params, microbatches,
+                          targets, mesh, axis, batch_axis)
+
+
+def stage_param_shardings(stage_params, mesh, axis=AXIS_PP):
+    """NamedShardings matching the leading-stage-dim specs the 1F1B
+    wrappers use.  Place union params once before a training loop
+    (``tree_map(jax.device_put, params, shardings)``) so that
+    ``p - lr * g`` against the pipeline's mesh-sharded grads stays
+    on-mesh instead of mixing host and mesh placements."""
+    from jax.sharding import NamedSharding
+    return jax.tree_util.tree_map(
+        lambda p: NamedSharding(mesh,
+                                P(axis, *([None] * (p.ndim - 1)))),
+        stage_params)
+
+
+def _shardmap_1f1b(local_call, stage_params, microbatches, targets,
+                   mesh, axis, batch_axis):
+    """Shared mesh wrapper for the 1F1B variants: shard union params on
+    their leading stage dim over ``axis``, place inputs (union params
+    commonly arrive committed to the default device by functionalize),
+    strip the stage dim inside shard_map, and psum loss/grads over an
+    optional batch axis."""
+    tmap = jax.tree_util.tree_map
+    from jax.sharding import NamedSharding
+    param_specs = tmap(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params)
+    data_spec = (P(None, batch_axis) if batch_axis else P())
+    stage_params = tmap(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        stage_params, param_specs)
+    microbatches = jax.device_put(microbatches,
+                                  NamedSharding(mesh, data_spec))
+    targets = jax.device_put(targets, NamedSharding(mesh, data_spec))
+
+    def fn(sp, mb, tg):
+        local = tmap(lambda p: p[0], sp)
+        loss, grads = local_call(local, mb, tg)
+        if batch_axis is not None:
+            # each batch shard computed its slice's loss/grads; the
+            # replicated out_specs promise the TOTAL — sum them
+            loss = lax.psum(loss, batch_axis)
+            grads = tmap(lambda g: lax.psum(g, batch_axis), grads)
+        grads = tmap(lambda g: g[None], grads)
+        return loss, grads
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, data_spec, data_spec),
+        out_specs=(P(), param_specs),
+        check_rep=False)(stage_params, microbatches, targets)
+
+
 def pipeline_apply_1f1b(stage_params, microbatches, targets, stage_fn,
                         loss_fn, mesh=None, axis=AXIS_PP,
                         batch_axis=None):
@@ -198,24 +402,9 @@ def pipeline_apply_1f1b(stage_params, microbatches, targets, stage_fn,
     if mesh is None:
         return _pipeline_1f1b_local(stage_params, microbatches, targets,
                                     stage_fn, loss_fn, axis)
-    param_specs = jax.tree_util.tree_map(
-        lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params)
-    data_spec = (P(None, batch_axis) if batch_axis else P())
 
-    def fn(sp, mb, tg):
-        local = jax.tree_util.tree_map(lambda p: p[0], sp)
-        loss, grads = _pipeline_1f1b_local(local, mb, tg, stage_fn,
-                                           loss_fn, axis)
-        if batch_axis is not None:
-            # each batch shard computed its slice's loss/grads; the
-            # replicated out_specs promise the TOTAL — sum them
-            loss = lax.psum(loss, batch_axis)
-            grads = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, batch_axis), grads)
-        grads = jax.tree_util.tree_map(lambda g: g[None], grads)
-        return loss, grads
-    return shard_map(
-        fn, mesh=mesh,
-        in_specs=(param_specs, data_spec, data_spec),
-        out_specs=(P(), param_specs),
-        check_rep=False)(stage_params, microbatches, targets)
+    def local_call(local, mb, tg):
+        return _pipeline_1f1b_local(local, mb, tg, stage_fn, loss_fn,
+                                    axis)
+    return _shardmap_1f1b(local_call, stage_params, microbatches,
+                          targets, mesh, axis, batch_axis)

