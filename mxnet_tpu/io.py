@@ -722,6 +722,12 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         self._eof = False
+        # rebuild the round_batch pad cache from THIS pass's first batch:
+        # with shuffle the wrap rows must come from the new epoch's
+        # ordering, matching the reference's wrap-to-start-of-next-pass
+        # semantics (round-4 ADVICE)
+        self._first_data = None
+        self._first_label = None
         errs = self.num_decode_errors
         if errs:
             import logging

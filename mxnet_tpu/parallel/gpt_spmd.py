@@ -61,29 +61,53 @@ def shard_batch(tokens, mesh, dp_axis=AXIS_DP):
                                     _shd.batch_spec(tokens.ndim, dp_axis)))
 
 
+def loss_mask_from_segments(segments):
+    """Loss mask for packed-LM rows: drop pad positions (segment id 0)
+    and each segment's FINAL position — its next-token target is the
+    following document's first token, which would contaminate the
+    training signal (round-4 ADVICE).  Returns float32 [B, T]."""
+    seg = jnp.asarray(segments)
+    nxt = jnp.concatenate(
+        [seg[:, 1:], jnp.full_like(seg[:, :1], -1)], axis=1)
+    return jnp.logical_and(seg != 0, seg == nxt).astype(jnp.float32)
+
+
 def make_train_step(fn, mesh, lr=3e-4, momentum=0.9, wd=0.0,
                     dp_axis=AXIS_DP, compute_dtype=None):
     """Build (init_fn, step_fn) for flagship causal-LM training.
 
     Rides ``data_parallel.make_train_step`` (same jit/donation/batch
     placement path as every dp model) with ``GPT_TP_RULES`` as the
-    param rules.  ``fn`` is ``functionalize(net, toks, train=True)``.
+    param rules.  ``fn`` is ``functionalize(net, toks, train=True)``
+    — or ``functionalize(net, toks, segs)`` for the packed flagship.
 
     - ``init_fn(param_list) -> (params_dict, opt_state)`` — params
       tensor-sharded per the rules, optimizer state following them.
-    - ``step_fn(params_dict, opt_state, {"x": toks, "y": targets},
-      rng) -> (params_dict, opt_state, loss)`` — rng is threaded into
-      the forward, so dropout masks differ per step.
+    - ``step_fn(params_dict, opt_state, batch, rng) -> (params_dict,
+      opt_state, loss)`` — batch is ``{"x": toks, "y": targets}`` plus
+      optionally ``"segments"`` (forwarded to the packed model's
+      attention/position masking) and ``"mask"`` (float [B, T]; the
+      loss becomes a masked mean — pass
+      :func:`loss_mask_from_segments` so padding and cross-document
+      targets don't train).  rng is threaded into the forward, so
+      dropout masks differ per step.
     """
     cdt = compute_dtype or jnp.float32
     names = list(fn.param_names)
 
     def loss_fn(params, batch, rng):
         ps = [params[n].astype(cdt) for n in names]
-        (logits,), _ = fn(ps, batch["x"], rng=rng)
+        xs = (batch["x"],)
+        if "segments" in batch:
+            xs = xs + (batch["segments"],)
+        (logits,), _ = fn(ps, *xs, rng=rng)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return -jnp.take_along_axis(logp, batch["y"][..., None],
-                                    axis=-1).mean()
+        nll = -jnp.take_along_axis(logp, batch["y"][..., None],
+                                   axis=-1)[..., 0]
+        if "mask" in batch:
+            mask = batch["mask"].astype(jnp.float32)
+            return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return nll.mean()
 
     init_fn, step_fn = _dp.make_train_step(
         loss_fn, mesh,

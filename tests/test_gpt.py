@@ -181,6 +181,47 @@ def test_gpt_generate_kv_cache_matches_full_recompute():
     np.testing.assert_array_equal(out, ref)
 
 
+def test_gpt_generate_matches_recompute_small_geometry():
+    """KV-cache decode at gpt2_small HEAD GEOMETRY (768 units, 12
+    heads — 2 tiny layers are too forgiving of head-layout mistakes in
+    the fused-qkv [H, 3, D] unpacking) and with use_bias=False (the
+    structural _decode_params path must not assume biases exist)."""
+    net = gpt.GPTLM(128, 3, 768, 12, max_len=16)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 128, (1, 4)).astype(np.int32)
+    n_new = 4
+    out = gpt.generate(net, prompt, n_new)
+    ref = prompt.copy()
+    for _ in range(n_new):
+        logits = net(mx.nd.array(ref, dtype="int32")).asnumpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        ref = np.concatenate([ref, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_gpt_generate_no_bias_and_custom_prefix():
+    """generate() on a net with use_bias=False attention/MLP and a
+    custom prefix — the old name-template _decode_params KeyError'd on
+    both (round-4 ADVICE)."""
+    net = gpt.GPTLM(32, 2, 32, 4, max_len=24, prefix="mygpt_")
+    for blk in net.blocks._children:
+        with blk.name_scope():
+            blk.attn = gluon.nn.FlashSelfAttention(
+                32, 4, causal=True, use_bias=False, in_units=32,
+                prefix="attn2_")
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(6)
+    prompt = rng.randint(0, 32, (2, 3)).astype(np.int32)
+    out = gpt.generate(net, prompt, 5)
+    ref = prompt.copy()
+    for _ in range(5):
+        logits = net(mx.nd.array(ref, dtype="int32")).asnumpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        ref = np.concatenate([ref, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_gpt_generate_sampled_deterministic():
     net = gpt.gpt2_tiny(vocab_size=16, max_len=32)
     net.initialize(mx.init.Xavier())
@@ -215,6 +256,55 @@ def test_gpt_remat_identical_values_and_grads():
     for a, b, n in zip(g, g_r, fn.param_names):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_loss_mask_from_segments():
+    from mxnet_tpu.parallel import gpt_spmd
+    segs = jnp.asarray(np.array([[1, 1, 2, 2, 0, 0]], np.int32))
+    mask = gpt_spmd.loss_mask_from_segments(segs)
+    # drop: each segment's last position (target crosses into the next
+    # document) and pad positions (segment 0)
+    np.testing.assert_array_equal(np.asarray(mask),
+                                  [[1, 0, 1, 0, 0, 0]])
+
+
+def test_gpt_spmd_packed_masked_train_step():
+    """Packed flagship training through make_train_step: segments reach
+    the model's attention/position masking and the loss is the masked
+    mean — pad positions and cross-document targets do not train
+    (round-4 ADVICE)."""
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.parallel import gpt_spmd
+
+    net = gpt.GPTLM(32, 2, 32, 4, max_len=8)
+    net.initialize(mx.init.Xavier())
+    docs = [np.arange(1, 6), np.arange(6, 9), np.arange(9, 13),
+            np.arange(13, 17)]
+    toks_np, segs_np = gpt.pack_sequences(docs, 8)
+    assert toks_np.shape[0] == 2
+    toks = jnp.asarray(toks_np)
+    segs = jnp.asarray(segs_np)
+    y = jnp.roll(toks, -1, axis=1)
+    mask = gpt_spmd.loss_mask_from_segments(segs)
+
+    fn, params = functionalize(net, toks, segs, train=True)
+
+    # single-device oracle: masked-mean NLL with the same rng
+    rng = jax.random.PRNGKey(0)
+    (logits,), _ = fn(params, toks, segs, rng=rng)
+    lp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), -1)
+    nll = -jnp.take_along_axis(lp, y[..., None], -1)[..., 0]
+    ref = float((nll * mask).sum() / mask.sum())
+
+    mesh = par.make_mesh(dp=2, tp=4)
+    init_fn, step_fn = gpt_spmd.make_train_step(fn, mesh, lr=0.01)
+    with mesh:
+        ps, opt_state = init_fn(params)
+        batch = {k: gpt_spmd.shard_batch(v, mesh)
+                 for k, v in (("x", toks), ("y", y),
+                              ("segments", segs), ("mask", mask))}
+        ps, opt_state, loss = step_fn(ps, opt_state, batch, rng)
+    np.testing.assert_allclose(float(loss), ref, rtol=2e-5)
 
 
 def test_gpt_spmd_dp_tp_matches_single_device():
@@ -282,6 +372,22 @@ def test_pack_sequences():
             for s in sorted(set(segs[r])) if s > 0]
     joined = np.concatenate(flat)
     assert np.array_equal(np.sort(joined), np.sort(np.concatenate(docs)))
+
+
+def test_pack_sequences_no_straddle():
+    """A doc that would not fit the current row starts a FRESH row
+    (round-4 ADVICE): only docs longer than seq_len are ever split."""
+    docs = [np.arange(1, 6), np.arange(10, 16)]    # sizes 5, 6
+    toks, segs = gpt.pack_sequences(docs, 8, pad_id=0)
+    # doc 2 (size 6 <= 8) must NOT straddle: row 0 = doc1 + pad,
+    # row 1 = doc2 whole + pad
+    assert toks.shape[0] == 2
+    np.testing.assert_array_equal(toks[0], [1, 2, 3, 4, 5, 0, 0, 0])
+    np.testing.assert_array_equal(toks[1], [10, 11, 12, 13, 14, 15, 0, 0])
+    assert (segs[1][:6] == segs[1][0]).all()
+    # a doc LONGER than seq_len still splits (unavoidable)
+    toks2, segs2 = gpt.pack_sequences([np.arange(1, 12)], 8)
+    assert toks2.shape[0] == 2 and (segs2[0][:8] > 0).all()
 
 
 def test_gpt_packed_training_independence():

@@ -151,6 +151,23 @@ def test_image_record_iter_native(tmp_path):
     assert len(list(it)) == 3
 
 
+def test_round_batch_pad_cache_refreshed_per_epoch(tmp_path):
+    """round_batch wrap rows come from THE CURRENT pass's first batch:
+    with shuffle, epoch 2's tail must wrap epoch 2's ordering, not a
+    stale epoch-1 cache (round-4 ADVICE; reference semantics are
+    wrap-to-start-of-next-pass, src/io/iter_image_recordio_2.cc)."""
+    rec, idx, _ = _write_images(tmp_path, n=10, size=(24, 24))
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 24, 24), batch_size=8,
+                               shuffle=True, seed=3, round_batch=True)
+    for epoch in range(2):
+        batches = [b.data[0].asnumpy().copy() for b in it]
+        assert batches[-1].shape[0] == 8
+        # wrap rows (pad=6) equal this epoch's leading rows
+        np.testing.assert_allclose(batches[-1][2:], batches[0][:6])
+        it.reset()
+
+
 @requires_native
 def test_image_record_iter_shuffle_and_values(tmp_path):
     rec, idx, _ = _write_images(tmp_path, n=16, size=(24, 24))

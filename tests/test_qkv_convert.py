@@ -1,0 +1,77 @@
+"""tools/convert_qkv_layout.py — the round-3 -> round-4 fused-qkv
+checkpoint converter (round-4 ADVICE, medium).
+
+The layout change ([3, H, D]-major -> head-major [H, 3, D]) kept the
+tensor shape, so an old checkpoint loads silently wrong; the converter
+must restore bit-exact attention output.
+"""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.gluon import nn
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "convert_qkv_layout.py")
+spec = importlib.util.spec_from_file_location("convert_qkv_layout", _TOOL)
+cvt = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(cvt)
+
+
+def _old_layout(arr, num_heads):
+    """Inverse of the converter: express a head-major param in the
+    pre-round-4 [3, H, D]-major ordering."""
+    a = np.asarray(arr)
+    d = a.shape[0] // (3 * num_heads)
+    rest = a.shape[1:]
+    return a.reshape((num_heads, 3, d) + rest) \
+            .transpose((1, 0, 2) + tuple(range(3, 3 + len(rest)))) \
+            .reshape(a.shape)
+
+
+def test_convert_roundtrip_is_identity():
+    rng = np.random.RandomState(0)
+    w = rng.randn(48, 16).astype(np.float32)
+    old = _old_layout(w, num_heads=4)
+    np.testing.assert_array_equal(cvt.convert_qkv(old, 4), w)
+
+
+def test_converted_checkpoint_restores_attention(tmp_path):
+    h = 4
+    net = nn.FlashSelfAttention(16, h, causal=True, in_units=16,
+                                prefix="attn_")
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(1).randn(2, 8, 16)
+                    .astype(np.float32))
+    ref = net(x).asnumpy()
+
+    # simulate a round-3 checkpoint: same values, old qkv ordering
+    old_file = str(tmp_path / "old.params")
+    new_file = str(tmp_path / "new.params")
+    params = {}
+    for name, p in net.collect_params().items():
+        a = p.data().asnumpy()
+        if name.endswith("qkv_weight") or name.endswith("qkv_bias"):
+            a = _old_layout(a, h)
+        # save_params strips the net prefix; match that file format
+        params[name[len(net.prefix):]] = nd.array(a)
+    nd.save(old_file, params)
+
+    converted = cvt.convert_file(old_file, new_file, h)
+    assert sorted(converted) == ["qkv_bias", "qkv_weight"]
+
+    net2 = nn.FlashSelfAttention(16, h, causal=True, in_units=16,
+                                 prefix="attn_")
+    net2.load_params(new_file)
+    np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-6,
+                               atol=1e-6)
+
+    # and WITHOUT conversion the old file really does attend wrong
+    net3 = nn.FlashSelfAttention(16, h, causal=True, in_units=16,
+                                 prefix="attn_")
+    net3.load_params(old_file)
+    assert np.abs(net3(x).asnumpy() - ref).max() > 1e-3

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Convert pre-round-4 FlashSelfAttention checkpoints to the head-major
+fused-qkv layout.
+
+Round 4 changed the fused qkv projection's out-dim ordering from
+[3, H, D]-major to head-major [H, 3, D] (gluon/nn/basic_layers.py
+FlashSelfAttention: a tensor-parallel column split then lands on whole
+heads instead of straddling the q/k/v factor).  The tensor SHAPE
+(3C, in) is unchanged, so an old checkpoint loads without error but
+permutes q/k/v slices across heads — wrong attention with no
+diagnostic.  The layouts cannot be told apart from the file alone;
+run this once over any V2 ``.params`` file saved by a round-3 build:
+
+    python tools/convert_qkv_layout.py --num-heads 12 old.params new.params
+
+Every parameter whose name ends in ``qkv_weight`` / ``qkv_bias`` has
+its out dim re-ordered (3, H, D) -> (H, 3, D); everything else is
+copied through byte-identical.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def convert_qkv(arr, num_heads):
+    """Re-order the out dim of a fused qkv weight/bias from [3, H, D]
+    to head-major [H, 3, D].  arr: numpy [3C] or [3C, in]."""
+    import numpy as np
+    a = np.asarray(arr)
+    three_c = a.shape[0]
+    if three_c % (3 * num_heads):
+        raise ValueError("out dim %d not divisible by 3*heads=%d"
+                         % (three_c, 3 * num_heads))
+    d = three_c // (3 * num_heads)
+    rest = a.shape[1:]
+    return a.reshape((3, num_heads, d) + rest) \
+            .transpose((1, 0, 2) + tuple(range(3, 3 + len(rest)))) \
+            .reshape(a.shape)
+
+
+def convert_file(src, dst, num_heads):
+    from mxnet_tpu import ndarray as nd
+    loaded = nd.load(src)
+    if not isinstance(loaded, dict):
+        raise SystemExit("expected a name-keyed .params file")
+    out, converted = {}, []
+    for name, arr in loaded.items():
+        if name.endswith("qkv_weight") or name.endswith("qkv_bias"):
+            out[name] = nd.array(convert_qkv(arr.asnumpy(), num_heads))
+            converted.append(name)
+        else:
+            out[name] = arr
+    nd.save(dst, out)
+    return converted
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("src", help="round-3 .params file ([3,H,D] layout)")
+    ap.add_argument("dst", help="output .params file ([H,3,D] layout)")
+    ap.add_argument("--num-heads", type=int, required=True,
+                    help="attention heads of every qkv layer in the file")
+    args = ap.parse_args(argv)
+    converted = convert_file(args.src, args.dst, args.num_heads)
+    print("converted %d qkv parameter(s): %s"
+          % (len(converted), ", ".join(converted) or "(none)"))
+
+
+if __name__ == "__main__":
+    main()
