@@ -93,6 +93,24 @@ class GPTLM(HybridBlock):
                                              dropout=dropout))
             self.ln_f = nn.LayerNorm(in_channels=units, prefix="lnf_")
 
+    def sequence_parallel(self, mesh, axis="sp", batch_axis=None,
+                          impl=None):
+        """Long-context switch: every block's attention becomes RING
+        attention over ``mesh``'s ``axis`` (sequence dim sharded,
+        nearest-neighbour ICI hops — parallel/ring_attention.py), so
+        ``gpt2_small(max_len=32k)`` trains on an sp mesh through this
+        one call; packing segment ids keep riding the forward and are
+        threaded through the ring hops.  Shard the [B, T] token batch
+        with T over ``axis`` (and B over dp/``batch_axis`` if
+        composing); everything outside attention is position-local, so
+        XLA GSPMD keeps it sharded.  ``mesh=None`` restores the
+        single-device flash kernel."""
+        for blk in self.blocks._children:
+            blk.attn.sequence_parallel(mesh, axis=axis,
+                                       batch_axis=batch_axis, impl=impl)
+            blk._cached_op = None
+        self._cached_op = None
+
     def hybrid_forward(self, F, tokens, segments=None, wte=None,
                        wpe=None):
         t = tokens.shape[1]

@@ -243,6 +243,7 @@ class FlashSelfAttention(HybridBlock):
         self._units = units
         self._num_heads = num_heads
         self._causal = causal
+        self._ring = None
         with self.name_scope():
             self.qkv = Dense(3 * units, flatten=False, use_bias=use_bias,
                              weight_initializer=weight_initializer,
@@ -250,6 +251,19 @@ class FlashSelfAttention(HybridBlock):
             self.out_proj = Dense(units, flatten=False, use_bias=use_bias,
                                   weight_initializer=weight_initializer,
                                   in_units=units, prefix="out_")
+
+    def sequence_parallel(self, mesh, axis="sp", batch_axis=None,
+                          impl=None):
+        """Run this layer's attention as RING attention over ``mesh``'s
+        ``axis`` (parallel/ring_attention.py): the sequence dim of
+        q/k/v is sharded, K/V blocks rotate via ppermute, and packing
+        segment ids (when given to forward) ride the ring — long
+        context through the layer API, no ``parallel/`` calls in user
+        code.  Applies on the traced path (functionalize/jit training);
+        pass ``mesh=None`` to restore the single-device kernel."""
+        self._ring = (None if mesh is None
+                      else (mesh, axis, batch_axis, impl))
+        self._cached_op = None
 
     def hybrid_forward(self, F, x, segments=None):
         b, t = x.shape[0], x.shape[1]
@@ -268,13 +282,37 @@ class FlashSelfAttention(HybridBlock):
                       shape=(b, h, t, d))
         v = F.reshape(F.slice_axis(qkv, axis=0, begin=2, end=3),
                       shape=(b, h, t, d))
-        attn = getattr(F, "_contrib_flash_attention")
-        if segments is None:
-            o = attn(q, k, v, causal=self._causal)    # [B, H, T, D]
+        if self._ring is not None:
+            # sequence-parallel path: ring attention over the sp mesh
+            # axis (T sharded; packing ids rotate with their K/V block)
+            from ... import parallel as _par
+            from ... import autograd as _ag
+            if hasattr(q, "_data") and _ag.is_recording():
+                # the ring call runs outside the op registry, so the
+                # imperative tape cannot record it — grads upstream of
+                # attention would silently be zero
+                raise RuntimeError(
+                    "sequence_parallel attention does not support the "
+                    "imperative autograd tape; train through "
+                    "functionalize/jit (see parallel/gpt_spmd.py), or "
+                    "call sequence_parallel(None) first")
+            mesh, axis_name, batch_axis, impl = self._ring
+
+            def _raw(a):
+                return a._data if hasattr(a, "_data") else a
+            o = _par.ring_attention_fn(
+                _raw(q), _raw(k), _raw(v), mesh=mesh, axis=axis_name,
+                causal=self._causal, batch_axis=batch_axis, impl=impl,
+                segment_ids=(None if segments is None
+                             else _raw(segments)))
         else:
-            # sequence packing: [B, T] int ids, attend within-segment
-            o = attn(q, k, v, segments, causal=self._causal,
-                     use_segments=True)
+            attn = getattr(F, "_contrib_flash_attention")
+            if segments is None:
+                o = attn(q, k, v, causal=self._causal)  # [B, H, T, D]
+            else:
+                # sequence packing: [B, T] int ids, attend within-segment
+                o = attn(q, k, v, segments, causal=self._causal,
+                         use_segments=True)
         o = F.reshape(F.transpose(o, axes=(0, 2, 1, 3)),
                       shape=(b, t, self._units))
         return self.out_proj(o)

@@ -769,20 +769,30 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
 
 
 def flash_forward_with_lse(q, k, v, causal=False, scale=None, block_q=512,
-                           block_k=512):
+                           block_k=512, segment_ids=None,
+                           kv_segment_ids=None):
     """Forward-only kernel call returning (out, lse) over [B,H,T,D].
 
     ``lse = m + log l`` per query row — the merge quantity ring attention
     needs to combine per-block results (parallel/ring_attention.py).  Not
     differentiable; ring attention defines its own vjp around it.
+    ``segment_ids``/``kv_segment_ids`` ([B, Tq]/[B, Tk] int32) apply the
+    packing mask; rows with no visible key report ``lse = -inf`` so the
+    ring merge weighs them zero.
     """
     b, h, tq, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     q3 = q.reshape(b * h, tq, d)
     k3 = k.reshape(b * h, k.shape[2], k.shape[3])
     v3 = v.reshape(b * h, v.shape[2], v.shape[3])
+    qs = ks = None
+    if segment_ids is not None:
+        qs = jnp.asarray(segment_ids, jnp.int32)
+        ks = jnp.asarray(kv_segment_ids if kv_segment_ids is not None
+                         else segment_ids, jnp.int32)
     out, lse = _flash_fwd(q3, k3, v3, float(scale), bool(causal),
-                          int(block_q), int(block_k))
+                          int(block_q), int(block_k), qseg=qs, kseg=ks,
+                          h=h)
     return (out.reshape(b, h, tq, v.shape[3]),
             lse.reshape(b, h, tq, 1))
 

@@ -17,9 +17,12 @@ Sharding rules (weight layouts are FullyConnected's (out, in)):
 - embeddings / layernorms / position table / row biases: replicated
   (the tied-head [B·T, d] x [d, V] matmul batch-splits over dp).
 
-Long-context runs compose sp on top via ``parallel.ring_attention``
-(the dryrun's transformer pass shows the shard_map form); this module
-covers the dp x tp grid where XLA propagation alone suffices.
+Long-context runs switch the model itself: ``GPTLM.sequence_parallel
+(mesh)`` flips every block's attention to ring attention over sp with
+packing segment ids threaded through the hops (gluon/model_zoo/gpt.py,
+round 5) — no ``parallel/`` calls in user code.  Pipeline runs cut the
+same net into 1F1B stages via ``parallel.gpt_pp``.  This module covers
+the dp x tp grid where XLA propagation alone suffices.
 """
 from __future__ import annotations
 

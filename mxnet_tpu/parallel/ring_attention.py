@@ -55,8 +55,11 @@ def _causal_bias(q_off, k_off, tq, tk):
     return jnp.where(q_pos >= k_pos, 0.0, _NEG_INF)[None, None]
 
 
-def _ring_attention_local(q, k, v, axis, causal, scale):
-    """Runs inside shard_map: q/k/v are the local sequence blocks."""
+def _ring_attention_local(q, k, v, axis, causal, scale, qseg=None,
+                          kseg=None):
+    """Runs inside shard_map: q/k/v are the local sequence blocks.
+    ``qseg``/``kseg`` ([B, T_local] int32) add the packing mask; kseg
+    rotates around the ring in lock-step with its K/V block."""
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     tq, tk = q.shape[2], k.shape[2]
@@ -66,14 +69,20 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
     o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
     m = jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32)
     l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+    has_seg = qseg is not None
 
     def body(step, carry):
-        k_blk, v_blk, o, m, l = carry
+        k_blk, v_blk, ks_blk, o, m, l = carry
         src = (idx - step) % n  # which block we currently hold
         if causal:
             bias = _causal_bias(idx * tq, src * tk, tq, tk)
         else:
             bias = None
+        if has_seg:
+            seg_bias = jnp.where(
+                qseg[:, None, :, None] == ks_blk[:, None, None, :],
+                0.0, _NEG_INF)
+            bias = seg_bias if bias is None else bias + seg_bias
         o, m, l = _block_attend(qf, k_blk.astype(jnp.float32),
                                 v_blk, bias, o, m, l, scale)
         # rotate K/V to the next device; skipping the last (wasted) hop
@@ -81,21 +90,31 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
         # keep the uniform ring schedule instead.
         k_nxt = collectives.ring_permute(k_blk, axis, 1)
         v_nxt = collectives.ring_permute(v_blk, axis, 1)
-        return k_nxt, v_nxt, o, m, l
+        # the kv-side segment ids rotate in lock-step with their block
+        # (only when packing is on — no wasted collective otherwise)
+        ks_nxt = collectives.ring_permute(ks_blk, axis, 1) if has_seg \
+            else ks_blk
+        return k_nxt, v_nxt, ks_nxt, o, m, l
 
-    _, _, o, m, l = lax.fori_loop(0, n, body, (k, v, o, m, l))
+    seg0 = kseg if has_seg else jnp.zeros((), jnp.int32)
+    _, _, _, o, m, l = lax.fori_loop(0, n, body, (k, v, seg0, o, m, l))
     out = o / jnp.maximum(l, 1e-20)
     return out.astype(q.dtype)
 
 
-def _ring_flash_fwd_local(q, k, v, axis, causal, scale):
+def _ring_flash_fwd_local(q, k, v, axis, causal, scale, qseg=None,
+                          kseg=None):
     """Ring forward whose per-block attention is the Pallas flash kernel
     (ops/pallas/flash_attention.py) instead of jnp einsums: each hop runs
     the fused kernel on (q_local, k_block, v_block) getting (out, lse),
     and blocks merge by log-sum-exp — the O(T²) score matrix never exists
     in HBM and the MXU work happens inside the kernel.
 
-    Returns (out, lse_total) — lse_total is the flash-backward residual.
+    ``qseg``/``kseg`` thread sequence packing through the ring: the
+    kernel's segment mask applies per hop (kseg rotates with its K/V
+    block) and fully-masked rows report lse = -inf, so the merge weighs
+    them zero.  Returns (out, lse_total) — lse_total is the
+    flash-backward residual.
     """
     from ..ops.pallas.flash_attention import flash_forward_with_lse
     n = lax.axis_size(axis)  # static: mesh axis sizes are trace-time ints
@@ -105,12 +124,14 @@ def _ring_flash_fwd_local(q, k, v, axis, causal, scale):
     m = jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32)
     l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
     k_blk, v_blk = k, v
+    ks_blk = kseg
     # unrolled: n is the static mesh-axis size, so step (and the
     # diagonal's causal flag) stay Python values; only src is traced
     for step in range(n):
         src = (idx - step) % n
         o_b, lse_b = flash_forward_with_lse(
-            q, k_blk, v_blk, causal=(causal and step == 0), scale=scale)
+            q, k_blk, v_blk, causal=(causal and step == 0), scale=scale,
+            segment_ids=qseg, kv_segment_ids=ks_blk)
         if causal and step > 0:
             # later blocks are fully visible iff strictly earlier in the
             # sequence; otherwise fully masked
@@ -125,13 +146,16 @@ def _ring_flash_fwd_local(q, k, v, axis, causal, scale):
         if step < n - 1:
             k_blk = collectives.ring_permute(k_blk, axis, 1)
             v_blk = collectives.ring_permute(v_blk, axis, 1)
+            if ks_blk is not None:
+                ks_blk = collectives.ring_permute(ks_blk, axis, 1)
     l_safe = jnp.maximum(l, 1e-20)
     out = (o / l_safe).astype(q.dtype)
     lse = m + jnp.log(l_safe)
     return out, lse
 
 
-def _ring_flash_bwd_local(q, k, v, out, lse, g, axis, causal, scale):
+def _ring_flash_bwd_local(q, k, v, out, lse, g, axis, causal, scale,
+                          qseg=None, kseg=None):
     """Blockwise ring backward from saved (out, lse), with each hop's
     dq/dk/dv computed by the Pallas flash-backward kernels
     (ops/pallas/flash_attention.py:_flash_bwd) — the [B,H,T_loc,T_blk]
@@ -164,7 +188,7 @@ def _ring_flash_bwd_local(q, k, v, out, lse, g, axis, causal, scale):
     dq = jnp.zeros((b * h, tq, d), jnp.float32)
     dk = jnp.zeros((b * h, k.shape[2], d), jnp.float32)
     dv = jnp.zeros((b * h, v.shape[2], dvdim), jnp.float32)
-    k_blk, v_blk = k, v
+    k_blk, v_blk, ks_blk = k, v, kseg
     for step in range(n):
         src = (idx - step) % n
         if causal and step > 0:
@@ -174,9 +198,15 @@ def _ring_flash_bwd_local(q, k, v, out, lse, g, axis, causal, scale):
             qh, gh = q3 * visible, g3 * visible
         else:
             qh, gh = q3, g3
+        if qseg is None:
+            res = (qh, r3(k_blk), r3(v_blk), out3, lse3)
+        else:
+            # 7-tuple residual: the kernels apply the packing mask per
+            # hop against the rotating kseg block
+            res = (qh, r3(k_blk), r3(v_blk), out3, lse3, qseg, ks_blk)
         dq_c, dk_c, dv_c = _flash_bwd(
-            (qh, r3(k_blk), r3(v_blk), out3, lse3), gh, scale,
-            causal and step == 0, _ring_block(tq), _ring_block(k.shape[2]))
+            res, gh, scale, causal and step == 0, _ring_block(tq),
+            _ring_block(k.shape[2]), h=h)
         dq = dq + dq_c.astype(jnp.float32)
         dk = dk + dk_c.astype(jnp.float32)
         dv = dv + dv_c.astype(jnp.float32)
@@ -184,6 +214,8 @@ def _ring_flash_bwd_local(q, k, v, out, lse, g, axis, causal, scale):
         # full circle each dk/dv block is back on its owner
         k_blk = collectives.ring_permute(k_blk, axis, 1)
         v_blk = collectives.ring_permute(v_blk, axis, 1)
+        if ks_blk is not None:
+            ks_blk = collectives.ring_permute(ks_blk, axis, 1)
         dk = collectives.ring_permute(dk, axis, 1)
         dv = collectives.ring_permute(dv, axis, 1)
     return (dq.reshape(q.shape).astype(q.dtype),
@@ -219,6 +251,34 @@ def _ring_flash_vjp_bwd(axis, causal, scale, res, g):
 _ring_flash_local.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _ring_flash_seg_local(q, k, v, qseg, kseg, axis, causal, scale):
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    out, _ = _ring_flash_fwd_local(q, k, v, axis, causal, scale,
+                                   qseg, kseg)
+    return out
+
+
+def _ring_flash_seg_vjp_fwd(q, k, v, qseg, kseg, axis, causal, scale):
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _ring_flash_fwd_local(q, k, v, axis, causal, scale,
+                                     qseg, kseg)
+    return out, (q, k, v, out, lse, qseg, kseg)
+
+
+def _ring_flash_seg_vjp_bwd(axis, causal, scale, res, g):
+    q, k, v, out, lse, qseg, kseg = res
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    dq, dk, dv = _ring_flash_bwd_local(q, k, v, out, lse, g, axis,
+                                       causal, scale, qseg, kseg)
+    from ..ops.pallas.flash_attention import _int_zero_tangent
+    return dq, dk, dv, _int_zero_tangent(qseg), _int_zero_tangent(kseg)
+
+
+_ring_flash_seg_local.defvjp(_ring_flash_seg_vjp_fwd,
+                             _ring_flash_seg_vjp_bwd)
+
+
 def default_attention_impl():
     """Resolve the attention implementation.
 
@@ -237,7 +297,8 @@ def default_attention_impl():
 
 
 def ring_attention(q, k, v, mesh=None, axis=AXIS_SP, causal=False,
-                   scale=None, batch_axis=None, impl=None):
+                   scale=None, batch_axis=None, impl=None,
+                   segment_ids=None):
     """Sequence-parallel attention.
 
     With ``mesh`` given, q/k/v are global [B,H,T,D] arrays and the call is
@@ -247,21 +308,43 @@ def ring_attention(q, k, v, mesh=None, axis=AXIS_SP, causal=False,
     with dp in one program).  ``impl``: "flash" runs each hop's block
     attention in the Pallas kernel; "xla" keeps the plain jnp
     online-softmax step; None resolves via `default_attention_impl`.
+    ``segment_ids`` ([B, T] int32, T sharded like q) composes sequence
+    PACKING with the ring: the per-hop kernels mask cross-segment pairs
+    while the kv-side ids rotate with their K/V blocks, so packed rows
+    stay independent across the whole sp ring.
     """
     if impl is None:
         impl = default_attention_impl()
+    if segment_ids is None:
+        if impl == "flash":
+            local = functools.partial(_ring_flash_local, axis=axis,
+                                      causal=causal, scale=scale)
+        else:
+            local = functools.partial(_ring_attention_local, axis=axis,
+                                      causal=causal, scale=scale)
+        if mesh is None:
+            return local(q, k, v)
+        spec = P(batch_axis, None, axis, None)
+        return shard_map(lambda a, b, c: local(a, b, c), mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec, check_rep=False)(q, k, v)
+
+    seg = jnp.asarray(segment_ids, jnp.int32)
     if impl == "flash":
-        local = functools.partial(_ring_flash_local, axis=axis,
-                                  causal=causal, scale=scale)
+        def local_seg(a, b, c, s):
+            return _ring_flash_seg_local(a, b, c, s, s, axis, causal,
+                                         scale)
     else:
-        local = functools.partial(_ring_attention_local, axis=axis,
-                                  causal=causal, scale=scale)
+        def local_seg(a, b, c, s):
+            return _ring_attention_local(a, b, c, axis, causal, scale,
+                                         qseg=s, kseg=s)
     if mesh is None:
-        return local(q, k, v)
+        return local_seg(q, k, v, seg)
     spec = P(batch_axis, None, axis, None)
-    return shard_map(lambda a, b, c: local(a, b, c), mesh=mesh,
-                     in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+    seg_spec = P(batch_axis, axis)
+    return shard_map(local_seg, mesh=mesh,
+                     in_specs=(spec, spec, spec, seg_spec),
+                     out_specs=spec, check_rep=False)(q, k, v, seg)
 
 
 def attention_reference(q, k, v, causal=False, scale=None):
