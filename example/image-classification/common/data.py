@@ -58,8 +58,12 @@ class SyntheticDataIter(mx.io.DataIter):
         self.cur_iter = 0
         self.max_iter = max_iter
         self.dtype = dtype
-        label = np.random.randint(0, num_classes, [self.batch_size])
-        data = np.random.uniform(-1, 1, data_shape).astype(dtype)
+        # seeded: the benchmark replays one fixed batch, and the test
+        # suite asserts a memorization threshold on it — determinism
+        # keeps that threshold meaningful across runs
+        rng = np.random.RandomState(0)
+        label = rng.randint(0, num_classes, [self.batch_size])
+        data = rng.uniform(-1, 1, data_shape).astype(dtype)
         self.data = mx.nd.array(data)
         self.label = mx.nd.array(label.astype(np.float32))
         self.provide_data = [mx.io.DataDesc("data", data_shape, dtype)]

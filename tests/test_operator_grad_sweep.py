@@ -60,6 +60,9 @@ def _sample(domain, shape, r):
         d = np.arange(shape[-1])
         a[..., d, d] += 1.5
         return a
+    if domain.startswith("int1:"):        # 1..hi (nonzero lengths)
+        hi = int(domain.split(":")[1])
+        return r.randint(1, hi + 1, shape).astype(np.float64)
     if domain.startswith("int"):
         hi = int(domain.split(":")[1])
         return r.randint(0, hi, shape).astype(np.float64)
@@ -565,6 +568,211 @@ C("d4_tile_deep", "tile", [(D, (2, 1, 3), "any")],
 C("d4_reverse_multi", "reverse", [(D, (2, 3, 4), "any")],
   params={"axis": (0, 2)})
 
+# -- round-5 depth: axis/keepdims grids, deeper broadcasting, mode/param
+# corners, odd-shape unary sweeps (VERDICT r4 #7: toward the reference
+# suite's per-op breadth, tests/python/unittest/test_operator.py) ----------
+for op, dom in [("sum", "any"), ("mean", "any"), ("nansum", "any"),
+                ("max", "any"), ("min", "any"), ("prod", "pos"),
+                ("nanprod", "pos")]:
+    for ax_tag, ax in [("ax0", 0), ("axm1", -1), ("ax02", (0, 2))]:
+        for kd in (False, True):
+            C("d5_%s_%s_kd%d" % (op, ax_tag, int(kd)), op,
+              [(D, (2, 3, 4), dom)], params={"axis": ax, "keepdims": kd})
+
+for op in ["broadcast_add", "broadcast_sub", "broadcast_mul",
+           "broadcast_maximum", "broadcast_minimum"]:
+    C("d5_deep_%s" % op, op,
+      [("lhs", (2, 1, 3, 1), "any"), ("rhs", (1, 4, 1, 2), "any")])
+C("d5_deep_broadcast_div", "broadcast_div",
+  [("lhs", (2, 1, 3, 1), "any"), ("rhs", (1, 4, 1, 2), "pos")])
+C("d5_deep_broadcast_power", "broadcast_power",
+  [("lhs", (2, 1, 3), "pos"), ("rhs", (1, 4, 3), "unit")])
+C("d5_deep_broadcast_hypot", "broadcast_hypot",
+  [("lhs", (2, 1, 3, 1), "pos"), ("rhs", (1, 4, 1, 2), "pos")])
+C("d5_deep_broadcast_mod", "broadcast_mod",
+  [("lhs", (2, 1, 3), "pos"), ("rhs", (1, 4, 3), "gt1")])
+
+# every smooth unary again at a scalar-ish and a deep singleton shape —
+# rank-degenerate layouts take different XLA paths than (3, 4)
+for op in ["tanh", "sigmoid", "exp", "relu", "square", "negative",
+           "softsign", "sin", "cos", "arctan", "abs"]:
+    C("d5_%s_len1" % op, op, [(D, (1,), "any")])
+    C("d5_%s_deep1" % op, op, [(D, (5, 1, 1), "any")])
+for op in ["sqrt", "log", "rsqrt", "reciprocal", "cbrt", "log1p"]:
+    C("d5_%s_len1" % op, op, [(D, (1,), "pos")])
+    C("d5_%s_deep1" % op, op, [(D, (2, 1, 3), "pos")])
+
+C("d5_softmax_ax0", "softmax", [(D, (3, 4), "any")], params={"axis": 0})
+C("d5_softmax_temp", "softmax", [(D, (3, 4), "any")],
+  params={"temperature": 2.5})
+C("d5_softmax_deep", "softmax", [(D, (2, 3, 4, 2), "any")],
+  params={"axis": 2})
+C("d5_log_softmax_temp", "log_softmax", [(D, (3, 4), "any")],
+  params={"temperature": 0.7})
+C("d5_log_softmax_deep", "log_softmax", [(D, (2, 3, 4), "any")],
+  params={"axis": 1})
+
+C("d5_conv_k5_pad2", "Convolution",
+  [(D, (1, 2, 9, 9), "any"), ("weight", (2, 2, 5, 5), "any")],
+  params={"kernel": (5, 5), "num_filter": 2, "pad": (2, 2),
+          "no_bias": True})
+C("d5_conv_stride3", "Convolution",
+  [(D, (1, 2, 10, 10), "any"), ("weight", (3, 2, 3, 3), "any")],
+  params={"kernel": (3, 3), "num_filter": 3, "stride": (3, 3),
+          "no_bias": True})
+C("d5_conv1d_stride_dilate", "Convolution",
+  [(D, (2, 3, 11), "any"), ("weight", (2, 3, 3), "any")],
+  params={"kernel": (3,), "num_filter": 2, "stride": (2,),
+          "dilate": (2,), "no_bias": True})
+C("d5_deconv_pad", "Deconvolution",
+  [(D, (1, 2, 5, 5), "any"), ("weight", (2, 2, 3, 3), "any")],
+  params={"kernel": (3, 3), "num_filter": 2, "pad": (1, 1)})
+C("d5_deconv_stride_asym", "Deconvolution",
+  [(D, (1, 2, 4, 5), "any"), ("weight", (2, 1, 3, 3), "any")],
+  params={"kernel": (3, 3), "num_filter": 1, "stride": (2, 1),
+          "no_bias": True})
+for pt in ("max", "avg", "sum"):
+    C("d5_pool_%s_k1" % pt, "Pooling", [(D, (1, 2, 5, 5), "any")],
+      params={"kernel": (1, 1), "stride": (1, 1), "pool_type": pt})
+    C("d5_pool_%s_overlap" % pt, "Pooling", [(D, (1, 2, 6, 6), "any")],
+      params={"kernel": (3, 3), "stride": (1, 1), "pool_type": pt})
+
+C("d5_l2norm_channel", "L2Normalization", [(D, (2, 3, 4, 4), "any")],
+  params={"mode": "channel"})
+C("d5_l2norm_spatial", "L2Normalization", [(D, (2, 3, 4, 4), "any")],
+  params={"mode": "spatial"})
+C("d5_softmax_act_channel", "SoftmaxActivation",
+  [(D, (2, 3, 4, 4), "any")], params={"mode": "channel"})
+C("d5_lrn_wide", "LRN", [(D, (1, 6, 4, 4), "any")],
+  params={"nsize": 5, "alpha": 5e-4, "beta": 0.6})
+
+C("d5_SequenceMask_lens", "SequenceMask",
+  [(D, (4, 3, 2), "any"), ("sequence_length", (3,), "int1:4")],
+  params={"use_sequence_length": True, "value": 0.3},
+  fixed=("sequence_length",))
+C("d5_SequenceLast_lens", "SequenceLast",
+  [(D, (4, 3, 2), "any"), ("sequence_length", (3,), "int1:4")],
+  params={"use_sequence_length": True}, fixed=("sequence_length",))
+C("d5_SequenceReverse_lens", "SequenceReverse",
+  [(D, (4, 3, 2), "any"), ("sequence_length", (3,), "int1:4")],
+  params={"use_sequence_length": True}, fixed=("sequence_length",))
+
+C("d5_gemm2_tt", "linalg_gemm2",
+  [("A", (4, 3), "any"), ("B", (5, 4), "any")],
+  params={"transpose_a": True, "transpose_b": True, "alpha": 1.2})
+C("d5_trsm_right", "linalg_trsm",
+  [("A", (3, 3), "tril"), ("B", (4, 3), "any")],
+  params={"rightside": True}, rtol=2e-2)
+C("d5_trsm_transpose", "linalg_trsm",
+  [("A", (3, 3), "tril"), ("B", (3, 4), "any")],
+  params={"transpose": True}, rtol=2e-2)
+C("d5_syrk_trans", "linalg_syrk", [("A", (3, 4), "any")],
+  params={"transpose": True, "alpha": 0.9})
+C("d5_gemm_batched_t", "linalg_gemm",
+  [("A", (2, 4, 3), "any"), ("B", (2, 4, 5), "any"),
+   ("C", (2, 3, 5), "any")],
+  params={"transpose_a": True, "alpha": 0.9, "beta": 1.1})
+
+C("d5_pick_ax0", "pick",
+  [(D, (4, 5), "any"), ("index", (5,), "int:4")],
+  params={"axis": 0}, fixed=("index",))
+C("d5_pick_keepdims", "pick",
+  [(D, (4, 5), "any"), ("index", (4,), "int:5")],
+  params={"axis": -1, "keepdims": True}, fixed=("index",))
+C("d5_stack_ax0", "stack",
+  [("a0", (3, 4), "any"), ("a1", (3, 4), "any"), ("a2", (3, 4), "any")],
+  params={"axis": 0, "num_args": 3})
+C("d5_stack_last", "stack",
+  [("a0", (3, 4), "any"), ("a1", (3, 4), "any")],
+  params={"axis": 2, "num_args": 2})
+C("d5_concat_3args", "Concat",
+  [("a0", (2, 3, 1), "any"), ("a1", (2, 3, 2), "any"),
+   ("a2", (2, 3, 3), "any")], params={"dim": 2, "num_args": 3})
+C("d5_elemwise_sum5", "ElementWiseSum",
+  [("arg%d" % i, (2, 3), "any") for i in range(5)],
+  params={"num_args": 5})
+C("d5_slicechannel_squeeze", "SliceChannel", [(D, (3, 2, 4), "any")],
+  params={"num_outputs": 3, "axis": 0, "squeeze_axis": True})
+C("d5_slice_step", "slice", [(D, (6, 7), "any")],
+  params={"begin": (0, 1), "end": (5, 7), "step": (2, 3)})
+C("d5_slice_neg_end", "slice", [(D, (5, 6), "any")],
+  params={"begin": (1, 0), "end": (-1, -2)})
+C("d5_pad_edge", "Pad", [(D, (1, 2, 4, 4), "any")],
+  params={"mode": "edge", "pad_width": (0, 0, 0, 0, 2, 2, 1, 1)})
+C("d5_pad_reflect", "Pad", [(D, (1, 2, 5, 5), "any")],
+  params={"mode": "reflect", "pad_width": (0, 0, 0, 0, 1, 2, 2, 1)})
+C("d5_pad_const_val", "Pad", [(D, (1, 1, 3, 3), "any")],
+  params={"mode": "constant", "constant_value": 1.5,
+          "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)})
+C("d5_upsampling_s3", "UpSampling", [(D, (1, 2, 3, 3), "any")],
+  params={"scale": 3, "sample_type": "nearest", "num_args": 1})
+C("d5_swapaxis_12", "SwapAxis", [(D, (2, 3, 4), "any")],
+  params={"dim1": 1, "dim2": 2})
+C("d5_instnorm_b1", "InstanceNorm",
+  [(D, (1, 2, 5), "any"), ("gamma", (2,), "pos"), ("beta", (2,), "any")],
+  rtol=2e-2)
+C("d5_layer_norm_eps", "LayerNorm",
+  [(D, (2, 5), "any"), ("gamma", (5,), "pos"), ("beta", (5,), "any")],
+  params={"eps": 1e-2}, rtol=2e-2)
+C("d5_bn_fixgamma", "BatchNorm",
+  [(D, (4, 3, 2, 2), "any"), ("gamma", (3,), "pos"),
+   ("beta", (3,), "any")],
+  params={"fix_gamma": True}, rtol=5e-2, atol=5e-4, ignore=("gamma",),
+  aux={"moving_mean": ((3,), "unit"), "moving_var": ((3,), "pos")})
+C("d5_embedding_wide", "Embedding",
+  [(D, (3, 5), "int:11"), ("weight", (11, 7), "any")],
+  params={"input_dim": 11, "output_dim": 7}, fixed=(D,))
+C("d5_take_2d_indices", "take",
+  [("a", (6, 3), "any"), ("indices", (2, 4), "int:6")],
+  fixed=("indices",))
+C("d5_gather_nd_rows", "gather_nd",
+  [(D, (4, 3), "any"), ("indices", (1, 5), "int:4")],
+  fixed=("indices",))
+C("d5_scatter_nd_dup", "scatter_nd",
+  [(D, (6,), "any"), ("indices", (1, 6), "int:3")],
+  params={"shape": (4,)}, fixed=("indices",))  # dup indices accumulate
+C("d5_batch_dot_ta", "batch_dot",
+  [("lhs", (2, 4, 3), "any"), ("rhs", (2, 4, 5), "any")],
+  params={"transpose_a": True})
+C("d5_batch_dot_tb", "batch_dot",
+  [("lhs", (2, 3, 4), "any"), ("rhs", (2, 5, 4), "any")],
+  params={"transpose_b": True})
+C("d5_dot_tb", "dot",
+  [("lhs", (3, 4), "any"), ("rhs", (5, 4), "any")],
+  params={"transpose_b": True})
+C("d5_dot_vecmat", "dot", [("lhs", (4,), "any"), ("rhs", (4, 5), "any")])
+C("d5_smooth_l1_s2", "smooth_l1", [(D, (3, 4), "any")],
+  params={"scalar": 2.0})
+C("d5_square_sum_kd", "_square_sum", [(D, (3, 4), "any")],
+  params={"axis": 0, "keepdims": True})
+C("d5_transpose_default", "transpose", [(D, (2, 3, 4), "any")])
+C("d5_tile_short_reps", "tile", [(D, (2, 3), "any")],
+  params={"reps": (2,)})
+C("d5_repeat_ax0", "repeat", [(D, (3, 2), "any")],
+  params={"repeats": 2, "axis": 0})
+C("d5_expand_ax0", "expand_dims", [(D, (3, 4), "any")],
+  params={"axis": 0})
+C("d5_flatten_deep", "Flatten", [(D, (2, 3, 4, 5), "any")])
+C("d5_reshape_m4", "Reshape", [(D, (6, 4), "any")],
+  params={"shape": (-4, 2, 3, -2)})
+C("d5_sort_descend", "sort", [(D, (3, 5), "any")],
+  params={"axis": 1, "is_ascend": False})
+C("d5_norm_vec", "norm", [(D, (7,), "any")])
+C("d5_where_deep", "where",
+  [("condition", (2, 3, 4), "cell"), ("x", (2, 3, 4), "any"),
+   ("y", (2, 3, 4), "any")], fixed=("condition",))
+C("d5_maximum_equal_kink", "_maximum",
+  [("lhs", (3, 4), "pos"), ("rhs", (3, 4), "gt1")])
+C("d5_mean_all", "mean", [(D, (2, 3, 4), "any")])
+C("d5_crop_offset0", "Crop", [(D, (1, 2, 5, 5), "any")],
+  params={"h_w": (3, 3)})
+C("d5_fc_wide", "FullyConnected",
+  [(D, (2, 3), "any"), ("weight", (17, 3), "any"), ("bias", (17,), "any")],
+  params={"num_hidden": 17})
+C("d5_grid_gen_warp", "GridGenerator",
+  [(D, (1, 2, 4, 4), "unit")],
+  params={"transform_type": "warp", "target_shape": (4, 4)})
+
 #: registry OpDefs with no finite-difference case, and why.  The
 #: completeness guard below fails when a newly-registered op appears in
 #: neither CASES nor this table.
@@ -766,6 +974,19 @@ ADD_REQ_IDS = [
     "nn_act_relu", "nn_leaky", "nn_softmax", "nn_log_softmax",
     "nn_L2Norm", "nn_LRN", "seq_SequenceReverse", "la_gemm2",
     "sp_BilinearSampler", "odd_conv_1x1", "odd_broadcast_both_sides",
+    # round-5 growth: deeper/odd variants through the accumulation path
+    "d5_deep_broadcast_mul", "d5_deep_broadcast_div",
+    "d5_conv_k5_pad2", "d5_conv_stride3", "d5_conv1d_stride_dilate",
+    "d5_deconv_pad", "d5_pool_max_overlap", "d5_pool_avg_k1",
+    "d5_pool_sum_overlap", "d5_softmax_ax0", "d5_softmax_temp",
+    "d5_gemm2_tt", "d5_trsm_right", "d5_syrk_trans",
+    "d5_pick_ax0", "d5_stack_ax0", "d5_concat_3args",
+    "d5_elemwise_sum5", "d5_slice_step", "d5_pad_edge",
+    "d5_pad_reflect", "d5_swapaxis_12", "d5_take_2d_indices",
+    "d5_scatter_nd_dup", "d5_batch_dot_ta", "d5_dot_tb",
+    "d5_dot_vecmat", "d5_transpose_default", "d5_flatten_deep",
+    "d5_reshape_m4", "d5_sum_ax02_kd1", "d5_mean_axm1_kd0",
+    "d5_max_ax0_kd0", "d5_prod_axm1_kd1", "d5_fc_wide",
 ]
 
 
@@ -790,6 +1011,12 @@ DTYPE_IDS = [
     "nn_softmax", "nn_log_softmax", "bin_dot", "nn_fc", "nn_conv2d",
     "nn_pool_avg", "red_sum", "red_norm", "bc_broadcast_mul",
     "la_gemm2", "shape_clip",
+    # round-5 growth
+    "d5_softmax_temp", "d5_log_softmax_deep", "d5_conv_k5_pad2",
+    "d5_deconv_pad", "d5_pool_sum_overlap", "d5_deep_broadcast_power",
+    "d5_gemm_batched_t", "d5_trsm_transpose", "d5_batch_dot_tb",
+    "d5_sum_ax02_kd1", "d5_l2norm_channel", "d5_layer_norm_eps",
+    "d5_smooth_l1_s2", "d5_where_deep", "d5_norm_vec",
 ]
 
 
@@ -812,7 +1039,11 @@ def test_dtype_consistency(cid):
 #: half-precision forward sanity: bf16/f16 track f32 within half-precision
 #: tolerance (the bench trains bf16; ops must not silently upcast-crash)
 HALF_IDS = ["unary_tanh", "nn_softmax", "bin_dot", "nn_fc", "nn_conv2d",
-            "red_sum", "bc_broadcast_mul"]
+            "red_sum", "bc_broadcast_mul",
+            # round-5 growth: bf16/f16 forward across more families
+            "nn_log_softmax", "nn_pool_avg", "nn_deconv2d", "la_gemm2",
+            "d5_deep_broadcast_mul", "d5_sum_ax02_kd1", "layer_norm",
+            "d5_batch_dot_tb", "shape_transpose"]
 
 
 @pytest.mark.parametrize("cid", HALF_IDS)

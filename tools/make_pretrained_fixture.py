@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Generate the committed pretrained-fixture artifacts.
+
+The reference pins inference numerics with downloaded pretrained models
+plus expected outputs (tests/python/gpu/test_forward.py +
+gluon/model_zoo/model_store.py).  This repo is egress-free, so the
+equivalent is generated ONCE by this script and committed:
+
+    tests/fixtures/squeezenet_tiny.params  + squeezenet_tiny_logits.npy
+    tests/fixtures/gpt2_tiny.params        + gpt2_tiny_logits.npy
+
+tests/test_pretrained_fixture.py rebuilds the deterministic input from
+the same seeds, loads the checkpoint through the standard V2 path, and
+asserts the logits — so ANY change to an op lowering, layer math, or
+the serialization format that silently shifts inference shows up as a
+cross-round regression.  Regenerate (and re-commit, with a note in the
+commit message) only when an INTENTIONAL numerics change lands.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+FIXDIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "fixtures")
+
+
+def fixture_inputs():
+    """The deterministic inputs the regression test replays (kept in
+    one place so generator and test cannot drift)."""
+    import numpy as np
+    rng = np.random.RandomState(1234)
+    img = rng.randn(4, 3, 64, 64).astype(np.float32)
+    toks = rng.randint(0, 256, (2, 32)).astype(np.int32)
+    return img, toks
+
+
+def _train_squeezenet():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.squeezenet1_1(classes=10)
+    net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2))
+    rng = np.random.RandomState(7)
+    x = mx.nd.array(rng.randn(16, 3, 64, 64).astype(np.float32))
+    y = mx.nd.array((rng.rand(16) * 10).astype(np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    for i in range(5):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(16)
+    return net
+
+
+def _train_gpt():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    net = gpt.gpt2_tiny()
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(8)
+    toks = mx.nd.array(rng.randint(0, 256, (4, 32)), dtype="int32")
+    tgts = mx.nd.array(rng.randint(0, 256, (4, 32)), dtype="int32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1,
+                                                 sparse_label=True)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    for i in range(5):
+        with autograd.record():
+            loss = loss_fn(net(toks), tgts).mean()
+        loss.backward()
+        trainer.step(4)
+    return net
+
+
+def main():
+    import numpy as np
+    import jax
+    jax.config.update("jax_default_matmul_precision", "float32")
+    import mxnet_tpu as mx
+
+    os.makedirs(FIXDIR, exist_ok=True)
+    img, toks = fixture_inputs()
+
+    net = _train_squeezenet()
+    net.save_params(os.path.join(FIXDIR, "squeezenet_tiny.params"))
+    logits = net(mx.nd.array(img)).asnumpy()
+    np.save(os.path.join(FIXDIR, "squeezenet_tiny_logits.npy"), logits)
+    print("squeezenet_tiny: logits", logits.shape,
+          "mean %.6f" % logits.mean())
+
+    net = _train_gpt()
+    net.save_params(os.path.join(FIXDIR, "gpt2_tiny.params"))
+    logits = net(mx.nd.array(toks, dtype="int32")).asnumpy()
+    np.save(os.path.join(FIXDIR, "gpt2_tiny_logits.npy"), logits)
+    print("gpt2_tiny: logits", logits.shape, "mean %.6f" % logits.mean())
+
+
+if __name__ == "__main__":
+    main()
