@@ -379,6 +379,29 @@ def main():
                         else "", "tok/s"),
     }.get(mode, (_network_metric(network), "img/s"))
     _install_init_watchdog(metric, unit)
+    try:
+        _run_mode(mode, network)
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the driver needs a row
+        # a mid-run failure (tunnel RPC death, compile error) must still
+        # produce the one parseable JSON line the driver records; the
+        # round-5 headline run died with a raw traceback and the round's
+        # BENCH artifact was garbage (PERF.md §7b)
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": metric,
+            "value": 0.0,
+            "unit": "%s (measurement unavailable)" % unit,
+            "vs_baseline": 0.0,
+            "error": "benchmark crashed mid-run: %s: %s"
+                     % (type(e).__name__, str(e)[:300]),
+        }), flush=True)
+        sys.exit(4)
+
+
+def _run_mode(mode, network):
     if mode == "attention":
         bench_attention()
         return

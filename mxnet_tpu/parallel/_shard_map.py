@@ -13,11 +13,23 @@ except AttributeError:  # pragma: no cover - older jax
 _PARAMS = set(inspect.signature(_shard_map).parameters)
 
 
-def shard_map(fn, mesh, in_specs, out_specs, check_rep=False):
+def shard_map(fn, mesh, in_specs, out_specs, check_rep=False,
+              axis_names=None):
+    """``axis_names`` (iterable of mesh axis names) selects PARTIAL
+    manual mode: listed axes are manual (specs may reference them),
+    unlisted axes stay auto — GSPMD keeps propagating their shardings
+    inside the body (used by the pipeline to run pp manually while tp
+    rides XLA's Megatron propagation)."""
     kw = {}
     if "check_vma" in _PARAMS:
         kw["check_vma"] = check_rep
     elif "check_rep" in _PARAMS:
         kw["check_rep"] = check_rep
+    if axis_names is not None:
+        if "axis_names" not in _PARAMS:  # pragma: no cover - older jax
+            raise NotImplementedError(
+                "this jax version's shard_map has no axis_names "
+                "(partial-auto) support")
+        kw["axis_names"] = frozenset(axis_names)
     return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       **kw)

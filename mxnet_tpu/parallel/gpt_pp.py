@@ -29,7 +29,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["make_gpt_stages", "tie_wte_grad", "grads_by_name"]
+__all__ = ["make_gpt_stages", "gpt_stage_tp_specs", "tie_wte_grad",
+           "grads_by_name"]
 
 
 def _strip_block_idx(name):
@@ -139,6 +140,44 @@ def make_gpt_stages(net, n_stages, micro_batch, seq_len,
     names = {"blocks": blk_names, "lnf": list(lnf_fn.param_names),
              "prefix": net.prefix, "lps": lps, "n_stages": n_stages}
     return stage_params, stage_fns, wire, names
+
+
+class _NdimOnly:
+    """Rule matching needs only .ndim (PartitionRule.matches)."""
+
+    def __init__(self, n):
+        self.ndim = n
+
+
+def gpt_stage_tp_specs(stage_params, names, tp_axis="tp"):
+    """Inner PartitionSpecs (dims after the stage dim) composing
+    Megatron tensor parallelism with the pipeline stages, derived from
+    THE dp×tp recipe's rule table (``gpt_spmd.GPT_TP_RULES`` — one
+    source of truth): qkv/fc1 column-split and out/fc2 row-split over
+    ``tp_axis`` inside each block chunk; embeddings, layernorms and the
+    tied head stay replicated beyond pp.  Feed to
+    ``pipeline_apply_1f1b_het(param_inner_specs=...)``.
+    """
+    from . import gpt_spmd as _gs
+    from .mesh import AXIS_TP
+
+    def rep(leaf):
+        return (None,) * (leaf.ndim - 1)
+
+    rel0 = [_strip_block_idx(n) for n in names["blocks"][0]]
+    blocks = []
+    for p, leaf in enumerate(stage_params["blocks"]):
+        # leaf dims: [S, lps, *param]; inner covers [lps, *param]
+        pnd = leaf.ndim - 2
+        spec = tuple(_gs.gpt_param_spec(rel0[p], _NdimOnly(pnd)))
+        spec = tuple(tp_axis if e == AXIS_TP else e for e in spec)
+        blocks.append((None,) + spec + (None,) * (pnd - len(spec)))
+    return {
+        "embed": {k: rep(v) for k, v in stage_params["embed"].items()},
+        "blocks": blocks,
+        "head": {"lnf": [rep(v) for v in stage_params["head"]["lnf"]],
+                 "wte": rep(stage_params["head"]["wte"])},
+    }
 
 
 def tie_wte_grad(grads):

@@ -317,7 +317,8 @@ def _pipeline_1f1b_het_local(stage_params, microbatches, targets,
 
 def pipeline_apply_1f1b_het(stage_params, microbatches, targets,
                             stage_fns, loss_fn, wire, mesh=None,
-                            axis=AXIS_PP, batch_axis=None):
+                            axis=AXIS_PP, batch_axis=None,
+                            param_inner_specs=None):
     """Heterogeneous-stage 1F1B over a mesh: (summed loss, union grads).
 
     See :func:`_pipeline_1f1b_het_local` for the stage contract.  With
@@ -325,6 +326,15 @@ def pipeline_apply_1f1b_het(stage_params, microbatches, targets,
     stage dim over ``axis`` and microbatches/targets on dim 1 over
     ``batch_axis`` (pass ``wire`` at the LOCAL per-shard microbatch
     shape in that case); grads come back sharded like ``stage_params``.
+
+    ``param_inner_specs`` (pytree matching ``stage_params``; each leaf
+    a tuple of PartitionSpec entries for the dims AFTER the stage dim)
+    composes TENSOR parallelism with the pipeline: params are placed
+    ``P(axis, *inner)``, the shard_map goes partial-manual (``axis``/
+    ``batch_axis`` manual, everything else auto), and XLA GSPMD
+    propagates the inner shardings through each stage's compute —
+    Megatron-style tp inside pp stages with no communication code in
+    the stage functions.
     """
     if mesh is None:
         return _pipeline_1f1b_het_local(stage_params, microbatches,
@@ -335,7 +345,8 @@ def pipeline_apply_1f1b_het(stage_params, microbatches, targets,
         return _pipeline_1f1b_het_local(local, mb, tg, stage_fns,
                                         loss_fn, wire, axis)
     return _shardmap_1f1b(local_call, stage_params, microbatches,
-                          targets, mesh, axis, batch_axis)
+                          targets, mesh, axis, batch_axis,
+                          param_inner_specs=param_inner_specs)
 
 
 def stage_param_shardings(stage_params, mesh, axis=AXIS_PP):
@@ -352,20 +363,31 @@ def stage_param_shardings(stage_params, mesh, axis=AXIS_PP):
 
 
 def _shardmap_1f1b(local_call, stage_params, microbatches, targets,
-                   mesh, axis, batch_axis):
+                   mesh, axis, batch_axis, param_inner_specs=None):
     """Shared mesh wrapper for the 1F1B variants: shard union params on
     their leading stage dim over ``axis``, place inputs (union params
     commonly arrive committed to the default device by functionalize),
     strip the stage dim inside shard_map, and psum loss/grads over an
-    optional batch axis."""
+    optional batch axis.  With ``param_inner_specs`` the shard_map is
+    partial-manual (only ``axis``/``batch_axis`` manual) so the inner
+    tensor shardings ride GSPMD through the stage bodies."""
     tmap = jax.tree_util.tree_map
     from jax.sharding import NamedSharding
     param_specs = tmap(
         lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params)
     data_spec = (P(None, batch_axis) if batch_axis else P())
+    axis_names = None
+    place_specs = param_specs
+    if param_inner_specs is not None:
+        # inner-spec leaves are TUPLES of spec entries for the dims
+        # after the stage dim (flatten_up_to stops at stage_params's
+        # leaf positions, so the tuples arrive whole)
+        place_specs = tmap(lambda p, inner: P(axis, *inner),
+                           stage_params, param_inner_specs)
+        axis_names = {axis} | ({batch_axis} if batch_axis else set())
     stage_params = tmap(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
-        stage_params, param_specs)
+        stage_params, place_specs)
     microbatches = jax.device_put(microbatches,
                                   NamedSharding(mesh, data_spec))
     targets = jax.device_put(targets, NamedSharding(mesh, data_spec))
@@ -380,11 +402,19 @@ def _shardmap_1f1b(local_call, stage_params, microbatches, targets,
             grads = tmap(lambda g: lax.psum(g, batch_axis), grads)
         grads = tmap(lambda g: g[None], grads)
         return loss, grads
-    return shard_map(
+    mapped = shard_map(
         fn, mesh=mesh,
         in_specs=(param_specs, data_spec, data_spec),
         out_specs=(P(), param_specs),
-        check_rep=False)(stage_params, microbatches, targets)
+        check_rep=False,
+        axis_names=axis_names)
+    if axis_names is not None:
+        # partial-manual shard_map only composes correctly under jit in
+        # this jax version (the eager dispatch re-enters shard_map with
+        # specs merged over the auto axes and trips the manual-axes
+        # check); jit also lets GSPMD propagate the inner tp shardings
+        mapped = jax.jit(mapped)
+    return mapped(stage_params, microbatches, targets)
 
 
 def pipeline_apply_1f1b(stage_params, microbatches, targets, stage_fn,
