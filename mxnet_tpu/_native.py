@@ -46,6 +46,12 @@ def _declare(lib):
         c.c_int, c.c_uint64, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
         c.c_float, c.c_float, c.c_float, c.POINTER(c.c_float),
         c.POINTER(c.c_float), c.c_int]
+    lib.MXTImageDetIterCreate.restype = c.c_void_p
+    lib.MXTImageDetIterCreate.argtypes = [
+        c.c_char_p, c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_int, c.c_int, c.c_uint64, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_float, c.c_float, c.c_float, c.c_float, c.c_float, c.c_float,
+        c.POINTER(c.c_float), c.POINTER(c.c_float), c.c_int]
     lib.MXTImageIterNext.restype = c.c_int
     lib.MXTImageIterNext.argtypes = [
         c.c_void_p, c.POINTER(c.c_float), c.POINTER(c.c_float)]
@@ -85,6 +91,18 @@ def get_lib():
         if os.path.exists(_LIB_PATH):
             try:
                 _lib = _declare(ctypes.CDLL(_LIB_PATH))
+            except AttributeError:
+                # a STALE prebuilt .so lacking newly-declared symbols
+                # (dlsym miss) — rebuild once rather than killing every
+                # native-IO caller
+                _lib = None
+                try:
+                    subprocess.run(["make", "-C", _SRC_DIR, "-B"],
+                                   check=True, capture_output=True,
+                                   timeout=300)
+                    _lib = _declare(ctypes.CDLL(_LIB_PATH))
+                except Exception:
+                    _lib = None
             except OSError:
                 _lib = None
         return _lib

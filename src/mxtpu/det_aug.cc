@@ -1,0 +1,169 @@
+// Detection augmentation kernels — see det_aug.h.
+#include "det_aug.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+namespace mxtpu {
+
+void CropImage(const Image& src, int x0, int y0, int w, int h, Image* dst) {
+  x0 = std::max(0, std::min(x0, src.w - 1));
+  y0 = std::max(0, std::min(y0, src.h - 1));
+  w = std::max(1, std::min(w, src.w - x0));
+  h = std::max(1, std::min(h, src.h - y0));
+  dst->h = h;
+  dst->w = w;
+  dst->c = src.c;
+  dst->data.resize(static_cast<size_t>(h) * w * src.c);
+  for (int y = 0; y < h; ++y) {
+    const uint8_t* srow =
+        &src.data[(static_cast<size_t>(y0 + y) * src.w + x0) * src.c];
+    std::memcpy(&dst->data[static_cast<size_t>(y) * w * src.c], srow,
+                static_cast<size_t>(w) * src.c);
+  }
+}
+
+namespace {
+
+// Coverage of each object box by the crop window (all normalized).
+// Mirrors DetRandomCropAug._check_satisfy_constraints
+// (mxnet_tpu/image/detection.py): accept iff every TOUCHED object is
+// covered >= min_object_covered; surviving rows (coverage >=
+// min_eject_coverage) are rewritten in crop coordinates.  Returns the
+// number of kept objects written into `kept` (n_obj rows of obj_w), or
+// -1 when the candidate fails.
+int TryCrop(const float* objs, int n_obj, int obj_w, float cx0, float cy0,
+            float cx1, float cy1, float min_covered, float min_eject,
+            std::vector<float>* kept) {
+  float cw = cx1 - cx0, ch = cy1 - cy0;
+  std::vector<float> coverage(static_cast<size_t>(n_obj), 0.f);
+  bool any_valid = false;
+  for (int i = 0; i < n_obj; ++i) {
+    const float* o = objs + static_cast<size_t>(i) * obj_w;
+    if (o[0] <= -1.f) continue;
+    any_valid = true;
+    float ix0 = std::max(cx0, o[1]), iy0 = std::max(cy0, o[2]);
+    float ix1 = std::min(cx1, o[3]), iy1 = std::min(cy1, o[4]);
+    float inter = std::max(0.f, ix1 - ix0) * std::max(0.f, iy1 - iy0);
+    float area = (o[3] - o[1]) * (o[4] - o[2]);
+    float cov = area > 0.f ? inter / std::max(area, 1e-12f) : 0.f;
+    coverage[i] = cov;
+    if (cov > 0.f && cov < min_covered) return -1;
+  }
+  if (any_valid) {
+    bool touched = false;
+    for (int i = 0; i < n_obj; ++i) touched |= coverage[i] > 0.f;
+    if (!touched) return -1;  // crop sees no object at all
+  }
+  kept->assign(static_cast<size_t>(n_obj) * obj_w, -1.f);
+  int nk = 0;
+  for (int i = 0; i < n_obj; ++i) {
+    const float* o = objs + static_cast<size_t>(i) * obj_w;
+    if (o[0] <= -1.f || coverage[i] < min_eject) continue;
+    float* k = kept->data() + static_cast<size_t>(nk) * obj_w;
+    std::memcpy(k, o, sizeof(float) * obj_w);
+    k[1] = (std::max(cx0, o[1]) - cx0) / cw;
+    k[2] = (std::max(cy0, o[2]) - cy0) / ch;
+    k[3] = (std::min(cx1, o[3]) - cx0) / cw;
+    k[4] = (std::min(cy1, o[4]) - cy0) / ch;
+    ++nk;
+  }
+  if (any_valid && nk == 0) return -1;
+  return nk;
+}
+
+}  // namespace
+
+int DetAugmentToFloat(const Image& img_in, int out_c, int out_h, int out_w,
+                      const DetAugmentParams& p, std::mt19937* rng,
+                      float* data_out, float* objs, int n_obj, int obj_w) {
+  Image cropped;
+  const Image* img = &img_in;
+  int n_valid = n_obj;
+
+  // 1. IoU/coverage-constrained random crop (SSD sampler)
+  if (p.max_attempts > 0 && p.max_area >= p.min_area &&
+      p.min_aspect <= p.max_aspect) {
+    std::uniform_real_distribution<float> u_area(p.min_area, p.max_area);
+    std::uniform_real_distribution<float> u_ar(p.min_aspect, p.max_aspect);
+    std::uniform_real_distribution<float> u01(0.f, 1.f);
+    std::vector<float> kept;
+    for (int attempt = 0; attempt < p.max_attempts; ++attempt) {
+      float area = u_area(*rng);
+      float ratio = u_ar(*rng);
+      float cw = std::sqrt(area * ratio);
+      float ch = std::sqrt(area / ratio);
+      if (cw > 1.f || ch > 1.f) continue;
+      float x0 = u01(*rng) * (1.f - cw);
+      float y0 = u01(*rng) * (1.f - ch);
+      int nk = TryCrop(objs, n_obj, obj_w, x0, y0, x0 + cw, y0 + ch,
+                       p.min_object_covered, p.min_eject_coverage, &kept);
+      if (nk < 0) continue;
+      int px0 = static_cast<int>(x0 * img->w);
+      int py0 = static_cast<int>(y0 * img->h);
+      int pw = std::max(1, static_cast<int>(cw * img->w));
+      int ph = std::max(1, static_cast<int>(ch * img->h));
+      CropImage(*img, px0, py0, pw, ph, &cropped);
+      img = &cropped;
+      std::memcpy(objs, kept.data(),
+                  sizeof(float) * static_cast<size_t>(n_obj) * obj_w);
+      n_valid = nk;
+      break;
+    }
+  }
+
+  // 2. horizontal flip (image flipped during the output copy below;
+  //    boxes flipped here)
+  bool mirror =
+      p.rand_mirror && std::uniform_int_distribution<int>(0, 1)(*rng);
+  if (mirror) {
+    for (int i = 0; i < n_valid; ++i) {
+      float* o = objs + static_cast<size_t>(i) * obj_w;
+      if (o[0] <= -1.f) continue;
+      float tmp = 1.f - o[1];
+      o[1] = 1.f - o[3];
+      o[3] = tmp;
+    }
+  }
+
+  // 3. force resize to the network input (normalized boxes unchanged)
+  Image resized;
+  if (img->h != out_h || img->w != out_w) {
+    ResizeBilinear(*img, out_h, out_w, &resized);
+    img = &resized;
+  }
+
+  // 4. normalize + layout
+  const int c = img->c;
+  const size_t plane = static_cast<size_t>(out_h) * out_w;
+  for (int y = 0; y < out_h; ++y) {
+    const uint8_t* row = &img->data[static_cast<size_t>(y) * out_w * c];
+    for (int x = 0; x < out_w; ++x) {
+      int sx = mirror ? (out_w - 1 - x) : x;
+      const uint8_t* px = row + static_cast<size_t>(sx) * c;
+      float v[3] = {static_cast<float>(px[0]),
+                    c >= 3 ? static_cast<float>(px[1])
+                           : static_cast<float>(px[0]),
+                    c >= 3 ? static_cast<float>(px[2])
+                           : static_cast<float>(px[0])};
+      if (out_c == 1) {
+        float gray = 0.299f * v[0] + 0.587f * v[1] + 0.114f * v[2];
+        float fv = (gray - p.mean[0]) / p.std[0];
+        data_out[static_cast<size_t>(y) * out_w + x] = fv;
+      } else {
+        for (int ch2 = 0; ch2 < 3; ++ch2) {
+          float fv = (v[ch2] - p.mean[ch2]) / p.std[ch2];
+          size_t idx = p.channels_first
+              ? ch2 * plane + static_cast<size_t>(y) * out_w + x
+              : (static_cast<size_t>(y) * out_w + x) * 3 + ch2;
+          data_out[idx] = fv;
+        }
+      }
+    }
+  }
+  return n_valid;
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,52 @@
+// Detection augmentation for the native data pipeline.
+//
+// Native equivalent of the reference's threaded detection augmenter
+// (src/io/image_det_aug_default.cc driven from iter_image_recordio_2.cc
+// in /root/reference): SSD-style IoU/coverage-constrained random crop,
+// horizontal flip, force-resize — all box-aware, run on the worker
+// threads so detection training's augmentation never holds the GIL.
+// Semantics mirror mxnet_tpu/image/detection.py (DetRandomCropAug /
+// DetHorizontalFlipAug / ForceResizeAug), which the tests use as the
+// oracle.
+#ifndef MXTPU_DET_AUG_H_
+#define MXTPU_DET_AUG_H_
+
+#include <random>
+
+#include "image_aug.h"
+
+namespace mxtpu {
+
+struct DetAugmentParams {
+  bool rand_mirror = false;
+  // IoU/coverage-constrained random crop (0 attempts = off).  A crop
+  // candidate (area in area_range, aspect in aspect_range, uniform
+  // position) is accepted when every object it touches is covered at
+  // least min_object_covered; accepted crops keep objects with
+  // coverage >= min_eject_coverage, re-expressed in crop coordinates.
+  int max_attempts = 0;
+  float min_object_covered = 0.1f;
+  float min_aspect = 0.75f, max_aspect = 1.33f;
+  float min_area = 0.05f, max_area = 1.0f;
+  float min_eject_coverage = 0.3f;
+  float mean[3] = {0.f, 0.f, 0.f};
+  float std[3] = {1.f, 1.f, 1.f};
+  bool channels_first = true;
+};
+
+// Crop a pixel window (clamped to bounds) out of `src`.
+void CropImage(const Image& src, int x0, int y0, int w, int h, Image* dst);
+
+// Detection augment chain over one decoded image + its object list.
+// `objs`: n_obj rows of obj_w floats, [cls, xmin, ymin, xmax, ymax, ...]
+// with normalized corners; transformed IN PLACE (crop/flip coordinate
+// updates).  Writes the force-resized, normalized float image into
+// `data_out` (out_c*out_h*out_w floats, CHW when channels_first).
+// Returns the number of surviving objects (<= n_obj; crop may eject).
+int DetAugmentToFloat(const Image& img, int out_c, int out_h, int out_w,
+                      const DetAugmentParams& p, std::mt19937* rng,
+                      float* data_out, float* objs, int n_obj, int obj_w);
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_DET_AUG_H_

@@ -21,6 +21,7 @@
 #include <thread>
 #include <vector>
 
+#include "det_aug.h"
 #include "image_aug.h"
 #include "recordio.h"
 
@@ -52,11 +53,23 @@ class ImageRecordIter {
   ImageRecordIter(const std::string& rec_path, const std::string& idx_path,
                   int batch_size, int channels, int height, int width,
                   int label_width, bool shuffle, uint64_t seed, int nthreads,
-                  const AugmentParams& aug, int prefetch)
+                  const AugmentParams& aug, int prefetch,
+                  const DetAugmentParams* det = nullptr, int max_objs = 0,
+                  int obj_w = 0)
       : rec_path_(rec_path), batch_size_(batch_size), c_(channels),
-        h_(height), w_(width), label_width_(label_width), shuffle_(shuffle),
-        aug_(aug), nthreads_(std::max(1, nthreads)),
+        h_(height), w_(width),
+        label_width_(det ? max_objs * obj_w : label_width),
+        shuffle_(shuffle), aug_(aug), nthreads_(std::max(1, nthreads)),
         prefetch_(std::max(2, prefetch)), rng_(seed), epoch_seed_(seed) {
+    if (det) {
+      det_mode_ = true;
+      det_aug_ = *det;
+      max_objs_ = max_objs;
+      obj_w_ = obj_w;
+      if (max_objs_ < 1 || obj_w_ < 5)
+        throw std::runtime_error(
+            "det pipeline: need max_objs >= 1 and obj_width >= 5");
+    }
     if (channels != 1 && channels != 3)
       throw std::runtime_error(
           "image pipeline: data_shape channels must be 1 or 3");
@@ -232,6 +245,10 @@ class ImageRecordIter {
 
   void ParseOne(const std::string& rec, std::mt19937* rng, float* data_out,
                 float* label_out) {
+    if (det_mode_) {
+      ParseOneDet(rec, rng, data_out, label_out);
+      return;
+    }
     if (rec.size() < sizeof(IRHeader)) return;
     IRHeader hdr;
     std::memcpy(&hdr, rec.data(), sizeof(hdr));
@@ -256,10 +273,58 @@ class ImageRecordIter {
     AugmentToFloat(decoded, c_, h_, w_, aug_, rng, data_out);
   }
 
+  // Detection record: flag = total label floats, laid out
+  // [A(header w) B(obj w) extra... obj0(B floats) obj1 ...]; emits a
+  // (max_objs, obj_w) slab per sample, pad rows -1 (the same padded
+  // tensor ImageDetIter exposes, mxnet_tpu/image/detection.py).
+  void ParseOneDet(const std::string& rec, std::mt19937* rng,
+                   float* data_out, float* label_out) {
+    std::fill(label_out, label_out + label_width_, -1.f);
+    if (rec.size() < sizeof(IRHeader)) return;
+    IRHeader hdr;
+    std::memcpy(&hdr, rec.data(), sizeof(hdr));
+    const uint8_t* img = reinterpret_cast<const uint8_t*>(rec.data()) +
+                         sizeof(IRHeader);
+    uint64_t img_len = rec.size() - sizeof(IRHeader);
+    uint64_t lab_bytes = static_cast<uint64_t>(hdr.flag) * 4;
+    if (hdr.flag < 2 + 5 || img_len < lab_bytes)
+      throw std::runtime_error(
+          "det pipeline: record lacks a detection label");
+    std::vector<float> lab(hdr.flag);
+    std::memcpy(lab.data(), img, lab_bytes);
+    int a = static_cast<int>(lab[0]);
+    int b = static_cast<int>(lab[1]);
+    int total = static_cast<int>(hdr.flag);
+    if (a < 2 || a > total || b != obj_w_ || (total - a) % b != 0)
+      throw std::runtime_error(
+          "det pipeline: corrupt label header (header " +
+          std::to_string(a) + ", obj width " + std::to_string(b) +
+          ", total " + std::to_string(total) + ", expected obj width " +
+          std::to_string(obj_w_) + ")");
+    int n = std::max(0, std::min((total - a) / b, max_objs_));
+    std::vector<float> objs(static_cast<size_t>(max_objs_) * obj_w_, -1.f);
+    std::memcpy(objs.data(), lab.data() + a,
+                sizeof(float) * static_cast<size_t>(n) * obj_w_);
+    img += lab_bytes;
+    img_len -= lab_bytes;
+    Image decoded;
+    if (!DecodeJPEG(img, img_len, &decoded)) {
+      errors_.fetch_add(1);
+      return;  // zero image + all-pad label slot
+    }
+    DetAugmentToFloat(decoded, c_, h_, w_, det_aug_, rng, data_out,
+                      objs.data(), n, obj_w_);
+    std::memcpy(label_out, objs.data(),
+                sizeof(float) * static_cast<size_t>(label_width_));
+  }
+
   const std::string rec_path_;
   const int batch_size_, c_, h_, w_, label_width_;
   const bool shuffle_;
   const AugmentParams aug_;
+  bool det_mode_ = false;
+  DetAugmentParams det_aug_;
+  int max_objs_ = 0, obj_w_ = 0;
   const int nthreads_, prefetch_;
   std::mt19937_64 rng_;
   uint64_t epoch_seed_;
@@ -392,6 +457,42 @@ void* MXTImageIterCreate(const char* rec_path, const char* idx_path,
                                     batch_size, channels, height, width,
                                     label_width, shuffle != 0, seed, nthreads,
                                     aug, prefetch);
+  MXT_GUARD_END
+}
+
+// Detection variant: same handle type — Next/Reset/Free/NumSamples/
+// NumErrors above all apply.  Labels come back as a per-sample
+// (max_objs, obj_w) slab, pad rows -1.
+void* MXTImageDetIterCreate(const char* rec_path, const char* idx_path,
+                            int batch_size, int channels, int height,
+                            int width, int max_objs, int obj_w, int shuffle,
+                            uint64_t seed, int nthreads, int prefetch,
+                            int rand_mirror, int max_attempts,
+                            float min_object_covered, float min_aspect,
+                            float max_aspect, float min_area, float max_area,
+                            float min_eject_coverage, const float* mean,
+                            const float* std_, int channels_first) {
+  MXT_GUARD_BEGIN
+  mxtpu::DetAugmentParams det;
+  det.rand_mirror = rand_mirror != 0;
+  det.max_attempts = max_attempts;
+  det.min_object_covered = min_object_covered;
+  det.min_aspect = min_aspect;
+  det.max_aspect = max_aspect;
+  det.min_area = min_area;
+  det.max_area = max_area;
+  det.min_eject_coverage = min_eject_coverage;
+  det.channels_first = channels_first != 0;
+  for (int i = 0; i < 3; ++i) {
+    if (mean) det.mean[i] = mean[i];
+    if (std_) det.std[i] = std_[i];
+  }
+  mxtpu::AugmentParams unused;
+  return new mxtpu::ImageRecordIter(rec_path, idx_path ? idx_path : "",
+                                    batch_size, channels, height, width,
+                                    /*label_width=*/0, shuffle != 0, seed,
+                                    nthreads, unused, prefetch, &det,
+                                    max_objs, obj_w);
   MXT_GUARD_END
 }
 

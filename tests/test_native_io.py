@@ -151,6 +151,158 @@ def test_image_record_iter_native(tmp_path):
     assert len(list(it)) == 3
 
 
+def _write_det_images(tmp_path, n=11, size=(32, 32), max_boxes=4):
+    """Det records: flat labels [2, 5, obj0(cls,x1,y1,x2,y2), ...]."""
+    from PIL import Image
+    rec_path = str(tmp_path / "det.rec")
+    idx_path = str(tmp_path / "det.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    all_labels = []
+    for i in range(n):
+        arr = rng.randint(0, 255, size=(size[0], size[1], 3),
+                          dtype=np.uint8)
+        nb = rng.randint(1, max_boxes + 1)
+        objs = []
+        for _ in range(nb):
+            x1, y1 = rng.uniform(0, 0.6, 2)
+            w, h = rng.uniform(0.2, 0.39, 2)
+            objs.append([float(rng.randint(0, 10)),
+                         x1, y1, x1 + w, y1 + h])
+        flat = np.asarray([2.0, 5.0] + [v for o in objs for v in o],
+                          np.float32)
+        all_labels.append(np.asarray(objs, np.float32))
+        img = Image.fromarray(arr)
+        buf = pyio.BytesIO()
+        img.save(buf, format="JPEG", quality=95)
+        payload = recordio.pack(
+            recordio.IRHeader(len(flat), flat, i, 0), buf.getvalue())
+        writer.write_idx(i, payload)
+    writer.close()
+    return rec_path, idx_path, all_labels
+
+
+@requires_native
+def test_image_det_record_iter_resize_only(tmp_path):
+    """No-aug det pipeline: normalized boxes ride through the force
+    resize untouched; labels come back (B, max_obj, 5) with -1 pads."""
+    rec, idx, labels = _write_det_images(tmp_path, n=11)
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, shuffle=False, round_batch=False)
+    assert it.provide_label[0].shape == (4, it.max_objects, 5)
+    assert it.max_objects == max(l.shape[0] for l in labels)
+    seen = []
+    for batch in it:
+        data = batch.data[0].asnumpy()
+        lab = batch.label[0].asnumpy()
+        assert data.shape == (4, 3, 24, 24)
+        assert np.isfinite(data).all()
+        for row in lab:
+            valid = row[row[:, 0] > -1]
+            if valid.size:
+                seen.append(valid)
+    got = np.concatenate(seen)
+    want = np.concatenate(labels[:8])  # 2 full batches of 4 (no round)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@requires_native
+def test_image_det_record_iter_mirror_deterministic(tmp_path):
+    rec, idx, labels = _write_det_images(tmp_path, n=8)
+    kw = dict(path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+              batch_size=8, shuffle=False, rand_mirror=True, seed=9,
+              preprocess_threads=1)
+    a = next(iter(mx.io.ImageDetRecordIter(**kw))).label[0].asnumpy()
+    b = next(iter(mx.io.ImageDetRecordIter(**kw))).label[0].asnumpy()
+    np.testing.assert_array_equal(a, b)   # seeded: reproducible
+    flipped = 0
+    for i, row in enumerate(a):
+        valid = row[row[:, 0] > -1]
+        orig = labels[i]
+        assert valid.shape[0] == orig.shape[0]
+        # mirror preserves class, y coords and box widths
+        np.testing.assert_allclose(valid[:, 0], orig[:, 0])
+        np.testing.assert_allclose(valid[:, 2], orig[:, 2], atol=1e-6)
+        np.testing.assert_allclose(valid[:, 4], orig[:, 4], atol=1e-6)
+        np.testing.assert_allclose(valid[:, 3] - valid[:, 1],
+                                   orig[:, 3] - orig[:, 1], atol=1e-6)
+        if not np.allclose(valid[:, 1], orig[:, 1], atol=1e-6):
+            # flipped row: x1' = 1 - x2
+            np.testing.assert_allclose(valid[:, 1], 1.0 - orig[:, 3],
+                                       atol=1e-6)
+            flipped += 1
+    assert flipped > 0                    # the coin actually flips
+
+
+@requires_native
+def test_image_det_record_iter_random_crop_invariants(tmp_path):
+    rec, idx, labels = _write_det_images(tmp_path, n=11)
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, shuffle=False, rand_crop=1, max_attempts=25,
+        area_range=(0.3, 0.9), min_object_covered=0.1,
+        min_eject_coverage=0.2, seed=3, round_batch=False)
+    n_orig = sum(l.shape[0] for l in labels[:8])
+    n_seen = 0
+    for batch in it:
+        lab = batch.label[0].asnumpy()
+        for row in lab:
+            valid = row[row[:, 0] > -1]
+            n_seen += valid.shape[0]
+            if valid.size == 0:
+                continue
+            # every surviving box is a valid normalized box in the crop
+            assert (valid[:, 1:] >= -1e-6).all()
+            assert (valid[:, 1:] <= 1 + 1e-6).all()
+            assert (valid[:, 3] >= valid[:, 1] - 1e-6).all()
+            assert (valid[:, 4] >= valid[:, 2] - 1e-6).all()
+    assert 0 < n_seen <= n_orig
+
+
+@requires_native
+def test_image_det_record_iter_matches_python_labels(tmp_path):
+    """Native det labels agree with the Python ImageDetIter oracle on
+    the no-aug path (same records, force-resize only)."""
+    rec, idx, _ = _write_det_images(tmp_path, n=8)
+    nat = mx.io.ImageDetRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=8, shuffle=False)
+    pyit = mx.image.ImageDetIter(
+        batch_size=8, data_shape=(3, 24, 24), path_imgrec=rec,
+        path_imgidx=idx, shuffle=False)
+    nb = next(iter(nat)).label[0].asnumpy()
+    pb = next(iter(pyit)).label[0].asnumpy()
+    assert nb.shape[2] == pb.shape[2] == 5
+    for i in range(8):
+        nv = nb[i][nb[i][:, 0] > -1]
+        pv = pb[i][pb[i][:, 0] > -1]
+        np.testing.assert_allclose(nv, pv, rtol=1e-5, atol=1e-5)
+
+
+@requires_native
+def test_image_det_record_iter_corrupt_header_raises(tmp_path):
+    """A label whose header width exceeds the label length must surface
+    as a clean pipeline error, not a worker crash."""
+    from PIL import Image
+    rec_path = str(tmp_path / "bad.rec")
+    idx_path = str(tmp_path / "bad.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    img = Image.fromarray(np.zeros((16, 16, 3), np.uint8))
+    buf = pyio.BytesIO()
+    img.save(buf, format="JPEG")
+    flat = np.asarray([20.0, 5.0, 1, 0.1, 0.1, 0.5, 0.5], np.float32)
+    writer.write_idx(0, recordio.pack(
+        recordio.IRHeader(len(flat), flat, 0, 0), buf.getvalue()))
+    writer.close()
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path,
+        data_shape=(3, 16, 16), batch_size=1, max_objects=2,
+        object_width=5)
+    with pytest.raises(mx.base.MXNetError, match="corrupt label"):
+        next(iter(it))
+
+
 def test_round_batch_pad_cache_refreshed_per_epoch(tmp_path):
     """round_batch wrap rows come from THE CURRENT pass's first batch:
     with shuffle, epoch 2's tail must wrap epoch 2's ordering, not a
