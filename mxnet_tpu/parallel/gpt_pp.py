@@ -41,7 +41,7 @@ def _strip_block_idx(name):
 
 
 def make_gpt_stages(net, n_stages, micro_batch, seq_len,
-                    compute_dtype=None):
+                    compute_dtype=None, remat=False):
     """Cut an initialized GPTLM into ``n_stages`` 1F1B stages.
 
     Returns ``(stage_params, stage_fns, wire, names)``:
@@ -56,6 +56,10 @@ def make_gpt_stages(net, n_stages, micro_batch, seq_len,
       chunk, the last adds final-LN + tied head and returns logits.
     - ``wire`` — the [mb, T, d] boundary ShapeDtypeStruct.
     - ``names`` — metadata for :func:`grads_by_name`.
+
+    ``remat=True`` wraps each block in ``jax.checkpoint`` so the 1F1B
+    backward's stage recompute holds one block's activations at a time
+    (identical math, tested; the long-sequence memory trade).
     """
     from ..gluon.block import functionalize
     cdt = compute_dtype or jnp.float32
@@ -102,10 +106,22 @@ def make_gpt_stages(net, n_stages, micro_batch, seq_len,
                  "wte": _slot(wte, n_stages - 1)},
     }
 
+    def _one_block(ps, h):
+        (h,), _ = blk_fn(ps, h)
+        return h
+
+    if remat:
+        # per-block rematerialisation WITHIN a stage: 1F1B already
+        # recomputes each stage's forward from the stashed input; remat
+        # bounds that recompute's own activation footprint to one block
+        # — O(T·d) instead of O(lps·T·d) per in-flight microbatch, the
+        # long-sequence pipeline memory trade
+        _one_block = jax.checkpoint(_one_block)
+
     def apply_chunk(blocks_local, h):
         for j in range(lps):
             ps = [leaf[j].astype(cdt) for leaf in blocks_local]
-            (h,), _ = blk_fn(ps, h)
+            h = _one_block(ps, h)
         return h
 
     def _embed(local, feed):

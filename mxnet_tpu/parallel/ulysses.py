@@ -18,31 +18,50 @@ from ._shard_map import shard_map
 
 from . import collectives
 from .mesh import AXIS_SP
-from .ring_attention import attention_reference
 
 
-def _ulysses_local(q, k, v, axis, causal, scale):
+def _ulysses_local(q, k, v, axis, causal, scale, seg=None):
     """Inside shard_map: [B, H, T_local, D] → [B, H, T_local, D]."""
     # seq-sharded → head-sharded: split heads (dim 1), gather seq (dim 2)
     qh = collectives.alltoall(q, axis, split_axis=1, concat_axis=2)
     kh = collectives.alltoall(k, axis, split_axis=1, concat_axis=2)
     vh = collectives.alltoall(v, axis, split_axis=1, concat_axis=2)
-    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    # after the all-to-all each device holds the FULL sequence for its
+    # head subset, so packing is the plain global segment mask (ids
+    # all-gathered along T — ints, tiny)
+    from ..ops.pallas.flash_attention import flash_attention_reference
+    seg_full = (None if seg is None
+                else lax.all_gather(seg, axis, axis=1, tiled=True))
+    out = flash_attention_reference(qh, kh, vh, causal=causal,
+                                    scale=scale, segment_ids=seg_full)
     # head-sharded → seq-sharded
     return collectives.alltoall(out, axis, split_axis=2, concat_axis=1)
 
 
 def ulysses_attention(q, k, v, mesh=None, axis=AXIS_SP, causal=False,
-                      scale=None, batch_axis=None):
-    """[B,H,T,D] attention with T sharded over ``axis``; needs H % sp == 0."""
+                      scale=None, batch_axis=None, segment_ids=None):
+    """[B,H,T,D] attention with T sharded over ``axis``; needs H % sp == 0.
+    ``segment_ids`` ([B, T] int32, T sharded like q) composes sequence
+    packing: the head-sharded full-sequence attention applies the global
+    segment mask (ids are all-gathered along T — ints, tiny)."""
     if mesh is None:
-        return _ulysses_local(q, k, v, axis, causal, scale)
+        return _ulysses_local(q, k, v, axis, causal, scale,
+                              seg=segment_ids)
     n = mesh.shape[axis]
     if q.shape[1] % n:
         raise ValueError("Ulysses needs heads (%d) divisible by sp=%d"
                          % (q.shape[1], n))
     spec = P(batch_axis, None, axis, None)
-    fn = functools.partial(_ulysses_local, axis=axis, causal=causal,
-                           scale=scale)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+    if segment_ids is None:
+        fn = functools.partial(_ulysses_local, axis=axis, causal=causal,
+                               scale=scale)
+        return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_rep=False)(q, k, v)
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    seg_spec = P(batch_axis, axis)
+
+    def fn(a, b, c, s):
+        return _ulysses_local(a, b, c, axis, causal, scale, seg=s)
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(spec, spec, spec, seg_spec),
+                     out_specs=spec, check_rep=False)(q, k, v, seg)

@@ -173,6 +173,29 @@ def test_gpt_single_stage_matches_sequential():
     _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
 
 
+def test_gpt_1f1b_remat_identical():
+    """remat=True (per-block checkpoint inside stages) changes memory,
+    not math: loss and grads equal the non-remat pipeline bitwise-ish."""
+    net, vocab, t = _make_net(n_layers=4)
+    mesh = par.make_mesh(devices=jax.devices()[:2], pp=2)
+    n_micro, mb = 4, 2
+    toks, tgts = _data(n_micro, mb, t, vocab, seed=7)
+    out = {}
+    for tag, rm in (("plain", False), ("remat", True)):
+        stage_params, stage_fns, wire, names = \
+            par.gpt_pp.make_gpt_stages(net, 2, mb, t, remat=rm)
+        loss, grads = par.pipeline_apply_1f1b_het(
+            stage_params, toks, tgts, stage_fns, _ce_sum, wire,
+            mesh=mesh)
+        out[tag] = (float(loss), par.gpt_pp.grads_by_name(grads, names))
+    np.testing.assert_allclose(out["plain"][0], out["remat"][0],
+                               rtol=1e-6)
+    for k, g in out["plain"][1].items():
+        np.testing.assert_allclose(np.asarray(g),
+                                   np.asarray(out["remat"][1][k]),
+                                   rtol=1e-5, atol=1e-5, err_msg=k)
+
+
 def test_het_pipeline_rejects_wrong_stage_count():
     net, vocab, t = _make_net(n_layers=4)
     with pytest.raises(ValueError):

@@ -147,6 +147,24 @@ def test_ring_attention_segments_match_reference(causal, impl):
                                rtol=3e-5, atol=3e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_segments_match_reference(causal):
+    """Packing through Ulysses: the head-sharded full-sequence attention
+    applies the all-gathered global segment mask."""
+    from mxnet_tpu.ops.pallas.flash_attention import \
+        flash_attention_reference
+    mesh = par.make_mesh(sp=8)
+    b, h, t, d = 2, 8, 64, 16
+    q, k, v = (_rand(i + 60, b, h, t, d) for i in range(3))
+    segs = _seg_rows(b, t, 11)
+    ref = flash_attention_reference(q, k, v, causal=causal,
+                                    segment_ids=segs)
+    out = par.ulysses_attention(q, k, v, mesh=mesh, causal=causal,
+                                segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)
+
+
 @pytest.mark.parametrize("impl", ["xla"])
 def test_ring_attention_segments_grad(impl):
     from mxnet_tpu.ops.pallas.flash_attention import \

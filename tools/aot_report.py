@@ -47,10 +47,12 @@ MEASURED = {
     # bench.py note) — 2235 img/s on analytic 24.5 GFLOP/img is 27.8%
     "resnet50_bs128": ("2235 img/s, ~25.5% XLA-basis MFU (PERF.md r3)",
                        (0.20, 0.278, 0.32)),
-    "flash_attention_fwd_bwd": ("fwd 36-40 TFLOP/s (~19% fwd+bwd, "
-                                "pre-rewrite kernels)",
-                                (0.15, 0.19, 0.30)),
-    "gpt2_small_T2048": ("never measured (round-4 addition)",
+    "flash_attention_fwd_bwd": ("fwd+bwd 39.4 TFLOP/s @T=4k / 58.4 "
+                                "@T=32k, grid-streamed kernels "
+                                "(PERF.md §7b, round 5)",
+                                (0.15, 0.20, 0.30)),
+    "gpt2_small_T2048": ("never measured (r5 headline run crashed "
+                         "into a wedged tunnel, PERF.md §7b)",
                          (0.25, 0.35, 0.45)),
 }
 
