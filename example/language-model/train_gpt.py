@@ -10,6 +10,9 @@ checkable) instead of a download.
 Usage:
     python train_gpt.py                   # tiny config, CPU-friendly
     python train_gpt.py --config small --seq-len 2048   # the MFU config
+    python train_gpt.py --dp 2 --tp 2    # SPMD mesh (Megatron dp x tp)
+    python train_gpt.py --dp 2 --sp 2    # long context: ring attention
+    python train_gpt.py --pp 2 --dp 2    # 1F1B pipeline (+ --tp for 3-D)
 """
 import argparse
 import logging
@@ -70,6 +73,136 @@ def sample(net, stoi_chars, prompt_ids, n_new, max_len, temperature=0.8,
                    for i in out)
 
 
+def _finish(net, chars, tokens, losses, seq_len):
+    """Shared reporting epilogue — the tests grep the final-loss line."""
+    final_loss = float(np.mean(losses[-20:]))
+    text = sample(net, chars, tokens[:16], 80, seq_len)
+    print("final-loss=%.3f" % final_loss)
+    print("sample: %r" % text)
+    return final_loss
+
+
+def train_mesh(args, net, tokens, chars):
+    """SPMD training over a dp x tp x sp mesh, or a 1F1B pipeline when
+    --pp > 1 — the same recipes the parallel/ tests pin, driven from a
+    user-facing script.  SGD(+momentum) rather than the single-device
+    path's adam: the point here is the parallelism recipe."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.parallel import gpt_spmd
+    from mxnet_tpu.gluon.block import functionalize
+
+    rng = np.random.RandomState(1)
+    if args.pp > 1:
+        if args.sp > 1:
+            raise SystemExit("--sp does not compose with --pp here: the "
+                             "pipeline path shards pp/dp/tp (use ring "
+                             "attention inside stages via the library "
+                             "API if you need both)")
+        return _train_pp(args, net, tokens, chars, rng)
+
+    mesh = par.make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+    if args.sp > 1:
+        net.sequence_parallel(
+            mesh, batch_axis="dp" if args.dp > 1 else None)
+    xb0, yb0 = next(batches(tokens, args.seq_len, args.batch_size, rng))
+    fn, params = functionalize(net, jnp.asarray(xb0), train=True)
+    init_fn, step_fn = gpt_spmd.make_train_step(fn, mesh, lr=args.lr)
+    data_spec = P("dp" if args.dp > 1 else None,
+                  "sp" if args.sp > 1 else None)
+
+    def place(a):
+        return jax.device_put(jnp.asarray(a), NamedSharding(mesh,
+                                                            data_spec))
+
+    step = 0
+    with mesh:
+        ps, opt = init_fn(params)
+        for epoch in range(args.epochs):
+            t0 = time.time()
+            losses = []
+            for xb, yb in batches(tokens, args.seq_len, args.batch_size,
+                                  rng):
+                batch = {"x": place(xb), "y": place(yb.astype(np.int32))}
+                ps, opt, loss = step_fn(ps, opt, batch,
+                                        jax.random.PRNGKey(step))
+                losses.append(float(loss))
+                step += 1
+            tok_s = len(losses) * args.batch_size * args.seq_len \
+                / max(time.time() - t0, 1e-9)
+            logging.info("Epoch[%d] loss=%.3f (%d steps, %.0f tok/s, "
+                         "mesh %s)", epoch, float(np.mean(losses[-20:])),
+                         step, tok_s, dict(mesh.shape))
+    # trained weights back into the net so sampling uses them
+    by_name = net.collect_params()
+    for name, val in ps.items():
+        by_name[name].set_data(np.asarray(val))
+    net.sequence_parallel(None)
+    return _finish(net, chars, tokens, losses, args.seq_len)
+
+
+def _train_pp(args, net, tokens, chars, rng):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.parallel import gpt_pp
+
+    mesh = par.make_mesh(pp=args.pp, dp=args.dp, tp=args.tp)
+    n_micro = 2 * args.pp
+    if args.batch_size % (n_micro * max(args.dp, 1)):
+        raise SystemExit("--batch-size must divide into %d microbatches "
+                         "x dp=%d" % (n_micro, args.dp))
+    mb = args.batch_size // n_micro
+    stage_params, stage_fns, wire, names = gpt_pp.make_gpt_stages(
+        net, args.pp, mb // args.dp, args.seq_len)
+    inner = (gpt_pp.gpt_stage_tp_specs(stage_params, names)
+             if args.tp > 1 else None)
+    shardings = par.stage_param_shardings(stage_params, mesh)
+    stage_params = jax.tree_util.tree_map(jax.device_put, stage_params,
+                                          shardings)
+
+    def ce(logits, y):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.take_along_axis(lp, y[..., None], -1).sum()
+
+    denom = args.batch_size * args.seq_len
+    lr = args.lr / denom          # summed loss -> per-token step size
+    step = 0
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        losses = []
+        for xb, yb in batches(tokens, args.seq_len, args.batch_size,
+                              rng):
+            toks = jnp.asarray(xb.reshape(n_micro, mb, args.seq_len))
+            tgts = jnp.asarray(
+                yb.astype(np.int32).reshape(n_micro, mb, args.seq_len))
+            loss, grads = par.pipeline_apply_1f1b_het(
+                stage_params, toks, tgts, stage_fns, ce, wire,
+                mesh=mesh, batch_axis="dp" if args.dp > 1 else None,
+                param_inner_specs=inner)
+            g_wte = gpt_pp.tie_wte_grad(grads)
+            old_e = stage_params["embed"]["wte"][0]
+            old_h = stage_params["head"]["wte"][-1]
+            stage_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, stage_params, grads)
+            # tied embedding: both slots take the summed-grad update
+            stage_params["embed"]["wte"] = \
+                stage_params["embed"]["wte"].at[0].set(old_e - lr * g_wte)
+            stage_params["head"]["wte"] = \
+                stage_params["head"]["wte"].at[-1].set(old_h - lr * g_wte)
+            losses.append(float(loss) / denom)
+            step += 1
+        tok_s = len(losses) * denom / max(time.time() - t0, 1e-9)
+        logging.info("Epoch[%d] loss=%.3f (%d steps, %.0f tok/s, "
+                     "pp=%d dp=%d tp=%d)", epoch,
+                     float(np.mean(losses[-20:])), step, tok_s, args.pp,
+                     args.dp, args.tp)
+    gpt_pp.write_back(net, stage_params, names)
+    return _finish(net, chars, tokens, losses, args.seq_len)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="tiny",
@@ -79,6 +212,14 @@ def main():
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--corpus-chars", type=int, default=20000)
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel mesh axis")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel (Megatron) mesh axis")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel axis: ring attention")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (1F1B; layers %% pp == 0)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -91,6 +232,10 @@ def main():
                "medium": gpt.gpt2_medium}[args.config]
     net = factory(vocab_size=vocab, max_len=args.seq_len)
     net.initialize(mx.init.Xavier())
+
+    if args.dp * args.tp * args.sp * args.pp > 1:
+        return train_mesh(args, net, tokens, chars)
+
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": args.lr})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1,
@@ -115,11 +260,7 @@ def main():
         logging.info("Epoch[%d] loss=%.3f (%d steps, %.0f tok/s)",
                      epoch, float(np.mean(losses[-20:])), step, tok_s)
 
-    final_loss = float(np.mean(losses[-20:]))
-    text = sample(net, chars, tokens[:16], 80, args.seq_len)
-    print("final-loss=%.3f" % final_loss)
-    print("sample: %r" % text)
-    return final_loss
+    return _finish(net, chars, tokens, losses, args.seq_len)
 
 
 if __name__ == "__main__":

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["make_gpt_stages", "gpt_stage_tp_specs", "tie_wte_grad",
-           "grads_by_name"]
+           "grads_by_name", "write_back"]
 
 
 def _strip_block_idx(name):
@@ -201,6 +201,29 @@ def tie_wte_grad(grads):
     (slot 0) plus the head copy's (slot S-1) — apply the SAME update to
     both slots to keep the tie exact."""
     return grads["embed"]["wte"][0] + grads["head"]["wte"][-1]
+
+
+def write_back(net, stage_params, names):
+    """Copy trained union params back into the live net's Parameters
+    (inference/sampling after pipeline training; inverse of
+    :func:`make_gpt_stages`'s packing — the tied wte is taken from the
+    embed slot, which equals the head slot when updates stayed tied)."""
+    import numpy as np
+    by_name = net.collect_params()
+    prefix = names["prefix"]
+
+    def set_(name, val):
+        by_name[name].set_data(np.asarray(val))
+
+    set_(prefix + "wte_weight", stage_params["embed"]["wte"][0])
+    set_(prefix + "wpe_weight", stage_params["embed"]["wpe"][0])
+    for p, n in enumerate(names["lnf"]):
+        set_(n, stage_params["head"]["lnf"][p][-1])
+    lps = names["lps"]
+    for s in range(names["n_stages"]):
+        for j in range(lps):
+            for p, leaf in enumerate(stage_params["blocks"]):
+                set_(names["blocks"][s * lps + j][p], leaf[s, j])
 
 
 def grads_by_name(grads, names):

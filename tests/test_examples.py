@@ -94,6 +94,36 @@ def test_train_mnist_gradient_compression():
     assert accs and accs[-1] > 0.3, accs
 
 
+_GPT_BASE = ["train_gpt.py", "--epochs", "2", "--corpus-chars", "6000",
+             "--batch-size", "8", "--seq-len", "32"]
+#: ln(vocab~27) = 3.3 is the uniform-prediction loss; thresholds sit
+#: decisively below it so "passed" means actually learned
+_GPT_LEARNED = 3.0
+
+
+def test_train_gpt_single_device():
+    out = _run(os.path.join(EX, "language-model"), list(_GPT_BASE))
+    assert _last_metric(out, "final-loss") < _GPT_LEARNED
+
+
+def test_train_gpt_dp_tp():
+    out = _run(os.path.join(EX, "language-model"),
+               _GPT_BASE + ["--dp", "2", "--tp", "2"])
+    assert _last_metric(out, "final-loss") < _GPT_LEARNED
+
+
+def test_train_gpt_dp_sp_long_context():
+    out = _run(os.path.join(EX, "language-model"),
+               _GPT_BASE + ["--dp", "2", "--sp", "2"])
+    assert _last_metric(out, "final-loss") < _GPT_LEARNED
+
+
+def test_train_gpt_pipeline():
+    out = _run(os.path.join(EX, "language-model"),
+               _GPT_BASE + ["--pp", "2", "--dp", "2", "--lr", "0.05"])
+    assert _last_metric(out, "final-loss") < _GPT_LEARNED
+
+
 def test_matrix_factorization_learns():
     out = _run(os.path.join(EX, "recommenders"),
                ["matrix_fact.py", "--num-epochs", "10"], timeout=420)
