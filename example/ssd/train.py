@@ -139,6 +139,35 @@ def synthetic_batch(batch_size, size=64, max_obj=2, seed=0):
     return x, y
 
 
+def write_shapes_rec(path, n=256, size=64, max_obj=2, seed=0):
+    """Pack the synthetic shapes dataset into a detection .rec (flat
+    labels [2, 5, obj...]) so the NATIVE box-aware pipeline
+    (io.ImageDetRecordIter, src/mxtpu/det_aug.cc) can feed training."""
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(seed)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.uniform(0, 25, (size, size, 3))
+        objs = []
+        for _ in range(rng.randint(1, max_obj + 1)):
+            bw, bh = rng.uniform(0.25, 0.5, 2)
+            x1 = rng.uniform(0, 1 - bw)
+            y1 = rng.uniform(0, 1 - bh)
+            cls = rng.randint(0, 2)
+            val = 230 if cls else 128
+            img[int(y1 * size):int((y1 + bh) * size),
+                int(x1 * size):int((x1 + bw) * size)] = val
+            objs.append([float(cls), x1, y1, x1 + bw, y1 + bh])
+        flat = np.asarray([2.0, 5.0] + [v for o in objs for v in o],
+                          np.float32)
+        # pack_img owns the JPEG encode (recordio.py); BGR in, like the
+        # reference's cv2 convention — the shapes are channel-symmetric
+        w.write(recordio.pack_img(
+            recordio.IRHeader(len(flat), flat, i, 0),
+            img.astype(np.uint8)[:, :, ::-1], quality=95))
+    w.close()
+
+
 def main():
     parser = argparse.ArgumentParser(description="train a tiny SSD")
     parser.add_argument("--batch-size", type=int, default=8)
@@ -146,12 +175,40 @@ def main():
     parser.add_argument("--lr", type=float, default=0.02)
     parser.add_argument("--num-classes", type=int, default=2)
     parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--data-train", default="",
+                        help="detection .rec: train through the native "
+                             "box-aware pipeline (io.ImageDetRecordIter) "
+                             "instead of in-memory synthetic batches; "
+                             "'synthetic' writes+uses a generated one")
     args = parser.parse_args()
+
+    rec_iter = None
+    if args.data_train:
+        rec_path = args.data_train
+        if rec_path == "synthetic":
+            import tempfile
+            rec_path = os.path.join(tempfile.mkdtemp(prefix="ssd_rec_"),
+                                    "shapes.rec")
+            write_shapes_rec(rec_path, n=32 * args.batch_size,
+                             size=args.image_size)
+        # the native pipeline decodes/augments on C++ worker threads;
+        # mirror is box-aware, pixels normalized to the synthetic scale
+        rec_iter = mx.io.ImageDetRecordIter(
+            path_imgrec=rec_path,
+            data_shape=(3, args.image_size, args.image_size),
+            batch_size=args.batch_size, shuffle=True, seed=0,
+            rand_mirror=True, std_r=255.0, std_g=255.0, std_b=255.0)
+        label_shape = (args.batch_size, rec_iter.max_objects,
+                       rec_iter.object_width)
+        print("rec-mode: %d samples, label shape %s"
+              % (rec_iter.num_samples, label_shape))
 
     net = get_ssd_symbol(args.num_classes, mode="train")
     mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
                         context=mx.tpu() if mx.num_gpus() > 0 else mx.cpu())
     x, y = synthetic_batch(args.batch_size, args.image_size)
+    if rec_iter is not None:
+        y = np.full(label_shape, -1.0, np.float32)
     mod.bind(data_shapes=[("data", x.shape)],
              label_shapes=[("label", y.shape)])
     mod.init_params(mx.init.Xavier(magnitude=2))
@@ -159,10 +216,20 @@ def main():
                        optimizer_params={"learning_rate": args.lr,
                                          "momentum": 0.9, "wd": 1e-4})
     import time
+
+    def next_batch(step):
+        if rec_iter is None:
+            xs, ys = synthetic_batch(args.batch_size, args.image_size,
+                                     seed=step)
+            return mx.io.DataBatch([mx.nd.array(xs)], [mx.nd.array(ys)])
+        try:
+            return next(rec_iter)
+        except StopIteration:
+            rec_iter.reset()
+            return next(rec_iter)
+
     for step in range(args.steps):
-        xs, ys = synthetic_batch(args.batch_size, args.image_size,
-                                 seed=step)
-        batch = mx.io.DataBatch([mx.nd.array(xs)], [mx.nd.array(ys)])
+        batch = next_batch(step)
         t0 = time.time()
         mod.forward(batch, is_train=True)
         mod.backward()

@@ -72,6 +72,17 @@ def test_ssd_smoke():
     assert "detections shape" in out
 
 
+def test_ssd_native_rec_pipeline_learns():
+    """SSD trained FROM the native detection pipeline
+    (io.ImageDetRecordIter, C++ box-aware augmenters): the script's
+    internal anchor-classification assert (>0.75) gates learning."""
+    out = _run(os.path.join(EX, "ssd"),
+               ["train.py", "--data-train", "synthetic", "--steps",
+                "150", "--batch-size", "8", "--image-size", "32",
+                "--lr", "0.04"])
+    assert "rec-mode" in out and "SSD OK" in out
+
+
 def test_model_parallel_lstm_smoke():
     out = _run(os.path.join(EX, "model-parallel-lstm"),
                ["lstm.py", "--num-layers", "2", "--ngpu", "2", "--steps",
