@@ -261,6 +261,28 @@ def _ln(x, g, b, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * g + b
 
 
+def _block_qkv(lp, x, n_heads):
+    """Shared per-layer front half: LN1 + fused head-major qkv.
+    x [B, T, C] -> q, k, v [B, H, T, D] (layout from basic_layers.py's
+    FlashSelfAttention; the ONE copy _prefill and _decode_one share)."""
+    b, t, c = x.shape
+    d = c // n_heads
+    h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+    qkv = (h @ lp["qkv_w"].T + lp["qkv_b"]).reshape(b, t, n_heads, 3, d)
+    qkv = qkv.transpose(0, 2, 1, 3, 4)           # [B, H, T, 3, D]
+    return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+
+def _block_finish(lp, x, o):
+    """Shared per-layer back half: attention output o [B, T, C] ->
+    residual + LN2 + gelu MLP + residual."""
+    import jax
+    x = x + o @ lp["out_w"].T + lp["out_b"]
+    h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+    h = jax.nn.gelu(h @ lp["fc1_w"].T + lp["fc1_b"], approximate=True)
+    return x + h @ lp["fc2_w"].T + lp["fc2_b"]
+
+
 def _decode_one(p, tok, pos, caches, n_heads):
     """One decode step: tok [B] int32, pos scalar, caches list of
     (k_cache, v_cache) [B, H, T_max, D].  Returns (logits [B, V],
@@ -268,37 +290,58 @@ def _decode_one(p, tok, pos, caches, n_heads):
     import jax
     import jax.numpy as jnp
     from jax import lax
-    x = p["wte"][tok] + lax.dynamic_index_in_dim(p["wpe"], pos, 0,
-                                                 keepdims=False)  # [B, C]
-    b = x.shape[0]
+    x = p["wte"][tok][:, None] + lax.dynamic_index_in_dim(
+        p["wpe"], pos, 0, keepdims=False)              # [B, 1, C]
+    b, _, c = x.shape
+    d = c // n_heads
     t_max = caches[0][0].shape[2]
     new_caches = []
     # keys at position > pos are zeros in the cache; mask them
     mask = (jnp.arange(t_max) <= pos)[None, None, :]
     for lp, (kc, vc) in zip(p["layers"], caches):
-        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
-        qkv = h @ lp["qkv_w"].T + lp["qkv_b"]          # [B, 3C]
-        c = x.shape[-1]
-        d = c // n_heads
-        # head-major fused layout [H, 3, D] (basic_layers.py)
-        qkv = qkv.reshape(b, n_heads, 3, d)
-        q = qkv[:, :, 0]
-        k = qkv[:, :, 1]
-        v = qkv[:, :, 2]                               # [B, H, D]
-        kc = lax.dynamic_update_index_in_dim(kc, k[:, :, None], pos, 2)
-        vc = lax.dynamic_update_index_in_dim(vc, v[:, :, None], pos, 2)
-        s = jnp.einsum("bhd,bhtd->bht", q, kc) / jnp.sqrt(
+        q, k, v = _block_qkv(lp, x, n_heads)           # [B, H, 1, D]
+        kc = lax.dynamic_update_index_in_dim(kc, k, pos, 2)
+        vc = lax.dynamic_update_index_in_dim(vc, v, pos, 2)
+        s = jnp.einsum("bhd,bhtd->bht", q[:, :, 0], kc) / jnp.sqrt(
             jnp.float32(d))
         s = jnp.where(mask, s, -1e30)
         pr = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bht,bhtd->bhd", pr, vc).reshape(b, c)
-        x = x + o @ lp["out_w"].T + lp["out_b"]
-        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
-        h = jax.nn.gelu(h @ lp["fc1_w"].T + lp["fc1_b"], approximate=True)
-        x = x + h @ lp["fc2_w"].T + lp["fc2_b"]
+        o = jnp.einsum("bht,bhtd->bhd", pr, vc).reshape(b, 1, c)
+        x = _block_finish(lp, x, o)
         new_caches.append((kc, vc))
-    x = _ln(x, p["lnf_g"], p["lnf_b"])
+    x = _ln(x[:, 0], p["lnf_g"], p["lnf_b"])
     return x @ p["wte"].T, new_caches
+
+
+def _prefill(p, toks, t_max, n_heads):
+    """One batched causal pass over the prompt: fills every layer's KV
+    cache for positions [0, T0) and returns the last position's logits
+    — replacing T0 sequential decode steps with one forward (the
+    standard prefill/decode split; same parameter dict and layer math
+    as ``_decode_one``, pinned together by the generate-vs-recompute
+    equality tests)."""
+    import jax
+    import jax.numpy as jnp
+    b, t0 = toks.shape
+    x = p["wte"][toks] + p["wpe"][:t0][None]           # [B, T0, C]
+    c = x.shape[-1]
+    d = c // n_heads
+    causal = jnp.tril(jnp.ones((t0, t0), bool))[None, None]
+    pad_t = t_max - t0
+    caches = []
+    for lp in p["layers"]:
+        q, k, v = _block_qkv(lp, x, n_heads)           # [B, H, T0, D]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+        s = jnp.where(causal, s, -1e30)
+        pr = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pr, v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t0, c)
+        x = _block_finish(lp, x, o)
+        kc = jnp.pad(k, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+        vc = jnp.pad(v, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+        caches.append((kc, vc))
+    x = _ln(x[:, -1], p["lnf_g"], p["lnf_b"])          # [B, C]
+    return x @ p["wte"].T, caches
 
 
 def _filter_logits(logits, top_k, top_p):
@@ -325,56 +368,56 @@ def _filter_logits(logits, top_k, top_p):
 
 
 @functools.lru_cache(maxsize=32)
-def _decode_runner(n_heads, greedy, total, t0, t_max, n_layers, d,
+def _decode_runner(n_heads, greedy, n_new, t0, t_max,
                    top_k=0, top_p=0.0):
-    """Build (once per static configuration) the jitted scan runner.
-    Params, prompt, caches, key, and temperature are traced ARGUMENTS,
-    so repeated generate() calls — and further training between them —
-    hit jit's compile cache instead of recompiling the whole scan."""
+    """Build (once per static configuration) the jitted prefill+decode
+    runner.  The prompt is consumed by ONE batched causal pass
+    (``_prefill`` — fills all caches and yields the first new token's
+    logits); only the n_new-1 truly sequential steps run in the
+    ``lax.scan`` — long prompts cost one forward, not T0 scan
+    iterations.  Params, prompt, key, and temperature are traced
+    ARGUMENTS, so repeated generate() calls — and further training
+    between them — hit jit's compile cache."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    def step(p, temp, carry, inp):
-        caches, tok, key = carry
-        pos, prompt_tok, in_prompt = inp
-        logits, caches = _decode_one(p, tok, pos, caches, n_heads)
+    def pick(logits, key, temp):
         if greedy:
-            nxt = logits.argmax(-1)
-        else:
-            key, sub = jax.random.split(key)
-            scaled = _filter_logits(logits / temp, top_k, top_p)
-            nxt = jax.random.categorical(sub, scaled, axis=-1)
-        nxt = nxt.astype(jnp.int32)
-        # while in the prompt, the "generated" token is overridden by
-        # the actual next prompt token (prefill rides the same scan)
-        out_tok = jnp.where(in_prompt, prompt_tok, nxt)
-        return (caches, out_tok, key), out_tok
+            return logits.argmax(-1).astype(jnp.int32), key
+        key, sub = jax.random.split(key)
+        scaled = _filter_logits(logits / temp, top_k, top_p)
+        return (jax.random.categorical(sub, scaled, axis=-1)
+                .astype(jnp.int32), key)
 
-    positions = jnp.arange(total)
-    in_prompt = (positions < t0 - 1)[:, None]
+    def step(p, temp, carry, pos):
+        caches, tok, key = carry
+        logits, caches = _decode_one(p, tok, pos, caches, n_heads)
+        nxt, key = pick(logits, key, temp)
+        return (caches, nxt, key), nxt
 
     @jax.jit
-    def run(p, prompt, caches, key, temp):
-        prompt_next = jnp.concatenate(
-            [prompt[:, 1:].T,
-             jnp.zeros((total - (t0 - 1), prompt.shape[0]), jnp.int32)])
-        (caches, _, _), toks = lax.scan(
-            functools.partial(step, p, temp),
-            (caches, prompt[:, 0], key),
-            (positions, prompt_next, in_prompt))
-        return toks  # [total, B]
+    def run(p, prompt, key, temp):
+        logits0, caches = _prefill(p, prompt, t_max, n_heads)
+        first, key = pick(logits0, key, temp)
+        if n_new == 1:
+            return first[None]
+        positions = jnp.arange(t0, t0 + n_new - 1)
+        _, toks = lax.scan(functools.partial(step, p, temp),
+                           (caches, first, key), positions)
+        return jnp.concatenate([first[None], toks])  # [n_new, B]
 
     return run
 
 
 def generate(net, prompt_ids, n_new, temperature=0.0, seed=0, top_k=0,
              top_p=0.0):
-    """Autoregressive generation with a KV cache — O(T) per new token
-    instead of the O(T²) full-context recompute.  One jitted
-    ``lax.scan`` over decode steps (static shapes: the cache is
-    ``max_len`` long), TPU-friendly by construction; the compiled scan
-    is cached per (shape, config), so repeated calls don't retrace.
+    """Autoregressive generation with a KV cache — ONE batched prefill
+    pass over the prompt, then O(1) work per new token (vs the O(T²)
+    full-context recompute).  The decode loop is one jitted
+    ``lax.scan`` with static shapes (the cache is ``max_len`` long),
+    TPU-friendly by construction; the compiled runner is cached per
+    (shape, config), so repeated calls don't retrace.
 
     ``prompt_ids``: int array [B, T0]; returns int array
     [B, T0 + n_new].  temperature 0 = greedy; otherwise samples with
@@ -394,22 +437,15 @@ def generate(net, prompt_ids, n_new, temperature=0.0, seed=0, top_k=0,
         raise ValueError("prompt %d + new %d exceeds max_len %d"
                          % (t0, n_new, t_max))
     n_heads = net.blocks._children[0].attn._num_heads
-    d = net._units // n_heads
-    n_layers = len(net.blocks._children)
     p = _decode_params(net)
 
-    caches = [(jnp.zeros((bsz, n_heads, t_max, d), jnp.float32),
-               jnp.zeros((bsz, n_heads, t_max, d), jnp.float32))
-              for _ in range(n_layers)]
     greedy = temperature <= 0
-    run = _decode_runner(n_heads, greedy, t0 + n_new - 1, t0,
-                         t_max, n_layers, d,
+    run = _decode_runner(n_heads, greedy, n_new, t0, t_max,
                          0 if greedy else int(top_k),
                          0.0 if greedy else float(top_p))
-    toks = run(p, prompt, caches, jax.random.PRNGKey(seed),
+    toks = run(p, prompt, jax.random.PRNGKey(seed),
                jnp.float32(max(temperature, 1e-6)))
-    out = jnp.concatenate([prompt[:, :1].T, toks]).T  # [B, total+1]
-    return np.asarray(out)
+    return np.asarray(jnp.concatenate([prompt, toks.T], axis=1))
 
 
 def get_gpt(num_layers, units, num_heads, vocab_size=50257, max_len=1024,
