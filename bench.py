@@ -173,12 +173,65 @@ GPT_CONFIGS = {"tiny": (2, 128, 4), "small": (12, 768, 12),
                "medium": (24, 1024, 16)}
 
 
-def _gpt_metric():
+def _gpt_metric(kind="train"):
     cfg_name = os.environ.get("BENCH_GPT", "small")
     if cfg_name not in GPT_CONFIGS:
         raise ValueError("BENCH_GPT must be one of %s, got %r"
                          % (sorted(GPT_CONFIGS), cfg_name))
-    return cfg_name, "gpt2_%s_train_tokens_per_sec" % cfg_name
+    return cfg_name, "gpt2_%s_%s_tokens_per_sec" % (cfg_name, kind)
+
+
+def bench_generate():
+    """BENCH_MODE=generate: GPT flagship INFERENCE throughput.
+
+    Times gpt.generate (prefill + jitted KV-cache decode scan): one
+    batched causal pass over the prompt, then n_new sequential decode
+    steps.  Metric is decoded tokens/s (batch * n_new / wall) with the
+    prompt prefill amortized in — the serving-path number next to the
+    training MFU headline.
+    """
+    import numpy as np
+    import jax
+
+    cfg_name, metric = _gpt_metric("generate")
+    n_layer, d_model, n_head = GPT_CONFIGS[cfg_name]
+    platform = jax.devices()[0].platform
+    _disarm_watchdog()
+    device_kind = jax.devices()[0].device_kind
+    on_cpu = platform == "cpu"
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "32" if on_cpu
+                                    else "512"))
+    n_new = int(os.environ.get("BENCH_NEW", "16" if on_cpu else "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "2" if on_cpu else "8"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS",
+                                      "2" if on_cpu else "10")))
+    vocab = 512 if on_cpu else 50304
+
+    from mxnet_tpu.gluon.model_zoo import gpt
+    net = gpt.GPTLM(vocab, n_layer, d_model, n_head,
+                    max_len=prompt_len + n_new)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, vocab, (batch, prompt_len)).astype(np.int32)
+
+    # warm up the SAME (sampling) runner the timed loop uses — greedy
+    # and sampling compile different scans (static cache key)
+    gpt.generate(net, prompt, n_new, temperature=0.8, seed=-1)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        out = gpt.generate(net, prompt, n_new, temperature=0.8,
+                           seed=i)
+    dt = (time.perf_counter() - t0) / steps
+    assert out.shape == (batch, prompt_len + n_new)
+    tok_s = batch * n_new / dt
+    print(json.dumps({
+        "metric": metric,
+        "value": round(tok_s, 1),
+        "unit": "tok/s (B%d prompt %d +%d new, %d %s)" % (
+            batch, prompt_len, n_new, len(jax.devices()), device_kind),
+        "vs_baseline": 0.0,
+        "ms_per_step": round(dt * 1000, 2),
+    }), flush=True)
 
 
 def bench_transformer():
@@ -377,6 +430,8 @@ def main():
         "pipeline": ("input_pipeline_images_per_sec", "img/s"),
         "transformer": (_gpt_metric()[1] if mode == "transformer"
                         else "", "tok/s"),
+        "generate": (_gpt_metric("generate")[1] if mode == "generate"
+                     else "", "tok/s"),
     }.get(mode, (_network_metric(network), "img/s"))
     _install_init_watchdog(metric, unit)
     try:
@@ -410,6 +465,9 @@ def _run_mode(mode, network):
         return
     if mode == "transformer":
         bench_transformer()
+        return
+    if mode == "generate":
+        bench_generate()
         return
     # bs 128 is the measured single-chip sweet spot on v5e (PERF.md:
     # 2379 img/s vs 2263 at bs 256, 2114 at bs 512)

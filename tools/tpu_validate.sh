@@ -31,6 +31,9 @@ python bench.py 2>&1 | grep -v WARNING | tail -1
 BENCH_MODE=transformer BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
 MXTPU_FLASH_BWD=fused BENCH_MODE=transformer BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
 
+# 4b. inference: prefill + KV-cache decode throughput (round 5)
+BENCH_MODE=generate BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
+
 # 5. two more families for the per-network table
 BENCH_NETWORK=resnet152_v1 BENCH_STEPS=10 python bench.py 2>&1 | grep -v WARNING | tail -1
 BENCH_NETWORK=inception_v3 BENCH_STEPS=10 BENCH_BATCH=64 python bench.py 2>&1 | grep -v WARNING | tail -1
