@@ -28,17 +28,26 @@ import functools
 from .. import nn
 from ..block import HybridBlock
 
-__all__ = ["GPTBlock", "GPTLM", "get_gpt", "gpt2_tiny", "gpt2_small",
-           "gpt2_medium"]
+__all__ = ["GPTBlock", "GPTLM", "get_gpt", "gpt2_tiny",
+           "gpt2_tiny_moe", "gpt2_small", "gpt2_medium"]
 
 
 class GPTBlock(HybridBlock):
-    """One pre-LN transformer decoder block."""
+    """One pre-LN transformer decoder block.
+
+    ``moe_experts > 0`` swaps the dense gelu MLP for a GShard-style
+    top-1-gated mixture of experts (parallel/moe.py): off-mesh the
+    experts run locally (``moe_dense``); after
+    :meth:`GPTLM.expert_parallel` they shard over the ``ep`` mesh axis
+    with all_to_all dispatch — the flagship's fifth mesh axis."""
 
     def __init__(self, units, num_heads, mlp_ratio=4, dropout=0.0,
-                 **kwargs):
+                 moe_experts=0, moe_capacity=2.0, **kwargs):
         super().__init__(**kwargs)
         self._dropout = dropout
+        self._moe = int(moe_experts)
+        self._moe_capacity = moe_capacity
+        self._moe_mesh = None
         with self.name_scope():
             self.ln1 = nn.LayerNorm(in_channels=units, prefix="ln1_")
             self.attn = nn.FlashSelfAttention(units, num_heads,
@@ -46,12 +55,70 @@ class GPTBlock(HybridBlock):
                                               in_units=units,
                                               prefix="attn_")
             self.ln2 = nn.LayerNorm(in_channels=units, prefix="ln2_")
-            self.fc1 = nn.Dense(mlp_ratio * units, flatten=False,
-                                in_units=units, prefix="fc1_")
-            self.fc2 = nn.Dense(units, flatten=False,
-                                in_units=mlp_ratio * units, prefix="fc2_")
+            if self._moe:
+                e, f = self._moe, mlp_ratio * units
+                self.moe_gate = self.params.get(
+                    "moe_gate_weight", shape=(units, e))
+                self.moe_w1 = self.params.get("moe_fc1_weight",
+                                              shape=(e, units, f))
+                self.moe_b1 = self.params.get("moe_fc1_bias",
+                                              shape=(e, f))
+                self.moe_w2 = self.params.get("moe_fc2_weight",
+                                              shape=(e, f, units))
+                self.moe_b2 = self.params.get("moe_fc2_bias",
+                                              shape=(e, units))
+            else:
+                self.fc1 = nn.Dense(mlp_ratio * units, flatten=False,
+                                    in_units=units, prefix="fc1_")
+                self.fc2 = nn.Dense(units, flatten=False,
+                                    in_units=mlp_ratio * units,
+                                    prefix="fc2_")
 
-    def hybrid_forward(self, F, x, segments=None):
+    def expert_parallel(self, mesh, axis="ep", batch_axis=None):
+        """Shard this block's experts over ``mesh``'s ``axis`` —
+        tokens all_to_all to their expert's device (parallel.moe_apply).
+        Traced path only; ``mesh=None`` restores local experts."""
+        self._moe_mesh = (None if mesh is None
+                          else (mesh, axis, batch_axis))
+        self._cached_op = None
+
+    def _moe_forward(self, F, h, moe_params):
+        import jax
+        from ... import parallel as _par
+        from ... import autograd as _ag
+        gate_w, w1, b1, w2, b2 = moe_params
+        if hasattr(h, "_data") and _ag.is_recording():
+            raise RuntimeError(
+                "MoE blocks do not support the imperative autograd "
+                "tape; train through functionalize/jit")
+
+        def _raw(a):
+            return a._data if hasattr(a, "_data") else a
+        hj = _raw(h)
+        b, t, d = hj.shape
+        flat = hj.reshape(b * t, d)
+        args = tuple(_raw(a) for a in (gate_w, w1, b1, w2, b2))
+        if self._moe_mesh is None:
+            out = _par.moe.moe_dense(
+                flat, *args, capacity_factor=self._moe_capacity,
+                act=jax.nn.gelu)
+        else:
+            mesh, axis, batch_axis = self._moe_mesh
+            out = _par.moe_apply(
+                flat, *args, mesh=mesh, axis=axis,
+                batch_axis=batch_axis,
+                capacity_factor=self._moe_capacity, act=jax.nn.gelu)
+        out = out.reshape(b, t, d)
+        if hasattr(h, "_data"):
+            # imperative (inference) caller: rewrap so the residual add
+            # stays in the NDArray domain
+            from ...ndarray import NDArray
+            return NDArray(out)
+        return out
+
+    def hybrid_forward(self, F, x, segments=None, moe_gate=None,
+                       moe_w1=None, moe_b1=None, moe_w2=None,
+                       moe_b2=None):
         if segments is None:
             h = self.attn(self.ln1(x))
         else:
@@ -59,8 +126,13 @@ class GPTBlock(HybridBlock):
         if self._dropout:
             h = F.Dropout(h, p=self._dropout)
         x = x + h
-        h = self.fc2(F.Activation(self.fc1(self.ln2(x)),
-                                  act_type="gelu"))
+        if self._moe:
+            h = self._moe_forward(F, self.ln2(x),
+                                  (moe_gate, moe_w1, moe_b1, moe_w2,
+                                   moe_b2))
+        else:
+            h = self.fc2(F.Activation(self.fc1(self.ln2(x)),
+                                      act_type="gelu"))
         if self._dropout:
             h = F.Dropout(h, p=self._dropout)
         return x + h
@@ -74,7 +146,8 @@ class GPTLM(HybridBlock):
     """
 
     def __init__(self, vocab_size, num_layers, units, num_heads,
-                 max_len=1024, dropout=0.0, remat=False, **kwargs):
+                 max_len=1024, dropout=0.0, remat=False, moe_experts=0,
+                 moe_capacity=2.0, **kwargs):
         super().__init__(**kwargs)
         self._vocab = vocab_size
         self._units = units
@@ -90,8 +163,19 @@ class GPTLM(HybridBlock):
             with self.blocks.name_scope():
                 for _ in range(num_layers):
                     self.blocks.add(GPTBlock(units, num_heads,
-                                             dropout=dropout))
+                                             dropout=dropout,
+                                             moe_experts=moe_experts,
+                                             moe_capacity=moe_capacity))
             self.ln_f = nn.LayerNorm(in_channels=units, prefix="lnf_")
+
+    def expert_parallel(self, mesh, axis="ep", batch_axis=None):
+        """MoE switch: every block's experts shard over ``mesh``'s
+        ``axis`` (tokens all_to_all to their expert's device —
+        parallel/moe.py); ``mesh=None`` restores local experts.  Only
+        meaningful when built with ``moe_experts > 0``."""
+        for blk in self.blocks._children:
+            blk.expert_parallel(mesh, axis=axis, batch_axis=batch_axis)
+        self._cached_op = None
 
     def sequence_parallel(self, mesh, axis="sp", batch_axis=None,
                           impl=None):
@@ -240,14 +324,22 @@ def _decode_params(net):
 
     layers = []
     for blk in net.blocks._children:
-        layers.append({
+        lp = {
             "ln1_g": g(blk.ln1.gamma), "ln1_b": g(blk.ln1.beta),
             "qkv_w": g(blk.attn.qkv.weight), "qkv_b": bias(blk.attn.qkv),
             "out_w": g(blk.attn.out_proj.weight),
             "out_b": bias(blk.attn.out_proj),
-            "ln2_g": g(blk.ln2.gamma), "ln2_b": g(blk.ln2.beta),
-            "fc1_w": g(blk.fc1.weight), "fc1_b": bias(blk.fc1),
-            "fc2_w": g(blk.fc2.weight), "fc2_b": bias(blk.fc2)})
+            "ln2_g": g(blk.ln2.gamma), "ln2_b": g(blk.ln2.beta)}
+        if getattr(blk, "_moe", 0):
+            lp["moe"] = tuple(g(p) for p in (
+                blk.moe_gate, blk.moe_w1, blk.moe_b1, blk.moe_w2,
+                blk.moe_b2))
+        else:
+            lp.update({"fc1_w": g(blk.fc1.weight),
+                       "fc1_b": bias(blk.fc1),
+                       "fc2_w": g(blk.fc2.weight),
+                       "fc2_b": bias(blk.fc2)})
+        layers.append(lp)
     return {"wte": g(net.wte), "wpe": g(net.wpe),
             "lnf_g": g(net.ln_f.gamma), "lnf_b": g(net.ln_f.beta),
             "layers": layers}
@@ -275,10 +367,23 @@ def _block_qkv(lp, x, n_heads):
 
 def _block_finish(lp, x, o):
     """Shared per-layer back half: attention output o [B, T, C] ->
-    residual + LN2 + gelu MLP + residual."""
+    residual + LN2 + MLP (dense gelu or mixture of experts) +
+    residual."""
     import jax
     x = x + o @ lp["out_w"].T + lp["out_b"]
     h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+    if "moe" in lp:
+        from ...parallel.moe import moe_dense
+        b, t, c = h.shape
+        gate_w, w1, b1, w2, b2 = lp["moe"]
+        # DROPLESS at inference (capacity == token count): GShard's
+        # capacity dropping is a training-throughput trade whose queue
+        # positions couple tokens across the batch — decode must stay
+        # position-local to match the cache-free forward
+        out = moe_dense(h.reshape(b * t, c), gate_w, w1, b1, w2, b2,
+                        capacity_factor=float(w1.shape[0]),
+                        act=jax.nn.gelu)
+        return x + out.reshape(b, t, c)
     h = jax.nn.gelu(h @ lp["fc1_w"].T + lp["fc1_b"], approximate=True)
     return x + h @ lp["fc2_w"].T + lp["fc2_b"]
 
@@ -449,10 +554,19 @@ def generate(net, prompt_ids, n_new, temperature=0.0, seed=0, top_k=0,
 
 
 def get_gpt(num_layers, units, num_heads, vocab_size=50257, max_len=1024,
-            dropout=0.0, remat=False, **kwargs):
+            dropout=0.0, remat=False, moe_experts=0, **kwargs):
     """Build a GPTLM with the vocab padded to the MXU lane width."""
     return GPTLM(_pad_vocab(vocab_size), num_layers, units, num_heads,
-                 max_len=max_len, dropout=dropout, remat=remat, **kwargs)
+                 max_len=max_len, dropout=dropout, remat=remat,
+                 moe_experts=moe_experts, **kwargs)
+
+
+def gpt2_tiny_moe(moe_experts=4, **kwargs):
+    """2-layer test-scale MoE config (every block's MLP is a top-1
+    mixture of ``moe_experts`` experts — the flagship's ep-axis form)."""
+    kwargs.setdefault("vocab_size", 256)
+    kwargs.setdefault("max_len", 128)
+    return get_gpt(2, 128, 4, moe_experts=moe_experts, **kwargs)
 
 
 def gpt2_tiny(**kwargs):

@@ -42,6 +42,27 @@ def top1_gating(logits, capacity):
     return combine, dispatch
 
 
+def moe_dense(x, gate_w, w1, b1, w2, b2, capacity_factor=2.0,
+              act=jax.nn.relu):
+    """Single-device MoE FFN (no collectives): the same GShard top-1
+    gating + capacity math as ``_moe_local`` with every expert local —
+    the flagship's MoE blocks use this off-mesh, and it equals the
+    ep-sharded form exactly when capacity doesn't bind (e.g.
+    ``capacity_factor >= num_experts``).  x [T, D] -> [T, D]."""
+    t, d = x.shape
+    e = w1.shape[0]
+    capacity = max(1, int(capacity_factor * t / e))
+    logits = jnp.dot(x, gate_w, preferred_element_type=jnp.float32)
+    combine, dispatch = top1_gating(logits, capacity)
+    slots = jnp.einsum("tec,td->ecd", dispatch, x)
+    h = jnp.einsum("ecd,edf->ecf", slots, w1,
+                   preferred_element_type=jnp.float32) + b1[:, None, :]
+    h = act(h)
+    y = jnp.einsum("ecf,efd->ecd", h, w2,
+                   preferred_element_type=jnp.float32) + b2[:, None, :]
+    return jnp.einsum("tec,ecd->td", combine, y).astype(x.dtype)
+
+
 def _moe_local(x, gate_w, w1, b1, w2, b2, axis, capacity_factor, act):
     """Inside shard_map.  x: [T_local, D]; experts sharded: w1 [E_local,...]."""
     n = lax.axis_size(axis)
