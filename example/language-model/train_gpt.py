@@ -13,6 +13,7 @@ Usage:
     python train_gpt.py --dp 2 --tp 2    # SPMD mesh (Megatron dp x tp)
     python train_gpt.py --dp 2 --sp 2    # long context: ring attention
     python train_gpt.py --pp 2 --dp 2    # 1F1B pipeline (+ --tp for 3-D)
+    python train_gpt.py --moe-experts 4 --ep 2 --dp 2   # MoE over ep
 """
 import argparse
 import logging
@@ -103,14 +104,21 @@ def train_mesh(args, net, tokens, chars):
                              "API if you need both)")
         return _train_pp(args, net, tokens, chars, rng)
 
-    mesh = par.make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+    mesh = par.make_mesh(dp=args.dp, tp=args.tp, sp=args.sp,
+                         ep=args.ep)
+    dp_n = dict(mesh.shape).get("dp", 1)
     if args.sp > 1:
         net.sequence_parallel(
-            mesh, batch_axis="dp" if args.dp > 1 else None)
+            mesh, batch_axis="dp" if dp_n > 1 else None)
+    if args.ep > 1:
+        if not args.moe_experts:
+            raise SystemExit("--ep needs --moe-experts")
+        net.expert_parallel(mesh,
+                            batch_axis="dp" if dp_n > 1 else None)
     xb0, yb0 = next(batches(tokens, args.seq_len, args.batch_size, rng))
     fn, params = functionalize(net, jnp.asarray(xb0), train=True)
     init_fn, step_fn = gpt_spmd.make_train_step(fn, mesh, lr=args.lr)
-    data_spec = P("dp" if args.dp > 1 else None,
+    data_spec = P("dp" if dp_n > 1 else None,
                   "sp" if args.sp > 1 else None)
 
     def place(a):
@@ -140,6 +148,8 @@ def train_mesh(args, net, tokens, chars):
     for name, val in ps.items():
         by_name[name].set_data(np.asarray(val))
     net.sequence_parallel(None)
+    if args.moe_experts:
+        net.expert_parallel(None)
     return _finish(net, chars, tokens, losses, args.seq_len)
 
 
@@ -220,6 +230,10 @@ def main():
                    help="sequence-parallel axis: ring attention")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline stages (1F1B; layers %% pp == 0)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel axis (needs --moe-experts)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="experts per block (0 = dense MLP)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -230,10 +244,17 @@ def main():
     from mxnet_tpu.gluon.model_zoo import gpt
     factory = {"tiny": gpt.gpt2_tiny, "small": gpt.gpt2_small,
                "medium": gpt.gpt2_medium}[args.config]
-    net = factory(vocab_size=vocab, max_len=args.seq_len)
+    net = factory(vocab_size=vocab, max_len=args.seq_len,
+                  moe_experts=args.moe_experts)
     net.initialize(mx.init.Xavier())
 
-    if args.dp * args.tp * args.sp * args.pp > 1:
+    if args.dp * args.tp * args.sp * args.pp * args.ep > 1:
+        return train_mesh(args, net, tokens, chars)
+    if args.moe_experts:
+        # MoE blocks train through functionalize (the imperative tape
+        # cannot record the expert dispatch) — reuse the mesh path,
+        # data-parallel over every visible device
+        args.dp = -1
         return train_mesh(args, net, tokens, chars)
 
     trainer = gluon.Trainer(net.collect_params(), "adam",

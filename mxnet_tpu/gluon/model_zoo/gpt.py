@@ -87,10 +87,16 @@ class GPTBlock(HybridBlock):
         from ... import parallel as _par
         from ... import autograd as _ag
         gate_w, w1, b1, w2, b2 = moe_params
-        if hasattr(h, "_data") and _ag.is_recording():
-            raise RuntimeError(
-                "MoE blocks do not support the imperative autograd "
-                "tape; train through functionalize/jit")
+        if hasattr(h, "_data"):
+            if _ag.is_recording():
+                raise RuntimeError(
+                    "MoE blocks do not support the imperative autograd "
+                    "tape; train through functionalize/jit")
+            if self._moe_mesh is not None:
+                raise RuntimeError(
+                    "imperative inference with expert_parallel active: "
+                    "call expert_parallel(None) first (the ep shard_map "
+                    "needs the jit/functionalize path)")
 
         def _raw(a):
             return a._data if hasattr(a, "_data") else a

@@ -129,6 +129,13 @@ def test_train_gpt_dp_sp_long_context():
     assert _last_metric(out, "final-loss") < _GPT_LEARNED
 
 
+def test_train_gpt_moe_ep():
+    out = _run(os.path.join(EX, "language-model"),
+               _GPT_BASE + ["--moe-experts", "4", "--ep", "2",
+                            "--dp", "2"])
+    assert _last_metric(out, "final-loss") < _GPT_LEARNED
+
+
 def test_train_gpt_pipeline():
     out = _run(os.path.join(EX, "language-model"),
                _GPT_BASE + ["--pp", "2", "--dp", "2", "--lr", "0.05"])

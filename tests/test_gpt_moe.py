@@ -105,6 +105,37 @@ def test_gpt_moe_generate_matches_recompute():
     np.testing.assert_array_equal(out, ref)
 
 
+def test_gpt_moe_dp_times_ep_matches_dense():
+    """ep composes with dp in one mesh (tokens sharded over both for
+    dispatch): loss equals the local-expert oracle (no-drop config)."""
+    net = _net(e=4, capacity=4.0)
+    rng = np.random.RandomState(2)
+    toks = jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+    y = jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+
+    def mk_loss(fn):
+        def loss(ps):
+            (logits,), _ = fn(ps, toks)
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            return -jnp.take_along_axis(lp, y[..., None], -1).mean()
+        return loss
+
+    fn, params = functionalize(net, toks, train=True)
+    l_ref = float(mk_loss(fn)(params))
+
+    mesh = par.make_mesh(dp=2, ep=4)
+    net.expert_parallel(mesh, batch_axis="dp")
+    try:
+        fn_ep, params_ep = functionalize(net, toks, train=True)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        params_ep = [jax.device_put(p, NamedSharding(mesh, P()))
+                     for p in params_ep]
+        l_ep = float(mk_loss(fn_ep)(params_ep))
+    finally:
+        net.expert_parallel(None)
+    np.testing.assert_allclose(l_ep, l_ref, rtol=2e-5)
+
+
 def test_gpt_moe_rejects_imperative_tape():
     from mxnet_tpu import autograd
     net = _net()
