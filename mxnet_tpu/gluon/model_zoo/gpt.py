@@ -39,7 +39,13 @@ class GPTBlock(HybridBlock):
     top-1-gated mixture of experts (parallel/moe.py): off-mesh the
     experts run locally (``moe_dense``); after
     :meth:`GPTLM.expert_parallel` they shard over the ``ep`` mesh axis
-    with all_to_all dispatch — the flagship's fifth mesh axis."""
+    with all_to_all dispatch — the flagship's fifth mesh axis.
+
+    Scope note: routing is top-1 with a capacity bound and NO auxiliary
+    load-balancing loss — adequate at the tested scales (the gate
+    trains through the combine weights); large-scale MoE pretraining
+    conventionally adds a Switch-style balance term, which needs the
+    per-block gate logits plumbed to the loss (a possible extension)."""
 
     def __init__(self, units, num_heads, mlp_ratio=4, dropout=0.0,
                  moe_experts=0, moe_capacity=2.0, **kwargs):
