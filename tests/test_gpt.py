@@ -159,6 +159,17 @@ def test_gpt_gluon_spmd_dp():
         assert len(arr.sharding.device_set) == 8, name
 
 
+def _greedy_oracle(net, prompt, n_new):
+    """Greedy decoding by full recompute through the gluon forward —
+    the reference every KV-cache/prefill test compares against."""
+    ref = prompt.copy()
+    for _ in range(n_new):
+        logits = net(mx.nd.array(ref, dtype="int32")).asnumpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        ref = np.concatenate([ref, nxt[:, None]], axis=1)
+    return ref
+
+
 def test_gpt_generate_kv_cache_matches_full_recompute():
     """Greedy KV-cache decoding must produce exactly the tokens the
     O(T^2) full-context forward picks at each step."""
@@ -172,13 +183,8 @@ def test_gpt_generate_kv_cache_matches_full_recompute():
     assert out.shape == (2, 5 + n_new)
     np.testing.assert_array_equal(out[:, :5], prompt)
 
-    # reference: greedy with full recompute through the gluon forward
-    ref = prompt.copy()
-    for _ in range(n_new):
-        logits = net(mx.nd.array(ref, dtype="int32")).asnumpy()
-        nxt = logits[:, -1].argmax(-1).astype(np.int32)
-        ref = np.concatenate([ref, nxt[:, None]], axis=1)
-    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(out, _greedy_oracle(net, prompt,
+                                                      n_new))
 
 
 def test_gpt_generate_matches_recompute_small_geometry():
@@ -192,12 +198,8 @@ def test_gpt_generate_matches_recompute_small_geometry():
     prompt = rng.randint(0, 128, (1, 4)).astype(np.int32)
     n_new = 4
     out = gpt.generate(net, prompt, n_new)
-    ref = prompt.copy()
-    for _ in range(n_new):
-        logits = net(mx.nd.array(ref, dtype="int32")).asnumpy()
-        nxt = logits[:, -1].argmax(-1).astype(np.int32)
-        ref = np.concatenate([ref, nxt[:, None]], axis=1)
-    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(out, _greedy_oracle(net, prompt,
+                                                      n_new))
 
 
 def test_gpt_generate_no_bias_and_custom_prefix():
@@ -214,12 +216,22 @@ def test_gpt_generate_no_bias_and_custom_prefix():
     rng = np.random.RandomState(6)
     prompt = rng.randint(0, 32, (2, 3)).astype(np.int32)
     out = gpt.generate(net, prompt, 5)
-    ref = prompt.copy()
-    for _ in range(5):
-        logits = net(mx.nd.array(ref, dtype="int32")).asnumpy()
-        nxt = logits[:, -1].argmax(-1).astype(np.int32)
-        ref = np.concatenate([ref, nxt[:, None]], axis=1)
-    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(out, _greedy_oracle(net, prompt, 5))
+
+
+def test_gpt_generate_edge_regimes():
+    """n_new=1 (the runner's early return, no scan) and a single-token
+    prompt (T0=1 prefill) both match the full recompute."""
+    net = gpt.GPTLM(32, 2, 32, 4, max_len=24)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(9)
+
+    p_long = rng.randint(0, 32, (2, 7)).astype(np.int32)
+    np.testing.assert_array_equal(gpt.generate(net, p_long, 1),
+                                  _greedy_oracle(net, p_long, 1))
+    p_one = rng.randint(0, 32, (3, 1)).astype(np.int32)
+    np.testing.assert_array_equal(gpt.generate(net, p_one, 5),
+                                  _greedy_oracle(net, p_one, 5))
 
 
 def test_gpt_generate_sampled_deterministic():
