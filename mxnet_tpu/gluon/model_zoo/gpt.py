@@ -29,7 +29,8 @@ from .. import nn
 from ..block import HybridBlock
 
 __all__ = ["GPTBlock", "GPTLM", "get_gpt", "gpt2_tiny",
-           "gpt2_tiny_moe", "gpt2_small", "gpt2_medium"]
+           "gpt2_tiny_moe", "gpt2_small", "gpt2_medium",
+           "pack_sequences", "packed_positions", "generate"]
 
 
 class GPTBlock(HybridBlock):
@@ -221,16 +222,7 @@ class GPTLM(HybridBlock):
             # packed rows: positions restart at each segment boundary so
             # every document trains with the same wpe rows it would see
             # standalone (segments are contiguous per row)
-            import jax.numpy as _jnp
-            idx = _jnp.arange(t)[None, :]
-            seg = segments if not hasattr(segments, "_data") \
-                else segments._data
-            change = _jnp.concatenate(
-                [_jnp.ones_like(seg[:, :1], dtype=bool),
-                 seg[:, 1:] != seg[:, :-1]], axis=1)
-            start = _jnp.maximum.accumulate(
-                _jnp.where(change, idx, 0), axis=1)
-            pos = (idx - start).astype(_jnp.int32)
+            pos = packed_positions(segments)
             h = h + F.Embedding(pos, wpe, input_dim=self._max_len,
                                 output_dim=self._units)
         if self._dropout:
@@ -265,6 +257,22 @@ class GPTLM(HybridBlock):
 
 def _pad_vocab(v, mult=128):
     return (v + mult - 1) // mult * mult
+
+
+def packed_positions(segments):
+    """Per-row positions that RESTART at each segment boundary — the
+    wpe rows a packed document sees equal its standalone ones.  ONE
+    copy of this math: GPTLM's forward and the pipeline stage cutter
+    (parallel/gpt_pp.py) both call it.  segments [B, T] -> int32 [B, T]."""
+    import jax.numpy as jnp
+    seg = segments if not hasattr(segments, "_data") else segments._data
+    t = seg.shape[1]
+    idx = jnp.arange(t)[None, :]
+    change = jnp.concatenate(
+        [jnp.ones_like(seg[:, :1], dtype=bool),
+         seg[:, 1:] != seg[:, :-1]], axis=1)
+    start = jnp.maximum.accumulate(jnp.where(change, idx, 0), axis=1)
+    return (idx - start).astype(jnp.int32)
 
 
 def pack_sequences(docs, seq_len, pad_id=0):

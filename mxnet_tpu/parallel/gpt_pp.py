@@ -12,7 +12,8 @@ Two invariants the cut preserves:
   ``functionalize``d live :class:`GPTBlock` — the same traced graph the
   sequential model runs — so the pipeline cannot drift from the model
   (the embedding gather and the tied-head matmul, three lines each, are
-  the only re-expressed pieces, and the equality test pins them).
+  the only re-expressed pieces — packed position restart is shared via
+  ``gpt.packed_positions`` — and the equality tests pin them).
 - **Tied embeddings stay tied.** ``wte`` lives in BOTH the stage-0
   embed component and the stage-(S-1) head component of the union
   params; :func:`tie_wte_grad` sums the two slots' gradients —
@@ -41,7 +42,7 @@ def _strip_block_idx(name):
 
 
 def make_gpt_stages(net, n_stages, micro_batch, seq_len,
-                    compute_dtype=None, remat=False):
+                    compute_dtype=None, remat=False, packed=False):
     """Cut an initialized GPTLM into ``n_stages`` 1F1B stages.
 
     Returns ``(stage_params, stage_fns, wire, names)``:
@@ -60,6 +61,12 @@ def make_gpt_stages(net, n_stages, micro_batch, seq_len,
     ``remat=True`` wraps each block in ``jax.checkpoint`` so the 1F1B
     backward's stage recompute holds one block's activations at a time
     (identical math, tested; the long-sequence memory trade).
+
+    ``packed=True`` composes SEQUENCE PACKING with the pipeline: the
+    microbatch feed becomes the pytree ``(tokens, segments)`` (both
+    [mb, T] int32) — segments reach every stage's segment-masked
+    attention through the per-microbatch feed, and positions restart at
+    document boundaries exactly like ``GPTLM(tokens, segments)``.
     """
     from ..gluon.block import functionalize
     cdt = compute_dtype or jnp.float32
@@ -72,11 +79,13 @@ def make_gpt_stages(net, n_stages, micro_batch, seq_len,
     units = net._units
 
     h_ex = jnp.zeros((micro_batch, seq_len, units), cdt)
-    blk_fn, _ = functionalize(blocks[0], h_ex)
+    seg_ex = jnp.zeros((micro_batch, seq_len), jnp.int32)
+    blk_args = (h_ex, seg_ex) if packed else (h_ex,)
+    blk_fn, _ = functionalize(blocks[0], *blk_args)
     rel0 = [_strip_block_idx(n) for n in blk_fn.param_names]
     blk_params, blk_names = [], []
     for blk in blocks:
-        fn_i, params_i = functionalize(blk, h_ex)
+        fn_i, params_i = functionalize(blk, *blk_args)
         rel_i = [_strip_block_idx(n) for n in fn_i.param_names]
         if rel_i != rel0:
             raise AssertionError(
@@ -106,8 +115,8 @@ def make_gpt_stages(net, n_stages, micro_batch, seq_len,
                  "wte": _slot(wte, n_stages - 1)},
     }
 
-    def _one_block(ps, h):
-        (h,), _ = blk_fn(ps, h)
+    def _one_block(ps, h, seg=None):
+        (h,), _ = (blk_fn(ps, h, seg) if packed else blk_fn(ps, h))
         return h
 
     if remat:
@@ -118,25 +127,35 @@ def make_gpt_stages(net, n_stages, micro_batch, seq_len,
         # long-sequence pipeline memory trade
         _one_block = jax.checkpoint(_one_block)
 
-    def apply_chunk(blocks_local, h):
+    def apply_chunk(blocks_local, h, seg=None):
         for j in range(lps):
             ps = [leaf[j].astype(cdt) for leaf in blocks_local]
-            h = _one_block(ps, h)
+            h = _one_block(ps, h, seg)
         return h
 
+    def _split_feed(feed):
+        return feed if packed else (feed, None)
+
     def _embed(local, feed):
+        toks, seg = _split_feed(feed)
         e = local["embed"]
-        return e["wte"].astype(cdt)[feed] \
-            + e["wpe"].astype(cdt)[:seq_len]
+        wte = e["wte"].astype(cdt)
+        wpe = e["wpe"].astype(cdt)
+        if seg is None:
+            return wte[toks] + wpe[:seq_len]
+        # packed rows: THE position-restart math (one copy, gpt.py)
+        from ..gluon.model_zoo.gpt import packed_positions
+        return wte[toks] + wpe[packed_positions(seg)]
 
     def embed_stage(local, x, feed):
-        return apply_chunk(local["blocks"], _embed(local, feed))
+        return apply_chunk(local["blocks"], _embed(local, feed),
+                           _split_feed(feed)[1])
 
     def mid_stage(local, x, feed):
-        return apply_chunk(local["blocks"], x)
+        return apply_chunk(local["blocks"], x, _split_feed(feed)[1])
 
     def head_stage(local, x, feed):
-        h = apply_chunk(local["blocks"], x)
+        h = apply_chunk(local["blocks"], x, _split_feed(feed)[1])
         hd = local["head"]
         (h,), _ = lnf_fn([p.astype(cdt) for p in hd["lnf"]], h)
         # tied head: [mb·T, d] x [d, V] against the embedding table

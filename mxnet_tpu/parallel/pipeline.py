@@ -221,9 +221,11 @@ def _pipeline_1f1b_het_local(stage_params, microbatches, targets,
         raise ValueError("got %d stage_fns for a %d-stage pipeline"
                          % (len(stage_fns), n_stages))
     stage = lax.axis_index(axis)
-    n_micro = microbatches.shape[0]
-    stash_len = 2 * n_stages
     tmap = jax.tree_util.tree_map
+    # microbatches/targets may be PYTREES of [n_micro, ...] leaves
+    # (e.g. packed rows feed (tokens, segments) to every stage)
+    n_micro = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    stash_len = 2 * n_stages
     is_last = stage == n_stages - 1
 
     zeros_wire = tmap(lambda s: jnp.zeros(s.shape, s.dtype), wire)
@@ -258,9 +260,10 @@ def _pipeline_1f1b_het_local(stage_params, microbatches, targets,
         m_f = r - stage
         f_valid = jnp.logical_and(m_f >= 0, m_f < n_micro)
         m_f_c = jnp.clip(m_f, 0, n_micro - 1)
-        feed = lax.dynamic_index_in_dim(microbatches, m_f_c, 0,
-                                        keepdims=False)
-        tgt = lax.dynamic_index_in_dim(targets, m_f_c, 0, keepdims=False)
+        feed = tmap(lambda a: lax.dynamic_index_in_dim(
+            a, m_f_c, 0, keepdims=False), microbatches)
+        tgt = tmap(lambda a: lax.dynamic_index_in_dim(
+            a, m_f_c, 0, keepdims=False), targets)
         slot_f = m_f_c % stash_len
         stash = tmap(
             lambda st, xx: lax.dynamic_update_index_in_dim(
@@ -278,9 +281,10 @@ def _pipeline_1f1b_het_local(stage_params, microbatches, targets,
         m_b = r - 2 * (n_stages - 1) + stage
         b_valid = jnp.logical_and(m_b >= 0, m_b < n_micro)
         m_b_c = jnp.clip(m_b, 0, n_micro - 1)
-        feed_b = lax.dynamic_index_in_dim(microbatches, m_b_c, 0,
-                                          keepdims=False)
-        tgt_b = lax.dynamic_index_in_dim(targets, m_b_c, 0, keepdims=False)
+        feed_b = tmap(lambda a: lax.dynamic_index_in_dim(
+            a, m_b_c, 0, keepdims=False), microbatches)
+        tgt_b = tmap(lambda a: lax.dynamic_index_in_dim(
+            a, m_b_c, 0, keepdims=False), targets)
         slot_b = m_b_c % stash_len
         x_b = tmap(lambda st: lax.dynamic_index_in_dim(st, slot_b, 0,
                                                        keepdims=False),
@@ -388,9 +392,16 @@ def _shardmap_1f1b(local_call, stage_params, microbatches, targets,
     stage_params = tmap(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
         stage_params, place_specs)
-    microbatches = jax.device_put(microbatches,
-                                  NamedSharding(mesh, data_spec))
-    targets = jax.device_put(targets, NamedSharding(mesh, data_spec))
+    # microbatches/targets may be pytrees ([M, ...] leaves — packed
+    # rows feed (tokens, segments)); every leaf shares the data spec
+    microbatches = tmap(
+        lambda a: jax.device_put(a, NamedSharding(mesh, data_spec)),
+        microbatches)
+    targets = tmap(
+        lambda a: jax.device_put(a, NamedSharding(mesh, data_spec)),
+        targets)
+    mb_specs = tmap(lambda a: data_spec, microbatches)
+    tg_specs = tmap(lambda a: data_spec, targets)
 
     def fn(sp, mb, tg):
         local = tmap(lambda p: p[0], sp)
@@ -404,7 +415,7 @@ def _shardmap_1f1b(local_call, stage_params, microbatches, targets,
         return loss, grads
     mapped = shard_map(
         fn, mesh=mesh,
-        in_specs=(param_specs, data_spec, data_spec),
+        in_specs=(param_specs, mb_specs, tg_specs),
         out_specs=(P(), param_specs),
         check_rep=False,
         axis_names=axis_names)
