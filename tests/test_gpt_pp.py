@@ -255,6 +255,32 @@ def test_gpt_1f1b_packed_matches_sequential():
                  dict(zip(fn.param_names, ref_grads)))
 
 
+def test_write_back_roundtrip():
+    """make_gpt_stages -> write_back is the identity on the net's
+    parameters (the inverse mapping used after pipeline training)."""
+    net, vocab, t = _make_net(n_layers=4)
+    before = {k: p.data().asnumpy().copy()
+              for k, p in net.collect_params().items()}
+    stage_params, _, _, names = par.gpt_pp.make_gpt_stages(net, 2, 2, t)
+    par.gpt_pp.write_back(net, stage_params, names)
+    after = {k: p.data().asnumpy() for k, p in net.collect_params().items()}
+    assert set(before) == set(after)
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+
+
+def test_loss_mask_all_pad_is_finite():
+    """A batch whose mask is all-zero (e.g. a pad-only shard) must give
+    a finite loss, not NaN (the masked mean's denominator guard)."""
+    from mxnet_tpu.parallel import gpt_spmd
+    segs = jnp.zeros((2, 8), jnp.int32)          # all padding
+    mask = gpt_spmd.loss_mask_from_segments(segs)
+    assert float(mask.sum()) == 0.0
+    nll = jnp.ones((2, 8), jnp.float32)
+    masked = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    assert np.isfinite(float(masked))
+
+
 def test_het_pipeline_rejects_wrong_stage_count():
     net, vocab, t = _make_net(n_layers=4)
     with pytest.raises(ValueError):
