@@ -256,29 +256,43 @@ def test_gpt_1f1b_packed_matches_sequential():
 
 
 def test_write_back_roundtrip():
-    """make_gpt_stages -> write_back is the identity on the net's
-    parameters (the inverse mapping used after pipeline training)."""
+    """write_back maps every union slot onto its net parameter: after
+    perturbing ALL stage leaves by +1, every net param must equal its
+    original value + 1 — an omitted or cross-wired write fails."""
     net, vocab, t = _make_net(n_layers=4)
     before = {k: p.data().asnumpy().copy()
               for k, p in net.collect_params().items()}
     stage_params, _, _, names = par.gpt_pp.make_gpt_stages(net, 2, 2, t)
-    par.gpt_pp.write_back(net, stage_params, names)
-    after = {k: p.data().asnumpy() for k, p in net.collect_params().items()}
+    bumped = jax.tree_util.tree_map(lambda p: p + 1.0, stage_params)
+    par.gpt_pp.write_back(net, bumped, names)
+    after = {k: p.data().asnumpy()
+             for k, p in net.collect_params().items()}
     assert set(before) == set(after)
     for k in before:
-        np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+        np.testing.assert_allclose(after[k], before[k] + 1.0,
+                                   rtol=1e-6, err_msg=k)
 
 
 def test_loss_mask_all_pad_is_finite():
-    """A batch whose mask is all-zero (e.g. a pad-only shard) must give
-    a finite loss, not NaN (the masked mean's denominator guard)."""
+    """An all-pad batch (mask sums to zero) must give a finite loss
+    through the PRODUCTION masked-mean in make_train_step, not NaN."""
     from mxnet_tpu.parallel import gpt_spmd
-    segs = jnp.zeros((2, 8), jnp.int32)          # all padding
+
+    net, vocab, t = _make_net(n_layers=2)
+    toks = jnp.zeros((4, t), jnp.int32)
+    segs = jnp.zeros((4, t), jnp.int32)          # all padding
     mask = gpt_spmd.loss_mask_from_segments(segs)
     assert float(mask.sum()) == 0.0
-    nll = jnp.ones((2, 8), jnp.float32)
-    masked = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    assert np.isfinite(float(masked))
+    fn, params = functionalize(net, toks, segs, train=True)
+    mesh = par.make_mesh(dp=2, tp=4)
+    init_fn, step_fn = gpt_spmd.make_train_step(fn, mesh, lr=0.01)
+    with mesh:
+        ps, opt = init_fn(params)
+        batch = {k: gpt_spmd.shard_batch(v, mesh)
+                 for k, v in (("x", toks), ("y", toks),
+                              ("segments", segs), ("mask", mask))}
+        _, _, loss = step_fn(ps, opt, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
 
 
 def test_het_pipeline_rejects_wrong_stage_count():
