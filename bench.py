@@ -419,6 +419,34 @@ def bench_pipeline():
     }))
 
 
+def bench_steptrace():
+    """BENCH_MODE=steptrace: per-step XLA dispatch/compile counts of the
+    fused Module.fit_step vs the split forward_backward+update pair on a
+    small MLP fit loop — the regression tail for BENCH_*.json (the fused
+    path must stay at exactly 1 dispatch/step, 0 steady-state compiles;
+    see PERF.md, "Fused train step")."""
+    import jax
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools", "perf_probe"))
+    import steptrace as _steptrace
+
+    jax.devices()
+    _disarm_watchdog()
+    result = _steptrace.run()
+    fused = result["fused"]
+    unfused = result["unfused"]
+    print(json.dumps({
+        "metric": "fused_step_dispatches_per_step",
+        "value": round(fused["dispatches_per_step"], 3),
+        "unit": "dispatches/step (steady state; unfused=%s; %d params)"
+                % (round(unfused["dispatches_per_step"], 3),
+                   result["n_params"]),
+        # 1.0 == the fused-path contract; anything above is a regression
+        "vs_baseline": round(fused["dispatches_per_step"] / 1.0, 3),
+        "steptrace": result,
+    }))
+
+
 def main():
     mode = os.environ.get("BENCH_MODE")
     network = os.environ.get("BENCH_NETWORK", "resnet50_v1")
@@ -428,6 +456,7 @@ def main():
     metric, unit = {
         "attention": ("flash_attention_train_tflops", "TFLOP/s"),
         "pipeline": ("input_pipeline_images_per_sec", "img/s"),
+        "steptrace": ("fused_step_dispatches_per_step", "dispatches/step"),
         "transformer": (_gpt_metric()[1] if mode == "transformer"
                         else "", "tok/s"),
         "generate": (_gpt_metric("generate")[1] if mode == "generate"
@@ -436,7 +465,9 @@ def main():
     _install_init_watchdog(metric, unit)
     try:
         _run_mode(mode, network)
-    except SystemExit:
+    except (SystemExit, KeyboardInterrupt):
+        # the driver-row guarantee below is for genuine failures only;
+        # Ctrl-C keeps its conventional interrupt exit (ADVICE r5)
         raise
     except BaseException as e:  # noqa: BLE001 — the driver needs a row
         # a mid-run failure (tunnel RPC death, compile error) must still
@@ -468,6 +499,9 @@ def _run_mode(mode, network):
         return
     if mode == "generate":
         bench_generate()
+        return
+    if mode == "steptrace":
+        bench_steptrace()
         return
     # bs 128 is the measured single-chip sweet spot on v5e (PERF.md:
     # 2379 img/s vs 2263 at bs 256, 2114 at bs 512)

@@ -49,7 +49,7 @@ from . import image
 from . import rnn
 from . import profiler
 from . import monitor
-from .monitor import Monitor
+from .monitor import Monitor, StepStatsMonitor
 from . import visualization
 from . import visualization as viz
 from . import gluon

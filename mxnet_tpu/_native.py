@@ -12,7 +12,9 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import subprocess
+import tempfile
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -95,17 +97,48 @@ def get_lib():
                 # a STALE prebuilt .so lacking newly-declared symbols
                 # (dlsym miss) — rebuild once rather than killing every
                 # native-IO caller
-                _lib = None
                 try:
-                    subprocess.run(["make", "-C", _SRC_DIR, "-B"],
-                                   check=True, capture_output=True,
-                                   timeout=300)
-                    _lib = _declare(ctypes.CDLL(_LIB_PATH))
+                    _lib = _rebuild_stale_lib()
                 except Exception:
                     _lib = None
             except OSError:
                 _lib = None
         return _lib
+
+
+def _rebuild_stale_lib():
+    """Recover from a stale libmxtpu.so already mapped in this process.
+
+    Two traps in the naive rebuild-in-place-and-re-CDLL fix (ADVICE r5):
+    (1) make relinking over a .so currently mapped by this or another
+    process can SIGBUS readers of the truncated file — so the rebuild
+    links to a temporary path on the same filesystem and os.replace()s it
+    into place atomically (the old image stays mapped, unharmed);
+    (2) dlopen caches by pathname, so re-CDLLing _LIB_PATH just returns
+    the stale in-process image — so we load from a process-unique copy,
+    whose pathname dlopen has never seen.
+    """
+    lib_dir = os.path.dirname(_LIB_PATH)
+    build_dir = tempfile.mkdtemp(prefix=".mxtpu_rebuild_", dir=lib_dir)
+    try:
+        tmp_out = os.path.join(build_dir, "libmxtpu.so")
+        subprocess.run(["make", "-C", _SRC_DIR, "-B", "OUT=%s" % tmp_out],
+                       check=True, capture_output=True, timeout=300)
+        os.replace(tmp_out, _LIB_PATH)  # same fs: atomic
+    finally:
+        shutil.rmtree(build_dir, ignore_errors=True)
+    fd, unique = tempfile.mkstemp(prefix="libmxtpu_%d_" % os.getpid(),
+                                  suffix=".so")
+    os.close(fd)
+    try:
+        shutil.copy2(_LIB_PATH, unique)
+        return _declare(ctypes.CDLL(unique))
+    finally:
+        # the mapping outlives the unlink on Linux; no on-disk litter
+        try:
+            os.unlink(unique)
+        except OSError:
+            pass
 
 
 def available():

@@ -212,6 +212,13 @@ class Executor:
 
         return graph_fn
 
+    @staticmethod
+    def _instrument(fn):
+        """Dispatch/compile accounting around a jitted program (shapes
+        are fixed at bind time, so first call == the one XLA compile)."""
+        from . import profiler as _profiler
+        return _profiler.instrument(fn)
+
     def _fwd(self, train):
         fn = self._fwd_cache.get(train)
         if fn is None:
@@ -220,7 +227,7 @@ class Executor:
             if not self._staged:
                 # staged (multi-device ctx_group) binds run eagerly:
                 # jit would collapse placement onto one device
-                fn = jax.jit(fn)
+                fn = self._instrument(jax.jit(fn))
             self._fwd_cache[train] = fn
         return fn
 
@@ -265,8 +272,8 @@ class Executor:
             return vjp(tuple(ograds))[0]
 
         if not self._staged:
-            fwd_lin = jax.jit(fwd_lin)
-            bwd_apply = jax.jit(bwd_apply)
+            fwd_lin = self._instrument(jax.jit(fwd_lin))
+            bwd_apply = self._instrument(jax.jit(bwd_apply))
         self._lin_fns = (fwd_lin, bwd_apply)
         return self._lin_fns
 
@@ -280,7 +287,7 @@ class Executor:
             return outs, new_aux, grads
 
         if not self._staged:
-            grad_fn = jax.jit(grad_fn)
+            grad_fn = self._instrument(jax.jit(grad_fn))
         self._grad_fn = grad_fn
         return grad_fn
 
@@ -438,6 +445,54 @@ class Executor:
             else:
                 dst._set_data(g)
         return self.outputs
+
+    def make_fit_step(self, update_names, apply_fn):
+        """Build the fused donated train-step program: forward + backward +
+        tree-wide optimizer apply traced into ONE jitted XLA program.
+
+        This is the single-dispatch-per-batch hot path the per-param
+        update loop (module.update → one XLA kernel per parameter) cannot
+        reach: XLA sees the whole step, fuses the optimizer arithmetic
+        into the backward epilogue, and ``donate_argnums`` on params /
+        optimizer state / aux turns every update into an in-place HBM
+        write (the reference's PlanMemory inplace discipline).
+
+        ``update_names``  — grad_req='write' parameters the step updates.
+        ``apply_fn(params, grads, state, lr, wd, rescale, t)``
+                          — pure tree-wide optimizer apply
+                            (ops.optimizer_ops.make_fused_apply).
+
+        Returns ``step(param_vals, opt_state, other_vals, aux_vals, rng,
+        lr, wd, rescale, t) -> (outs, new_params, new_state, new_aux)``
+        where new_aux covers ALL aux states (unchanged ones pass through,
+        so donated aux buffers stay owned by the caller's write-back).
+        """
+        plan = self._plan
+        update_names = tuple(update_names)
+
+        def step(param_vals, opt_state, other_vals, aux_vals, rng,
+                 lr, wd, rescale, t):
+            def f(p):
+                merged = dict(other_vals)
+                merged.update(p)
+                outs, new_aux = plan(merged, aux_vals, rng, True)
+                return tuple(outs), new_aux
+
+            outs, vjp, new_aux = jax.vjp(f, param_vals, has_aux=True)
+            # loss heads seed with ones, exactly like forward_backward's
+            # default out_grads — fused and unfused paths share semantics
+            ograds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            grads = vjp(ograds)[0]
+            new_params, new_state = apply_fn(param_vals, grads, opt_state,
+                                             lr, wd, rescale, t)
+            merged_aux = dict(aux_vals)
+            merged_aux.update(new_aux)
+            return outs, new_params, new_state, merged_aux
+
+        if self._staged:
+            return step  # eager multi-device ctx_group binds can't donate
+        return self._instrument(
+            jax.jit(step, donate_argnums=(0, 1, 3)))
 
     # -- parameter management ----------------------------------------------
     @property

@@ -3,9 +3,16 @@
 Batches a Dataset through a Sampler.  The reference (0.11) is
 single-process; later versions added multiprocessing workers.  Here the
 batchification keeps everything in numpy until the final device_put of the
-full batch — one transfer per batch, TPU-friendly.
+full batch — one transfer per batch, TPU-friendly — and a double-buffered
+background prefetcher (``prefetch``, default 2) overlaps the host-side
+sample gather + batchify + host→device transfer of batch N+1 with the
+device compute of batch N, the role the reference's ThreadedIter /
+PrefetcherIter played for the C++ pipeline (src/io/iter_prefetcher.h).
 """
 from __future__ import annotations
+
+import queue as _queue
+import threading
 
 import numpy as _np
 
@@ -26,10 +33,101 @@ def default_batchify_fn(data):
     return nd.array(data, dtype=data.dtype)
 
 
+def _device_put_batch(batch):
+    """Start the async host→device transfer for every array in the batch
+    (jax.device_put returns immediately; by the time the consumer uses the
+    batch the copy has overlapped with compute)."""
+    import jax
+    if isinstance(batch, (list, tuple)):
+        for b in batch:
+            _device_put_batch(b)
+        return batch
+    if isinstance(batch, nd.NDArray):
+        batch._set_data(jax.device_put(batch._data))
+    return batch
+
+
+class _PrefetchIter:
+    """Double-buffered iterator: a daemon thread stays ``depth`` batches
+    ahead, so batchify + device_put of the next batch runs while the
+    caller trains on the current one.  Worker exceptions re-raise at the
+    point of consumption, preserving the sequential path's semantics.
+    Abandoned iteration (a peeked batch, an early ``break``) must not pin
+    the worker + its queued device batches for the process lifetime, so
+    the producer polls a stop flag and ``close()``/``__del__`` drain."""
+
+    _SENTINEL = object()
+
+    def __init__(self, make_batches, depth):
+        self._q = _queue.Queue(maxsize=depth)
+        self._done = False
+        self._stop = threading.Event()
+        # the worker closes over LOCALS only — capturing self would cycle
+        # (self._worker -> closure -> self) and defer the __del__ cleanup
+        # below to a cyclic-GC pass instead of refcount drop
+        q, stop, sentinel = self._q, self._stop, self._SENTINEL
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def work():
+            try:
+                for batch in make_batches():
+                    if not put(_device_put_batch(batch)):
+                        return
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                put(e)
+                return
+            put(sentinel)
+
+        self._worker = threading.Thread(target=work, daemon=True)
+        self._worker.start()
+
+    def close(self):
+        """Unblock and retire the worker; free queued batches."""
+        self._done = True
+        self._stop.set()
+        try:
+            # a put() already past its stop check can still land one item;
+            # join first (the worker exits within one 0.1 s poll) so the
+            # drain below really empties the queue
+            self._worker.join(timeout=2.0)
+        except Exception:
+            pass  # interpreter shutdown
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+
+    __del__ = close
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._SENTINEL:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        return item
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
-                 batchify_fn=None, num_workers=0):
+                 batchify_fn=None, num_workers=0, prefetch=2):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -55,10 +153,16 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn if batchify_fn is not None \
             else default_batchify_fn
+        self._prefetch = max(0, int(prefetch))
 
-    def __iter__(self):
+    def _make_batches(self):
         for batch in self._batch_sampler:
             yield self._batchify_fn([self._dataset[idx] for idx in batch])
+
+    def __iter__(self):
+        if self._prefetch == 0:
+            return self._make_batches()
+        return _PrefetchIter(self._make_batches, self._prefetch)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -3,8 +3,13 @@
 Port of /root/reference/python/mxnet/gluon/trainer.py (:26-121): applies an
 Optimizer to a ParameterDict, optionally aggregating gradients through a
 KVStore.  On TPU a single process sees the whole mesh, so the kvstore path
-only matters for the dist facade; the common path is a direct optimizer
-step per parameter — each update op is a jitted XLA kernel.
+only matters for the dist facade.  The common (no-kvstore) path applies the
+whole optimizer step as ONE donated jitted XLA program over the full
+parameter pytree — a single dispatch per step instead of one jitted update
+kernel per parameter; per-param lr_mult/wd_mult are baked in as a static
+aux tree while lr / rescale_grad stay dynamic scalars.  Configurations the
+tree-wide apply can't express (sparse grads, non-fusable optimizers,
+kvstore aggregation) keep the per-param loop.
 """
 from __future__ import annotations
 
@@ -59,6 +64,7 @@ class Trainer:
         self._optimizer.set_lr_mult(lr_mult)
         self._optimizer.set_wd_mult(wd_mult)
         self._updaters = opt.get_updater(self._optimizer)
+        self._fused = None  # fused tree-wide step cache
 
     def _init_kvstore(self):
         arg_arrays = {param.name: param.data() for param in self._params
@@ -91,6 +97,8 @@ class Trainer:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
 
+        if self._kv is None and self._fused_step():
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -102,6 +110,89 @@ class Trainer:
                 self._kv.pull(i, param.list_grad())
             self._updaters(i, param.grad(), param.data())
 
+    # -- fused tree-wide step ----------------------------------------------
+    def _fused_step(self):
+        """Apply the whole optimizer step as ONE donated jitted program
+        over the parameter pytree.  Returns False when the configuration
+        can't fuse (caller then runs the per-param loop)."""
+        def bail():
+            # falling back to the per-param loop: hand accumulated fused
+            # state to the Updater (else it create_states fresh zeros)
+            # and drop the cache so a later fused return re-seeds from it
+            self._fused_flush_to_updater()
+            self._fused = None
+            return False
+
+        optimizer = self._optimizer
+        kind = optimizer.fused_kind()
+        if kind is None:
+            return bail()
+        from ..ndarray.sparse import RowSparseNDArray
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not live:
+            return True  # nothing to update — and nothing to dispatch
+        if len({id(p) for _, p in live}) != len(live):
+            return bail()  # duplicated Parameter: donation would alias
+        if any(isinstance(p.grad(), RowSparseNDArray) for _, p in live):
+            return bail()  # lazy/sparse updates keep the per-param path
+
+        import jax
+        from .. import profiler as _profiler
+
+        # params are keyed by their updater index so state save/load and
+        # the mult resolution (Trainer seeds lr_mult by index) line up
+        keys = [str(i) for i, _ in live]
+        idx2key = {i: str(i) for i, _ in live}
+        mults = optimizer.fused_mults(idx2key)
+        cache_key = (id(optimizer), kind, tuple(keys),
+                     tuple(sorted(mults.items())),
+                     tuple(sorted(optimizer.fused_hyper().items())),
+                     tuple(p.shape for _, p in live))
+        if self._fused is None or self._fused["key"] != cache_key:
+            # a reconfiguration (new mults, frozen param...) rebuilds the
+            # program; park accumulated momentum/Adam state in the Updater
+            # first so the re-seed below picks it up instead of zeros
+            self._fused_flush_to_updater()
+            init_state, apply_fn = optimizer.make_fused_apply(idx2key)
+            raw = {k: p.data()._data for k, (_, p) in zip(keys, live)}
+            state = init_state(raw)
+            if self._updaters.states:
+                from ..optimizer import fused_state_from_updater
+                for i, p in live:
+                    if i in self._updaters.states:
+                        state[str(i)] = fused_state_from_updater(
+                            kind, self._updaters.states[i], p.data())
+            self._fused = {
+                "key": cache_key, "kind": kind, "state": state,
+                "step": _profiler.instrument(
+                    jax.jit(apply_fn, donate_argnums=(0, 2)))}
+
+        fused = self._fused
+        params = {str(i): p.data()._data for i, p in live}
+        grads = {str(i): p.grad()._data for i, p in live}
+        first = live[0][0]
+        for i, _ in live:
+            optimizer._update_count(i)
+        t = float(optimizer._index_update_count[first])
+        new_params, new_state = fused["step"](
+            params, grads, fused["state"], optimizer.fused_base_lr(),
+            float(optimizer.wd), float(optimizer.rescale_grad), t)
+        fused["state"] = new_state
+        for i, p in live:
+            p.data()._set_data(new_params[str(i)])
+        _profiler.note_step()
+        return True
+
+    def _fused_flush_to_updater(self):
+        if self._fused is None:
+            return
+        from ..optimizer import fused_state_to_updater
+        kind = self._fused["kind"]
+        for key, st in self._fused["state"].items():
+            self._updaters.states[int(key)] = \
+                fused_state_to_updater(kind, st)
+
     def save_states(self, fname):
         assert self._optimizer is not None
         if not self._kv_initialized:
@@ -109,6 +200,7 @@ class Trainer:
         if self._update_on_kvstore:
             self._kv.save_optimizer_states(fname, dump_optimizer=True)
         else:
+            self._fused_flush_to_updater()
             with open(fname, "wb") as fout:
                 fout.write(self._updaters.get_states())
 
@@ -121,3 +213,4 @@ class Trainer:
         else:
             with open(fname, "rb") as f:
                 self._updaters.set_states(f.read())
+            self._fused = None  # re-seed fused state from the Updater

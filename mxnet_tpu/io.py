@@ -907,6 +907,19 @@ class ImageDetRecordIter(ImageRecordIter):
                         "record label too short for detection: %d floats"
                         % lab.size)
                 a, b = int(lab[0]), int(lab[1])
+                # mirror the native ParseOneDet header checks: a is the
+                # header length (>= 2), b the per-object width (>= 5 for
+                # id + 4 box coords); a classification .rec here would
+                # otherwise divide by zero or yield negative counts
+                if a < 2 or b < 5:
+                    raise MXNetError(
+                        "invalid detection record header: header length "
+                        "%d (need >= 2), object width %d (need >= 5) — "
+                        "is this a detection .rec file?" % (a, b))
+                if a > lab.size:
+                    raise MXNetError(
+                        "invalid detection record header: header length "
+                        "%d exceeds label size %d" % (a, lab.size))
                 if not ow:
                     ow = b
                 mo = max(mo, (lab.size - a) // b)

@@ -64,6 +64,14 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def fit_step(self, data_batch):
+        """One full train step (forward + backward + optimizer update) —
+        the per-batch hot path of ``fit``.  Subclasses fuse this into a
+        single donated XLA program when the configuration allows
+        (Module.fit_step); the default is the classic split pair."""
+        self.forward_backward(data_batch)
+        self.update()
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
@@ -180,8 +188,7 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                self.fit_step(data_batch)
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch)

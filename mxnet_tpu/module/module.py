@@ -70,6 +70,7 @@ class Module(BaseModule):
         self._update_on_kvstore = None
         self._updater = None
         self._preload_opt_states = None
+        self._fused = None  # fused fit_step cache (program + opt state)
 
         self._exec = None
         self._data_shapes = None
@@ -216,6 +217,10 @@ class Module(BaseModule):
         if self.binded:
             self.logger.warning("Already binded, ignoring bind()")
             return
+        # the fused step program closes over the executor being replaced;
+        # optimizer state (plain jnp arrays) survives via _fused_setup
+        self._fused_flush_to_updater()
+        self._fused = None
 
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
@@ -327,6 +332,7 @@ class Module(BaseModule):
             self._updater = opt.get_updater(optimizer)
 
         self.optimizer_initialized = True
+        self._fused = None  # rebuilt lazily against the new optimizer
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
@@ -359,10 +365,168 @@ class Module(BaseModule):
         feeds = self._feed_batch(data_batch)
         self._exec.forward_backward(**feeds)
 
+    # -- fused fit step ----------------------------------------------------
+    def _fused_eligible(self):
+        """Can this configuration run fwd+bwd+update as ONE donated XLA
+        program?  kvstore aggregation, grad_req='add' accumulation,
+        inputs_need_grad, installed monitors, staged (multi-ctx-group)
+        binds, and non-fusable optimizers all keep the split path."""
+        if self._kvstore is not None or self._update_on_kvstore:
+            return False
+        if self._optimizer is None or self._optimizer.fused_kind() is None:
+            return False
+        if self._exec is None or self._exec._staged:
+            return False
+        if self._exec._monitor_callback is not None:
+            return False
+        if self.inputs_need_grad:
+            return False
+        for name in self._param_names:
+            if self._exec._grad_req.get(name, "null") not in ("write",
+                                                              "null"):
+                return False
+        return True
+
+    def _fused_update_names(self):
+        return [n for n in self._param_names
+                if self._exec._grad_req.get(n) == "write"]
+
+    def _fused_setup(self):
+        """(Re)build the fused step program + optimizer state.  The cache
+        key covers everything baked statically into the program
+        (optimizer identity/kind and the per-param mult aux tree);
+        lr / wd / rescale_grad / t stay dynamic so schedulers never force
+        a rebuild."""
+        opt = self._optimizer
+        kind = opt.fused_kind()
+        update_names = self._fused_update_names()
+        idx2name = {i: n for i, n in enumerate(self._param_names)
+                    if n in set(update_names)}
+        mults = opt.fused_mults(idx2name)
+        key = (id(opt), kind, tuple(update_names),
+               tuple(sorted(mults.items())),
+               tuple(sorted(opt.fused_hyper().items())))
+        if self._fused is not None and self._fused["key"] == key:
+            return self._fused
+        init_state, apply_fn = opt.make_fused_apply(idx2name)
+        params = {n: self._exec.arg_dict[n] for n in update_names}
+        if self._fused is not None and self._fused["kind"] == kind and \
+                set(self._fused["state"]) == set(update_names):
+            state = self._fused["state"]  # mults changed; state carries
+        else:
+            state = self._fused_state_from_updater(kind, init_state, params)
+        self._fused = {
+            "key": key, "kind": kind, "update_names": update_names,
+            "state": state,
+            "step": self._exec.make_fit_step(update_names, apply_fn),
+        }
+        return self._fused
+
+    def _fused_state_from_updater(self, kind, init_state, params):
+        """Seed fused optimizer state, adopting any state the Updater
+        already holds (e.g. from load_optimizer_states)."""
+        # _raw commits params to their mesh placement first, so
+        # zeros_like state inherits it (mixed committed devices would
+        # fail the jitted fused step)
+        raw = self._exec._raw(params)
+        state = init_state(raw)
+        if self._updater is not None and self._updater.states:
+            from ..optimizer import fused_state_from_updater
+            for i, name in enumerate(self._param_names):
+                if name in state and i in self._updater.states:
+                    state[name] = fused_state_from_updater(
+                        kind, self._updater.states[i], params[name])
+        if self._exec._mesh is not None:
+            # align every state leaf (incl. Updater-loaded ones) with its
+            # param's sharding
+            import jax
+            state = {
+                name: jax.tree_util.tree_map(
+                    lambda s: jax.device_put(s, raw[name].sharding), st)
+                for name, st in state.items()}
+        return state
+
+    def _fused_flush_to_updater(self):
+        """Mirror fused optimizer state back into the Updater's per-index
+        dict so save_optimizer_states round-trips across paths."""
+        if self._fused is None or self._updater is None:
+            return
+        from ..optimizer import fused_state_to_updater
+        kind = self._fused["kind"]
+        for i, name in enumerate(self._param_names):
+            if name in self._fused["state"]:
+                self._updater.states[i] = fused_state_to_updater(
+                    kind, self._fused["state"][name])
+
+    def fit_step(self, data_batch):
+        """One donated XLA program per batch: fwd + bwd + optimizer.
+
+        The BaseModule.fit hot loop calls this instead of the
+        forward_backward()/update() pair; ineligible configurations fall
+        back to exactly that pair.  Steady state: ONE dispatch, zero
+        compiles (profiler.step_stats proves it)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        if not self._fused_eligible():
+            return super().fit_step(data_batch)
+        from .. import profiler as _profiler
+        from .. import random as _random
+        from ..ndarray.ndarray import NDArray
+
+        fused = self._fused_setup()
+        exe = self._exec
+        feeds = self._feed_batch(data_batch)
+        for k, v in feeds.items():
+            exe.arg_dict[k]._set_data(
+                v._data if isinstance(v, nd.NDArray) else
+                nd.array(v)._data)
+
+        update_names = fused["update_names"]
+        in_update = set(update_names)
+        param_vals = exe._raw({n: exe.arg_dict[n] for n in update_names})
+        other_vals = exe._raw({n: a for n, a in exe.arg_dict.items()
+                               if n not in in_update})
+        aux_vals = exe._raw_aux()
+
+        opt = self._optimizer
+        first_idx = None
+        for i, name in enumerate(self._param_names):
+            if name in in_update:
+                opt._update_count(i)
+                if first_idx is None:
+                    first_idx = i
+        t = float(opt._index_update_count[first_idx]) \
+            if first_idx is not None else 1.0
+        lr = opt.fused_base_lr()
+        wd = float(opt.wd)
+        rescale = float(opt.rescale_grad)
+
+        rng = _random.next_key()
+        with _profiler._timed("module_fit_step") as timed:
+            outs, new_params, new_state, new_aux = fused["step"](
+                param_vals, fused["state"], other_vals, aux_vals, rng,
+                lr, wd, rescale, t)
+            timed.sync_arrays = outs
+        fused["state"] = new_state
+        # donated inputs are dead now — re-point every wrapper at the
+        # step's outputs before anything else can touch them
+        for name, v in new_params.items():
+            exe.arg_dict[name]._set_data(v)
+        for name, v in new_aux.items():
+            exe.aux_dict[name]._set_data(v)
+        exe.outputs = [NDArray(o, exe._ctx) for o in outs]
+        self._params_dirty = True
+        _profiler.note_step()
+
     def update(self):
         """Apply optimizer using accumulated grads (reference module.py:615)."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        if self._fused is not None:
+            # momentum/mean/var accumulated by fused steps must seed the
+            # per-param Updater, and vice versa on the next fit_step
+            self._fused_flush_to_updater()
+            self._fused = None
         self._params_dirty = True
         param_arrays = [[self._exec.arg_dict[n]] for n in self._param_names]
         grad_arrays = [[self._exec.grad_dict.get(n)]
@@ -413,6 +577,7 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
+            self._fused_flush_to_updater()
             with open(fname, "wb") as fout:
                 fout.write(self._updater.get_states())
 
@@ -423,6 +588,7 @@ class Module(BaseModule):
         else:
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
+            self._fused = None  # re-seed fused state from the Updater
 
     def reshape(self, data_shapes, label_shapes=None):
         """Re-bind for new shapes (XLA re-jits; params carry over)."""

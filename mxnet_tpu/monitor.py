@@ -14,7 +14,38 @@ import re
 
 from .ndarray.ndarray import NDArray
 
-__all__ = ["Monitor"]
+__all__ = ["Monitor", "StepStatsMonitor"]
+
+
+class StepStatsMonitor(object):
+    """Periodic reporter over profiler.step_stats() — dispatch count,
+    compile count, and the step-time EMA maintained by the fused train
+    step.  Usable directly as a ``batch_end_callback`` in fit(); a healthy
+    fused loop shows dispatches growing by exactly 1 per step and zero
+    steady-state compiles (see PERF.md, "Fused train step").
+    """
+
+    def __init__(self, interval=50, logger=None):
+        self.interval = max(1, int(interval))
+        self.logger = logger or logging
+        self._nseen = 0
+        self._last = None
+
+    def __call__(self, param=None):
+        from . import profiler as _profiler
+        self._nseen += 1
+        if self._nseen % self.interval:
+            return
+        stats = _profiler.step_stats()
+        prev = self._last or {"dispatch_count": 0, "compile_count": 0}
+        ema = stats["step_time_ema_s"]
+        self.logger.info(
+            "step[%d] dispatches +%d compiles +%d step_time_ema %s",
+            self._nseen,
+            stats["dispatch_count"] - prev["dispatch_count"],
+            stats["compile_count"] - prev["compile_count"],
+            "%.2f ms" % (ema * 1e3) if ema is not None else "n/a")
+        self._last = stats
 
 
 class Monitor(object):

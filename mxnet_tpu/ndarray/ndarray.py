@@ -126,15 +126,30 @@ class NDArray:
     def copy(self):
         return NDArray(jnp.copy(self._data), self._ctx)
 
+    def _copied_to_device(self, device):
+        """A buffer-independent copy of self on ``device``.  A same-device
+        device_put REUSES the input buffer (possibly under a fresh Array
+        wrapper) — with the fused train step DONATING its parameter
+        buffers, an aliased 'copy' would be deleted out from under its
+        holder, so that case must materialize a real copy.  A cross-device
+        transfer already allocates a fresh buffer."""
+        data = self._data
+        try:
+            on_target = data.devices() == {device}
+        except Exception:
+            on_target = False  # tracers etc.: device_put decides
+        if on_target:
+            return jnp.copy(data)
+        return jax.device_put(data, device)
+
     def copyto(self, other):
         if isinstance(other, NDArray):
             if other is self:
                 raise MXNetError("cannot copy an array onto itself")
-            other._set_data(jax.device_put(self._data,
-                                           other._ctx.jax_device()))
+            other._set_data(self._copied_to_device(other._ctx.jax_device()))
             return other
         if isinstance(other, Context):
-            return NDArray(jax.device_put(self._data, other.jax_device()),
+            return NDArray(self._copied_to_device(other.jax_device()),
                            other)
         raise TypeError("copyto does not support type %s" % type(other))
 
@@ -446,6 +461,8 @@ def imperative_invoke(op_name, inputs, params, out=None):
         from .. import random as _random
         raw_inputs.append(_random.next_key())
 
+    from .. import profiler as _profiler
+    _profiler.count_dispatch()  # one XLA execution per imperative op call
     result = op.jitted(**params)(*raw_inputs)
     flat = list(result) if isinstance(result, (tuple, list)) else [result]
 

@@ -144,6 +144,80 @@ def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
     return new_weight, new_n, new_g, new_delta
 
 
+# -- tree-wide fused apply ---------------------------------------------------
+#
+# The per-op updates above dispatch one XLA kernel per parameter when called
+# imperatively (the reference's server-side/kvstore shape).  The fused train
+# step instead maps ONE update rule over the whole parameter pytree inside a
+# single jitted program: per-parameter lr_mult/wd_mult are baked in as a
+# static aux tree (they come from symbol attrs / Parameter objects and only
+# change on reconfiguration, which rebuilds the program), while lr / wd /
+# rescale_grad / t stay dynamic scalars so schedulers and Trainer.step's
+# 1/batch_size rescale never trigger a recompile.
+
+FUSED_KINDS = ("sgd", "sgd_mom", "adam")
+
+
+def make_fused_apply(kind, mults, momentum=0.0, beta1=0.9, beta2=0.999,
+                     epsilon=1e-8, clip_gradient=None):
+    """Build (init_state, apply) for a tree-wide optimizer update.
+
+    ``kind``  — one of FUSED_KINDS.
+    ``mults`` — static dict name -> (lr_mult, wd_mult).
+
+    init_state(params) -> state dict (name -> per-param state pytree)
+    apply(params, grads, state, lr, wd, rescale_grad, t)
+        -> (new_params, new_state); pure, jit/donation-friendly.  ``t`` is
+        the 1-based update count (Adam bias correction); unused by sgd.
+    """
+    if kind not in FUSED_KINDS:
+        raise ValueError("unsupported fused optimizer kind %r (want one of "
+                         "%s)" % (kind, list(FUSED_KINDS)))
+    mults = {k: (float(lm), float(wm)) for k, (lm, wm) in mults.items()}
+    clip = float(clip_gradient) if clip_gradient is not None and \
+        clip_gradient > 0 else None
+
+    def init_state(params):
+        if kind == "sgd":
+            return {name: () for name in params}
+        if kind == "sgd_mom":
+            return {name: jnp.zeros_like(w) for name, w in params.items()}
+        return {name: (jnp.zeros_like(w), jnp.zeros_like(w))
+                for name, w in params.items()}
+
+    def apply(params, grads, state, lr, wd, rescale_grad, t):
+        if kind == "adam":
+            # reference Adam bias correction folded into lr
+            # (optimizer.py Adam.update); t is dynamic so consecutive
+            # steps reuse the same program
+            lr = lr * jnp.sqrt(1.0 - beta2 ** t) / (1.0 - beta1 ** t)
+        new_params, new_state = {}, {}
+        for name in params:
+            w, g = params[name], grads[name]
+            lm, wm = mults.get(name, (1.0, 1.0))
+            p_lr, p_wd = lr * lm, wd * wm
+            if kind == "sgd":
+                new_params[name] = _sgd_update(
+                    w, g, lr=p_lr, wd=p_wd, rescale_grad=rescale_grad,
+                    clip_gradient=clip)
+                new_state[name] = ()
+            elif kind == "sgd_mom":
+                new_params[name], new_state[name] = _sgd_mom_update(
+                    w, g, state[name], lr=p_lr, momentum=momentum, wd=p_wd,
+                    rescale_grad=rescale_grad, clip_gradient=clip)
+            else:
+                mean, var = state[name]
+                new_w, new_mean, new_var = _adam_update(
+                    w, g, mean, var, lr=p_lr, beta1=beta1, beta2=beta2,
+                    epsilon=epsilon, wd=p_wd, rescale_grad=rescale_grad,
+                    clip_gradient=clip)
+                new_params[name] = new_w
+                new_state[name] = (new_mean, new_var)
+        return new_params, new_state
+
+    return init_state, apply
+
+
 @register_op("ftrl_update", arg_names=("weight", "grad", "z", "n"),
              num_outputs=3,
              param_defaults={"lr": 0.1, "lamda1": 0.01, "beta": 1.0,

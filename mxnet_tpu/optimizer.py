@@ -125,6 +125,56 @@ class Optimizer:
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
 
+    # -- fused train-step support -----------------------------------------
+    # Optimizers that can run as a tree-wide update inside ONE donated XLA
+    # program (executor.fit_step / Trainer fused step) declare a kind from
+    # ops.optimizer_ops.FUSED_KINDS.  Everything else (mixed precision,
+    # host-side state like Nadam's m_schedule) keeps the per-param path.
+    def fused_kind(self):
+        return None
+
+    def fused_hyper(self):
+        """Static hyperparameters closed over by the fused program."""
+        return {}
+
+    def fused_mults(self, index_to_name):
+        """Static {name: (lr_mult, wd_mult)} aux tree for the fused apply;
+        resolves exactly like _get_lr/_get_wd (index key wins over the
+        idx2name lookup)."""
+        out = {}
+        for index, name in index_to_name.items():
+            if index in self.lr_mult:
+                lm = self.lr_mult[index]
+            elif index in self.idx2name:
+                lm = self.lr_mult.get(self.idx2name[index], 1.0)
+            else:
+                lm = 1.0
+            if index in self.wd_mult:
+                wm = self.wd_mult[index]
+            elif index in self.idx2name:
+                wm = self.wd_mult.get(self.idx2name[index], 1.0)
+            else:
+                wm = 1.0
+            out[name] = (lm, wm)
+        return out
+
+    def make_fused_apply(self, index_to_name):
+        """(init_state, apply) over the named parameter tree, or None when
+        this optimizer configuration cannot fuse."""
+        kind = self.fused_kind()
+        if kind is None:
+            return None
+        from .ops.optimizer_ops import make_fused_apply as _make
+        return _make(kind, self.fused_mults(index_to_name),
+                     **self.fused_hyper())
+
+    def fused_base_lr(self):
+        """Dynamic base lr for the current step (scheduler-aware); the
+        fused program multiplies in the static per-param lr_mult."""
+        if self.lr_scheduler is not None:
+            return float(self.lr_scheduler(self.num_update))
+        return float(self.lr)
+
 
 register = Optimizer.register
 create = Optimizer.create_optimizer
@@ -154,6 +204,15 @@ class SGD(Optimizer):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
+
+    def fused_kind(self):
+        if self.multi_precision:
+            return None  # fp32 master copies keep the per-param mp path
+        return "sgd" if self.momentum == 0.0 else "sgd_mom"
+
+    def fused_hyper(self):
+        return {"momentum": self.momentum,
+                "clip_gradient": self.clip_gradient}
 
     def create_state(self, index, weight):
         if self.multi_precision and weight.dtype == numpy.float16:
@@ -199,6 +258,9 @@ class SGD(Optimizer):
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD (reference optimizer.py:469)."""
+
+    def fused_kind(self):
+        return None  # nesterov step differs from the fused sgd_mom rule
 
     def update(self, index, weight, grad, state):
         lr = self._get_lr(index)
@@ -275,6 +337,14 @@ class Adam(Optimizer):
         self.beta2 = beta2
         self.epsilon = epsilon
         self.lazy_update = lazy_update
+
+    def fused_kind(self):
+        return "adam"
+
+    def fused_hyper(self):
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon,
+                "clip_gradient": self.clip_gradient}
 
     def create_state(self, index, weight):
         return (zeros(weight.shape, dtype=weight.dtype),
@@ -568,3 +638,39 @@ class Updater:
 
 def get_updater(optimizer):
     return Updater(optimizer)
+
+
+# -- fused <-> Updater state bridging ---------------------------------------
+# The fused train step keeps optimizer state as raw jnp arrays keyed by
+# param name; the Updater keeps per-index NDArray state in the layout
+# create_state produces.  These converters keep save/load_optimizer_states
+# and kvstore hand-off working across both paths.
+
+def fused_state_from_updater(kind, state, weight):
+    """One Updater per-index state -> fused (jnp) form; zeros when the
+    Updater hasn't materialized it yet."""
+    import jax.numpy as jnp
+    if kind == "sgd":
+        return ()
+    if kind == "sgd_mom":
+        return state._data if state is not None else \
+            jnp.zeros_like(weight._data)
+    if kind == "adam":
+        if state is None:
+            z = jnp.zeros_like(weight._data)
+            return (z, z)
+        mean, var = state
+        return (mean._data, var._data)
+    raise ValueError("unknown fused kind %r" % kind)
+
+
+def fused_state_to_updater(kind, state):
+    """Fused (jnp) per-param state -> the layout create_state produces."""
+    if kind == "sgd":
+        return None
+    if kind == "sgd_mom":
+        return NDArray(state)
+    if kind == "adam":
+        mean, var = state
+        return (NDArray(mean), NDArray(var))
+    raise ValueError("unknown fused kind %r" % kind)

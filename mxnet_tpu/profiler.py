@@ -23,7 +23,9 @@ import threading
 import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "State", "set_config", "set_state", "pause", "resume"]
+           "State", "set_config", "set_state", "pause", "resume",
+           "count_dispatch", "count_compile", "note_step", "step_stats",
+           "reset_step_stats", "instrument"]
 
 _lock = threading.Lock()
 _state = "stop"
@@ -133,6 +135,91 @@ class _timed(object):
             end = time.perf_counter_ns() // 1000
             record_event(self.name, self.start, end - self.start)
         return False
+
+
+# -- step instrumentation (always on; a few integer adds per batch) --------
+#
+# The reference engine could count pushed ops per step; under XLA the
+# equivalent health metric is "how many compiled programs did this batch
+# dispatch, and did any of them recompile".  The fused fit path targets
+# exactly ONE dispatch per steady-state step (vs N params + 1 today), and
+# these counters are how bench.py / tools/perf_probe/steptrace.py prove it.
+_step_lock = threading.Lock()
+_dispatch_count = 0
+_compile_count = 0
+_step_count = 0
+_step_ema_s = None
+_last_step_t = None
+_EMA_ALPHA = 0.1
+
+
+def count_dispatch(n=1):
+    """Record n compiled-program dispatches (XLA executions).  Called by
+    the Executor around every jitted invocation and by imperative_invoke
+    for each eager op — so (dispatches per step) is comparable between the
+    fused and unfused train paths.  Lock-free on purpose: this sits on the
+    per-op hot path, and a GIL-raced increment merely miscounts telemetry
+    under concurrent eager threads."""
+    global _dispatch_count
+    _dispatch_count += n
+
+
+def count_compile(n=1):
+    """Record n XLA compilations (first execution of a (program, shape)
+    key).  Steady state should add zero."""
+    global _compile_count
+    _compile_count += n
+
+
+def note_step():
+    """Mark a train-step boundary; maintains an EMA of inter-step wall
+    time.  The first call only arms the clock."""
+    global _step_count, _step_ema_s, _last_step_t
+    now = time.perf_counter()
+    with _step_lock:
+        if _last_step_t is not None:
+            dt = now - _last_step_t
+            _step_ema_s = dt if _step_ema_s is None else \
+                (1 - _EMA_ALPHA) * _step_ema_s + _EMA_ALPHA * dt
+            _step_count += 1
+        _last_step_t = now
+
+
+def step_stats():
+    """Snapshot {dispatch_count, compile_count, steps, step_time_ema_s}."""
+    with _step_lock:
+        return {"dispatch_count": _dispatch_count,
+                "compile_count": _compile_count,
+                "steps": _step_count,
+                "step_time_ema_s": _step_ema_s}
+
+
+def reset_step_stats():
+    global _dispatch_count, _compile_count, _step_count, _step_ema_s, \
+        _last_step_t
+    with _step_lock:
+        _dispatch_count = 0
+        _compile_count = 0
+        _step_count = 0
+        _step_ema_s = None
+        _last_step_t = None
+
+
+def instrument(fn):
+    """Dispatch/compile accounting around a jitted program whose input
+    shapes are fixed for its lifetime (executor programs are bound to one
+    shape set; fused Trainer programs rebuild on shape change) — so the
+    first invocation IS its one XLA compile, and every invocation is one
+    dispatch."""
+    compiled = []
+
+    def wrapper(*args):
+        count_dispatch()
+        if not compiled:
+            compiled.append(True)
+            count_compile()
+        return fn(*args)
+    return wrapper
 
 
 def dump_profile():

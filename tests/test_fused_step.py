@@ -1,0 +1,340 @@
+"""Fused donated train step: numerical equivalence vs the unfused
+per-param path (sgd, sgd+momentum, adam; distinct lr_mult/wd_mult), the
+one-dispatch-per-step regression guard, Trainer tree-wide updates, and the
+DataLoader prefetcher."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+from mxnet_tpu.gluon import Trainer
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+
+N, D, K, BATCH = 128, 10, 3, 32
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=K, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _train_iter(seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(N, D).astype(np.float32)
+    w = rs.randn(D, K).astype(np.float32)
+    y = (X @ w).argmax(axis=1).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=False,
+                             label_name="softmax_label")
+
+
+_MULTS = {"fc1_weight": (0.5, 2.0), "fc1_bias": (1.5, 0.0),
+          "fc2_weight": (2.0, 0.5), "fc2_bias": (0.7, 0.0)}
+
+
+def _make_module(optimizer, optimizer_params):
+    train = _train_iter()
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(kvstore=None, optimizer=optimizer,
+                       optimizer_params=optimizer_params)
+    # >= 3 params with distinct lr_mult/wd_mult, exercising the static
+    # aux tree baked into the fused program
+    mod._optimizer.set_lr_mult({k: v[0] for k, v in _MULTS.items()})
+    mod._optimizer.set_wd_mult({k: v[1] for k, v in _MULTS.items()})
+    return mod, train
+
+
+@pytest.mark.parametrize("optimizer,params", [
+    ("sgd", (("learning_rate", 0.1), ("wd", 0.01))),
+    ("sgd", (("learning_rate", 0.05), ("momentum", 0.9), ("wd", 0.01))),
+    ("adam", (("learning_rate", 0.01), ("wd", 0.01))),
+])
+def test_fused_matches_unfused_module(optimizer, params):
+    fused_mod, train_f = _make_module(optimizer, params)
+    ref_mod, train_r = _make_module(optimizer, params)
+    ref_mod.set_params(*fused_mod.get_params())  # identical starting point
+    assert fused_mod._fused_eligible()
+
+    for _ in range(2):  # several steps over 2 epochs
+        train_f.reset()
+        train_r.reset()
+        for bf, br in zip(train_f, train_r):
+            fused_mod.fit_step(bf)
+            ref_mod.forward_backward(br)
+            ref_mod.update()
+    assert fused_mod._fused is not None  # fused path actually ran
+
+    fa, _ = fused_mod.get_params()
+    ra, _ = ref_mod.get_params()
+    assert set(fa) == set(ra)
+    for name in fa:
+        np.testing.assert_allclose(
+            fa[name].asnumpy(), ra[name].asnumpy(), rtol=1e-4, atol=1e-5,
+            err_msg="fused/unfused diverged on %s (%s)" % (name, optimizer))
+
+
+def test_fused_one_dispatch_per_step():
+    """Steady state: exactly ONE XLA dispatch per batch, ZERO compiles;
+    exactly one compile total per (shape, train) key."""
+    mod, train = _make_module("sgd", (("learning_rate", 0.1),))
+    train.reset()
+    batches = list(train)
+
+    profiler.reset_step_stats()
+    mod.fit_step(batches[0])  # warmup: traces + compiles the program
+    warm = profiler.step_stats()
+    assert warm["compile_count"] == 1
+    assert warm["dispatch_count"] == 1
+
+    profiler.reset_step_stats()
+    for b in batches[1:]:
+        mod.fit_step(b)
+    steady = profiler.step_stats()
+    assert steady["dispatch_count"] == len(batches) - 1
+    assert steady["compile_count"] == 0
+    assert steady["step_time_ema_s"] is not None
+
+
+def test_unfused_dispatches_more_than_fused():
+    """The split path costs >= 1 (fwd+bwd) + N param-update dispatches."""
+    mod, train = _make_module("sgd", (("learning_rate", 0.1),))
+    train.reset()
+    batches = list(train)
+    mod.forward_backward(batches[0])
+    mod.update()  # warm both programs and the per-param update kernels
+    profiler.reset_step_stats()
+    mod.forward_backward(batches[1])
+    mod.update()
+    split = profiler.step_stats()["dispatch_count"]
+    n_params = len(mod._param_names)
+    assert split >= 1 + n_params  # one program + one kernel per param
+
+
+def test_fused_fallback_grad_req_add():
+    """grad_req='add' keeps the split path but still trains."""
+    train = _train_iter()
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label, grad_req="add")
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    assert not mod._fused_eligible()
+    before = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    train.reset()
+    for b in train:
+        mod.fit_step(b)  # falls back to forward_backward + update
+        for g in mod._exec.grad_dict.values():
+            g[:] = 0
+    after = mod.get_params()[0]
+    assert any(np.abs(after[k].asnumpy() - before[k]).max() > 0
+               for k in before)
+
+
+def test_fused_optimizer_state_roundtrip(tmp_path):
+    """Momentum accumulated by fused steps survives save/load and seeds
+    the next fused program."""
+    mod, train = _make_module(
+        "sgd", (("learning_rate", 0.05), ("momentum", 0.9)))
+    train.reset()
+    batches = list(train)
+    for b in batches:
+        mod.fit_step(b)
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    assert mod._updater.states  # fused state flushed into the Updater
+
+    mod2, _ = _make_module(
+        "sgd", (("learning_rate", 0.05), ("momentum", 0.9)))
+    mod2.set_params(*mod.get_params())
+    mod2.load_optimizer_states(fname)
+    assert mod2._fused is None  # will re-seed from the loaded Updater
+    mod2.fit_step(batches[0])
+    # the re-seeded momentum must match continuing the original module
+    mod.fit_step(batches[0])
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for name in a1:
+        np.testing.assert_allclose(a1[name].asnumpy(), a2[name].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def _gluon_problem(seed=0):
+    from mxnet_tpu import gluon, autograd
+    mx.random.seed(seed)  # identical parameter init across calls
+    rs = np.random.RandomState(seed)
+    X = nd.array(rs.randn(64, 8).astype(np.float32))
+    Y = nd.array(rs.randn(64, 1).astype(np.float32))
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(4, activation="relu"))
+    net.add(gluon.nn.Dense(1))
+    net.initialize(mx.initializer.Uniform(0.1))
+    # materialize + give >=3 params distinct multipliers
+    with autograd.record():
+        loss = ((net(X) - Y) ** 2).mean()
+    loss.backward()
+    for i, p in enumerate(net.collect_params().values()):
+        p.lr_mult = (0.5, 1.0, 2.0, 1.5, 0.7, 1.2)[i % 6]
+        p.wd_mult = (2.0, 0.0, 0.5, 0.0, 1.0, 0.0)[i % 6]
+    return net, X, Y
+
+
+@pytest.mark.parametrize("optimizer,params", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 0.01}),
+    ("adam", {"learning_rate": 0.01, "wd": 0.01}),
+])
+def test_trainer_fused_matches_per_param(optimizer, params):
+    from mxnet_tpu import autograd
+
+    def run(force_unfused):
+        net, X, Y = _gluon_problem()
+        trainer = Trainer(net.collect_params(), optimizer, dict(params),
+                          kvstore=None)
+        if force_unfused:
+            trainer._fused_step = lambda: False
+        for _ in range(5):
+            with autograd.record():
+                loss = ((net(X) - Y) ** 2).mean()
+            loss.backward()
+            trainer.step(batch_size=64)
+        # gluon auto-naming counts globally; compare by position
+        return [v.data().asnumpy()
+                for v in net.collect_params().values()]
+
+    fused = run(False)
+    ref = run(True)
+    assert len(fused) == len(ref) >= 3
+    for i, (f, r) in enumerate(zip(fused, ref)):
+        np.testing.assert_allclose(
+            f, r, rtol=1e-4, atol=1e-5,
+            err_msg="trainer fused/unfused diverged on param %d" % i)
+
+
+def test_trainer_fused_single_dispatch():
+    from mxnet_tpu import autograd
+    net, X, Y = _gluon_problem()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05, "momentum": 0.9},
+                      kvstore=None)
+
+    def one_step():
+        with autograd.record():
+            loss = ((net(X) - Y) ** 2).mean()
+        loss.backward()
+        profiler.reset_step_stats()
+        trainer.step(batch_size=64)
+        return profiler.step_stats()
+
+    first = one_step()
+    assert first["compile_count"] == 1 and first["dispatch_count"] == 1
+    steady = one_step()
+    assert steady["compile_count"] == 0 and steady["dispatch_count"] == 1
+
+
+def test_fused_spmd_module_8dev():
+    """Fused step over a Module(context=[8 devices]) dp mesh: optimizer
+    state must follow the params onto the mesh (mixed committed devices
+    fail the jitted program), and the 1-dispatch contract holds."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    train = _train_iter()
+    mod = mx.mod.Module(_mlp_symbol(), context=[mx.cpu(i) for i in range(8)])
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),
+                                         ("momentum", 0.9)))
+    assert mod._fused_eligible()
+    train.reset()
+    batches = list(train)
+    mod.fit_step(batches[0])
+    profiler.reset_step_stats()
+    for b in batches[1:]:
+        mod.fit_step(b)
+    st = profiler.step_stats()
+    assert st["dispatch_count"] == len(batches) - 1
+    assert st["compile_count"] == 0
+    arr = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert np.isfinite(arr).all()
+
+
+def test_dataloader_prefetch_matches_sequential():
+    rs = np.random.RandomState(3)
+    data = rs.randn(37, 5).astype(np.float32)
+    label = rs.randn(37).astype(np.float32)
+    ds = ArrayDataset(data, label)
+    plain = [b for b in DataLoader(ds, batch_size=8, prefetch=0)]
+    pre = [b for b in DataLoader(ds, batch_size=8, prefetch=2)]
+    assert len(plain) == len(pre) == 5
+    for (pd, pl), (qd, ql) in zip(plain, pre):
+        np.testing.assert_array_equal(pd.asnumpy(), qd.asnumpy())
+        np.testing.assert_array_equal(pl.asnumpy(), ql.asnumpy())
+
+
+def test_trainer_fused_rebuild_preserves_state():
+    """Changing a multiplier rebuilds the fused program; accumulated
+    momentum must carry through the Updater, not reset to zeros."""
+    from mxnet_tpu import autograd
+    net, X, Y = _gluon_problem()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05, "momentum": 0.9},
+                      kvstore=None)
+
+    def step():
+        with autograd.record():
+            loss = ((net(X) - Y) ** 2).mean()
+        loss.backward()
+        trainer.step(batch_size=64)
+
+    for _ in range(3):
+        step()
+    pre = {k: np.asarray(v) for k, v in trainer._fused["state"].items()}
+    assert any(np.abs(v).max() > 0 for v in pre.values())
+    trainer._optimizer.set_lr_mult({0: 0.123})  # forces a rebuild
+    step()
+    # the rebuild flushed pre-change momentum into the Updater...
+    st = trainer._updaters.states
+    assert st
+    for k, v in pre.items():
+        np.testing.assert_allclose(st[int(k)].asnumpy(), v,
+                                   rtol=1e-6, atol=0)
+    # ...and the re-seeded fused state kept accumulating from it
+    assert trainer._fused is not None
+
+
+def test_dataloader_prefetch_abandoned_iteration_stops_worker():
+    ds = ArrayDataset(np.zeros((64, 3), np.float32),
+                      np.zeros(64, np.float32))
+    loader = DataLoader(ds, batch_size=4, prefetch=2)
+    it = iter(loader)
+    next(it)  # peek one batch, abandon the rest
+    worker = it._worker
+    it.close()
+    worker.join(timeout=5)
+    assert not worker.is_alive()
+
+
+def test_dataloader_prefetch_propagates_errors():
+    class Bad:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, idx):
+            if idx >= 5:
+                raise RuntimeError("boom at %d" % idx)
+            return np.zeros(3, np.float32)
+
+    loader = DataLoader(Bad(), batch_size=4, prefetch=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        for _ in loader:
+            pass

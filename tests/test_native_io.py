@@ -182,6 +182,31 @@ def _write_det_images(tmp_path, n=11, size=(32, 32), max_boxes=4):
     return rec_path, idx_path, all_labels
 
 
+def _write_det_header_rec(tmp_path, header_vals):
+    """One det record whose flat label starts with the given header."""
+    rec_path = str(tmp_path / "bad.rec")
+    writer = recordio.MXRecordIO(rec_path, "w")
+    flat = np.asarray(list(header_vals) + [0.0] * 10, np.float32)
+    writer.write(recordio.pack(
+        recordio.IRHeader(len(flat), flat, 0, 0), b""))
+    writer.close()
+    return rec_path
+
+
+@pytest.mark.parametrize("header,msg", [
+    ((1.0, 0.0), "object width"),    # a=1 < 2, b=0 would divide-by-zero
+    ((2.0, 3.0), "object width"),    # b=3 < 5: no room for id + 4 coords
+    ((40.0, 5.0), "exceeds label"),  # a past the label end: negative count
+])
+def test_det_label_shape_validates_header(tmp_path, header, msg):
+    """A malformed (e.g. classification) .rec must raise MXNetError with
+    the offending header values, not ZeroDivisionError or a negative
+    object count (ADVICE r5)."""
+    rec = _write_det_header_rec(tmp_path, header)
+    with pytest.raises(mx.base.MXNetError, match=msg):
+        mx.io.ImageDetRecordIter._estimate_label_shape(None, rec, 0, 0)
+
+
 @requires_native
 def test_image_det_record_iter_resize_only(tmp_path):
     """No-aug det pipeline: normalized boxes ride through the force

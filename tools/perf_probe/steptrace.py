@@ -1,0 +1,89 @@
+"""Per-step dispatch/compile trace for the train hot path (PERF.md,
+"Fused train step").  Runs the same MLP fit loop through the fused
+Module.fit_step (one donated XLA program per batch) and the split
+forward_backward()+update() pair (one program + one update kernel per
+parameter), printing profiler.step_stats() for each so dispatch-count
+regressions are visible at a glance.
+
+Usage: JAX_PLATFORMS=cpu python tools/perf_probe/steptrace.py
+Prints one JSON object: {"fused": {...}, "unfused": {...}} where each
+side carries steady-state dispatches_per_step, compile_count and
+step_time_ema_ms.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def build_module(batch=64, dim=32, classes=4, hidden=64):
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(8 * batch, dim).astype(np.float32)
+    y = rs.randint(0, classes, size=8 * batch).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False,
+                              label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    s = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(s, context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),
+                                         ("momentum", 0.9)))
+    return mod, train
+
+
+def trace(step_fn, batches, epochs=3):
+    """Warm one epoch, then measure steady state."""
+    from mxnet_tpu import profiler
+    for b in batches:
+        step_fn(b)
+    profiler.reset_step_stats()
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(epochs):
+        for b in batches:
+            step_fn(b)
+            n += 1
+    dt = time.perf_counter() - t0
+    stats = profiler.step_stats()
+    ema = stats["step_time_ema_s"]
+    return {
+        "steps": n,
+        "dispatches_per_step": stats["dispatch_count"] / n,
+        "compile_count": stats["compile_count"],
+        "step_time_ema_ms": round(ema * 1e3, 3) if ema else None,
+        "wall_ms_per_step": round(dt / n * 1e3, 3),
+    }
+
+
+def run():
+    mod, train = build_module()
+    batches = list(train)
+
+    fused = trace(mod.fit_step, batches)
+
+    mod2, _ = build_module()
+
+    def split_step(b):
+        from mxnet_tpu import profiler
+        mod2.forward_backward(b)
+        mod2.update()
+        profiler.note_step()  # the fused path notes its own steps
+
+    unfused = trace(split_step, batches)
+    n_params = len(mod._param_names)
+    return {"fused": fused, "unfused": unfused, "n_params": n_params}
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))
