@@ -435,6 +435,13 @@ def bench_steptrace():
     result = _steptrace.run()
     fused = result["fused"]
     unfused = result["unfused"]
+    # the divergence guard rides INSIDE the fused program — folding it in
+    # must not cost a dispatch.  Fail the bench loudly if it ever does.
+    if fused["dispatches_per_step"] != 1.0:
+        raise AssertionError(
+            "guarded fused step dispatched %.3f programs/step (contract: "
+            "exactly 1.0 — the divergence guard must stay inside the "
+            "fused program)" % fused["dispatches_per_step"])
     print(json.dumps({
         "metric": "fused_step_dispatches_per_step",
         "value": round(fused["dispatches_per_step"], 3),

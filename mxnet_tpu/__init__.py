@@ -40,6 +40,9 @@ from . import callback
 from . import io
 from . import kvstore
 from . import kvstore as kv
+from . import fault
+from . import checkpoint
+from .checkpoint import CheckpointManager
 from . import model
 from . import module
 from . import module as mod

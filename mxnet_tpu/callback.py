@@ -13,24 +13,32 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint the Module each `period` epochs (reference callback.py:29)."""
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
+                      keep_last=None):
+    """Checkpoint the Module each `period` epochs (reference callback.py:29).
+
+    Writes are crash-safe (atomic + manifest, checkpoint.py); pass
+    ``keep_last`` to prune to the N newest complete checkpoints."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states,
+                                keep_last=keep_last)
     return _callback
 
 
-def do_checkpoint(prefix, period=1):
-    """Checkpoint params each `period` epochs (reference callback.py:55)."""
+def do_checkpoint(prefix, period=1, keep_last=None):
+    """Checkpoint params each `period` epochs (reference callback.py:55).
+
+    Crash-safe like module_checkpoint; ``keep_last`` enables retention."""
     from .model import save_checkpoint
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux,
+                            keep_last=keep_last)
     return _callback
 
 

@@ -1,0 +1,436 @@
+"""Crash-safe checkpointing: atomic writes, manifests, recovery discovery.
+
+The reference MXNet recovered from worker death through parameter-server
+heartbeat hooks (src/kvstore/kvstore_dist.h:59-62); the TPU-native rebuild
+uses the checkpoint-restart model pods actually run (tools/launch.py
+--max-restarts).  That model is only as good as the checkpoints: a crash
+mid-``nd.save`` used to leave a torn ``.params`` file at the final path
+that a naive "newest epoch" scan would happily load.  This module makes
+the checkpoint the unit of trust:
+
+- ``atomic_write``: tmp file in the same directory + fsync + ``os.replace``
+  + directory fsync, with retry-and-exponential-backoff on transient
+  OSError.  A crash at any instant leaves either the old file or the new
+  one at the final path — never a torn hybrid.
+- ``CheckpointManager``: one manifest per checkpoint
+  (``prefix-%04d.manifest.json``) written LAST, carrying the sha256 +
+  size of every artifact; ``latest()`` walks manifests newest-first and
+  returns the first checkpoint whose artifacts all verify, silently
+  skipping torn/partial/corrupt ones; keep-last-N retention deletes the
+  manifest before the data so a half-finished cleanup can never produce a
+  "valid" manifest over missing files.
+- framed optimizer-state files (``write_state_file``/``read_state_file``):
+  magic + sha256 + payload so a corrupt ``.states`` file raises MXNetError
+  naming the path instead of a cryptic unpickling error.
+
+Fault-injection sites (mxnet_tpu.fault): ``ckpt.write.ioerror`` (transient,
+retried), ``ckpt.write.torn`` / ``ckpt.write.crash`` (simulated crashes —
+never retried).  ROBUSTNESS.md documents layout + recovery semantics.
+"""
+from __future__ import annotations
+
+import errno as _errno
+import hashlib
+import json
+import os
+import re
+import time
+
+from . import fault as _fault
+from .base import MXNetError
+
+__all__ = ["CheckpointManager", "atomic_write", "write_state_file",
+           "read_state_file", "load_state_file"]
+
+_STATE_MAGIC = b"MXTPUST1"  # framed optimizer-state container, version 1
+
+# OSErrors that repeat identically on every attempt — retrying only
+# delays the real error (mirrors tools/launch.py's permanent/retryable
+# exit classification).  Anything else (EIO, EAGAIN, NFS hiccups,
+# errno-less OSErrors) is treated as transient and retried.
+_PERMANENT_ERRNO = frozenset(
+    getattr(_errno, name) for name in
+    ("ENOENT", "EACCES", "EPERM", "EISDIR", "ENOTDIR", "EROFS",
+     "ENAMETOOLONG", "EBADF", "ENOSPC") if hasattr(_errno, name))
+
+
+def _retry_io(fn, retries=4, backoff=0.05, max_backoff=2.0):
+    """Run ``fn`` retrying transient OSError with exponential backoff.
+    FaultInjected is a simulated crash, not a transient error — it (and
+    every non-OSError, and permanent-errno OSErrors) propagates
+    immediately."""
+    delay = backoff
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except _fault.FaultInjected:
+            raise
+        except OSError as e:
+            if e.errno in _PERMANENT_ERRNO or attempt == retries:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, max_backoff)
+
+
+def _fsync_dir(path):
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path, data, retries=4, backoff=0.05):
+    """Write ``data`` (bytes) to ``path`` atomically: the final path only
+    ever holds a complete file.  Transient OSErrors are retried with
+    exponential backoff."""
+    path = os.fspath(path)
+
+    def attempt():
+        if _fault.trigger("ckpt.write.ioerror"):
+            raise OSError("[fault injection] transient I/O error writing %s"
+                          % path)
+        if _fault.trigger("ckpt.write.torn"):
+            # the legacy non-atomic writer dying mid-write: a truncated
+            # file lands at the FINAL path, then the "crash"
+            with open(path, "wb") as f:
+                f.write(data[:max(1, len(data) // 2)])
+            raise _fault.FaultInjected(
+                "[fault injection] torn write at %s" % path)
+        tmp = "%s.tmp-%d" % (path, os.getpid())
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            _fault.check("ckpt.write.crash",
+                         "crash before publishing %s" % path)
+            os.replace(tmp, path)
+        except BaseException as e:
+            # a simulated crash leaves the tmp litter a real crash would;
+            # ordinary failures clean up after themselves
+            if not isinstance(e, _fault.FaultInjected):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            raise
+        _fsync_dir(path)
+
+    _retry_io(attempt, retries=retries, backoff=backoff)
+
+
+def _sha256(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+def _frame_state(payload):
+    """The one place the .states frame layout lives."""
+    return _STATE_MAGIC + hashlib.sha256(payload).digest() + payload
+
+
+def write_state_file(path, payload, retries=4, backoff=0.05):
+    """Atomically write optimizer-state ``payload`` (bytes) framed with a
+    magic + checksum header so loads can verify integrity.  Returns the
+    framed bytes as written (manifests hash exactly these)."""
+    framed = _frame_state(payload)
+    atomic_write(path, framed, retries=retries, backoff=backoff)
+    return framed
+
+
+def load_state_file(path, setter):
+    """Validated optimizer-state load: read + verify the frame, then run
+    ``setter(payload)`` (the unpickle/restore), wrapping any failure in
+    MXNetError naming the path.  The one home of the 'corrupt optimizer
+    state file' contract used by KVStore, Module, and Trainer."""
+    payload = read_state_file(path)
+    try:
+        setter(payload)
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError(
+            "corrupt optimizer state file %s: %s" % (path, e)) from e
+
+
+def read_state_file(path):
+    """Read an optimizer-state file, verifying the checksum frame.  Files
+    written before the frame existed (raw pickle) pass through unchanged;
+    a framed file that fails verification raises MXNetError naming the
+    path."""
+    def attempt():
+        with open(path, "rb") as f:
+            return f.read()
+    blob = _retry_io(attempt)
+    if not blob.startswith(_STATE_MAGIC):
+        return blob  # legacy unframed file; caller validates the unpickle
+    digest, payload = blob[8:40], blob[40:]
+    if len(digest) != 32 or hashlib.sha256(payload).digest() != digest:
+        raise MXNetError(
+            "corrupt optimizer state file %s: checksum mismatch "
+            "(truncated or damaged write)" % path)
+    return payload
+
+
+class CheckpointManager:
+    """Atomic, validated, self-pruning checkpoint store for one prefix.
+
+    Layout per epoch E (all under ``prefix``'s directory):
+      prefix-symbol.json        network definition (shared across epochs)
+      prefix-%04d.params        arg:/aux: NDArray dict (reference format)
+      prefix-%04d.states        framed optimizer state (optional)
+      prefix-%04d.manifest.json commit record, written LAST
+
+    A checkpoint without a verifying manifest does not exist as far as
+    recovery is concerned; ``latest()`` falls back to the previous
+    complete one.
+    """
+
+    def __init__(self, prefix, keep_last=None, retries=4, backoff=0.05):
+        self.prefix = os.fspath(prefix)
+        self.keep_last = keep_last
+        self._retries = retries
+        self._backoff = backoff
+
+    # -- paths -------------------------------------------------------------
+    def params_path(self, epoch):
+        return "%s-%04d.params" % (self.prefix, epoch)
+
+    def states_path(self, epoch):
+        return "%s-%04d.states" % (self.prefix, epoch)
+
+    def manifest_path(self, epoch):
+        return "%s-%04d.manifest.json" % (self.prefix, epoch)
+
+    def symbol_path(self):
+        return "%s-symbol.json" % self.prefix
+
+    # -- saving ------------------------------------------------------------
+    def save(self, epoch, arg_params, aux_params, symbol=None,
+             optimizer_states=None):
+        """Write one complete checkpoint; the manifest is committed last,
+        so a crash anywhere earlier leaves the previous checkpoint as the
+        newest *complete* one."""
+        from .ndarray import utils as _nd_utils
+        from .ndarray import serialization as _ser
+        files = {}
+
+        # params first: the epoch's defining artifact is the natural torn-
+        # write victim, and the shared symbol file is only touched once
+        # the per-epoch data is safely down
+        save_dict = {("arg:%s" % k): v for k, v in
+                     (arg_params or {}).items()}
+        save_dict.update({("aux:%s" % k): v for k, v in
+                          (aux_params or {}).items()})
+        arrays, names = _nd_utils._to_payload(save_dict)
+        payload = _ser.dumps_ndarray_list(arrays, names)
+        atomic_write(self.params_path(epoch), payload,
+                     retries=self._retries, backoff=self._backoff)
+        files[os.path.basename(self.params_path(epoch))] = {
+            "sha256": _sha256(payload), "size": len(payload)}
+
+        if optimizer_states is not None:
+            framed = write_state_file(self.states_path(epoch),
+                                      optimizer_states,
+                                      retries=self._retries,
+                                      backoff=self._backoff)
+            files[os.path.basename(self.states_path(epoch))] = {
+                "sha256": _sha256(framed), "size": len(framed)}
+
+        if symbol is not None:
+            symbol.save(self.symbol_path())  # atomic (Symbol.save)
+
+        manifest = {"version": 1, "epoch": int(epoch), "files": files,
+                    "symbol": os.path.basename(self.symbol_path())
+                    if symbol is not None else None}
+        atomic_write(self.manifest_path(epoch),
+                     json.dumps(manifest, indent=1).encode("utf-8"),
+                     retries=self._retries, backoff=self._backoff)
+        if self.keep_last:
+            self._retain()
+        return manifest
+
+    # -- discovery / validation --------------------------------------------
+    def _scan_epochs(self, suffix_re):
+        """{epoch: [paths]} for prefix artifacts whose suffix matches
+        ``suffix_re`` — the one directory-scan shared by discovery,
+        legacy fallback, and retention."""
+        d = os.path.dirname(os.path.abspath(self.prefix)) or "."
+        base = os.path.basename(self.prefix)
+        pat = re.compile(re.escape(base) + r"-(\d{4,})" + suffix_re + "$")
+        out = {}
+        try:
+            entries = os.listdir(d)
+        except OSError:
+            return {}
+        for name in entries:
+            m = pat.match(name)
+            if m:
+                out.setdefault(int(m.group(1)), []).append(
+                    os.path.join(d, name))
+        return out
+
+    def _manifest_epochs(self):
+        return sorted(self._scan_epochs(r"\.manifest\.json"))
+
+    def validate(self, epoch):
+        """True when epoch's manifest exists and every artifact it lists
+        is present with matching size + sha256.  Hashes in fixed-size
+        chunks — recovery must not need checkpoint-sized host memory."""
+        try:
+            with open(self.manifest_path(epoch), "rb") as f:
+                manifest = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError):
+            return False
+        d = os.path.dirname(os.path.abspath(self.prefix)) or "."
+        for name, meta in (manifest.get("files") or {}).items():
+            path = os.path.join(d, name)
+            try:
+                if os.stat(path).st_size != meta.get("size"):
+                    return False
+                h = hashlib.sha256()
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+            except OSError:
+                return False
+            if h.hexdigest() != meta.get("sha256"):
+                return False
+        if manifest.get("symbol"):
+            # the symbol file is shared and rewritten by every save, so
+            # per-epoch hashes would go stale by design — but it must at
+            # least BE a parseable JSON document, or recovery would hand
+            # back an epoch whose Module.load crash-loops on it.  It is
+            # small (KBs); a full parse is cheap.
+            try:
+                with open(os.path.join(d, manifest["symbol"]), "rb") as f:
+                    json.loads(f.read().decode("utf-8"))
+            except (OSError, ValueError):
+                return False
+        return True
+
+    def complete_epochs(self):
+        """All epochs whose checkpoints fully verify, ascending."""
+        return [e for e in self._manifest_epochs() if self.validate(e)]
+
+    def latest(self):
+        """Newest epoch with a complete, checksum-verified checkpoint, or
+        None.  Torn/partial/corrupt checkpoints (no manifest, manifest
+        over missing/damaged files) are skipped — recovery falls back to
+        the previous complete one.  Prefixes written before manifests
+        existed fall back to a load-probe scan of ``prefix-*.params``."""
+        for epoch in reversed(self._manifest_epochs()):
+            if self.validate(epoch):
+                return epoch
+        return self._legacy_latest()
+
+    def _legacy_latest(self):
+        """Manifest-less discovery: newest .params file that actually
+        parses (a torn legacy file fails deserialization and is skipped).
+        Epochs that HAVE a manifest are never considered here: a
+        manifested checkpoint that failed validation is damaged, and
+        resurrecting it would send recovery into load() -> MXNetError on
+        every restart attempt.
+
+        The parse-probe reads each candidate file whole — with no
+        checksum on disk, proving a legacy file complete requires walking
+        its records (the decoded arrays are frombuffer views over the
+        blob, not copies).  This path only runs for prefixes written
+        before manifests existed; the first post-upgrade save commits a
+        manifest and retires it."""
+        epochs = self._scan_epochs(r"\.params")
+        from .ndarray import utils as _nd_utils
+        for epoch in sorted(epochs, reverse=True):
+            if os.path.exists(self.manifest_path(epoch)):
+                continue  # manifested-but-invalid: damaged, not legacy
+            try:
+                _nd_utils.load(self.params_path(epoch))
+                return epoch
+            except Exception:
+                continue  # torn/corrupt legacy file — fall back further
+        return None
+
+    # -- loading -----------------------------------------------------------
+    def load(self, epoch=None):
+        """Load (epoch, arg_params, aux_params).  With ``epoch=None`` the
+        newest complete checkpoint is used; an explicit epoch must
+        verify."""
+        if epoch is None:
+            epoch = self.latest()
+            if epoch is None:
+                raise MXNetError(
+                    "no complete checkpoint found for prefix %s"
+                    % self.prefix)
+        elif os.path.exists(self.manifest_path(epoch)) and \
+                not self.validate(epoch):
+            raise MXNetError(
+                "checkpoint %s failed validation (torn or corrupt); "
+                "latest complete epoch is %s"
+                % (self.params_path(epoch), self.latest()))
+        elif not os.path.exists(self.params_path(epoch)):
+            # e.g. an epoch pruned by keep-last-N retention: surface the
+            # documented recovery error, not a raw FileNotFoundError
+            raise MXNetError(
+                "checkpoint %s does not exist (pruned or never written); "
+                "latest complete epoch is %s"
+                % (self.params_path(epoch), self.latest()))
+        from .ndarray import utils as _nd_utils
+        try:
+            save_dict = _nd_utils.load(self.params_path(epoch))
+        except Exception as e:
+            # a torn manifest-less (legacy) file: surface the documented
+            # recovery error, not the deserializer's internals
+            raise MXNetError(
+                "checkpoint %s is unreadable (torn or corrupt): %s; "
+                "latest complete epoch is %s"
+                % (self.params_path(epoch), e, self.latest())) from e
+        arg_params, aux_params = {}, {}
+        for k, v in save_dict.items():
+            tp, _, name = k.partition(":")
+            if tp == "arg":
+                arg_params[name] = v
+            elif tp == "aux":
+                aux_params[name] = v
+            else:
+                raise MXNetError("unknown param prefix in %s" % k)
+        return epoch, arg_params, aux_params
+
+    def load_optimizer_states(self, epoch):
+        """Validated optimizer-state payload bytes for ``epoch``."""
+        return read_state_file(self.states_path(epoch))
+
+    # -- retention ---------------------------------------------------------
+    def _retain(self):
+        """Keep the newest ``keep_last`` checkpoints by manifest list —
+        no content re-hashing on the save path (full validation belongs
+        to recovery/latest(), not to every epoch's save).  Every epoch
+        artifact older than the oldest kept manifest is pruned too,
+        INCLUDING manifest-less torn debris from crashed saves, so a
+        long-running job with injected/real crashes doesn't accumulate
+        junk forever.  The manifest is removed FIRST so an interrupted
+        prune leaves dangling data files (harmless, skipped by latest())
+        rather than a manifest over a hole."""
+        kept = self._manifest_epochs()[-self.keep_last:]
+        if not kept:
+            return
+        cutoff = kept[0]
+        # the optional .tmp-<pid> tail also sweeps atomic_write's crash
+        # litter (a tmp file survives a death between fsync and publish)
+        doomed = self._scan_epochs(
+            r"\.(manifest\.json|params|states)(\.tmp-\d+)?")
+        for epoch, paths in doomed.items():
+            if epoch >= cutoff:
+                continue
+            # manifest first (see docstring)
+            for path in sorted(paths,
+                               key=lambda p: not p.endswith(".json")):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass

@@ -462,16 +462,27 @@ class Executor:
                           — pure tree-wide optimizer apply
                             (ops.optimizer_ops.make_fused_apply).
 
+        The apply is wrapped in the divergence guard
+        (ops.optimizer_ops.make_guarded_apply): an all-finite check over
+        the global gradient tree runs inside the SAME program — still one
+        dispatch per step — and a non-finite batch turns the update into
+        a tree-wide no-op.  ``poison`` (0.0 normally, NaN when the
+        grad.nan fault-injection site fires) is a dynamic scalar, so
+        injected and production steps share one compiled program.
+
         Returns ``step(param_vals, opt_state, other_vals, aux_vals, rng,
-        lr, wd, rescale, t) -> (outs, new_params, new_state, new_aux)``
-        where new_aux covers ALL aux states (unchanged ones pass through,
-        so donated aux buffers stay owned by the caller's write-back).
+        lr, wd, rescale, t, poison) -> (outs, new_params, new_state,
+        new_aux, ok)`` where new_aux covers ALL aux states (unchanged
+        ones pass through, so donated aux buffers stay owned by the
+        caller's write-back) and ``ok`` is the guard verdict scalar.
         """
+        from .ops.optimizer_ops import make_guarded_apply
         plan = self._plan
         update_names = tuple(update_names)
+        guarded = make_guarded_apply(apply_fn)
 
         def step(param_vals, opt_state, other_vals, aux_vals, rng,
-                 lr, wd, rescale, t):
+                 lr, wd, rescale, t, poison):
             def f(p):
                 merged = dict(other_vals)
                 merged.update(p)
@@ -483,11 +494,15 @@ class Executor:
             # default out_grads — fused and unfused paths share semantics
             ograds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp(ograds)[0]
-            new_params, new_state = apply_fn(param_vals, grads, opt_state,
-                                             lr, wd, rescale, t)
+            new_params, new_state, ok = guarded(
+                param_vals, grads, opt_state, lr, wd, rescale, t, poison)
+            # the guard's skip covers aux too: a NaN batch must not commit
+            # poisoned forward-pass statistics (BatchNorm moving mean/var)
+            # any more than poisoned weights
             merged_aux = dict(aux_vals)
-            merged_aux.update(new_aux)
-            return outs, new_params, new_state, merged_aux
+            for k, v in new_aux.items():
+                merged_aux[k] = jnp.where(ok, v, aux_vals[k])
+            return outs, new_params, new_state, merged_aux, ok
 
         if self._staged:
             return step  # eager multi-device ctx_group binds can't donate

@@ -1,0 +1,161 @@
+"""Pluggable fault injection for the resilient-training runtime.
+
+The recovery paths in this framework (atomic checkpoints, ``latest()``
+fallback, the divergence-guarded fused step, launcher restarts) are only
+trustworthy if they are *exercised*, not just written.  This layer lets
+tests and soak runs inject faults deterministically at named sites:
+
+    MXTPU_FAULT="ckpt.write.torn:1;grad.nan:0.1" python train.py
+
+Spec grammar: ``site:spec`` pairs separated by ``;``.  ``spec`` is either
+an integer count N (trigger the next N times the site is checked, then
+disarm — deterministic) or a float probability in (0, 1) (trigger each
+check with that probability from a seeded RNG — reproducible under
+``MXTPU_FAULT_SEED``, default 0, and identical across worker ranks so
+data-parallel replicas skip the same steps).
+
+Sites wired in this package:
+
+- ``ckpt.write.ioerror``  transient OSError inside atomic_write (exercises
+                          the retry-with-backoff path; retried, recovers).
+- ``ckpt.write.torn``     simulate the legacy non-atomic writer dying
+                          mid-write: a truncated file appears at the FINAL
+                          path, then FaultInjected (a crash stand-in).
+- ``ckpt.write.crash``    crash after the tmp file is written but before
+                          os.replace publishes it (no final-path artifact).
+- ``nd.save``             crash at nd.save entry (nothing written).
+- ``data.prefetch``       raise inside the DataLoader prefetch worker
+                          (exercises cross-thread exception re-raise).
+- ``grad.nan``            poison the global gradient tree of the fused
+                          fit_step / Trainer step with NaN (exercises the
+                          divergence guard's skip-update path).
+
+``FaultInjected`` deliberately subclasses MXNetError, NOT OSError: the
+retry loops treat OSError as transient but must never retry a simulated
+crash.
+"""
+from __future__ import annotations
+
+import os
+import random as _random
+import threading
+import zlib
+
+from .base import MXNetError
+
+__all__ = ["FaultInjected", "configure", "reset", "is_active", "trigger",
+           "check", "fire_count"]
+
+
+class FaultInjected(MXNetError):
+    """Raised at an injection site standing in for a crash/failure."""
+
+
+_lock = threading.Lock()
+_rules = {}        # site -> {"count": int} | {"rate": float, "rng": Random}
+_fired = {}        # site -> times triggered
+_loaded_env = None  # last MXTPU_FAULT value parsed (None = never)
+
+
+def _parse(spec):
+    rules = {}
+    seed = int(os.environ.get("MXTPU_FAULT_SEED", "0"))
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise MXNetError(
+                "bad MXTPU_FAULT entry %r (want site:count or site:rate)"
+                % part)
+        site, _, val = part.partition(":")
+        site = site.strip()
+        val = val.strip()
+        try:
+            if "." in val or "e" in val or "E" in val:
+                rate = float(val)
+                if not 0.0 < rate <= 1.0:
+                    raise ValueError(val)
+                # one RNG per site, seeded independently of check order at
+                # other sites so a spec edit never reshuffles this site;
+                # crc32 (NOT hash(): salted per process) keeps the draw
+                # sequence identical across worker ranks and restarts
+                rules[site] = {"rate": rate, "rng": _random.Random(
+                    (seed << 32) ^ zlib.crc32(site.encode("utf-8")))}
+            else:
+                count = int(val)
+                if count < 1:
+                    raise ValueError(val)
+                rules[site] = {"count": count}
+        except ValueError:
+            raise MXNetError("bad MXTPU_FAULT value %r for site %r"
+                             % (val, site))
+    return rules
+
+
+def configure(spec=None):
+    """Install fault rules from ``spec`` (or the MXTPU_FAULT env when
+    None).  Replaces any previous configuration; fire counters reset."""
+    global _rules, _fired, _loaded_env
+    if spec is None:
+        spec = os.environ.get("MXTPU_FAULT", "")
+    with _lock:
+        _rules = _parse(spec)
+        _fired = {}
+        _loaded_env = spec
+
+
+def reset():
+    """Remove all rules and counters."""
+    configure("")
+
+
+def _ensure_loaded():
+    # lazy env pickup so `import mxnet_tpu` stays side-effect free for
+    # processes that never touch a fault site
+    if _loaded_env is None:
+        configure()
+
+
+def is_active(site):
+    """True if ``site`` still has a rule that can fire."""
+    _ensure_loaded()
+    with _lock:
+        rule = _rules.get(site)
+        if rule is None:
+            return False
+        if "count" in rule:
+            return rule["count"] > 0
+        return True
+
+
+def trigger(site):
+    """Roll the dice for ``site``; True means the caller must inject."""
+    _ensure_loaded()
+    with _lock:
+        rule = _rules.get(site)
+        if rule is None:
+            return False
+        if "count" in rule:
+            if rule["count"] <= 0:
+                return False
+            rule["count"] -= 1
+            _fired[site] = _fired.get(site, 0) + 1
+            return True
+        if rule["rng"].random() < rule["rate"]:
+            _fired[site] = _fired.get(site, 0) + 1
+            return True
+        return False
+
+
+def check(site, msg=None):
+    """Raise FaultInjected when ``site`` triggers (crash-style sites)."""
+    if trigger(site):
+        raise FaultInjected("[fault injection] %s"
+                            % (msg or "site %r fired" % site))
+
+
+def fire_count(site):
+    """How many times ``site`` has triggered since configure()."""
+    with _lock:
+        return _fired.get(site, 0)

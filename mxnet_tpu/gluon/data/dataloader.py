@@ -78,10 +78,16 @@ class _PrefetchIter:
 
         def work():
             try:
+                from ... import fault as _fault
                 for batch in make_batches():
+                    _fault.check("data.prefetch",
+                                 "prefetch worker failure")
                     if not put(_device_put_batch(batch)):
                         return
             except BaseException as e:  # noqa: BLE001 — re-raised below
+                # e.__traceback__ carries the worker-side frames; the
+                # consumer re-raises the same object so the user sees the
+                # original failure point chained under their next() call
                 put(e)
                 return
             put(sentinel)
@@ -108,6 +114,16 @@ class _PrefetchIter:
 
     __del__ = close
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # deterministic teardown: `with iter(loader) as it:` frees the
+        # worker thread + queued device batches at block exit instead of
+        # whenever GC notices the abandoned iterator
+        self.close()
+        return False
+
     def __iter__(self):
         return self
 
@@ -116,10 +132,14 @@ class _PrefetchIter:
             raise StopIteration
         item = self._q.get()
         if item is self._SENTINEL:
-            self._done = True
+            self.close()  # worker finished; free the thread + queue now
             raise StopIteration
         if isinstance(item, BaseException):
-            self._done = True
+            self.close()
+            # re-raise the worker's exception object: its __traceback__
+            # still points into the worker (batchify/dataset frames), so
+            # the surfaced traceback chains the original failure site
+            # under this consumption point
             raise item
         return item
 

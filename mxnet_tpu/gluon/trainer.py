@@ -65,6 +65,8 @@ class Trainer:
         self._optimizer.set_wd_mult(wd_mult)
         self._updaters = opt.get_updater(self._optimizer)
         self._fused = None  # fused tree-wide step cache
+        self._consec_guard_skips = 0  # divergence-guard skip streak
+        self._pending_verdict = None  # (ok, indices, pre_num_update)
 
     def _init_kvstore(self):
         arg_arrays = {param.name: param.data() for param in self._params
@@ -93,6 +95,15 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimizer step, scaling grads by 1/batch_size."""
+        self._resolve_pending_verdict()
+        from ..ops.optimizer_ops import (max_consecutive_skips,
+                                         raise_skip_limit_error)
+        limit = max_consecutive_skips()
+        if self._consec_guard_skips >= limit:
+            # the Kth skip may have been resolved from a save/flush path
+            # (which never raises); the training loop is where the error
+            # belongs
+            raise_skip_limit_error(limit)
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -138,7 +149,9 @@ class Trainer:
             return bail()  # lazy/sparse updates keep the per-param path
 
         import jax
+        from .. import fault as _fault
         from .. import profiler as _profiler
+        from ..ops.optimizer_ops import make_guarded_apply
 
         # params are keyed by their updater index so state save/load and
         # the mult resolution (Trainer seeds lr_mult by index) line up
@@ -165,26 +178,57 @@ class Trainer:
                             kind, self._updaters.states[i], p.data())
             self._fused = {
                 "key": cache_key, "kind": kind, "state": state,
+                # same divergence guard as Module.fit_step: all-finite
+                # check + no-op select inside the ONE donated program
                 "step": _profiler.instrument(
-                    jax.jit(apply_fn, donate_argnums=(0, 2)))}
+                    jax.jit(make_guarded_apply(apply_fn),
+                            donate_argnums=(0, 2)))}
 
         fused = self._fused
         params = {str(i): p.data()._data for i, p in live}
         grads = {str(i): p.grad()._data for i, p in live}
         first = live[0][0]
+        pre_num_update = optimizer.num_update
         for i, _ in live:
             optimizer._update_count(i)
         t = float(optimizer._index_update_count[first])
-        new_params, new_state = fused["step"](
+        poison = float("nan") if _fault.trigger("grad.nan") else 0.0
+        new_params, new_state, ok = fused["step"](
             params, grads, fused["state"], optimizer.fused_base_lr(),
-            float(optimizer.wd), float(optimizer.rescale_grad), t)
+            float(optimizer.wd), float(optimizer.rescale_grad), t, poison)
         fused["state"] = new_state
+        # donation killed the old buffers — write back even on a skipped
+        # step (new_params then carries the unchanged values through)
         for i, p in live:
             p.data()._set_data(new_params[str(i)])
         _profiler.note_step()
+        # the verdict is resolved one step LATE: reading ``ok`` now would
+        # block on the whole fused program and kill the dispatch/compute
+        # overlap the trainer path otherwise keeps (Module.fit syncs per
+        # batch for metrics anyway, so IT reads immediately).  Skip
+        # semantics tolerate the lag — the rewind happens before the next
+        # step's clock ticks, and the K-consecutive raise fires one step
+        # later (PERF.md "Divergence guard").
+        self._pending_verdict = (ok, [i for i, _ in live], pre_num_update)
         return True
 
+    def _resolve_pending_verdict(self):
+        """Apply the previous fused step's guard verdict (skip counter +
+        optimizer-clock rewind).  Never raises: the K-consecutive-skip
+        MXNetError is checked at the top of step(), so save/flush paths
+        that settle the clock cannot abort on a training-health error."""
+        if self._pending_verdict is None:
+            return
+        from ..ops.optimizer_ops import handle_guard_verdict
+        ok, indices, pre_num_update = self._pending_verdict
+        self._pending_verdict = None
+        self._consec_guard_skips = handle_guard_verdict(
+            ok, self._optimizer, indices, self._consec_guard_skips,
+            pre_num_update, raise_on_limit=False)
+
     def _fused_flush_to_updater(self):
+        # state hand-offs and saves must see a settled optimizer clock
+        self._resolve_pending_verdict()
         if self._fused is None:
             return
         from ..optimizer import fused_state_to_updater
@@ -194,23 +238,31 @@ class Trainer:
                 fused_state_to_updater(kind, st)
 
     def save_states(self, fname):
+        """Atomic, checksummed write (checkpoint.write_state_file)."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
             self._kv.save_optimizer_states(fname, dump_optimizer=True)
         else:
+            from ..checkpoint import write_state_file
             self._fused_flush_to_updater()
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters.get_states())
+            write_state_file(fname, self._updaters.get_states())
 
     def load_states(self, fname):
+        """Validated read — corrupt state files raise MXNetError naming
+        the path (checkpoint.load_state_file)."""
+        # settle any in-flight verdict against the OLD optimizer before
+        # its state is replaced; a stale rollback applied to the restored
+        # clock would corrupt Adam's t / the lr schedule
+        self._resolve_pending_verdict()
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
             self._kv.load_optimizer_states(fname)
             self._optimizer = self._kv._optimizer
         else:
-            with open(fname, "rb") as f:
-                self._updaters.set_states(f.read())
+            from ..checkpoint import load_state_file
+            load_state_file(fname, self._updaters.set_states)
             self._fused = None  # re-seed fused state from the Updater
+        self._consec_guard_skips = 0  # fresh state, fresh streak

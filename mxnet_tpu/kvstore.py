@@ -291,17 +291,32 @@ class KVStore:
         """No server processes exist in the TPU design; commands are local."""
 
     # -- optimizer state checkpointing -------------------------------------
-    def save_optimizer_states(self, fname, dump_optimizer=False):
+    def _optimizer_states_bytes(self, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("there is no updater")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
+        return self._updater.get_states(dump_optimizer=dump_optimizer)
+
+    def _set_optimizer_states_bytes(self, payload):
+        if self._updater is None:
+            raise MXNetError("there is no updater")
+        self._updater.set_states(payload)
+        if self._optimizer is not None and \
+                self._updater.optimizer is not self._optimizer:
+            # a dump_optimizer save round-trips the optimizer object too
+            self._optimizer = self._updater.optimizer
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        """Atomic, checksummed write — same write/validate path as
+        checkpoints (checkpoint.write_state_file)."""
+        from .checkpoint import write_state_file
+        write_state_file(
+            fname, self._optimizer_states_bytes(dump_optimizer))
 
     def load_optimizer_states(self, fname):
-        if self._updater is None:
-            raise MXNetError("there is no updater")
-        with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+        """Validated read: a torn/corrupt state file raises MXNetError
+        naming the path, not a cryptic unpickling error."""
+        from .checkpoint import load_state_file
+        load_state_file(fname, self._set_optimizer_states_bytes)
 
 
 from .base import _maybe_init_distributed

@@ -97,14 +97,18 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(index * num_device + k, g, w)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Write prefix-symbol.json + prefix-%04d.params (reference :340)."""
-    if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    keep_last=None):
+    """Write prefix-symbol.json + prefix-%04d.params (reference :340).
+
+    Crash-safe via checkpoint.CheckpointManager: each artifact lands
+    atomically and a manifest with content checksums commits the epoch
+    LAST, so recovery (``CheckpointManager.latest()``) never picks up a
+    torn half-written checkpoint.  ``keep_last`` prunes to the N newest
+    complete checkpoints."""
+    from .checkpoint import CheckpointManager
+    CheckpointManager(prefix, keep_last=keep_last).save(
+        epoch, arg_params, aux_params, symbol=symbol)
 
 
 def load_params(prefix, epoch):

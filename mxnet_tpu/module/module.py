@@ -71,6 +71,7 @@ class Module(BaseModule):
         self._updater = None
         self._preload_opt_states = None
         self._fused = None  # fused fit_step cache (program + opt state)
+        self._consec_guard_skips = 0  # divergence-guard skip streak
 
         self._exec = None
         self._data_shapes = None
@@ -88,14 +89,23 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """Save symbol+params(+optimizer states) (reference :173)."""
-        self._symbol.save("%s-symbol.json" % prefix)
-        param_name = "%s-%04d.params" % (prefix, epoch)
-        self.save_params(param_name)
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        keep_last=None):
+        """Save symbol+params(+optimizer states) (reference :173).
+
+        Crash-safe: every artifact is written atomically and the epoch's
+        manifest commits last (checkpoint.CheckpointManager), so a crash
+        mid-save can never produce a checkpoint that recovery would
+        mistake for complete.  ``keep_last`` prunes to the N newest
+        complete checkpoints."""
+        from ..checkpoint import CheckpointManager
+        states = None
         if save_optimizer_states:
-            state_name = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(state_name)
+            states = self._optimizer_states_bytes()
+        arg_params, aux_params = self.get_params()
+        CheckpointManager(prefix, keep_last=keep_last).save(
+            epoch, arg_params, aux_params, symbol=self._symbol,
+            optimizer_states=states)
 
     # -- properties --------------------------------------------------------
     @property
@@ -469,9 +479,11 @@ class Module(BaseModule):
             self.optimizer_initialized
         if not self._fused_eligible():
             return super().fit_step(data_batch)
+        from .. import fault as _fault
         from .. import profiler as _profiler
         from .. import random as _random
         from ..ndarray.ndarray import NDArray
+        from ..ops.optimizer_ops import handle_guard_verdict
 
         fused = self._fused_setup()
         exe = self._exec
@@ -490,9 +502,12 @@ class Module(BaseModule):
 
         opt = self._optimizer
         first_idx = None
+        update_idxs = []
+        pre_num_update = opt.num_update
         for i, name in enumerate(self._param_names):
             if name in in_update:
                 opt._update_count(i)
+                update_idxs.append(i)
                 if first_idx is None:
                     first_idx = i
         t = float(opt._index_update_count[first_idx]) \
@@ -500,12 +515,13 @@ class Module(BaseModule):
         lr = opt.fused_base_lr()
         wd = float(opt.wd)
         rescale = float(opt.rescale_grad)
+        poison = float("nan") if _fault.trigger("grad.nan") else 0.0
 
         rng = _random.next_key()
         with _profiler._timed("module_fit_step") as timed:
-            outs, new_params, new_state, new_aux = fused["step"](
+            outs, new_params, new_state, new_aux, ok = fused["step"](
                 param_vals, fused["state"], other_vals, aux_vals, rng,
-                lr, wd, rescale, t)
+                lr, wd, rescale, t, poison)
             timed.sync_arrays = outs
         fused["state"] = new_state
         # donated inputs are dead now — re-point every wrapper at the
@@ -517,6 +533,13 @@ class Module(BaseModule):
         exe.outputs = [NDArray(o, exe._ctx) for o in outs]
         self._params_dirty = True
         _profiler.note_step()
+        # divergence guard verdict: reading the scalar costs one small
+        # host readback that the fit loop's metric update would force
+        # anyway (PERF.md "Divergence guard"); a skipped step rewinds the
+        # optimizer clocks so it is as if the batch never arrived
+        self._consec_guard_skips = handle_guard_verdict(
+            ok, opt, update_idxs, self._consec_guard_skips,
+            pre_num_update)
 
     def update(self):
         """Apply optimizer using accumulated grads (reference module.py:615)."""
@@ -572,23 +595,36 @@ class Module(BaseModule):
                 self._exec.arg_dict[name][:] = value
 
     # -- optimizer state io -------------------------------------------------
+    def _optimizer_states_bytes(self):
+        """Current optimizer state as the payload save_optimizer_states
+        persists (fused state flushed into the Updater first)."""
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            return self._kvstore._optimizer_states_bytes()
+        self._fused_flush_to_updater()
+        return self._updater.get_states()
+
     def save_optimizer_states(self, fname):
+        """Atomic, checksummed write (checkpoint.write_state_file)."""
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
+            from ..checkpoint import write_state_file
             self._fused_flush_to_updater()
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            write_state_file(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        """Validated read: a torn/corrupt state file raises MXNetError
+        naming the path instead of a cryptic unpickling error."""
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+            from ..checkpoint import load_state_file
+            load_state_file(fname, self._updater.set_states)
             self._fused = None  # re-seed fused state from the Updater
+        self._consec_guard_skips = 0  # fresh state, fresh streak
 
     def reshape(self, data_shapes, label_shapes=None):
         """Re-bind for new shapes (XLA re-jits; params carry over)."""

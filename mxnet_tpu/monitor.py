@@ -37,13 +37,17 @@ class StepStatsMonitor(object):
         if self._nseen % self.interval:
             return
         stats = _profiler.step_stats()
-        prev = self._last or {"dispatch_count": 0, "compile_count": 0}
+        prev = self._last or {"dispatch_count": 0, "compile_count": 0,
+                              "skipped_steps": 0}
         ema = stats["step_time_ema_s"]
+        skipped = stats.get("skipped_steps", 0) - \
+            prev.get("skipped_steps", 0)
         self.logger.info(
-            "step[%d] dispatches +%d compiles +%d step_time_ema %s",
+            "step[%d] dispatches +%d compiles +%d%s step_time_ema %s",
             self._nseen,
             stats["dispatch_count"] - prev["dispatch_count"],
             stats["compile_count"] - prev["compile_count"],
+            " SKIPPED +%d (non-finite grads)" % skipped if skipped else "",
             "%.2f ms" % (ema * 1e3) if ema is not None else "n/a")
         self._last = stats
 

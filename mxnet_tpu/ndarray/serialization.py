@@ -190,9 +190,10 @@ def _deserialize_ndarray(r):
                           dtype=dtype).reshape(shape)
 
 
-def save_ndarray_list(fname, arrays, names):
-    """Write the reference list container. ``arrays`` elements are numpy
-    arrays or ('row_sparse', data, indices, shape) tuples."""
+def dumps_ndarray_list(arrays, names):
+    """Serialize the reference list container to bytes. ``arrays``
+    elements are numpy arrays or ('row_sparse', data, indices, shape) /
+    ('csr', ...) tuples."""
     out = [struct.pack("<QQ", LIST_MAGIC, 0),
            struct.pack("<Q", len(arrays))]
     for a in arrays:
@@ -207,8 +208,15 @@ def save_ndarray_list(fname, arrays, names):
         b = name.encode("utf-8")
         out.append(struct.pack("<Q", len(b)))
         out.append(b)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    return b"".join(out)
+
+
+def save_ndarray_list(fname, arrays, names):
+    """Write the reference list container crash-safely: serialize to
+    bytes, then publish via checkpoint.atomic_write (tmp + fsync +
+    os.replace) so the final path never holds a torn file."""
+    from ..checkpoint import atomic_write
+    atomic_write(fname, dumps_ndarray_list(arrays, names))
 
 
 def load_ndarray_list(data):

@@ -52,6 +52,8 @@ def _to_payload(data):
 
 
 def save(fname, data):
+    from .. import fault as _fault
+    _fault.check("nd.save", "crash entering nd.save(%r)" % fname)
     arrays, names = _to_payload(data)
     _ser.save_ndarray_list(fname, arrays, names)
 

@@ -12,6 +12,9 @@ before wd, update order) so convergence curves are comparable.
 """
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
 
 from .registry import register_op
@@ -216,6 +219,101 @@ def make_fused_apply(kind, mults, momentum=0.0, beta1=0.9, beta2=0.999,
         return new_params, new_state
 
     return init_state, apply
+
+
+# -- divergence guard --------------------------------------------------------
+#
+# The fused train step applies the optimizer inside the same XLA program as
+# forward+backward; one batch producing a non-finite gradient would silently
+# drive the whole parameter tree to NaN and every subsequent step would
+# compound it.  The guard below folds an all-finite check on the GLOBAL
+# gradient tree into that same program (still one dispatch per step): when
+# any gradient leaf is NaN/Inf the update is a tree-wide no-op — params and
+# optimizer state pass through unchanged — and the scalar verdict is
+# returned so the host can count skips and fail loudly after K consecutive
+# ones (see max_consecutive_skips / MXNetError in module.py & trainer.py).
+
+
+def all_finite(tree):
+    """Scalar bool: every leaf of ``tree`` is entirely finite.  One
+    fused reduction chain, no host sync."""
+    ok = jnp.bool_(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def make_guarded_apply(apply_fn):
+    """Wrap a tree-wide ``apply`` (from make_fused_apply) with the
+    divergence guard.
+
+    Returns ``guarded(params, grads, state, lr, wd, rescale_grad, t,
+    poison) -> (new_params, new_state, ok)``: when the (poisoned) gradient
+    tree contains NaN/Inf, params/state pass through unchanged and ``ok``
+    is False.  ``poison`` is a dynamic scalar added to every gradient —
+    0.0 in production, NaN when the ``grad.nan`` fault-injection site
+    fires — so tests drive the skip path through the very same compiled
+    program, with no trace divergence between guarded and injected runs.
+    """
+    def guarded(params, grads, state, lr, wd, rescale_grad, t, poison):
+        grads = {name: g + poison for name, g in grads.items()}
+        ok = all_finite(grads)
+        new_params, new_state = apply_fn(params, grads, state, lr, wd,
+                                         rescale_grad, t)
+        new_params = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(ok, n, o), new_params, params)
+        new_state = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(ok, n, o), new_state, state)
+        return new_params, new_state, ok
+
+    return guarded
+
+
+def max_consecutive_skips():
+    """K in the graceful-degradation contract: after K consecutive
+    guard-skipped steps the training loop raises MXNetError instead of
+    silently looping on a permanently-divergent configuration.
+    Overridable per-run via MXTPU_MAX_CONSECUTIVE_SKIPS."""
+    return int(os.environ.get("MXTPU_MAX_CONSECUTIVE_SKIPS", "100"))
+
+
+def raise_skip_limit_error(limit):
+    from ..base import MXNetError
+    raise MXNetError(
+        "divergence guard: %d consecutive steps produced non-finite "
+        "gradients — training cannot progress (lower the learning "
+        "rate, check the data pipeline, or raise "
+        "MXTPU_MAX_CONSECUTIVE_SKIPS)" % limit)
+
+
+def handle_guard_verdict(ok, optimizer, indices, streak, pre_num_update,
+                         raise_on_limit=True):
+    """Host-side bookkeeping shared by Module.fit_step and
+    gluon.Trainer._fused_step after the guarded program returns.
+
+    On a skipped step the optimizer clock is rewound so the batch is
+    indistinguishable from one that never arrived: ``_index_update_count``
+    (Adam's t) for every updated index and ``num_update`` (the lr
+    scheduler's clock, captured by the caller BEFORE its _update_count
+    calls) both roll back.  Returns the new consecutive-skip streak;
+    with ``raise_on_limit`` it raises MXNetError at
+    max_consecutive_skips().  The Trainer resolves verdicts from its
+    save/flush paths with ``raise_on_limit=False`` — a checkpoint write
+    must never be aborted by a training-health error — and re-checks the
+    limit at the top of the next step() instead.
+    """
+    if bool(ok):
+        return 0
+    from .. import profiler as _profiler
+    for i in indices:
+        optimizer._index_update_count[i] -= 1
+    optimizer.num_update = pre_num_update
+    _profiler.note_skipped_step()
+    streak += 1
+    limit = max_consecutive_skips()
+    if raise_on_limit and streak >= limit:
+        raise_skip_limit_error(limit)
+    return streak
 
 
 @register_op("ftrl_update", arg_names=("weight", "grad", "z", "n"),

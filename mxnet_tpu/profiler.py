@@ -24,8 +24,9 @@ import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "State", "set_config", "set_state", "pause", "resume",
-           "count_dispatch", "count_compile", "note_step", "step_stats",
-           "reset_step_stats", "instrument"]
+           "count_dispatch", "count_compile", "note_step",
+           "note_skipped_step", "step_stats", "reset_step_stats",
+           "instrument"]
 
 _lock = threading.Lock()
 _state = "stop"
@@ -148,6 +149,7 @@ _step_lock = threading.Lock()
 _dispatch_count = 0
 _compile_count = 0
 _step_count = 0
+_skipped_step_count = 0
 _step_ema_s = None
 _last_step_t = None
 _EMA_ALPHA = 0.1
@@ -185,22 +187,35 @@ def note_step():
         _last_step_t = now
 
 
+def note_skipped_step():
+    """Record one divergence-guard skip: the fused step ran (and counted
+    its dispatch) but the all-finite check vetoed the parameter update.
+    A healthy run keeps this at 0; a rising count with training still
+    progressing means occasional bad batches are being absorbed."""
+    global _skipped_step_count
+    with _step_lock:
+        _skipped_step_count += 1
+
+
 def step_stats():
-    """Snapshot {dispatch_count, compile_count, steps, step_time_ema_s}."""
+    """Snapshot {dispatch_count, compile_count, steps, skipped_steps,
+    step_time_ema_s}."""
     with _step_lock:
         return {"dispatch_count": _dispatch_count,
                 "compile_count": _compile_count,
                 "steps": _step_count,
+                "skipped_steps": _skipped_step_count,
                 "step_time_ema_s": _step_ema_s}
 
 
 def reset_step_stats():
-    global _dispatch_count, _compile_count, _step_count, _step_ema_s, \
-        _last_step_t
+    global _dispatch_count, _compile_count, _step_count, \
+        _skipped_step_count, _step_ema_s, _last_step_t
     with _step_lock:
         _dispatch_count = 0
         _compile_count = 0
         _step_count = 0
+        _skipped_step_count = 0
         _step_ema_s = None
         _last_step_t = None
 

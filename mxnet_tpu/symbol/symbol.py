@@ -479,8 +479,10 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        # crash-safe like every other checkpoint artifact: the final path
+        # only ever holds a complete symbol file
+        from ..checkpoint import atomic_write
+        atomic_write(fname, self.tojson().encode("utf-8"))
 
     def debug_str(self):
         lines = []

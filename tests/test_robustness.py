@@ -1,0 +1,548 @@
+"""Resilient-training runtime: crash-safe checkpoints, the divergence-
+guarded fused step, and the fault-injection layer that exercises both.
+
+Every recovery path here is driven through mxnet_tpu.fault injections —
+deterministically, in-process, fast — rather than trusted on inspection.
+The multi-process kill-restart integration lives in
+test_fault_injection.py (slow marker).
+"""
+import os
+import subprocess
+import sys
+import traceback
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import fault, profiler
+from mxnet_tpu.checkpoint import CheckpointManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+def _make_module(batch=16, n=64, dim=10):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, dim).astype(np.float32)
+    Y = rs.randint(0, 2, n).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                              name="fc1"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod, list(it)
+
+
+def _fc1(mod):
+    return mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+
+
+# -- atomic writes -----------------------------------------------------------
+
+@pytest.mark.fault
+def test_atomic_save_no_partial_file_after_crash(tmp_path):
+    """An injected crash between the tmp write and the publish must leave
+    NOTHING at the final path — the atomicity contract itself."""
+    mod, batches = _make_module()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1)
+    fault.configure("ckpt.write.crash:1")
+    with pytest.raises(fault.FaultInjected):
+        mod.save_checkpoint(prefix, 2)
+    assert not os.path.exists(prefix + "-0002.params")
+    assert not os.path.exists(prefix + "-0002.manifest.json")
+    # the previous checkpoint is untouched and still the newest complete
+    assert CheckpointManager(prefix).latest() == 1
+
+
+@pytest.mark.fault
+def test_atomic_write_retries_transient_ioerror(tmp_path):
+    """Transient OSErrors are retried with backoff and the write lands."""
+    path = str(tmp_path / "x.bin")
+    fault.configure("ckpt.write.ioerror:2")
+    ckpt.atomic_write(path, b"payload", backoff=0.001)
+    with open(path, "rb") as f:
+        assert f.read() == b"payload"
+    assert fault.fire_count("ckpt.write.ioerror") == 2
+
+
+def test_atomic_write_exhausted_retries_raise(tmp_path):
+    fault.configure("ckpt.write.ioerror:99")
+    with pytest.raises(OSError):
+        ckpt.atomic_write(str(tmp_path / "x.bin"), b"p",
+                          retries=2, backoff=0.001)
+
+
+# -- checkpoint discovery / recovery -----------------------------------------
+
+@pytest.mark.fault
+def test_torn_checkpoint_latest_falls_back_and_training_resumes(tmp_path):
+    """A torn final-epoch checkpoint is skipped by latest(); recovery
+    loads the previous complete epoch and training continues from it."""
+    mod, batches = _make_module()
+    prefix = str(tmp_path / "ckpt")
+    for b in batches:
+        mod.fit_step(b)
+    for epoch in (1, 2):
+        mod.save_checkpoint(prefix, epoch)
+    fault.configure("ckpt.write.torn:1")
+    with pytest.raises(fault.FaultInjected):
+        mod.save_checkpoint(prefix, 3)
+    # the torn artifact exists at the final path — exactly the legacy
+    # failure mode — yet discovery refuses it
+    assert os.path.exists(prefix + "-0003.params")
+    mgr = CheckpointManager(prefix)
+    assert mgr.latest() == 2
+    epoch, args, auxs = mgr.load()
+    assert epoch == 2
+    # resume: a fresh module inits from the recovered params and trains
+    mod2, batches2 = _make_module()
+    mod2.init_params(arg_params=args, aux_params=auxs, force_init=True)
+    w0 = _fc1(mod2)
+    mod2.fit_step(batches2[0])
+    assert not np.array_equal(w0, _fc1(mod2))
+
+
+def test_explicit_load_of_torn_checkpoint_raises(tmp_path):
+    mod, _ = _make_module()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1)
+    mod.save_checkpoint(prefix, 2)
+    # corrupt epoch 2's params under its manifest
+    with open(prefix + "-0002.params", "r+b") as f:
+        f.truncate(10)
+    mgr = CheckpointManager(prefix)
+    with pytest.raises(mx.MXNetError, match="torn or corrupt"):
+        mgr.load(2)
+    assert mgr.latest() == 1
+
+
+def test_corrupt_symbol_file_fails_validation(tmp_path):
+    """A damaged prefix-symbol.json must not leave 'complete' checkpoints
+    behind — Module.load would crash-loop on it at every restart."""
+    mod, _ = _make_module()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1)
+    mgr = CheckpointManager(prefix)
+    assert mgr.latest() == 1
+    with open(prefix + "-symbol.json", "wb") as f:
+        f.write(b"{truncated json")
+    assert not mgr.validate(1)
+    assert mgr.latest() is None
+
+
+def test_latest_legacy_manifestless_scan_skips_torn(tmp_path):
+    """Prefixes written before manifests existed: newest .params file
+    that parses wins; garbage is skipped."""
+    prefix = str(tmp_path / "leg")
+    mx.nd.save(prefix + "-0001.params", {"arg:w": mx.nd.array([1.0])})
+    with open(prefix + "-0002.params", "wb") as f:
+        f.write(b"torn-garbage")
+    assert CheckpointManager(prefix).latest() == 1
+
+
+def test_latest_never_resurrects_manifested_but_invalid_epoch(tmp_path):
+    """A damaged checkpoint that HAS a manifest must not be rediscovered
+    through the legacy manifest-less scan: latest() either falls back to
+    an older complete epoch or reports none — it never returns an epoch
+    that load() would then refuse (that would be a resume crash loop)."""
+    mod, _ = _make_module()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    # damage the only checkpoint's states file under its manifest
+    with open(prefix + "-0001.states", "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        f.write(b"\x00")
+    mgr = CheckpointManager(prefix)
+    assert not mgr.validate(1)
+    assert mgr.latest() is None  # params alone must NOT resurrect it
+    with pytest.raises(mx.MXNetError):
+        mgr.load()
+
+
+def test_load_of_pruned_epoch_raises_mxnet_error(tmp_path):
+    """Explicitly loading an epoch that retention pruned surfaces the
+    documented MXNetError (naming path + latest), not FileNotFoundError."""
+    mod, _ = _make_module()
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / "r")
+    mgr = CheckpointManager(prefix, keep_last=2)
+    for epoch in range(1, 5):
+        mgr.save(epoch, arg, aux)
+    with pytest.raises(mx.MXNetError, match="pruned or never written"):
+        mgr.load(1)
+
+
+def test_retention_keeps_last_n(tmp_path):
+    mod, _ = _make_module()
+    prefix = str(tmp_path / "r")
+    arg, aux = mod.get_params()
+    mgr = CheckpointManager(prefix, keep_last=2)
+    for epoch in range(1, 6):
+        mgr.save(epoch, arg, aux)
+    assert mgr.complete_epochs() == [4, 5]
+    assert not os.path.exists(prefix + "-0001.params")
+    assert mgr.latest() == 5
+
+
+def test_manager_save_load_roundtrip_with_states(tmp_path):
+    mod, batches = _make_module()
+    for b in batches:
+        mod.fit_step(b)
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 1)
+    np.testing.assert_array_equal(args["fc1_weight"].asnumpy(), _fc1(mod))
+    mgr = CheckpointManager(prefix)
+    assert mgr.load_optimizer_states(1)  # validated payload bytes
+    # Module.load picks the states file up through the standard path
+    mod2 = mx.mod.Module.load(prefix, 1, load_optimizer_states=True)
+    assert mod2._preload_opt_states == prefix + "-0001.states"
+
+
+# -- divergence guard --------------------------------------------------------
+
+@pytest.mark.fault
+def test_nan_batch_skips_update_counter_and_recovery():
+    """NaN-injected step: params/opt-state untouched, skipped_steps
+    increments, and the next clean batch updates normally."""
+    mod, batches = _make_module()
+    for b in batches:
+        mod.fit_step(b)
+    profiler.reset_step_stats()
+    w0 = _fc1(mod)
+    fault.configure("grad.nan:1")
+    mod.fit_step(batches[0])
+    st = profiler.step_stats()
+    assert st["skipped_steps"] == 1 and st["dispatch_count"] == 1
+    np.testing.assert_array_equal(w0, _fc1(mod))
+    mod.fit_step(batches[1])  # injection budget exhausted — clean step
+    st = profiler.step_stats()
+    assert st["skipped_steps"] == 1
+    assert not np.array_equal(w0, _fc1(mod))
+
+
+@pytest.mark.fault
+def test_k_consecutive_skips_raise_mxnet_error(monkeypatch):
+    monkeypatch.setenv("MXTPU_MAX_CONSECUTIVE_SKIPS", "3")
+    mod, batches = _make_module()
+    mod.fit_step(batches[0])
+    profiler.reset_step_stats()
+    fault.configure("grad.nan:999")
+    with pytest.raises(mx.MXNetError, match="divergence guard"):
+        for _ in range(10):
+            for b in batches:
+                mod.fit_step(b)
+    # raised at exactly K: K skips happened, not one more
+    assert profiler.step_stats()["skipped_steps"] == 3
+
+
+@pytest.mark.fault
+def test_guarded_fused_step_still_one_dispatch_per_step():
+    """The guard (and the poison input) ride INSIDE the fused program:
+    dispatch count stays exactly 1/step, compile count 0 in steady state,
+    even across a skipped step."""
+    mod, batches = _make_module()
+    for b in batches:
+        mod.fit_step(b)  # warm: compile happens here
+    profiler.reset_step_stats()
+    fault.configure("grad.nan:1")
+    for b in batches:
+        mod.fit_step(b)
+    st = profiler.step_stats()
+    assert st["dispatch_count"] == len(batches)
+    assert st["compile_count"] == 0
+    assert st["skipped_steps"] == 1
+
+
+@pytest.mark.fault
+def test_gluon_trainer_guard_skip_and_raise(monkeypatch):
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import Trainer, nn
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=None)
+    x = mx.nd.array(np.random.RandomState(0).randn(16, 8)
+                    .astype(np.float32))
+
+    def step():
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        tr.step(16)
+
+    step()
+    param = list(net.collect_params().values())[0]
+    w0 = param.data().asnumpy().copy()
+    fault.configure("grad.nan:1")
+    profiler.reset_step_stats()
+    step()
+    # params are already protected (the no-op select runs on device)...
+    np.testing.assert_array_equal(w0, param.data().asnumpy())
+    step()  # clean step; also resolves the DEFERRED verdict of the
+    # poisoned one (the trainer reads it one step late to keep the
+    # dispatch pipeline deep)
+    assert profiler.step_stats()["skipped_steps"] == 1
+    assert not np.array_equal(w0, param.data().asnumpy())
+
+    monkeypatch.setenv("MXTPU_MAX_CONSECUTIVE_SKIPS", "2")
+    fault.configure("grad.nan:999")
+    with pytest.raises(mx.MXNetError, match="divergence guard"):
+        for _ in range(5):
+            step()
+
+
+def test_skipped_step_does_not_advance_optimizer_clocks():
+    """Both optimizer clocks — the per-index update count t (Adam bias
+    correction) AND num_update (the lr-scheduler clock) — roll back on a
+    skipped step, so a skip is indistinguishable from the batch never
+    arriving."""
+    mod, batches = _make_module()
+    mod.init_optimizer(kvstore=None, optimizer="adam", force_init=True)
+    mod.fit_step(batches[0])
+    t0 = dict(mod._optimizer._index_update_count)
+    nu0 = mod._optimizer.num_update
+    fault.configure("grad.nan:1")
+    mod.fit_step(batches[1])
+    assert dict(mod._optimizer._index_update_count) == t0
+    assert mod._optimizer.num_update == nu0
+    fault.reset()
+    mod.fit_step(batches[2])
+    assert all(v == t0[k] + 1
+               for k, v in mod._optimizer._index_update_count.items())
+    assert mod._optimizer.num_update == nu0 + 1
+
+
+@pytest.mark.fault
+def test_skipped_step_does_not_commit_poisoned_aux():
+    """A NaN batch (bad input data → NaN aux updates AND NaN grads) must
+    not commit poisoned BatchNorm moving statistics: the guard's skip
+    covers the aux tree, not just params."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 10).astype(np.float32)
+    Y = rs.randint(0, 2, 64).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="fc1")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd")
+    batches = list(it)
+    for b in batches:
+        mod.fit_step(b)
+    aux0 = {k: v.asnumpy().copy()
+            for k, v in mod.get_params()[1].items()}
+    assert aux0, "BatchNorm should expose moving mean/var aux"
+    bad = batches[0]
+    bad.data[0][:] = float("nan")
+    mod.fit_step(bad)
+    assert profiler.step_stats()["skipped_steps"] >= 1
+    _, aux1 = mod.get_params()
+    for k, v0 in aux0.items():
+        v1 = aux1[k].asnumpy()
+        assert np.isfinite(v1).all(), "%s poisoned by skipped batch" % k
+        np.testing.assert_array_equal(v0, v1)
+    mod.fit_step(batches[1])  # clean batch advances aux again
+    _, aux2 = mod.get_params()
+    assert any(not np.array_equal(aux0[k], aux2[k].asnumpy())
+               for k in aux0)
+
+
+@pytest.mark.fault
+def test_trainer_save_states_never_aborts_on_skip_limit(monkeypatch,
+                                                        tmp_path):
+    """The checkpoint write that exists FOR recovery must not raise the
+    divergence-guard error; the raise belongs to the next step()."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import Trainer, nn
+    monkeypatch.setenv("MXTPU_MAX_CONSECUTIVE_SKIPS", "2")
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=None)
+    x = mx.nd.array(np.random.RandomState(0).randn(16, 8)
+                    .astype(np.float32))
+
+    def step():
+        with autograd.record():
+            loss = (net(x) * net(x)).sum()
+        loss.backward()
+        tr.step(16)
+
+    step()
+    fault.configure("grad.nan:999")
+    step()  # skip 1 (resolved at next step entry)
+    step()  # resolves skip 1; skip 2 left pending
+    fname = str(tmp_path / "mid.states")
+    tr.save_states(fname)  # resolves skip 2 (streak hits K) — no raise
+    assert os.path.exists(fname)
+    with pytest.raises(mx.MXNetError, match="divergence guard"):
+        step()
+    fault.reset()
+    # restoring states clears the streak and any stale pending verdict:
+    # training continues instead of instantly re-raising
+    tr.load_states(fname)
+    nu_loaded = tr._optimizer.num_update
+    step()
+    assert tr._optimizer.num_update == nu_loaded + 1
+
+
+# -- optimizer state files ---------------------------------------------------
+
+@pytest.mark.fault
+def test_corrupt_optimizer_state_file_raises_with_path(tmp_path):
+    mod, batches = _make_module()
+    for b in batches:
+        mod.fit_step(b)
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    mod.load_optimizer_states(fname)  # clean round trip
+    with open(fname, "r+b") as f:
+        f.seek(-4, os.SEEK_END)
+        f.write(b"\x00\x00\x00\x00")
+    with pytest.raises(mx.MXNetError, match="opt.states"):
+        mod.load_optimizer_states(fname)
+
+
+def test_legacy_unframed_state_file_still_loads(tmp_path):
+    """Pre-frame .states files (raw pickle) keep loading."""
+    mod, batches = _make_module()
+    for b in batches:
+        mod.fit_step(b)
+    fname = str(tmp_path / "legacy.states")
+    payload = mod._optimizer_states_bytes()
+    with open(fname, "wb") as f:
+        f.write(payload)
+    mod.load_optimizer_states(fname)
+
+
+def test_kvstore_corrupt_states_raise(tmp_path):
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.array([1.0]))
+    kv.set_optimizer(mx.optimizer.create("sgd"))
+    fname = str(tmp_path / "kv.states")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)
+    with open(fname, "wb") as f:
+        f.write(ckpt._STATE_MAGIC + b"\x00" * 32 + b"not-a-pickle")
+    with pytest.raises(mx.MXNetError, match="kv.states"):
+        kv.load_optimizer_states(fname)
+
+
+# -- DataLoader prefetcher ---------------------------------------------------
+
+def _loader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    ds = ArrayDataset(
+        mx.nd.array(np.arange(40).reshape(10, 4).astype(np.float32)),
+        mx.nd.array(np.arange(10).astype(np.float32)))
+    return DataLoader(ds, batch_size=2)
+
+
+def test_prefetch_iter_context_manager_frees_worker():
+    it = iter(_loader())
+    with it:
+        next(it)
+    assert not it._worker.is_alive()
+    with pytest.raises(StopIteration):
+        next(it)  # closed iterator stays closed
+
+
+def test_prefetch_iter_close_idempotent_and_on_exhaustion():
+    it = iter(_loader())
+    for _ in it:
+        pass
+    assert not it._worker.is_alive()  # released at exhaustion, not GC
+    it.close()
+    it.close()
+
+
+@pytest.mark.fault
+def test_prefetch_worker_exception_chains_original_traceback():
+    fault.configure("data.prefetch:1")
+    it = iter(_loader())
+    with pytest.raises(fault.FaultInjected) as exc_info:
+        for _ in it:
+            pass
+    frames = traceback.extract_tb(exc_info.value.__traceback__)
+    # the surfaced traceback reaches back into the worker thread
+    assert any("dataloader" in f.filename for f in frames)
+    assert not it._worker.is_alive()
+
+
+# -- launcher ----------------------------------------------------------------
+
+def _launch_mod():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import launch
+    return launch
+
+
+def test_classify_exit():
+    launch = _launch_mod()
+    assert launch.classify_exit(-9)[0] == "retryable"   # SIGKILL/OOM
+    assert launch.classify_exit(1)[0] == "retryable"    # runtime crash
+    assert launch.classify_exit(2)[0] == "permanent"    # usage/import
+    assert launch.classify_exit(127)[0] == "permanent"  # not runnable
+
+
+def test_launch_permanent_failure_preserves_restart_budget():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "1", "--max-restarts", "3", "--restart-backoff", "0.01",
+         "--", sys.executable, "-c", "import sys; sys.exit(2)"],
+        capture_output=True, timeout=120)
+    err = r.stderr.decode()
+    assert r.returncode == 2
+    assert "classified permanent" in err
+    assert "restarting job" not in err
+
+
+def test_launch_retryable_failure_backs_off_and_restarts():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "1", "--max-restarts", "2", "--restart-backoff", "0.01",
+         "--", sys.executable, "-c", "import sys; sys.exit(1)"],
+        capture_output=True, timeout=120)
+    err = r.stderr.decode()
+    assert r.returncode == 1
+    assert err.count("restarting job from checkpoints") == 2
+    assert "classified retryable" in err
+    assert "backing off" in err
+
+
+# -- fault spec parsing ------------------------------------------------------
+
+def test_fault_spec_parsing_and_determinism():
+    fault.configure("a.b:2;c.d:0.5")
+    assert fault.is_active("a.b") and fault.is_active("c.d")
+    assert fault.trigger("a.b") and fault.trigger("a.b")
+    assert not fault.trigger("a.b")  # count exhausted
+    assert not fault.is_active("a.b")
+    assert fault.fire_count("a.b") == 2
+    # rate sites draw from a seeded RNG: same spec → same sequence
+    seq1 = [fault.trigger("c.d") for _ in range(32)]
+    fault.configure("c.d:0.5")
+    seq2 = [fault.trigger("c.d") for _ in range(32)]
+    assert seq1 == seq2 and any(seq1) and not all(seq1)
+    with pytest.raises(mx.MXNetError):
+        fault.configure("bad-entry")
+    fault.configure("")
+    assert not fault.trigger("a.b")

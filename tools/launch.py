@@ -105,7 +105,31 @@ def _run_local_once(args, cmd, attempt):
         return -1, 1
 
 
+def classify_exit(rc):
+    """Classify a failed worker's exit code → ('retryable'|'permanent',
+    reason).
+
+    Restart attempts are a scarce budget; burning one on a failure that
+    will repeat identically (CLI misuse exit 2, unresolvable/unrunnable
+    command 126/127) just delays the terminal error.  Deaths by signal
+    (rc < 0: OOM-killer SIGKILL, preemption SIGTERM, segfaults) and
+    generic runtime failures (rc == 1: an uncaught exception
+    mid-training) are exactly what checkpoint-restart exists for.  Note
+    the interpreter exits 1 for uncaught ImportError too — exit codes
+    cannot distinguish an import-time crash from a mid-training one, so
+    those retry conservatively (bounded by the backoff schedule)."""
+    if rc < 0:
+        return "retryable", "killed by signal %d" % (-rc)
+    if rc == 2:
+        return "permanent", ("exit code 2: usage/import-time error — "
+                             "would fail identically on every attempt")
+    if rc in (126, 127):
+        return "permanent", "exit code %d: command not runnable" % rc
+    return "retryable", "exit code %d: runtime failure" % rc
+
+
 def launch_local(args, cmd):
+    import time
     if args.dry_run:
         port = args.port or _free_port()
         for rank in range(args.num_workers):
@@ -123,6 +147,23 @@ def launch_local(args, cmd):
             return 0
         if failed_rank == -1 or attempt == args.max_restarts:
             return rc or 1
+        kind, reason = classify_exit(rc)
+        print("launch.py: worker %d failure classified %s (%s)"
+              % (failed_rank, kind, reason), file=sys.stderr, flush=True)
+        if kind == "permanent":
+            print("launch.py: not restarting — failure is not retryable "
+                  "(%d restart attempts preserved)"
+                  % (args.max_restarts - attempt),
+                  file=sys.stderr, flush=True)
+            return rc or 1
+        # exponential backoff: crash loops (a flaky host, a wedged
+        # coordinator port) get geometrically more breathing room
+        delay = min(args.restart_backoff * (2 ** attempt),
+                    args.restart_backoff_max)
+        if delay > 0:
+            print("launch.py: backing off %.2fs before restart" % delay,
+                  file=sys.stderr, flush=True)
+            time.sleep(delay)
         print("launch.py: restarting job from checkpoints "
               "(attempt %d/%d) after worker %d failure"
               % (attempt + 1, args.max_restarts, failed_rank),
@@ -233,7 +274,13 @@ def main(argv=None):
                         help="restart the whole job this many times when "
                         "a worker dies (workers resume from their own "
                         "checkpoints; MXTPU_RESTART_ATTEMPT tells them "
-                        "which attempt is running)")
+                        "which attempt is running); non-retryable "
+                        "failures (e.g. exit code 2) stop immediately")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        help="base seconds between restarts; doubles "
+                        "each attempt (exponential backoff)")
+    parser.add_argument("--restart-backoff-max", type=float, default=60.0,
+                        help="backoff ceiling in seconds")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command for launching the program")
     args = parser.parse_args(argv)

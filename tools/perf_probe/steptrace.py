@@ -61,6 +61,7 @@ def trace(step_fn, batches, epochs=3):
         "steps": n,
         "dispatches_per_step": stats["dispatch_count"] / n,
         "compile_count": stats["compile_count"],
+        "skipped_steps": stats["skipped_steps"],
         "step_time_ema_ms": round(ema * 1e3, 3) if ema else None,
         "wall_ms_per_step": round(dt / n * 1e3, 3),
     }
