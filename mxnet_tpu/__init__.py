@@ -41,6 +41,7 @@ from . import io
 from . import kvstore
 from . import kvstore as kv
 from . import fault
+from . import telemetry
 from . import checkpoint
 from .checkpoint import CheckpointManager
 from . import model

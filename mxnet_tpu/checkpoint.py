@@ -37,6 +37,7 @@ import re
 import time
 
 from . import fault as _fault
+from . import telemetry as _telemetry
 from .base import MXNetError
 
 __all__ = ["CheckpointManager", "atomic_write", "write_state_file",
@@ -54,11 +55,13 @@ _PERMANENT_ERRNO = frozenset(
      "ENAMETOOLONG", "EBADF", "ENOSPC") if hasattr(_errno, name))
 
 
-def _retry_io(fn, retries=4, backoff=0.05, max_backoff=2.0):
+def _retry_io(fn, retries=4, backoff=0.05, max_backoff=2.0,
+              retry_counter="ckpt.io_retries"):
     """Run ``fn`` retrying transient OSError with exponential backoff.
     FaultInjected is a simulated crash, not a transient error — it (and
     every non-OSError, and permanent-errno OSErrors) propagates
-    immediately."""
+    immediately.  ``retry_counter=None`` skips the telemetry count
+    (non-checkpoint callers like the plain postmortem/trace writer)."""
     delay = backoff
     for attempt in range(retries + 1):
         try:
@@ -68,6 +71,8 @@ def _retry_io(fn, retries=4, backoff=0.05, max_backoff=2.0):
         except OSError as e:
             if e.errno in _PERMANENT_ERRNO or attempt == retries:
                 raise
+            if retry_counter:
+                _telemetry.counter(retry_counter).inc()
             time.sleep(delay)
             delay = min(delay * 2, max_backoff)
 
@@ -86,36 +91,48 @@ def _fsync_dir(path):
         os.close(fd)
 
 
-def atomic_write(path, data, retries=4, backoff=0.05):
-    """Write ``data`` (bytes) to ``path`` atomically: the final path only
-    ever holds a complete file.  Transient OSErrors are retried with
-    exponential backoff."""
+def _atomic_write_impl(path, data, retries, backoff, instrumented):
+    """The one tmp+fsync+``os.replace``+dir-fsync publish sequence.
+    ``instrumented`` adds the checkpoint layer's fault-injection sites,
+    ``ckpt.*`` telemetry, and the keep-tmp-on-simulated-crash rule; the
+    plain variant serves observability artifacts, which must neither
+    consume fault budgets nor pollute checkpoint metrics."""
     path = os.fspath(path)
 
     def attempt():
-        if _fault.trigger("ckpt.write.ioerror"):
-            raise OSError("[fault injection] transient I/O error writing %s"
-                          % path)
-        if _fault.trigger("ckpt.write.torn"):
-            # the legacy non-atomic writer dying mid-write: a truncated
-            # file lands at the FINAL path, then the "crash"
-            with open(path, "wb") as f:
-                f.write(data[:max(1, len(data) // 2)])
-            raise _fault.FaultInjected(
-                "[fault injection] torn write at %s" % path)
+        if instrumented:
+            if _fault.trigger("ckpt.write.ioerror"):
+                raise OSError(
+                    "[fault injection] transient I/O error writing %s"
+                    % path)
+            if _fault.trigger("ckpt.write.torn"):
+                # the legacy non-atomic writer dying mid-write: a
+                # truncated file lands at the FINAL path, then the "crash"
+                with open(path, "wb") as f:
+                    f.write(data[:max(1, len(data) // 2)])
+                raise _fault.FaultInjected(
+                    "[fault injection] torn write at %s" % path)
         tmp = "%s.tmp-%d" % (path, os.getpid())
         try:
             with open(tmp, "wb") as f:
                 f.write(data)
                 f.flush()
-                os.fsync(f.fileno())
-            _fault.check("ckpt.write.crash",
-                         "crash before publishing %s" % path)
-            os.replace(tmp, path)
+                if instrumented:
+                    with _telemetry.span("ckpt.fsync", cat="checkpoint"):
+                        os.fsync(f.fileno())
+                else:
+                    os.fsync(f.fileno())
+            if instrumented:
+                _fault.check("ckpt.write.crash",
+                             "crash before publishing %s" % path)
+                with _telemetry.span("ckpt.rename", cat="checkpoint"):
+                    os.replace(tmp, path)
+            else:
+                os.replace(tmp, path)
         except BaseException as e:
-            # a simulated crash leaves the tmp litter a real crash would;
-            # ordinary failures clean up after themselves
-            if not isinstance(e, _fault.FaultInjected):
+            # a simulated crash leaves the tmp litter a real crash
+            # would; ordinary failures clean up after themselves
+            if not (instrumented and isinstance(e, _fault.FaultInjected)):
                 try:
                     os.unlink(tmp)
                 except OSError:
@@ -123,7 +140,29 @@ def atomic_write(path, data, retries=4, backoff=0.05):
             raise
         _fsync_dir(path)
 
-    _retry_io(attempt, retries=retries, backoff=backoff)
+    _retry_io(attempt, retries=retries, backoff=backoff,
+              retry_counter="ckpt.io_retries" if instrumented else None)
+
+
+def _plain_atomic_write(path, data, retries=4, backoff=0.05):
+    """``atomic_write`` minus the checkpoint fault-injection sites and
+    ``ckpt.*`` telemetry — for observability artifacts (crash
+    postmortems, profiler trace dumps).  A postmortem written during a
+    fault-injected crash run must not consume ``ckpt.write.*`` budgets
+    (tearing the very record of the crash) or pollute checkpoint
+    metrics with non-checkpoint writes."""
+    _atomic_write_impl(path, data, retries, backoff, instrumented=False)
+
+
+def atomic_write(path, data, retries=4, backoff=0.05):
+    """Write ``data`` (bytes) to ``path`` atomically: the final path only
+    ever holds a complete file.  Transient OSErrors are retried with
+    exponential backoff.  Telemetry: ``ckpt.write`` span (whole call,
+    retries included), ``ckpt.fsync`` / ``ckpt.rename`` phase histograms,
+    ``ckpt.write_bytes`` size histogram, ``ckpt.io_retries`` counter."""
+    with _telemetry.span("ckpt.write", cat="checkpoint"):
+        _atomic_write_impl(path, data, retries, backoff, instrumented=True)
+    _telemetry.histogram("ckpt.write_bytes").observe(len(data))
 
 
 def _sha256(data):
@@ -217,6 +256,13 @@ class CheckpointManager:
         """Write one complete checkpoint; the manifest is committed last,
         so a crash anywhere earlier leaves the previous checkpoint as the
         newest *complete* one."""
+        with _telemetry.span("ckpt.save", cat="checkpoint"):
+            _telemetry.counter("ckpt.saves").inc()
+            return self._save(epoch, arg_params, aux_params, symbol,
+                              optimizer_states)
+
+    def _save(self, epoch, arg_params, aux_params, symbol,
+              optimizer_states):
         from .ndarray import utils as _nd_utils
         from .ndarray import serialization as _ser
         files = {}

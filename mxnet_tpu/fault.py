@@ -44,7 +44,7 @@ import zlib
 from .base import MXNetError
 
 __all__ = ["FaultInjected", "configure", "reset", "is_active", "trigger",
-           "check", "fire_count"]
+           "check", "fire_count", "fire_counts"]
 
 
 class FaultInjected(MXNetError):
@@ -132,20 +132,28 @@ def is_active(site):
 def trigger(site):
     """Roll the dice for ``site``; True means the caller must inject."""
     _ensure_loaded()
+    fired = False
     with _lock:
         rule = _rules.get(site)
         if rule is None:
             return False
         if "count" in rule:
-            if rule["count"] <= 0:
-                return False
-            rule["count"] -= 1
+            if rule["count"] > 0:
+                rule["count"] -= 1
+                _fired[site] = _fired.get(site, 0) + 1
+                fired = True
+        elif rule["rng"].random() < rule["rate"]:
             _fired[site] = _fired.get(site, 0) + 1
-            return True
-        if rule["rng"].random() < rule["rate"]:
-            _fired[site] = _fired.get(site, 0) + 1
-            return True
-        return False
+            fired = True
+    if fired:
+        # outside _lock: telemetry takes its own registry lock and the
+        # postmortem path reads fire_counts() under ours — never nest
+        try:
+            from . import telemetry as _telemetry
+            _telemetry.note_fault(site)
+        except Exception:
+            pass  # interpreter teardown; the injection still happens
+    return fired
 
 
 def check(site, msg=None):
@@ -159,3 +167,10 @@ def fire_count(site):
     """How many times ``site`` has triggered since configure()."""
     with _lock:
         return _fired.get(site, 0)
+
+
+def fire_counts():
+    """Snapshot of {site: times fired} since configure() — the
+    postmortem's fault attribution record."""
+    with _lock:
+        return dict(_fired)

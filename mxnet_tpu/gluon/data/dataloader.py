@@ -17,6 +17,7 @@ import threading
 import numpy as _np
 
 from ... import ndarray as nd
+from ... import telemetry as _telemetry
 from . import sampler as _sampler
 
 __all__ = ["DataLoader"]
@@ -82,7 +83,12 @@ class _PrefetchIter:
                 for batch in make_batches():
                     _fault.check("data.prefetch",
                                  "prefetch worker failure")
-                    if not put(_device_put_batch(batch)):
+                    # start (don't wait for) the host→device copy; the
+                    # span is the enqueue cost, the copy itself overlaps
+                    # with device compute
+                    with _telemetry.span("data.h2d", cat="data"):
+                        batch = _device_put_batch(batch)
+                    if not put(batch):
                         return
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 # e.__traceback__ carries the worker-side frames; the
@@ -130,7 +136,10 @@ class _PrefetchIter:
     def __next__(self):
         if self._done:
             raise StopIteration
-        item = self._q.get()
+        # time the consumer actually spends starved waiting on the
+        # producer — the "is the input pipeline keeping up" phase
+        with _telemetry.span("data.prefetch_wait", cat="data"):
+            item = self._q.get()
         if item is self._SENTINEL:
             self.close()  # worker finished; free the thread + queue now
             raise StopIteration
@@ -176,8 +185,13 @@ class DataLoader:
         self._prefetch = max(0, int(prefetch))
 
     def _make_batches(self):
+        batches = _telemetry.counter("data.batches")
         for batch in self._batch_sampler:
-            yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            with _telemetry.span("data.batchify", cat="data"):
+                out = self._batchify_fn(
+                    [self._dataset[idx] for idx in batch])
+            batches.inc()
+            yield out
 
     def __iter__(self):
         if self._prefetch == 0:

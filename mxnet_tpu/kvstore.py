@@ -26,6 +26,7 @@ import pickle
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
 from . import optimizer as opt
+from . import telemetry as _telemetry
 
 __all__ = ["KVStore", "create"]
 
@@ -174,7 +175,12 @@ class KVStore:
         return [s.addressable_data(0) for s in summed]
 
     def push(self, key, value, priority=0):
+        with _telemetry.span("kv.push", cat="kvstore"):
+            self._push(key, value)
+
+    def _push(self, key, value):
         keys, vals = _flatten_pairs(key, value)
+        _telemetry.counter("kv.push_keys").inc(len(keys))
         for k in keys:
             if k not in self._store:
                 raise MXNetError("key %s was not initialized" % str(k))
@@ -227,13 +233,15 @@ class KVStore:
 
     def pull(self, key, out=None, priority=0):
         assert out is not None
-        keys, outs = _flatten_pairs(key, out)
-        for k, olist in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError("key %s was not initialized" % str(k))
-            src = self._store[k]
-            for o in olist:
-                o._set_data(src._data)
+        with _telemetry.span("kv.pull", cat="kvstore"):
+            keys, outs = _flatten_pairs(key, out)
+            _telemetry.counter("kv.pull_keys").inc(len(keys))
+            for k, olist in zip(keys, outs):
+                if k not in self._store:
+                    raise MXNetError("key %s was not initialized" % str(k))
+                src = self._store[k]
+                for o in olist:
+                    o._set_data(src._data)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (reference kvstore.py:row_sparse_pull).

@@ -12,6 +12,7 @@ the parallel layer rather than split by Python.
 from __future__ import annotations
 
 import logging
+import time
 
 from .. import context as ctx_mod
 from .. import ndarray as nd
@@ -482,6 +483,7 @@ class Module(BaseModule):
         from .. import fault as _fault
         from .. import profiler as _profiler
         from .. import random as _random
+        from .. import telemetry as _telemetry
         from ..ndarray.ndarray import NDArray
         from ..ops.optimizer_ops import handle_guard_verdict
 
@@ -518,11 +520,11 @@ class Module(BaseModule):
         poison = float("nan") if _fault.trigger("grad.nan") else 0.0
 
         rng = _random.next_key()
-        with _profiler._timed("module_fit_step") as timed:
-            outs, new_params, new_state, new_aux, ok = fused["step"](
-                param_vals, fused["state"], other_vals, aux_vals, rng,
-                lr, wd, rescale, t, poison)
-            timed.sync_arrays = outs
+        t0 = time.perf_counter_ns()
+        outs, new_params, new_state, new_aux, ok = fused["step"](
+            param_vals, fused["state"], other_vals, aux_vals, rng,
+            lr, wd, rescale, t, poison)
+        t1 = time.perf_counter_ns()
         fused["state"] = new_state
         # donated inputs are dead now — re-point every wrapper at the
         # step's outputs before anything else can touch them
@@ -536,9 +538,19 @@ class Module(BaseModule):
         # divergence guard verdict: reading the scalar costs one small
         # host readback that the fit loop's metric update would force
         # anyway (PERF.md "Divergence guard"); a skipped step rewinds the
-        # optimizer clocks so it is as if the batch never arrived
+        # optimizer clocks so it is as if the batch never arrived.  The
+        # readback is also the step's device-sync point, so [t1, t2] is
+        # telemetry's "fit_step.sync" phase (~the device compute time).
+        ok_host = bool(ok)
+        t2 = time.perf_counter_ns()
+        # loss for the flight recorder, free of extra syncs: only a
+        # scalar head (loss-output nets) is worth a host read, and only
+        # while recording actually consumes it
+        loss = float(outs[0]) if outs and not outs[0].shape \
+            and _telemetry.enabled() else None
+        _telemetry.note_train_step(t0, t1, t2, not ok_host, loss)
         self._consec_guard_skips = handle_guard_verdict(
-            ok, opt, update_idxs, self._consec_guard_skips,
+            ok_host, opt, update_idxs, self._consec_guard_skips,
             pre_num_update)
 
     def update(self):

@@ -25,9 +25,10 @@ class StepStatsMonitor(object):
     steady-state compiles (see PERF.md, "Fused train step").
     """
 
-    def __init__(self, interval=50, logger=None):
+    def __init__(self, interval=50, logger=None, phases=True):
         self.interval = max(1, int(interval))
         self.logger = logger or logging
+        self.phases = phases
         self._nseen = 0
         self._last = None
 
@@ -49,7 +50,24 @@ class StepStatsMonitor(object):
             stats["compile_count"] - prev["compile_count"],
             " SKIPPED +%d (non-finite grads)" % skipped if skipped else "",
             "%.2f ms" % (ema * 1e3) if ema is not None else "n/a")
+        if self.phases:
+            self._log_phases()
         self._last = stats
+
+    def _log_phases(self):
+        """One compact line of telemetry's cumulative phase-time
+        breakdown (mean ms per call / call count for the costliest
+        phases) — where a step's wall time actually goes."""
+        from . import telemetry as _telemetry
+        phases = _telemetry.report()["phases"]
+        top = sorted(((n, p) for n, p in phases.items() if p["count"]),
+                     key=lambda np: -np[1]["sum"])[:4]
+        if top:
+            self.logger.info(
+                "phases " + "  ".join(
+                    "%s %.2fms/call x%d" % (n, 1e3 * p["sum"] / p["count"],
+                                            p["count"])
+                    for n, p in top))
 
 
 class Monitor(object):

@@ -287,7 +287,7 @@ def raise_skip_limit_error(limit):
 
 
 def handle_guard_verdict(ok, optimizer, indices, streak, pre_num_update,
-                         raise_on_limit=True):
+                         raise_on_limit=True, backfill_verdict=False):
     """Host-side bookkeeping shared by Module.fit_step and
     gluon.Trainer._fused_step after the guarded program returns.
 
@@ -302,7 +302,16 @@ def handle_guard_verdict(ok, optimizer, indices, streak, pre_num_update,
     must never be aborted by a training-health error — and re-checks the
     limit at the top of the next step() instead.
     """
-    if bool(ok):
+    ok_host = bool(ok)
+    if backfill_verdict:
+        # flight recorder: the Trainer records its step with a pending
+        # (None) verdict before this resolves one step late; back-fill
+        # both ways — ok steps become False-skipped, diverged True.
+        # Module.fit_step records the verdict inline instead (marking
+        # here would force a flight-ring drain on every step).
+        from .. import telemetry as _telemetry
+        _telemetry.mark_last_step_verdict(ok_host)
+    if ok_host:
         return 0
     from .. import profiler as _profiler
     for i in indices:

@@ -57,9 +57,12 @@ def profiler_set_config(mode="symbolic", filename="profile.json",
 
 
 def profiler_set_state(state="stop"):
-    """Start ('run') or stop ('stop') collecting (reference :43)."""
+    """Start ('run') or stop ('stop') collecting (reference :43).
+
+    jax is only imported when the jax-profiler bridge is actually
+    requested (use_jax_profiler), so MXNET_PROFILER_AUTOSTART=1 at
+    import time cannot drag in (or crash on) a backend."""
     global _state, _t0_us
-    import jax
     with _lock:
         if state == _state:
             return
@@ -67,6 +70,7 @@ def profiler_set_state(state="stop"):
             _events.clear()
             _t0_us = time.perf_counter_ns() // 1000
             if _use_jax:
+                import jax
                 logdir = _filename + ".jaxtrace"
                 os.makedirs(logdir, exist_ok=True)
                 try:
@@ -75,6 +79,7 @@ def profiler_set_state(state="stop"):
                     pass
         elif state == "stop":
             if _use_jax:
+                import jax
                 try:
                     jax.profiler.stop_trace()
                 except RuntimeError:
@@ -99,17 +104,25 @@ def is_running():
     return _state == "run" and not _paused
 
 
-def record_event(name, start_us, dur_us, cat="operator", tid=None):
-    """Append one duration event (called by the Executor hot path only
-    when is_running())."""
+def record_event(name, start_us, dur_us, cat="operator", tid=None,
+                 args=None):
+    """Append one duration event (called by the Executor hot path and
+    telemetry spans only when is_running()).  Appends under ``_lock``:
+    dump_profile/profiler_set_state read/clear the buffer under the same
+    lock, and spans record from prefetch worker threads too — an
+    unlocked append could race a concurrent clear."""
     if not is_running():
         return
-    _events.append({
+    ev = {
         "name": name, "cat": cat, "ph": "X",
         "ts": start_us - (_t0_us or 0), "dur": dur_us,
         "pid": os.getpid(),
         "tid": tid if tid is not None else threading.get_ident() & 0xffff,
-    })
+    }
+    if args is not None:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
 
 
 class _timed(object):
@@ -211,6 +224,11 @@ def step_stats():
 def reset_step_stats():
     global _dispatch_count, _compile_count, _step_count, \
         _skipped_step_count, _step_ema_s, _last_step_t
+    # settle pending flight records against the OLD counters, then
+    # re-baseline so the next record's delta starts from zero —
+    # reset_step_stats and telemetry.reset compose in either order
+    t = _telemetry()
+    t._drain_steps()
     with _step_lock:
         _dispatch_count = 0
         _compile_count = 0
@@ -218,6 +236,18 @@ def reset_step_stats():
         _skipped_step_count = 0
         _step_ema_s = None
         _last_step_t = None
+    t._rebaseline()
+
+
+_telemetry_mod = None
+
+
+def _telemetry():
+    global _telemetry_mod
+    if _telemetry_mod is None:
+        from . import telemetry
+        _telemetry_mod = telemetry
+    return _telemetry_mod
 
 
 def instrument(fn):
@@ -225,7 +255,14 @@ def instrument(fn):
     shapes are fixed for its lifetime (executor programs are bound to one
     shape set; fused Trainer programs rebuild on shape change) — so the
     first invocation IS its one XLA compile, and every invocation is one
-    dispatch."""
+    dispatch.
+
+    Steady-state recompiles — the cache key silently missing after
+    warmup, the exact failure the 1-compile contract exists to catch —
+    are invisible to the first-call heuristic, so post-warmup calls are
+    bracketed by telemetry's monotonic jax.monitoring backend-compile
+    event count: any compile event landing inside an instrumented call
+    feeds count_compile too."""
     compiled = []
 
     def wrapper(*args):
@@ -233,18 +270,28 @@ def instrument(fn):
         if not compiled:
             compiled.append(True)
             count_compile()
-        return fn(*args)
+            return fn(*args)
+        t = _telemetry()
+        pre = t._xla_compiles
+        out = fn(*args)
+        post = t._xla_compiles
+        if post != pre:
+            count_compile(post - pre)
+        return out
     return wrapper
 
 
 def dump_profile():
     """Write the chrome tracing JSON (reference profiler.py:55 /
-    src/engine/profiler.cc:152)."""
+    src/engine/profiler.cc:152).  Snapshot under the lock, write via the
+    checkpoint layer's atomic tmp+fsync+os.replace so a crash mid-dump
+    can never leave a torn trace at the final path."""
     with _lock:
         doc = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
-        with open(_filename, "w") as f:
-            json.dump(doc, f)
-    return _filename
+        fname = _filename
+    from .checkpoint import _plain_atomic_write
+    _plain_atomic_write(fname, json.dumps(doc).encode("utf-8"))
+    return fname
 
 
 # aliases matching later-era reference spellings kept by examples

@@ -1,0 +1,765 @@
+"""Unified telemetry: metrics registry, cross-layer spans, flight recorder.
+
+The reference MXNet engine profiled every pushed op
+(src/engine/profiler.cc: one OprExecStat per engine op); the XLA-fused
+rebuild collapsed the graph into one program per step, so per-op hooks
+vanished and visibility shrank to profiler.py's five global counters.
+This module is the always-on observability substrate the fused design
+needs instead:
+
+- **metrics registry** — named :class:`Counter` / :class:`Gauge` /
+  :class:`Histogram` (fixed log2 buckets, so percentile queries need no
+  sample storage).  Hot-path mutation is lock-free on purpose, exactly
+  like ``profiler.count_dispatch``: a GIL-raced increment merely
+  miscounts telemetry, and the fused step budget (<1% of a ~0.3 ms CPU
+  MLP step) has no room for a lock acquire per observation.
+- **span(name, cat)** — a context manager timing one named phase.  Every
+  span feeds a phase histogram (always on) and, while the profiler is
+  collecting, a chrome-tracing duration event in the same stream the
+  executor writes, so data-loading / checkpoint / kvstore phases land in
+  the same trace as ``executor_forward``.  Nested spans carry a ``depth``
+  arg so the hierarchy survives trace viewers that don't infer nesting.
+- **flight recorder** — a bounded ring of the last K per-step records
+  (dispatch/sync wall time, dispatch/compile deltas, skipped flag, loss
+  when the step has a scalar head, fault-site firings).  On an unhandled
+  exception (``MXNetError`` from the divergence guard included) or at
+  exit with a nonzero skip count, the ring is dumped as a postmortem
+  JSON into ``MXTPU_POSTMORTEM_DIR`` via the checkpoint layer's plain
+  atomic writer (no fault sites — a postmortem must never tear) —
+  the last seconds of a run that died are never lost.
+- **XLA compile attribution** — a ``jax.monitoring`` listener counts
+  every backend compile (``xla.compiles`` counter +
+  ``xla.compile_seconds`` histogram); ``profiler.instrument`` uses the
+  same monotonic event count to attribute *steady-state recompiles* of
+  an instrumented program to ``profiler.count_compile`` (its own
+  first-call heuristic only ever sees the initial compile).
+- **periodic emitter** — ``MXTPU_TELEMETRY=path[:interval]`` appends one
+  ``report()`` JSON line every ``interval`` seconds (default 10) so a
+  soak run leaves a machine-readable timeline behind.
+
+``tools/perf_probe/telemetry_report.py`` renders both artifacts
+(JSON-lines timeline and postmortem) for humans; OBSERVABILITY.md is the
+metric-name / span-taxonomy / schema contract.
+
+Env vars: ``MXTPU_TELEMETRY``, ``MXTPU_POSTMORTEM_DIR``,
+``MXTPU_FLIGHT_RECORDER_STEPS`` (ring size, default 64),
+``MXTPU_TELEMETRY_OFF=1`` (disable hot-path recording; the A/B side of
+``BENCH_MODE=telemetry``'s overhead measurement).
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy as _np
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
+           "histogram", "span", "report", "reset", "note_train_step",
+           "note_fault", "mark_last_step_verdict", "flight_records",
+           "flight_capacity", "dump_postmortem", "start_emitter",
+           "stop_emitter", "set_enabled", "enabled"]
+
+SCHEMA_REPORT = "mxtpu-telemetry-1"
+SCHEMA_POSTMORTEM = "mxtpu-postmortem-1"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+_DISABLED = os.environ.get("MXTPU_TELEMETRY_OFF", "0") == "1"
+
+
+def set_enabled(flag):
+    """Toggle hot-path recording (spans, per-step records).  Registry
+    objects stay queryable either way; BENCH_MODE=telemetry flips this
+    to measure the always-on overhead against a dark run."""
+    global _DISABLED
+    _DISABLED = not flag
+
+
+def enabled():
+    return not _DISABLED
+
+
+# -- lazy intra-package bindings (telemetry must stay importable from the
+# very bottom of the package: only .base above it) -------------------------
+_prof = None
+
+
+def _profiler():
+    global _prof
+    if _prof is None:
+        from . import profiler
+        _prof = profiler
+    return _prof
+
+
+# -- metrics registry ------------------------------------------------------
+_reg_lock = threading.Lock()     # creation only; mutation is lock-free
+_counters = {}
+_gauges = {}
+_histograms = {}
+_span_names = set()              # histogram names that came from spans
+
+
+class Counter(object):
+    """Monotonic named counter.  ``inc`` is a bare int add — lock-free
+    like profiler.count_dispatch; a GIL race miscounts, never corrupts."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class Gauge(object):
+    """Last-write-wins named value (queue depths, ring occupancy...)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = None
+
+    def set(self, v):
+        self.value = v
+
+
+class Histogram(object):
+    """Fixed log2-bucket histogram for durations (seconds) and sizes
+    (bytes).  Bucket ``e`` holds values in ``(2**(e-1), 2**e]`` (the
+    ``math.frexp`` exponent), zeros are counted separately — the bucket
+    map is sparse, observation is O(1), and percentiles come from linear
+    interpolation inside the covering bucket (bounded by construction to
+    one power of two of the truth, clamped to the observed min/max)."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_zeros", "_buckets")
+
+    def __init__(self, name):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._zeros = 0
+        self._buckets = {}
+
+    def observe(self, v):
+        v = float(v)
+        if v > 0.0:
+            e = math.frexp(v)[1]
+            b = self._buckets
+            b[e] = b.get(e, 0) + 1
+        else:
+            self._zeros += 1
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+    def observe_many(self, values, scale=1.0):
+        """Batch observe (the flight-recorder drain): one numpy
+        frexp+bincount replaces per-value Python bucketing — the reason
+        the always-on per-step telemetry stays inside its <1% budget.
+        ``scale`` converts raw units (e.g. ns deltas) in the same
+        vectorized pass."""
+        n = len(values)
+        if not n:
+            return
+        arr = _np.asarray(values, dtype=_np.float64)
+        if scale != 1.0:
+            arr = arr * scale
+        pos = arr[arr > 0.0]
+        if pos.size:
+            e = _np.frexp(pos)[1]
+            lo = int(e.min())
+            b = self._buckets
+            for i, cnt in enumerate(_np.bincount(e - lo)):
+                if cnt:
+                    k = lo + i
+                    b[k] = b.get(k, 0) + int(cnt)
+        self._zeros += n - int(pos.size)
+        self.count += n
+        self.sum += float(arr.sum())
+        amin, amax = float(arr.min()), float(arr.max())
+        if self.min is None or amin < self.min:
+            self.min = amin
+        if self.max is None or amax > self.max:
+            self.max = amax
+
+    def percentile(self, q, _buckets=None):
+        """Approximate q-quantile (q in [0, 1]) from the bucket counts."""
+        if not self.count:
+            return None
+        if _buckets is None:
+            # atomic copy: observers on other threads (prefetch workers)
+            # may insert new bucket keys mid-iteration
+            _buckets = dict(self._buckets)
+        target = q * self.count
+        cum = float(self._zeros)
+        if target <= cum and self._zeros:
+            return 0.0
+        for e in sorted(_buckets):
+            n = _buckets[e]
+            if target <= cum + n:
+                lo, hi = 2.0 ** (e - 1), 2.0 ** e
+                v = lo + (target - cum) / n * (hi - lo)
+                return min(max(v, self.min), self.max)
+            cum += n
+        return self.max
+
+    def snapshot(self):
+        buckets = dict(self._buckets)  # atomic vs concurrent observes
+        return {
+            "count": self.count, "sum": self.sum,
+            "min": self.min, "max": self.max,
+            "p50": self.percentile(0.50, buckets),
+            "p90": self.percentile(0.90, buckets),
+            "p99": self.percentile(0.99, buckets),
+            "buckets": {str(e): n for e, n in sorted(buckets.items())},
+            "zeros": self._zeros,
+        }
+
+
+def _get_or_create(table, name, cls):
+    obj = table.get(name)
+    if obj is None:
+        with _reg_lock:
+            obj = table.setdefault(name, cls(name))
+    return obj
+
+
+def counter(name):
+    """Get-or-create the named Counter (idempotent; hot callers should
+    hold the returned object instead of re-resolving the name)."""
+    return _get_or_create(_counters, name, Counter)
+
+
+def gauge(name):
+    return _get_or_create(_gauges, name, Gauge)
+
+
+def histogram(name):
+    return _get_or_create(_histograms, name, Histogram)
+
+
+def _span_hist(name):
+    h = _histograms.get(name)
+    if h is None:
+        h = histogram(name)
+        with _reg_lock:
+            _span_names.add(name)
+    return h
+
+
+# -- spans -----------------------------------------------------------------
+_tls = threading.local()
+
+
+class span(object):
+    """Time one named phase: always feeds the phase histogram ``name``
+    (seconds), and while the profiler collects, appends a chrome-tracing
+    duration event of category ``cat`` with a ``depth`` arg reflecting
+    span nesting on this thread.
+
+    >>> with telemetry.span("data.batchify", cat="data"):
+    ...     batch = batchify_fn(samples)
+    """
+
+    __slots__ = ("name", "cat", "_t0", "_depth")
+
+    def __init__(self, name, cat="phase"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self._depth = getattr(_tls, "depth", 0)
+        _tls.depth = self._depth + 1
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        _tls.depth = self._depth
+        dur_ns = t1 - self._t0
+        if not _DISABLED:
+            _span_hist(self.name).observe(dur_ns * 1e-9)
+        prof = _prof or _profiler()
+        # trace events survive MXTPU_TELEMETRY_OFF (profiling is its own
+        # explicit opt-in).  Trace-origin guard: a span opened before
+        # profiler_set_state("run") must not emit a pre-origin
+        # (negative-ts) phantom event.
+        if prof.is_running() and self._t0 // 1000 >= (prof._t0_us or 0):
+            prof.record_event(self.name, self._t0 // 1000,
+                              dur_ns // 1000, cat=self.cat,
+                              args={"depth": self._depth})
+        return False
+
+
+# -- XLA compile attribution (jax.monitoring bridge) -----------------------
+# Monotonic count of backend compiles, read by profiler.instrument to
+# attribute steady-state recompiles of an instrumented program to
+# count_compile.  Never reset (delta readers depend on monotonicity).
+_xla_compiles = 0
+_compile_hook_installed = False
+
+
+def _on_jax_event(event, duration, **kw):
+    if "backend_compile" in event:
+        global _xla_compiles
+        _xla_compiles += 1
+        counter("xla.compiles").inc()
+        histogram("xla.compile_seconds").observe(duration)
+
+
+def _install_compile_hook():
+    """Listen for jax.monitoring's per-compile duration events (the
+    log_compiles signal, structured).  Best-effort: jax versions without
+    the monitoring module leave the first-call heuristic in charge."""
+    global _compile_hook_installed
+    if _compile_hook_installed:
+        return True
+    try:
+        from jax import monitoring as _monitoring
+        _monitoring.register_event_duration_secs_listener(_on_jax_event)
+    except Exception:
+        return False
+    _compile_hook_installed = True
+    return True
+
+
+def xla_compile_events():
+    """Monotonic backend-compile event count (survives reset())."""
+    return _xla_compiles
+
+
+# -- flight recorder -------------------------------------------------------
+_FLIGHT_FIELDS = ("step", "t_unix", "dispatch_s", "sync_s",
+                  "dispatch_delta", "compile_delta", "skipped", "loss",
+                  "faults")
+_flight = collections.deque(
+    maxlen=max(1, _env_int("MXTPU_FLIGHT_RECORDER_STEPS", 64)))
+_step_seq = 0
+_last_dispatch = 0
+_last_compile = 0
+# sites fired since the last step record; bounded (a fault-heavy run
+# with no train steps — e.g. pure checkpoint I/O under ckpt.write.*
+# rates — must not grow it forever)
+_pending_faults = collections.deque(maxlen=256)
+_train_hists = {}                # where -> (dispatch hist, sync hist)
+
+# perf_counter↔unix correspondence, so the hot path never calls
+# time.time(): records carry perf_counter_ns stamps and the drain
+# reconstructs wall-clock time from this one base pair
+_unix_base = time.time()
+_perf_base = time.perf_counter_ns()
+
+# The per-step hot path appends ONE compact tuple here; histograms, the
+# flight ring, and trace events are folded in by _drain_steps in batches
+# of _PENDING_MAX (or on any read).  Batching exists for the <1%-of-a-
+# fused-step budget: folding touches a dozen Python objects, and doing
+# that once per 128 steps with hot caches costs a fraction of doing it
+# per step cold (BENCH_MODE=telemetry measures the result).
+_pending_steps = []
+_PENDING_MAX = 128
+_drain_lock = threading.Lock()
+
+
+def note_train_step(t0_ns, t1_ns, t2_ns=None, skipped=False, loss=None,
+                    where="fit_step"):
+    """Record one fused train step from three perf_counter_ns stamps:
+    program dispatch [t0, t1] and device sync / verdict readback
+    [t1, t2] (``t2_ns=None`` for paths that resolve the verdict lazily —
+    the Trainer — in which case ``skipped`` is back-filled by
+    :func:`mark_last_step_verdict`).
+
+    Hot-path cost is one tuple append plus two profiler counter reads;
+    everything else is deferred to the batched drain.  While the
+    profiler collects, the drain runs per step so trace events stay
+    timely (profiling already pays for accuracy with syncs)."""
+    prof = _prof or _profiler()
+    if _DISABLED:
+        # metrics off, but an explicitly-running profiler still gets
+        # its fused-step trace events (the _timed("module_fit_step")
+        # signal this layer replaced must survive MXTPU_TELEMETRY_OFF)
+        if prof.is_running():
+            prof.record_event(where + ".dispatch", t0_ns // 1000,
+                              (t1_ns - t0_ns) // 1000, cat="step")
+            if t2_ns is not None:
+                prof.record_event(where + ".sync", t1_ns // 1000,
+                                  (t2_ns - t1_ns) // 1000, cat="step")
+        return
+    if _pending_faults:
+        # popleft-until-empty: a note_fault append landing from another
+        # thread (e.g. the prefetch worker) mid-snapshot survives for
+        # the next record instead of vanishing
+        popped = []
+        while True:
+            try:
+                popped.append(_pending_faults.popleft())
+            except IndexError:
+                break
+        faults = tuple(popped)
+    else:
+        faults = ()
+    p = _pending_steps
+    p.append((where, t0_ns, t1_ns, t2_ns, skipped, loss,
+              prof._dispatch_count, prof._compile_count, faults))
+    # per-step drain only while the profiler actually collects (paused
+    # counts as not collecting — no trace events would be emitted, so
+    # defeating the batching would buy nothing)
+    if len(p) >= _PENDING_MAX or prof.is_running():
+        _drain_steps()
+
+
+def _drain_steps():
+    """Fold pending step tuples into the phase histograms, the flight
+    ring, and (while profiling) the trace stream.  Runs under a lock —
+    callers are the hot path every _PENDING_MAX steps, every reader, and
+    the emitter thread."""
+    global _step_seq, _last_dispatch, _last_compile
+    with _drain_lock:
+        batch = list(_pending_steps)
+        if not batch:
+            return
+        del _pending_steps[:len(batch)]
+        prof = _prof or _profiler()
+        running = prof.is_running()
+        # records buffered before the trace started must not leak into
+        # it as pre-origin (negative-ts) phantom events
+        trace_t0_us = (prof._t0_us or 0) if running else None
+        # histogram folds: vectorized per `where` over the whole batch
+        # (record layout: where, t0, t1, t2, skipped, loss, d, c, faults)
+        wheres = {r[0] for r in batch}
+        for w in wheres:
+            rs = batch if len(wheres) == 1 else \
+                [r for r in batch if r[0] == w]
+            pair = _train_hists.get(w)
+            if pair is None:
+                pair = (_span_hist(w + ".dispatch"),
+                        _span_hist(w + ".sync"))
+                _train_hists[w] = pair
+            pair[0].observe_many([r[2] - r[1] for r in rs], scale=1e-9)
+            pair[1].observe_many([r[3] - r[2] for r in rs
+                                  if r[3] is not None], scale=1e-9)
+        # ring fold: records past ring capacity would be appended then
+        # immediately evicted — advance the counters over them instead
+        seq, last_d, last_c = _step_seq, _last_dispatch, _last_compile
+        skip = len(batch) - _flight.maxlen
+        if skip > 0 and not running:
+            seq += skip
+            last_d, last_c = batch[skip - 1][6], batch[skip - 1][7]
+            batch = batch[skip:]
+        append = _flight.append
+        t_off = _unix_base - _perf_base * 1e-9
+        for (where, t0, t1, t2, skipped, loss, d, c, faults) in batch:
+            sync_s = (t2 - t1) * 1e-9 if t2 is not None else None
+            append([seq, t_off + t0 * 1e-9, (t1 - t0) * 1e-9, sync_s,
+                    d - last_d, c - last_c, skipped, loss, faults])
+            seq += 1
+            last_d, last_c = d, c
+            if running and t0 // 1000 >= trace_t0_us:
+                prof.record_event(where + ".dispatch", t0 // 1000,
+                                  (t1 - t0) // 1000, cat="step")
+                if t2 is not None:
+                    prof.record_event(where + ".sync", t1 // 1000,
+                                      (t2 - t1) // 1000, cat="step")
+        _step_seq, _last_dispatch, _last_compile = seq, last_d, last_c
+
+
+def _rebaseline(dispatch=0, compile_=0):
+    """Settle pending records against the old counters, then restart the
+    flight-recorder deltas from the given values — profiler.
+    reset_step_stats calls this so the two resets compose in either
+    order."""
+    global _last_dispatch, _last_compile
+    _drain_steps()
+    with _drain_lock:
+        _last_dispatch = dispatch
+        _last_compile = compile_
+
+
+def mark_last_step_verdict(ok):
+    """Back-fill the newest flight record's skipped flag from the
+    divergence-guard verdict — the Trainer records its step with
+    ``skipped=None`` (pending) and resolves one step late by design
+    (PERF.md "Divergence guard"), always before the next record is
+    appended.  A crash in between leaves the honest ``None``
+    ("verdict unknown"), never a false ``ok``."""
+    if _DISABLED:
+        return
+    skipped = not ok
+    # back-fill the NEWEST pending (None) record.  It usually still sits
+    # in _pending_steps (the Trainer resolves every step, and forcing a
+    # ring drain here would defeat the batching the <1% budget rests
+    # on), else in the drained ring — a Module.fit_step record may land
+    # in between, so scan tails, never touching resolved records.
+    # (Two Trainers with simultaneously pending verdicts in one process
+    # could still cross-attribute; verdicts resolve in step order, so
+    # the window is one record and the skip COUNT stays exact.)
+    # Under _drain_lock: concurrent drains/resets mutate both
+    # containers, and deque iteration raises on mutation mid-scan.
+    with _drain_lock:
+        for i in range(len(_pending_steps) - 1, -1, -1):
+            rec = _pending_steps[i]
+            if rec[4] is None:
+                _pending_steps[i] = rec[:4] + (skipped,) + rec[5:]
+                return
+        for rec in reversed(_flight):
+            if rec[6] is None:
+                rec[6] = skipped
+                return
+
+
+def note_fault(site):
+    """Called by fault.trigger when a site fires: per-site counter (the
+    registry stays live even when hot-path recording is off) plus
+    attribution of the firing to the next flight-recorder step record
+    (gated — nothing drains the pending list while recording is off,
+    and stale firings must not be dumped onto a later step)."""
+    counter("fault.fire.%s" % site).inc()
+    if not _DISABLED:
+        _pending_faults.append(site)
+
+
+def flight_records():
+    """The ring as a list of dicts, oldest first."""
+    _drain_steps()
+    return [dict(zip(_FLIGHT_FIELDS, rec)) for rec in list(_flight)]
+
+
+def flight_capacity():
+    return _flight.maxlen
+
+
+# -- reporting -------------------------------------------------------------
+def report():
+    """One JSON-able snapshot of everything: counters, gauges, phase
+    histograms (from spans / train steps), free histograms, profiler
+    step_stats, and flight-ring occupancy.  This is the emitter's line
+    format and StepStatsMonitor's data source."""
+    _drain_steps()
+    with _reg_lock:
+        counters = {n: c.value for n, c in _counters.items()}
+        gauges = {n: g.value for n, g in _gauges.items()}
+        hists = dict(_histograms)
+        spans = set(_span_names)
+    return {
+        "schema": SCHEMA_REPORT,
+        "time_unix": time.time(),
+        "pid": os.getpid(),
+        "counters": counters,
+        "gauges": gauges,
+        "phases": {n: h.snapshot() for n, h in hists.items()
+                   if n in spans},
+        "histograms": {n: h.snapshot() for n, h in hists.items()
+                       if n not in spans},
+        "step_stats": _profiler().step_stats(),
+        "flight": {"len": len(_flight), "maxlen": _flight.maxlen},
+    }
+
+
+def reset():
+    """Clear every metric, the flight ring, and the step sequence (tests
+    and benches; the monotonic XLA compile-event count is exempt)."""
+    global _step_seq, _last_dispatch, _last_compile, _dumped
+    # _drain_lock around the WHOLE reset: a concurrent emitter-thread
+    # drain must neither fold pre-reset pending records into the just-
+    # zeroed histograms nor re-append them into the just-cleared ring.
+    # Lock order _drain_lock -> _reg_lock matches _drain_steps (via
+    # _span_hist); nothing takes them in the reverse order.
+    with _drain_lock:
+        del _pending_steps[:]
+        _pending_faults.clear()
+        with _reg_lock:
+            # zero IN PLACE: hot callers hold metric objects (counter()'s
+            # documented contract), and clearing the dicts would orphan
+            # those handles — their post-reset increments would vanish
+            for c in _counters.values():
+                c.value = 0
+            for g in _gauges.values():
+                g.value = None
+            for h in _histograms.values():
+                h.count = 0
+                h.sum = 0.0
+                h.min = None
+                h.max = None
+                h._zeros = 0
+                h._buckets = {}
+        _train_hists.clear()
+        _flight.clear()
+        _step_seq = 0
+        prof = _profiler()
+        _last_dispatch = prof._dispatch_count
+        _last_compile = prof._compile_count
+    _dumped = False
+
+
+# -- postmortem ------------------------------------------------------------
+_dumped = False
+
+
+def dump_postmortem(reason, path=None):
+    """Write the crash-postmortem JSON: the full report() plus the last-K
+    step records and per-site fault firings, atomically (a crash during
+    the dump must not leave a torn postmortem — and without the
+    checkpoint layer's fault-injection sites, which must neither tear
+    this record nor have their budgets consumed by it).
+
+    Without an explicit ``path`` the file goes to
+    ``$MXTPU_POSTMORTEM_DIR/postmortem-<pid>.json``; unset dir means
+    postmortems are off and None is returned.  Only the first implicit
+    dump per process wins (excepthook fires before atexit; both route
+    here)."""
+    global _dumped
+    implicit = path is None
+    if implicit:
+        d = os.environ.get("MXTPU_POSTMORTEM_DIR")
+        if not d or _dumped:
+            return None
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "postmortem-%d.json" % os.getpid())
+    doc = report()
+    doc["schema"] = SCHEMA_POSTMORTEM
+    doc["reason"] = reason
+    from . import fault as _fault
+    doc["fault_fires"] = _fault.fire_counts()
+    doc["last_steps"] = flight_records()
+    # the plain writer: a ckpt.write.* fault armed for the checkpoint
+    # layer must not fire here and tear the record of the crash itself
+    from .checkpoint import _plain_atomic_write
+    _plain_atomic_write(path, json.dumps(doc, indent=1).encode("utf-8"))
+    if implicit:
+        # explicit-path dumps (health snapshots) must not suppress the
+        # one implicit crash/atexit postmortem this process gets
+        _dumped = True
+    return path
+
+
+_orig_excepthook = None
+_hooks_installed = False
+
+
+def _excepthook(tp, val, tb):
+    try:
+        dump_postmortem("%s: %s" % (tp.__name__, val))
+    except Exception:
+        pass  # the postmortem must never mask the real crash
+    (_orig_excepthook or sys.__excepthook__)(tp, val, tb)
+
+
+def _at_exit():
+    stop_emitter()
+    try:
+        skipped = _profiler().step_stats()["skipped_steps"]
+        if skipped and not _dumped:
+            dump_postmortem(
+                "atexit: run ended with %d divergence-guard skipped "
+                "steps" % skipped)
+    except Exception:
+        pass
+
+
+def install_crash_hooks():
+    """Chain the postmortem dump into sys.excepthook (covers unhandled
+    MXNetError — e.g. the divergence guard's K-consecutive-skips raise —
+    and every other crash) and register the atexit skipped-steps dump.
+    Idempotent; installed at import."""
+    global _orig_excepthook, _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    _orig_excepthook = sys.excepthook
+    sys.excepthook = _excepthook
+    atexit.register(_at_exit)
+
+
+# -- periodic JSON-lines emitter -------------------------------------------
+_emitter = None
+
+
+def _parse_emitter_spec(spec):
+    """``path[:interval]`` — a trailing ``:<float>`` is the period in
+    seconds (default 10); everything else is the path (so paths with
+    colons still work as long as the last segment isn't a number)."""
+    path, sep, tail = spec.rpartition(":")
+    if sep:
+        try:
+            return path, max(0.05, float(tail))
+        except ValueError:
+            pass
+    return spec, 10.0
+
+
+def _emit_line(path):
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(report()) + "\n")
+    except Exception:
+        pass  # telemetry must never take the run down
+
+
+def start_emitter(path, interval=10.0):
+    """Append one report() line to ``path`` every ``interval`` seconds
+    from a daemon thread (plus a final line on stop/exit)."""
+    global _emitter
+    stop_emitter()
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            _emit_line(path)
+        _emit_line(path)  # final line so short runs still leave a trace
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name="mxtpu-telemetry-emitter")
+    t.start()
+    _emitter = (t, stop)
+    return t
+
+
+def stop_emitter():
+    global _emitter
+    if _emitter is None:
+        return
+    t, stop = _emitter
+    _emitter = None
+    stop.set()
+    t.join(timeout=5.0)
+
+
+def _maybe_start_emitter():
+    spec = os.environ.get("MXTPU_TELEMETRY")
+    if not spec:
+        return
+    path, interval = _parse_emitter_spec(spec)
+    if not path:
+        # telemetry must never take the run down — and this runs at
+        # import time, where a raise would kill every process in the env
+        import logging
+        logging.warning(
+            "mxnet_tpu: bad MXTPU_TELEMETRY spec %r (want "
+            "path[:interval]); emitter disabled", spec)
+        return
+    start_emitter(path, interval)
+
+
+install_crash_hooks()
+_install_compile_hook()
+_maybe_start_emitter()

@@ -43,11 +43,16 @@ def build_module(batch=64, dim=32, classes=4, hidden=64):
 
 
 def trace(step_fn, batches, epochs=3):
-    """Warm one epoch, then measure steady state."""
-    from mxnet_tpu import profiler
+    """Warm one epoch, then measure steady state (profiler counters AND
+    the always-on telemetry phase histograms, reset together)."""
+    from mxnet_tpu import profiler, telemetry
     for b in batches:
         step_fn(b)
+    # the probe VERIFIES telemetry/step_stats consistency, so recording
+    # must be on even under MXTPU_TELEMETRY_OFF=1 in the environment
+    telemetry.set_enabled(True)
     profiler.reset_step_stats()
+    telemetry.reset()
     t0 = time.perf_counter()
     n = 0
     for _ in range(epochs):
@@ -56,6 +61,7 @@ def trace(step_fn, batches, epochs=3):
             n += 1
     dt = time.perf_counter() - t0
     stats = profiler.step_stats()
+    rep = telemetry.report()
     ema = stats["step_time_ema_s"]
     return {
         "steps": n,
@@ -64,6 +70,10 @@ def trace(step_fn, batches, epochs=3):
         "skipped_steps": stats["skipped_steps"],
         "step_time_ema_ms": round(ema * 1e3, 3) if ema else None,
         "wall_ms_per_step": round(dt / n * 1e3, 3),
+        "phase_counts": {name: p["count"]
+                         for name, p in rep["phases"].items()},
+        "flight_len": rep["flight"]["len"],
+        "flight_maxlen": rep["flight"]["maxlen"],
     }
 
 
@@ -83,6 +93,24 @@ def run():
 
     unfused = trace(split_step, batches)
     n_params = len(mod._param_names)
+
+    # the telemetry layer must agree with the profiler's step counters:
+    # every fused dispatch produced exactly one fit_step.dispatch /
+    # fit_step.sync phase record and one flight-recorder entry (the 1.0
+    # dispatch/step contract, cross-checked against the new per-phase
+    # counters; bench.py BENCH_MODE=steptrace still hard-asserts the
+    # dispatch rate itself)
+    n = fused["steps"]
+    for phase in ("fit_step.dispatch", "fit_step.sync"):
+        got = fused["phase_counts"].get(phase, 0)
+        assert got == n, (
+            "telemetry phase %r recorded %d entries for %d fused steps — "
+            "per-phase counters diverged from profiler.step_stats()"
+            % (phase, got, n))
+    assert fused["flight_len"] == min(n, fused["flight_maxlen"]), (
+        "flight recorder held %d records for %d fused steps (ring cap %d)"
+        % (fused["flight_len"], n, fused["flight_maxlen"]))
+
     return {"fused": fused, "unfused": unfused, "n_params": n_params}
 
 

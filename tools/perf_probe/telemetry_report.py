@@ -1,0 +1,179 @@
+"""Render telemetry artifacts for humans.
+
+Input files are either:
+
+- a JSON-lines timeline written by the periodic emitter
+  (``MXTPU_TELEMETRY=path[:interval]``) — one ``report()`` object per
+  line (schema ``mxtpu-telemetry-1``); the summary covers the LAST line
+  (cumulative totals) and notes the line count / wall span, or
+- a crash postmortem (schema ``mxtpu-postmortem-1``) dumped by the
+  flight recorder into ``MXTPU_POSTMORTEM_DIR`` — rendered as the crash
+  reason, step_stats, fault firings, and the last-K per-step table.
+
+Usage:
+    python tools/perf_probe/telemetry_report.py RUN.jsonl [POSTMORTEM.json ...]
+
+See OBSERVABILITY.md for the metric-name and schema contract.
+"""
+import json
+import sys
+
+
+def _fmt_s(v):
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return "%.2fs" % v
+    if v >= 1e-3:
+        return "%.2fms" % (v * 1e3)
+    return "%.1fus" % (v * 1e6)
+
+
+def _fmt_n(v):
+    return "-" if v is None else ("%.0f" % v)
+
+
+def _hist_rows(hists):
+    rows = []
+    for name, h in sorted(hists.items(), key=lambda kv: -kv[1]["sum"]):
+        if not h["count"]:
+            continue
+        # size histograms (ckpt.write_bytes...) render as plain numbers,
+        # duration histograms as scaled seconds
+        fmt = _fmt_n if "bytes" in name else _fmt_s
+        rows.append((name, h["count"], fmt(h["sum"] / h["count"]),
+                     fmt(h["p50"]), fmt(h["p90"]), fmt(h["p99"]),
+                     fmt(h["max"]), fmt(h["sum"])))
+    return rows
+
+
+def _table(header, rows, out):
+    if not rows:
+        return
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    for r in [header] + rows:
+        out.write("  " + "  ".join(
+            str(c).ljust(w) for c, w in zip(r, widths)).rstrip() + "\n")
+
+
+def render_report(doc, out, context=""):
+    """Phase-time breakdown + histogram percentiles of one report()."""
+    out.write("== telemetry report%s ==\n" % context)
+    ss = doc.get("step_stats") or {}
+    out.write("  steps %s  dispatches %s  compiles %s  skipped %s  "
+              "step_ema %s\n" % (
+                  ss.get("steps"), ss.get("dispatch_count"),
+                  ss.get("compile_count"), ss.get("skipped_steps"),
+                  _fmt_s(ss.get("step_time_ema_s"))))
+    phases = doc.get("phases") or {}
+    total = sum(h["sum"] for h in phases.values())
+    # NB: nested spans (ckpt.write encloses ckpt.fsync/rename, etc.)
+    # overlap, so the sum exceeds wall time and shares are of the SUM of
+    # span time, not of the run
+    out.write("\n  phase-time breakdown (summed span time %s; nested "
+              "spans overlap):\n" % _fmt_s(total))
+    rows = []
+    for (name, count, mean, p50, p90, p99, mx, tot) in \
+            _hist_rows(phases):
+        share = phases[name]["sum"] / total * 100 if total else 0.0
+        rows.append((name, count, mean, p50, p99, tot,
+                     "%.1f%%" % share))
+    _table(("phase", "count", "mean", "p50", "p99", "total", "of-sum"),
+           rows, out)
+    hists = doc.get("histograms") or {}
+    if any(h["count"] for h in hists.values()):
+        out.write("\n  histograms:\n")
+        _table(("name", "count", "mean", "p50", "p90", "p99", "max",
+                "sum"), _hist_rows(hists), out)
+    counters = {k: v for k, v in (doc.get("counters") or {}).items() if v}
+    if counters:
+        out.write("\n  counters: " + "  ".join(
+            "%s=%s" % kv for kv in sorted(counters.items())) + "\n")
+    gauges = {k: v for k, v in (doc.get("gauges") or {}).items()
+              if v is not None}
+    if gauges:
+        out.write("  gauges: " + "  ".join(
+            "%s=%s" % kv for kv in sorted(gauges.items())) + "\n")
+
+
+def render_postmortem(doc, out):
+    """Pretty-print a flight-recorder crash postmortem."""
+    out.write("== POSTMORTEM (pid %s) ==\n" % doc.get("pid"))
+    out.write("  reason: %s\n" % doc.get("reason"))
+    ss = doc.get("step_stats") or {}
+    out.write("  step_stats: %s\n" % json.dumps(ss))
+    fires = doc.get("fault_fires") or {}
+    if fires:
+        out.write("  fault firings: " + "  ".join(
+            "%s x%d" % kv for kv in sorted(fires.items())) + "\n")
+    steps = doc.get("last_steps") or []
+    out.write("\n  last %d step records (flight recorder, ring %s):\n"
+              % (len(steps), (doc.get("flight") or {}).get("maxlen")))
+    rows = []
+    for r in steps[-20:]:
+        rows.append((r["step"],
+                     _fmt_s(r["dispatch_s"]), _fmt_s(r["sync_s"]),
+                     r["dispatch_delta"], r["compile_delta"],
+                     "SKIP" if r["skipped"] else
+                     ("?" if r["skipped"] is None else "ok"),
+                     "-" if r["loss"] is None else "%.4g" % r["loss"],
+                     ",".join(r["faults"]) or "-"))
+    _table(("step", "dispatch", "sync", "disp+", "comp+", "guard",
+            "loss", "faults"), rows, out)
+    if len(steps) > 20:
+        out.write("  (%d older records omitted)\n" % (len(steps) - 20))
+    render_report(doc, out, context=" (at crash)")
+
+
+def render_file(path, out=sys.stdout):
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        out.write("%s: empty\n" % path)
+        return
+    try:
+        # a postmortem is one (indented, multi-line) JSON document
+        docs = [json.loads(text)]
+    except ValueError:
+        # emitter timeline: one report per line; a process killed
+        # mid-append leaves a torn final line — the exact crash this
+        # tooling serves — so skip unparseable lines with a note
+        docs, skipped = [], 0
+        for ln in text.splitlines():
+            if not ln.strip():
+                continue
+            try:
+                docs.append(json.loads(ln))
+            except ValueError:
+                skipped += 1
+        if skipped:
+            out.write("  (%d unparseable line(s) skipped — torn "
+                      "mid-append write)\n" % skipped)
+        if not docs:
+            out.write("%s: no parseable JSON\n" % path)
+            return
+    last = docs[-1]
+    if last.get("schema") == "mxtpu-postmortem-1":
+        render_postmortem(last, out)
+        return
+    ctx = ""
+    if len(docs) > 1:
+        span = last.get("time_unix", 0) - docs[0].get("time_unix", 0)
+        ctx = " (%d samples over %s)" % (len(docs), _fmt_s(span))
+    render_report(last, out, context=ctx)
+
+
+def main(argv):
+    if not argv:
+        sys.stderr.write(__doc__)
+        return 2
+    for i, path in enumerate(argv):
+        if i:
+            sys.stdout.write("\n")
+        render_file(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
