@@ -42,6 +42,11 @@ from . import kvstore
 from . import kvstore as kv
 from . import fault
 from . import telemetry
+from . import watchdog
+# workers spawned by tools/launch.py carry MXTPU_HEARTBEAT_DIR: start
+# touching the per-rank heartbeat file the launcher's stall monitor
+# watches (no-op otherwise)
+watchdog._maybe_start_heartbeat()
 from . import checkpoint
 from .checkpoint import CheckpointManager
 from . import model

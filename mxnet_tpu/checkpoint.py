@@ -101,6 +101,7 @@ def _atomic_write_impl(path, data, retries, backoff, instrumented):
 
     def attempt():
         if instrumented:
+            _fault.stall_if("ckpt.write.stall")
             if _fault.trigger("ckpt.write.ioerror"):
                 raise OSError(
                     "[fault injection] transient I/O error writing %s"
@@ -160,7 +161,13 @@ def atomic_write(path, data, retries=4, backoff=0.05):
     exponential backoff.  Telemetry: ``ckpt.write`` span (whole call,
     retries included), ``ckpt.fsync`` / ``ckpt.rename`` phase histograms,
     ``ckpt.write_bytes`` size histogram, ``ckpt.io_retries`` counter."""
-    with _telemetry.span("ckpt.write", cat="checkpoint"):
+    from . import watchdog as _watchdog
+    # scoped lease: a write wedged in the filesystem (hung NFS, dead
+    # disk) is a stall, not progress — the watchdog diagnoses + exits 75
+    # rather than letting the job hold every peer at the next barrier.
+    # Size the stall timeout above your worst-case checkpoint write.
+    with _telemetry.span("ckpt.write", cat="checkpoint"), \
+            _watchdog.guard("ckpt.write"):
         _atomic_write_impl(path, data, retries, backoff, instrumented=True)
     _telemetry.histogram("ckpt.write_bytes").observe(len(data))
 

@@ -29,6 +29,18 @@ Sites wired in this package:
 - ``grad.nan``            poison the global gradient tree of the fused
                           fit_step / Trainer step with NaN (exercises the
                           divergence guard's skip-update path).
+- ``worker.stall``        wedge the train step (fit_step / Trainer.step)
+                          in a lease-less sleep (watchdog detection).
+- ``data.stall``          wedge the DataLoader prefetch producer.
+- ``kv.hang``             wedge inside a KVStore collective/barrier
+                          (peer-loss deadlock stand-in).
+- ``ckpt.write.stall``    wedge an atomic_write (stuck NFS stand-in).
+
+The ``*.stall``/``kv.hang`` sites simulate HANGS, not crashes: they
+sleep ``MXTPU_FAULT_STALL_SECS`` (default 3600) without renewing any
+watchdog lease, so only the hang-defense layer (mxnet_tpu/watchdog.py,
+tools/launch.py heartbeats) can end the run — exactly the production
+failure mode they stand in for.
 
 ``FaultInjected`` deliberately subclasses MXNetError, NOT OSError: the
 retry loops treat OSError as transient but must never retry a simulated
@@ -39,12 +51,13 @@ from __future__ import annotations
 import os
 import random as _random
 import threading
+import time as _time
 import zlib
 
 from .base import MXNetError
 
 __all__ = ["FaultInjected", "configure", "reset", "is_active", "trigger",
-           "check", "fire_count", "fire_counts"]
+           "check", "stall_if", "fire_count", "fire_counts"]
 
 
 class FaultInjected(MXNetError):
@@ -161,6 +174,23 @@ def check(site, msg=None):
     if trigger(site):
         raise FaultInjected("[fault injection] %s"
                             % (msg or "site %r fired" % site))
+
+
+def stall_if(site):
+    """Simulate a HANG when ``site`` triggers: sleep
+    ``MXTPU_FAULT_STALL_SECS`` (default 3600) in short slices, renewing
+    nothing.  Unlike :func:`check` nothing is raised — a real wedge has
+    no exception either; detection belongs to the watchdog (lease
+    expiry → exit 75) or the launcher (heartbeat mtime gone stale)."""
+    if not trigger(site):
+        return
+    try:
+        secs = float(os.environ.get("MXTPU_FAULT_STALL_SECS", "3600"))
+    except ValueError:
+        secs = 3600.0
+    end = _time.monotonic() + secs
+    while _time.monotonic() < end:
+        _time.sleep(min(0.5, max(0.0, end - _time.monotonic())))
 
 
 def fire_count(site):

@@ -18,6 +18,7 @@ import numpy as _np
 
 from ... import ndarray as nd
 from ... import telemetry as _telemetry
+from ... import watchdog as _watchdog
 from . import sampler as _sampler
 
 __all__ = ["DataLoader"]
@@ -81,6 +82,11 @@ class _PrefetchIter:
             try:
                 from ... import fault as _fault
                 for batch in make_batches():
+                    # a wedged producer (hung storage read, deadlocked
+                    # augmentation) starves the consumer in __next__; the
+                    # consumer-side "data" lease expires and the watchdog
+                    # diagnoses the stall
+                    _fault.stall_if("data.stall")
                     _fault.check("data.prefetch",
                                  "prefetch worker failure")
                     # start (don't wait for) the host→device copy; the
@@ -104,6 +110,7 @@ class _PrefetchIter:
     def close(self):
         """Unblock and retire the worker; free queued batches."""
         self._done = True
+        _watchdog.release("data")  # no more progress expected from here
         self._stop.set()
         try:
             # a put() already past its stop check can still land one item;
@@ -150,6 +157,11 @@ class _PrefetchIter:
             # the surfaced traceback chains the original failure site
             # under this consumption point
             raise item
+        # consumer-side progress lease: renewed per batch actually
+        # delivered, so a starved consumer (wedged producer) expires it.
+        # primary=False: delivering batch 1 precedes the first step's
+        # compile and must not end the startup-grace window
+        _watchdog.renew("data", phase="data", primary=False)
         return item
 
 

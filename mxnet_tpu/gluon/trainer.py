@@ -97,6 +97,9 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimizer step, scaling grads by 1/batch_size."""
+        from .. import fault as _fault
+        from .. import watchdog as _watchdog
+        _fault.stall_if("worker.stall")
         self._resolve_pending_verdict()
         from ..ops.optimizer_ops import (max_consecutive_skips,
                                          raise_skip_limit_error)
@@ -110,18 +113,26 @@ class Trainer:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
 
-        if self._kv is None and self._fused_step():
-            return
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            if self._kv is not None:
-                self._kv.push(i, param.list_grad())
-                if self._update_on_kvstore:
-                    self._kv.pull(i, param.list_data())
+        try:
+            if self._kv is None and self._fused_step():
+                return
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null":
                     continue
-                self._kv.pull(i, param.list_grad())
-            self._updaters(i, param.grad(), param.data())
+                if self._kv is not None:
+                    self._kv.push(i, param.list_grad())
+                    if self._update_on_kvstore:
+                        self._kv.pull(i, param.list_data())
+                        continue
+                    self._kv.pull(i, param.list_grad())
+                self._updaters(i, param.grad(), param.data())
+        finally:
+            # progress lease (fused and per-param paths alike): gluon
+            # training loops are user-owned, so the watchdog self-arms on
+            # the first renewal when MXTPU_STALL_TIMEOUT is set; call
+            # watchdog.disarm() after your last step if the process keeps
+            # doing non-training work (ROBUSTNESS.md §7)
+            _watchdog.renew("trainer_step", phase="train")
 
     # -- fused tree-wide step ----------------------------------------------
     def _fused_step(self):

@@ -25,8 +25,19 @@ import pickle
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
+from . import fault as _fault
 from . import optimizer as opt
 from . import telemetry as _telemetry
+from . import watchdog as _watchdog
+
+
+def _collective_timeout():
+    """Deadline for one blocking collective/barrier (None = the global
+    stall timeout).  Collectives during bring-up legitimately wait for
+    peers still compiling, so MXTPU_COLLECTIVE_TIMEOUT can be raised
+    independently of the steady-state lease timeout."""
+    v = _watchdog._env_float("MXTPU_COLLECTIVE_TIMEOUT", 0.0)
+    return v if v > 0 else None
 
 __all__ = ["KVStore", "create"]
 
@@ -166,13 +177,19 @@ class KVStore:
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
-        mesh, gs = self._worker_gather(raws)
-        if self._allreduce_jit is None:
-            self._allreduce_jit = jax.jit(
-                lambda xs: tuple(jnp.sum(x, axis=0) for x in xs),
-                out_shardings=NamedSharding(mesh, P()))
-        summed = self._allreduce_jit(tuple(gs))
-        return [s.addressable_data(0) for s in summed]
+        # a peer dying mid-collective leaves this call blocked forever;
+        # the scoped watchdog lease turns that into a diagnosed stall
+        # (stack dump + postmortem + exit 75) the launcher restarts
+        with _watchdog.guard("kv.allreduce",
+                             timeout=_collective_timeout()):
+            _fault.stall_if("kv.hang")
+            mesh, gs = self._worker_gather(raws)
+            if self._allreduce_jit is None:
+                self._allreduce_jit = jax.jit(
+                    lambda xs: tuple(jnp.sum(x, axis=0) for x in xs),
+                    out_shardings=NamedSharding(mesh, P()))
+            summed = self._allreduce_jit(tuple(gs))
+            return [s.addressable_data(0) for s in summed]
 
     def push(self, key, value, priority=0):
         with _telemetry.span("kv.push", cat="kvstore"):
@@ -288,9 +305,13 @@ class KVStore:
 
     # -- distributed control -----------------------------------------------
     def barrier(self):
-        if self._kind.startswith("dist") and self.num_workers > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("kvstore_barrier")
+        # scoped lease: a barrier whose peer never arrives (worker wedged
+        # or dead) becomes a diagnosed stall instead of an eternal hang
+        with _watchdog.guard("kv.barrier", timeout=_collective_timeout()):
+            _fault.stall_if("kv.hang")
+            if self._kind.startswith("dist") and self.num_workers > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("kvstore_barrier")
 
     def _barrier_before_exit(self):
         self.barrier()

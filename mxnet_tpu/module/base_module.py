@@ -77,30 +77,45 @@ class BaseModule:
               epoch=0):
         """Run prediction + metric over eval_data (reference :176)."""
         assert self.binded and self.params_initialized
+        from .. import watchdog as _watchdog
         if reset:
             eval_data.reset()
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
         actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+        # scoped like fit: a standalone score() must not leave the
+        # watchdog armed with a live lease after it returns (an eval-only
+        # process would be killed during its post-scoring work)
+        _armed_here = _watchdog.maybe_arm()
+        try:
+            for nbatch, eval_batch in enumerate(eval_data):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                self.forward(eval_batch, is_train=False)
+                # evaluation is progress too: without this a validation
+                # pass longer than the stall timeout would expire the
+                # training leases and kill a healthy job mid-eval
+                _watchdog.renew("fit_step", phase="eval")
+                self.update_metric(eval_metric, eval_batch.label)
+                if batch_end_callback is not None:
+                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=locals())
+                    for callback in _as_list(batch_end_callback):
+                        callback(params)
+                actual_num_batch += 1
+            if score_end_callback:
+                params = BatchEndParam(epoch=epoch,
+                                       nbatch=actual_num_batch,
                                        eval_metric=eval_metric,
                                        locals=locals())
-                for callback in _as_list(batch_end_callback):
+                for callback in _as_list(score_end_callback):
                     callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
-        return eval_metric.get_name_value()
+            return eval_metric.get_name_value()
+        finally:
+            if _armed_here:
+                _watchdog.disarm()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
@@ -157,26 +172,50 @@ class BaseModule:
             monitor=None):
         """The training loop (reference base_module.py:376-487)."""
         assert num_epoch is not None, "please specify number of epochs"
+        from .. import watchdog as _watchdog
         from ..initializer import Uniform
         if initializer is None:
             initializer = Uniform(0.01)
+        # hang defense is scoped to the run: armed here (no-op unless
+        # MXTPU_STALL_TIMEOUT is set), disarmed in the finally below so
+        # post-training work can't trip over a stale training lease.
+        # The try covers bind/init too: a raise there must not leak an
+        # armed watchdog into a caller that handled the error.
+        _armed_here = _watchdog.maybe_arm()
+        try:
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label,
+                      for_training=True, force_rebind=force_rebind)
+            if monitor is not None:
+                self.install_monitor(monitor)
+            self.init_params(initializer=initializer,
+                             arg_params=arg_params,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
+                             force_init=force_init)
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=optimizer_params)
 
-        self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label,
-                  for_training=True, force_rebind=force_rebind)
-        if monitor is not None:
-            self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
-        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
+            if validation_metric is None:
+                validation_metric = eval_metric
+            if not isinstance(eval_metric, metric_mod.EvalMetric):
+                eval_metric = metric_mod.create(eval_metric)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+            self._fit_epochs(train_data, eval_data, eval_metric,
+                             validation_metric, epoch_end_callback,
+                             batch_end_callback, eval_end_callback,
+                             eval_batch_end_callback, monitor,
+                             begin_epoch, num_epoch)
+        finally:
+            if _armed_here:
+                _watchdog.disarm()
 
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, monitor, begin_epoch,
+                    num_epoch):
+        from .. import watchdog as _watchdog
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -189,6 +228,10 @@ class BaseModule:
                 if monitor is not None:
                     monitor.tic()
                 self.fit_step(data_batch)
+                # progress lease for the split fallback path too
+                # (Module.fit_step renews on the fused path; renewal is
+                # one monotonic store, so doubling up is free)
+                _watchdog.renew("fit_step", phase="train")
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch)

@@ -484,9 +484,14 @@ class Module(BaseModule):
         from .. import profiler as _profiler
         from .. import random as _random
         from .. import telemetry as _telemetry
+        from .. import watchdog as _watchdog
         from ..ndarray.ndarray import NDArray
         from ..ops.optimizer_ops import handle_guard_verdict
 
+        # hang-defense probe: a wedged step stops renewing the lease
+        # below; the watchdog (armed when MXTPU_STALL_TIMEOUT is set)
+        # diagnoses and exits 75 — retryable by the launcher
+        _fault.stall_if("worker.stall")
         fused = self._fused_setup()
         exe = self._exec
         feeds = self._feed_batch(data_batch)
@@ -549,6 +554,9 @@ class Module(BaseModule):
         loss = float(outs[0]) if outs and not outs[0].shape \
             and _telemetry.enabled() else None
         _telemetry.note_train_step(t0, t1, t2, not ok_host, loss)
+        # progress lease: one monotonic store per completed step (no
+        # dispatches — steptrace's 1.0 dispatch/step still holds)
+        _watchdog.renew("fit_step", phase="train")
         self._consec_guard_skips = handle_guard_verdict(
             ok_host, opt, update_idxs, self._consec_guard_skips,
             pre_num_update)

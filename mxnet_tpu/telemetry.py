@@ -641,6 +641,13 @@ def dump_postmortem(reason, path=None):
     from . import fault as _fault
     doc["fault_fires"] = _fault.fire_counts()
     doc["last_steps"] = flight_records()
+    try:
+        # hang-defense context: lease ages/timeouts at the moment of
+        # death — for a watchdog stall this names the wedged phase
+        from . import watchdog as _watchdog
+        doc["watchdog"] = _watchdog.snapshot()
+    except Exception:
+        pass  # interpreter teardown
     # the plain writer: a ckpt.write.* fault armed for the checkpoint
     # layer must not fire here and tear the record of the crash itself
     from .checkpoint import _plain_atomic_write

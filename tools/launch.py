@@ -14,7 +14,14 @@ Launchers:
   reference's `--launcher local` fake-cluster test mode
   (tests/nightly/dist_sync_kvstore.py workflow).
 - ``ssh``: run one worker per host from `-H hostfile` via ssh, pointing
-  all of them at this host's coordinator port.
+  all of them at this host's coordinator port; monitored like local
+  (first failure tears the job down, --max-restarts applies).
+
+Failure handling: worker exits are classified retryable/permanent
+(classify_exit) with exponential backoff between restarts; hangs are
+caught by the per-rank heartbeat monitor (--heartbeat-timeout, files
+touched by mxnet_tpu.watchdog under MXTPU_HEARTBEAT_DIR) and by the
+in-process watchdog's stall exit code 75 — see ROBUSTNESS.md §5/§7.
 - On real TPU pods, prefer the platform launcher (GKE/queued resources):
   every pod VM already runs one process; pass --use-env-ranks to adopt
   the platform-provided rank env instead of spawning.
@@ -24,10 +31,18 @@ from __future__ import annotations
 import argparse
 import os
 import shlex
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
+import time
+
+# exit-code contract with mxnet_tpu/watchdog.py (kept literal here: the
+# launcher must work without the package importable on this host)
+STALL_EXIT = 75         # EX_TEMPFAIL: watchdog stall — retryable
+PORT_IN_USE_EXIT = 76   # coordinator port bind failure — retryable
 
 
 def _free_port():
@@ -38,43 +53,69 @@ def _free_port():
     return port
 
 
-def _run_local_once(args, cmd, attempt):
-    """One job attempt: spawn N workers, watch for failures.
+def _escalate_kill(procs, first_sig=signal.SIGTERM, grace=5.0):
+    """Tear a job down with bounded patience: ``first_sig`` → wait up to
+    ``grace`` → SIGTERM → ``grace`` → SIGKILL, then reap.  Every stop
+    path (worker death, heartbeat stall, Ctrl-C) routes through here, so
+    a worker that ignores polite signals — or is the very wedged process
+    we are killing *because* it stopped responding — can delay teardown
+    by at most 2×grace, never forever."""
+    seq = []
+    for sig in (first_sig, signal.SIGTERM, signal.SIGKILL):
+        if not seq or seq[-1] != sig:
+            seq.append(sig)
+    for sig in seq:
+        alive = [p for p in procs if p.poll() is None]
+        if not alive:
+            break
+        for p in alive:
+            try:
+                p.send_signal(sig)
+            except OSError:
+                pass  # exited between poll and signal
+        if sig == signal.SIGKILL:
+            break
+        deadline = time.time() + grace
+        while time.time() < deadline and \
+                any(p.poll() is None for p in procs):
+            time.sleep(0.05)
+    # bounded reap: even SIGKILL cannot collect a process stuck in
+    # uninterruptible sleep (D-state — the hung-NFS case this defense
+    # targets); waiting forever here would convert a detected worker
+    # hang into an undetected launcher hang
+    deadline = time.time() + max(grace, 5.0)
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            print("launch.py: giving up reaping pid %d (uninterruptible "
+                  "sleep?); continuing teardown" % p.pid,
+                  file=sys.stderr, flush=True)
+        except Exception:
+            pass
 
-    Failure detection (the collective-era replacement for ps-lite's
+
+def _monitor_procs(args, procs, heartbeat_dir=None, label="worker"):
+    """Watch a running job; returns ``(failed_rank, rc)`` — (None, 0) on
+    clean completion, rank+code on the first failure (the job is torn
+    down first), (-1, 1) on Ctrl-C.
+
+    Two failure channels (the collective-era replacement for ps-lite's
     server heartbeat/recovery hooks, reference src/kvstore/
-    kvstore_dist.h:59-62): a worker dying strands its peers inside a
-    collective, so the launcher — not the survivors — detects the death,
-    tears the whole job down, and reports the failed rank.  Recovery is
-    full job restart from checkpoints (launch_local --max-restarts).
+    kvstore_dist.h:59-62):
+
+    - **exit**: a worker dying strands its peers inside a collective, so
+      the launcher — not the survivors — detects the death and kills the
+      job.
+    - **heartbeat silence** (``--heartbeat-timeout`` > 0): a worker that
+      *hangs* — wedged in native code under the GIL, swapped out, so
+      even its in-process watchdog can't run — stops touching its
+      per-rank heartbeat file (written by mxnet_tpu.watchdog inside the
+      worker).  A stale mtime past the deadline is treated as a stall:
+      the job is killed and the rank reported with the stall exit code
+      (75), which classify_exit maps to retryable.  Workers that never
+      wrote a heartbeat (non-mxnet commands) are not monitored.
     """
-    import time
-    port = args.port or _free_port()
-    coordinator = "127.0.0.1:%d" % port
-    procs = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({
-            # JAX multi-process coordination
-            "MXTPU_COORDINATOR": coordinator,
-            "MXTPU_NUM_WORKERS": str(args.num_workers),
-            "MXTPU_WORKER_RANK": str(rank),
-            "MXTPU_RESTART_ATTEMPT": str(attempt),
-            # reference env contract (dmlc_tracker) for script compat
-            "DMLC_ROLE": "worker",
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_NUM_SERVER": "0",
-            "DMLC_WORKER_ID": str(rank),
-        })
-        if args.cpu_fake_devices:
-            env["JAX_PLATFORMS"] = "cpu"
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-        if args.local_device_count:
-            flags = env.get("XLA_FLAGS", "")
-            env["XLA_FLAGS"] = ("%s --xla_force_host_platform_device_count"
-                                "=%d" % (flags,
-                                         args.local_device_count)).strip()
-        procs.append(subprocess.Popen(cmd, env=env))
     try:
         while True:
             running = False
@@ -84,25 +125,108 @@ def _run_local_once(args, cmd, attempt):
                     running = True
                 elif rc != 0:
                     # one worker died — peers may be stranded in a
-                    # collective; kill the job
-                    print("launch.py: worker %d exited with %d; "
-                          "terminating remaining workers" % (rank, rc),
-                          file=sys.stderr, flush=True)
-                    for q in procs:
-                        if q.poll() is None:
-                            q.kill()
-                    for q in procs:
-                        q.wait()
+                    # collective; kill the job (politely first: peers
+                    # flush telemetry postmortems on SIGTERM)
+                    print("launch.py: %s %d exited with %d; "
+                          "terminating remaining workers"
+                          % (label, rank, rc), file=sys.stderr,
+                          flush=True)
+                    _escalate_kill(procs, signal.SIGTERM,
+                                   args.kill_grace)
                     return rank, rc
             if not running:
                 return None, 0
+            if heartbeat_dir and args.heartbeat_timeout > 0:
+                now = time.time()
+                for rank, p in enumerate(procs):
+                    if p.poll() is not None:
+                        continue
+                    hb = os.path.join(heartbeat_dir,
+                                      "hb-%d.json" % rank)
+                    try:
+                        age = now - os.stat(hb).st_mtime
+                    except OSError:
+                        continue  # never wrote one: not monitored
+                    if age > args.heartbeat_timeout:
+                        print("launch.py: %s %d heartbeat silent for "
+                              "%.1fs (deadline %.1fs) — declaring the "
+                              "rank stalled and terminating the job"
+                              % (label, rank, age,
+                                 args.heartbeat_timeout),
+                              file=sys.stderr, flush=True)
+                        _escalate_kill(procs, signal.SIGTERM,
+                                       args.kill_grace)
+                        return rank, STALL_EXIT
             time.sleep(0.2)
     except KeyboardInterrupt:
-        for p in procs:
-            p.send_signal(signal.SIGINT)
-        for p in procs:
-            p.wait()
+        # bounded Ctrl-C teardown: SIGINT first (KeyboardInterrupt in
+        # the worker → its finally blocks / atexit postmortems run),
+        # then the escalation ladder — never an unbounded wait() on a
+        # worker that swallows the signal
+        print("launch.py: interrupt — stopping workers (SIGINT, then "
+              "escalating after %.1fs grace)" % args.kill_grace,
+              file=sys.stderr, flush=True)
+        _escalate_kill(procs, signal.SIGINT, args.kill_grace)
         return -1, 1
+
+
+def _run_local_once(args, cmd, attempt):
+    """One local job attempt: spawn N workers wired to a fresh
+    coordinator port (``--port 0`` re-picks per attempt, so a port left
+    wedged by the previous attempt is simply abandoned) plus a fresh
+    heartbeat run dir, then monitor to completion or teardown."""
+    port = args.port or _free_port()
+    coordinator = "127.0.0.1:%d" % port
+    hb_dir = tempfile.mkdtemp(prefix="mxtpu-hb-")
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                # JAX multi-process coordination
+                "MXTPU_COORDINATOR": coordinator,
+                "MXTPU_NUM_WORKERS": str(args.num_workers),
+                "MXTPU_WORKER_RANK": str(rank),
+                "MXTPU_RESTART_ATTEMPT": str(attempt),
+                # per-rank heartbeat files — exported even when
+                # --heartbeat-timeout is 0: the files are the "where
+                # was it" record on any kill, and the worker watchdog's
+                # stall diagnostics fall back to this dir when
+                # MXTPU_POSTMORTEM_DIR is unset (cost: one small write
+                # per worker per second)
+                "MXTPU_HEARTBEAT_DIR": hb_dir,
+                # reference env contract (dmlc_tracker) for script compat
+                "DMLC_ROLE": "worker",
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_NUM_SERVER": "0",
+                "DMLC_WORKER_ID": str(rank),
+            })
+            if args.cpu_fake_devices:
+                env["JAX_PLATFORMS"] = "cpu"
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+            if args.local_device_count:
+                flags = env.get("XLA_FLAGS", "")
+                env["XLA_FLAGS"] = (
+                    "%s --xla_force_host_platform_device_count"
+                    "=%d" % (flags, args.local_device_count)).strip()
+            procs.append(subprocess.Popen(cmd, env=env))
+        return _monitor_procs(args, procs, heartbeat_dir=hb_dir)
+    finally:
+        # a stalled worker without MXTPU_POSTMORTEM_DIR falls back to
+        # dumping its stack trace / postmortem HERE — deleting those
+        # would erase the diagnosis the stall exit just promised
+        try:
+            diagnostics = [n for n in os.listdir(hb_dir)
+                           if n.startswith(("stall-stacks-",
+                                            "postmortem-"))]
+        except OSError:
+            diagnostics = []
+        if diagnostics:
+            print("launch.py: stall diagnostics preserved in %s (%s)"
+                  % (hb_dir, ", ".join(sorted(diagnostics))),
+                  file=sys.stderr, flush=True)
+        else:
+            shutil.rmtree(hb_dir, ignore_errors=True)
 
 
 def classify_exit(rc):
@@ -117,9 +241,22 @@ def classify_exit(rc):
     mid-training) are exactly what checkpoint-restart exists for.  Note
     the interpreter exits 1 for uncaught ImportError too — exit codes
     cannot distinguish an import-time crash from a mid-training one, so
-    those retry conservatively (bounded by the backoff schedule)."""
+    those retry conservatively (bounded by the backoff schedule).
+
+    Two dedicated retryable classes from the hang-defense layer
+    (mxnet_tpu/watchdog.py): 75 (EX_TEMPFAIL) is a diagnosed stall —
+    the worker's watchdog dumped stacks + postmortem and self-terminated,
+    or this launcher declared heartbeat silence; 76 is a coordinator
+    port bind failure — a restart with ``--port 0`` picks a fresh port."""
     if rc < 0:
         return "retryable", "killed by signal %d" % (-rc)
+    if rc == STALL_EXIT:
+        return "retryable", ("exit code 75: stall (watchdog/heartbeat "
+                             "detected a hang; stacks + postmortem "
+                             "dumped)")
+    if rc == PORT_IN_USE_EXIT:
+        return "retryable", ("exit code 76: coordinator port in use — "
+                             "restart re-picks the port (--port 0)")
     if rc == 2:
         return "permanent", ("exit code 2: usage/import-time error — "
                              "would fail identically on every attempt")
@@ -128,21 +265,11 @@ def classify_exit(rc):
     return "retryable", "exit code %d: runtime failure" % rc
 
 
-def launch_local(args, cmd):
-    import time
-    if args.dry_run:
-        port = args.port or _free_port()
-        for rank in range(args.num_workers):
-            envs = ("MXTPU_COORDINATOR=127.0.0.1:%d MXTPU_NUM_WORKERS=%d "
-                    "MXTPU_WORKER_RANK=%d DMLC_ROLE=worker "
-                    "DMLC_NUM_WORKER=%d DMLC_WORKER_ID=%d"
-                    % (port, args.num_workers, rank, args.num_workers,
-                       rank))
-            print("%s %s" % (envs,
-                             " ".join(shlex.quote(c) for c in cmd)))
-        return 0
+def _restart_loop(args, run_once, cmd):
+    """The classify → backoff → restart-from-checkpoints policy, shared
+    by the local and ssh launchers."""
     for attempt in range(args.max_restarts + 1):
-        failed_rank, rc = _run_local_once(args, cmd, attempt)
+        failed_rank, rc = run_once(args, cmd, attempt)
         if failed_rank is None:
             return 0
         if failed_rank == -1 or attempt == args.max_restarts:
@@ -171,7 +298,22 @@ def launch_local(args, cmd):
     return 1
 
 
-def _ssh_commands(args, cmd):
+def launch_local(args, cmd):
+    if args.dry_run:
+        port = args.port or _free_port()
+        for rank in range(args.num_workers):
+            envs = ("MXTPU_COORDINATOR=127.0.0.1:%d MXTPU_NUM_WORKERS=%d "
+                    "MXTPU_WORKER_RANK=%d DMLC_ROLE=worker "
+                    "DMLC_NUM_WORKER=%d DMLC_WORKER_ID=%d"
+                    % (port, args.num_workers, rank, args.num_workers,
+                       rank))
+            print("%s %s" % (envs,
+                             " ".join(shlex.quote(c) for c in cmd)))
+        return 0
+    return _restart_loop(args, _run_local_once, cmd)
+
+
+def _ssh_commands(args, cmd, attempt=0):
     """→ [ssh argv per worker] — one worker per hostfile entry."""
     assert args.hostfile, "--launcher ssh requires -H hostfile"
     with open(args.hostfile) as f:
@@ -182,29 +324,39 @@ def _ssh_commands(args, cmd):
     out = []
     for rank, host in enumerate(hosts):
         envs = ("MXTPU_COORDINATOR=%s MXTPU_NUM_WORKERS=%d "
-                "MXTPU_WORKER_RANK=%d DMLC_ROLE=worker DMLC_NUM_WORKER=%d "
+                "MXTPU_WORKER_RANK=%d MXTPU_RESTART_ATTEMPT=%d "
+                "DMLC_ROLE=worker DMLC_NUM_WORKER=%d "
                 "DMLC_WORKER_ID=%d"
                 % (shlex.quote(coordinator), args.num_workers, rank,
-                   args.num_workers, rank))
+                   attempt, args.num_workers, rank))
         remote = "cd %s; %s %s" % (shlex.quote(os.getcwd()), envs,
                                    " ".join(shlex.quote(c) for c in cmd))
-        out.append(["ssh", "-o", "StrictHostKeyChecking=no", host,
-                    remote])
+        # -tt forces a remote tty so the remote process group dies with
+        # the ssh client when the monitor tears the job down — without
+        # it one remote worker failing leaves the others running forever
+        out.append(["ssh", "-tt", "-o", "StrictHostKeyChecking=no",
+                    "-o", "BatchMode=yes", host, remote])
     return out
 
 
+def _run_ssh_once(args, cmd, attempt):
+    """One ssh job attempt, monitored like the local launcher: the first
+    remote worker failing (its ssh client exits nonzero) tears the whole
+    job down and reports the failed rank, instead of the old
+    wait-for-everyone loop that left surviving hosts running forever.
+    No heartbeat files here — they are host-local; stall defense on ssh
+    jobs is the in-process watchdog (exit 75 propagates through ssh)."""
+    procs = [subprocess.Popen(argv)
+             for argv in _ssh_commands(args, cmd, attempt)]
+    return _monitor_procs(args, procs, label="ssh worker")
+
+
 def launch_ssh(args, cmd):
-    argvs = _ssh_commands(args, cmd)
     if args.dry_run:
-        for argv in argvs:
+        for argv in _ssh_commands(args, cmd):
             print(" ".join(shlex.quote(a) for a in argv))
         return 0
-    procs = [subprocess.Popen(argv) for argv in argvs]
-    code = 0
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
-    return code
+    return _restart_loop(args, _run_ssh_once, cmd)
 
 
 def _mpi_command(args, cmd):
@@ -281,6 +433,16 @@ def main(argv=None):
                         "each attempt (exponential backoff)")
     parser.add_argument("--restart-backoff-max", type=float, default=60.0,
                         help="backoff ceiling in seconds")
+    parser.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                        help="kill + restart the job when a worker's "
+                        "heartbeat file (touched by mxnet_tpu.watchdog "
+                        "under MXTPU_HEARTBEAT_DIR) goes quiet for this "
+                        "many seconds (0 = off); catches workers wedged "
+                        "in native code that their in-process watchdog "
+                        "cannot see")
+    parser.add_argument("--kill-grace", type=float, default=5.0,
+                        help="seconds to wait between teardown "
+                        "escalation steps (SIGINT/SIGTERM → SIGKILL)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command for launching the program")
     args = parser.parse_args(argv)

@@ -103,6 +103,17 @@ def render_postmortem(doc, out):
     out.write("  reason: %s\n" % doc.get("reason"))
     ss = doc.get("step_stats") or {}
     out.write("  step_stats: %s\n" % json.dumps(ss))
+    wd = doc.get("watchdog") or {}
+    if wd.get("leases") or str(doc.get("reason", "")).startswith("stall"):
+        prog = wd.get("progress") or {}
+        out.write("  watchdog: armed=%s timeout=%ss grace=%ss "
+                  "last-progress step=%s phase=%s\n"
+                  % (wd.get("armed"), wd.get("timeout"), wd.get("grace"),
+                     prog.get("step"), prog.get("phase")))
+        rows = [(name, _fmt_s(lease.get("age_s")),
+                 _fmt_s(lease.get("timeout_s")), lease.get("step"))
+                for name, lease in sorted((wd.get("leases") or {}).items())]
+        _table(("lease", "age", "timeout", "step"), rows, out)
     fires = doc.get("fault_fires") or {}
     if fires:
         out.write("  fault firings: " + "  ".join(
@@ -161,7 +172,33 @@ def render_file(path, out=sys.stdout):
     if len(docs) > 1:
         span = last.get("time_unix", 0) - docs[0].get("time_unix", 0)
         ctx = " (%d samples over %s)" % (len(docs), _fmt_s(span))
+    _render_watchdog_timeline(docs, out)
     render_report(last, out, context=ctx)
+
+
+def _render_watchdog_timeline(docs, out):
+    """Call out hang-defense events across an emitter timeline: the
+    samples where ``watchdog.stalls`` incremented (with the worst lease
+    age the sample carried), so a soak run's stalls are visible without
+    diffing counters by hand."""
+    t0 = docs[0].get("time_unix", 0)
+    prev = 0
+    events = []
+    for doc in docs:
+        v = (doc.get("counters") or {}).get("watchdog.stalls", 0) or 0
+        if v > prev:
+            events.append((doc.get("time_unix", 0) - t0, v - prev,
+                           (doc.get("gauges") or {})
+                           .get("watchdog.lease_age")))
+        prev = v
+    if not events:
+        return
+    out.write("== WATCHDOG: %d stall(s) in this timeline ==\n"
+              % sum(n for _, n, _ in events))
+    for t, n, age in events:
+        out.write("  +%s: %d stall(s) detected (lease_age %s)\n"
+                  % (_fmt_s(t), n, _fmt_s(age) if age is not None
+                     else "-"))
 
 
 def main(argv):
