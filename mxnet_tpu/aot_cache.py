@@ -1,0 +1,410 @@
+"""AOT executable cache: the compiled train step as a persistable artifact.
+
+Every restart under ``tools/launch.py`` used to re-trace and re-compile
+the fused fit step from scratch — the watchdog needs a startup grace of
+``max(4×timeout, 120s)`` mostly to cover that XLA compile.  Treating the
+compiled program as a deployable (the TVM ahead-of-time thesis,
+PAPERS.md) removes it from restart latency entirely:
+
+- on the first compile, ``executor.make_fit_step`` serializes the XLA
+  executable (``jax.experimental.serialize_executable``) plus its
+  pickled in/out pytree defs into this content-addressed cache;
+- a restarted rank with the same cache key deserializes and runs, and
+  tells the watchdog the startup grace can shrink
+  (:func:`mxnet_tpu.watchdog.note_warm_start`).
+
+**The donated-deserialize hazard.**  On this container's CPU backend
+(jaxlib 0.4.36 thunk runtime) executing a *deserialized* executable whose
+program has ``donate_argnums`` input-output aliasing corrupts the process
+heap: flaky SIGSEGV/SIGABRT inside ``execute_sharded``, double-frees at
+interpreter teardown, occasionally deterministic wrong numerics — all
+reproduced standalone with ``MALLOC_CHECK_=3`` (ROBUSTNESS.md §8; jax's
+own persistent compilation cache triggers the same bug when it replays a
+donated program).  Donation-free deserialized executables are sound.  So
+an entry stores ONE variant chosen per backend:
+
+- ``donated`` (TPU-class backends): the real fused step, deserialized and
+  run as-is — no trace, no compile;
+- ``plain`` (CPU): a donation-free twin.  A warm restart deserializes the
+  twin for an instant first step, then the executor compiles the donated
+  program in a background thread and hot-swaps it in — restart latency
+  AND steady-state throughput, neither paying for the other
+  (``executor._twin_hotswap``).
+
+An in-process memo fronts the disk layer: a module rebuild in the same
+process (optimizer reconfiguration, divergence recovery) reuses the
+ORIGINAL compiled object — always safe, zero cost, any backend.
+
+The cache key covers everything that makes an executable unusable when
+it changes: the backend/jax/jaxlib/XLA_FLAGS fingerprint (an executable
+is object code for one runtime + compiler-flag set), the full input
+tree structure + shapes + dtypes (params, optimizer state, data/label,
+aux), and the graph + optimizer-config hash the Module passes in (the
+symbol's ops and the mults/hyperparameters are baked into the traced
+program — same-shape different-graph models must not collide).  A
+changed key is simply a different sha256 — stale entries can never be
+loaded, only missed.
+
+Opt-in via ``MXTPU_AOT_CACHE_DIR`` (tools/launch.py exports a per-job
+dir that survives restarts).  ``JAX_COMPILATION_CACHE_DIR`` — jax's own
+persistent compile cache — is the fallback layer for the donation-free
+programs this cache doesn't cover (eager init ops, rng, metrics); the
+launcher exports both.  Donated programs are kept OUT of jax's cache by
+:func:`bypass_persistent_cache` / :func:`donation_cache_guard` on
+backends with the hazard.  Every failure path here (unpicklable,
+version-mismatched, corrupt, unreadable) falls back to the normal
+compile: the cache can only ever make a restart faster, never break it.
+
+Telemetry (OBSERVABILITY.md): ``aot.cache_hits`` / ``aot.cache_misses``
+/ ``aot.cache_errors`` / ``aot.memo_hits`` / ``aot.twin_compiles`` /
+``aot.hotswaps`` counters, ``aot.deserialize`` / ``aot.serialize`` /
+``aot.compile`` / ``aot.twin_compile`` / ``aot.hotswap_compile`` spans.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import os
+import pickle
+import threading
+
+from . import telemetry as _telemetry
+
+__all__ = ["cache_dir", "enabled", "fingerprint", "cache_key", "load",
+           "store", "variant", "deserialized_donation_safe",
+           "bypass_persistent_cache", "donation_cache_guard",
+           "memo_get", "memo_put", "clear_memo", "drain"]
+
+_FORMAT = "mxtpu-aot-3"  # bump to orphan every existing entry
+
+#: variants an entry can carry (exactly one per entry; the writer picks
+#: what its own backend can safely consume on restart)
+VARIANT_DONATED = "donated"
+VARIANT_PLAIN = "plain"
+
+
+def cache_dir():
+    return os.environ.get("MXTPU_AOT_CACHE_DIR") or None
+
+
+def enabled():
+    return bool(cache_dir())
+
+
+def deserialized_donation_safe():
+    """Can this backend EXECUTE a deserialized executable that donates
+    inputs?  False on CPU: jaxlib 0.4.36's thunk runtime corrupts the
+    heap replaying donated input-output aliasing from a deserialized
+    executable (module docstring; ROBUSTNESS.md §8).  TPU/GPU PJRT
+    serialization is the supported production path.  Override with
+    ``MXTPU_AOT_FORCE_DONATED=1`` after a jaxlib upgrade proves clean."""
+    if os.environ.get("MXTPU_AOT_FORCE_DONATED") == "1":
+        return True
+    import jax
+    return jax.devices()[0].platform != "cpu"
+
+
+def variant():
+    """Which executable variant this process stores and loads."""
+    return VARIANT_DONATED if deserialized_donation_safe() \
+        else VARIANT_PLAIN
+
+
+def fingerprint():
+    """Runtime identity baked into every key: a serialized executable is
+    object code for one (backend, jaxlib) pair, jax's x64 flag changes
+    the avals Python scalars lower to, and compile-affecting environment
+    (XLA_FLAGS, libtpu tuning args — jax's own persistent cache folds
+    XLA flags into its key for the same reason) changes what the
+    compiler would have produced."""
+    import jax
+    import jaxlib
+    dev = jax.devices()[0]
+    return "|".join((_FORMAT, jax.__version__, jaxlib.__version__,
+                     dev.platform, dev.device_kind,
+                     "x64" if jax.config.jax_enable_x64 else "x32",
+                     os.environ.get("XLA_FLAGS", ""),
+                     os.environ.get("LIBTPU_INIT_ARGS", "")))
+
+
+def cache_key(kind, trees, extra=""):
+    """sha256 over the runtime fingerprint + a structural description of
+    the program's inputs + the caller's config hash.  ``trees`` is any
+    pytree of arrays / ShapeDtypeStructs / scalars; structure, shapes,
+    and dtypes all land in the digest."""
+    import jax
+    import numpy as _np
+    leaves, treedef = jax.tree_util.tree_flatten(trees)
+    desc = [fingerprint(), kind, str(treedef), extra]
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            dtype = _np.result_type(type(leaf))
+        desc.append("%s:%s:%s" % (tuple(shape), _np.dtype(dtype).name,
+                                  getattr(leaf, "weak_type", "")))
+    return hashlib.sha256("\n".join(desc).encode("utf-8")).hexdigest()
+
+
+def _path(key):
+    return os.path.join(cache_dir(), "%s.aotx" % key)
+
+
+# -- in-process memo -------------------------------------------------------
+# key -> the ORIGINAL donated jax.stages.Compiled.  A same-process module
+# rebuild (optimizer reconfigured, divergence recovery re-bind) reuses it
+# directly: no serialization round-trip, so no deserialize hazard on any
+# backend.  Unbounded in principle; in practice one entry per distinct
+# (shapes, optimizer config) this process ever trained.
+
+_memo = {}
+_memo_lock = threading.Lock()
+
+
+def memo_get(key):
+    with _memo_lock:
+        fn = _memo.get(key)
+    if fn is not None:
+        _telemetry.counter("aot.memo_hits").inc()
+    return fn
+
+
+def memo_put(key, compiled):
+    with _memo_lock:
+        _memo[key] = compiled
+
+
+def clear_memo():
+    """Forget in-process executables (tests use this to make a rebuild
+    exercise the disk path the way a real process restart would)."""
+    with _memo_lock:
+        _memo.clear()
+
+
+# -- persistent-cache quarantine for donated programs ----------------------
+
+_bypass_lock = threading.Lock()
+_bypass_depth = 0
+_bypass_prev = None
+
+
+@contextlib.contextmanager
+def bypass_persistent_cache():
+    """Compile a DONATED program outside jax's persistent compilation
+    cache on backends with the donated-deserialize hazard: a cache hit
+    would hand back a deserialized executable whose donation aliasing
+    corrupts the heap (module docstring).  No-op where deserialized
+    donation is safe.
+
+    The flag is process-global and donated compiles can overlap (the
+    hot-swap/twin background threads vs a foreground compile), so this
+    is depth-counted: the first entry disables the cache, only the last
+    exit restores it — no interleaving can re-enable the cache under a
+    still-running donated compile or leave it stuck disabled.  A
+    concurrent compile of a cacheable program on another thread can
+    still lose its cache write while any bypass is held — a benign
+    re-miss, never corruption."""
+    if deserialized_donation_safe():
+        yield
+        return
+    import jax
+    global _bypass_depth, _bypass_prev
+    with _bypass_lock:
+        if _bypass_depth == 0:
+            _bypass_prev = jax.config.jax_enable_compilation_cache
+            jax.config.update("jax_enable_compilation_cache", False)
+        _bypass_depth += 1
+    try:
+        yield
+    finally:
+        with _bypass_lock:
+            _bypass_depth -= 1
+            if _bypass_depth == 0:
+                jax.config.update("jax_enable_compilation_cache",
+                                  _bypass_prev)
+
+
+def donation_cache_guard(fn):
+    """Wrap a donated jitted callable so any compile it performs runs
+    under :func:`bypass_persistent_cache`.  For donated programs that
+    compile lazily at dispatch (the mesh / fallback fused paths, gluon
+    Trainer, data_parallel, gradient compression) where there is no
+    discrete ``.compile()`` moment to wrap.  EVERY call is covered, not
+    just the first: a shape-polymorphic jit retraces and recompiles on a
+    new input shape (a short final batch, a different gradient size) and
+    that compile must stay out of the persistent cache too.  The bypass
+    is ~1µs per call (a depth-counted flag toggle; toggling does not
+    invalidate jit caches) and a no-op on donation-safe backends.
+
+    The backend probe is deferred to the first call, so wrapping at
+    module import time stays free of backend-initializing side effects
+    (a multi-host driver imports before jax.distributed.initialize)."""
+    cell = {}
+
+    def call(*args, **kwargs):
+        safe = cell.get("safe")
+        if safe is None:
+            safe = cell["safe"] = deserialized_donation_safe()
+        if safe:
+            return fn(*args, **kwargs)
+        with bypass_persistent_cache():
+            return fn(*args, **kwargs)
+
+    return call
+
+
+# -- serialization ---------------------------------------------------------
+#
+# jax.experimental.serialize_executable.deserialize_and_load calls
+# ``backend.deserialize_executable(bytes)`` WITHOUT the executable's
+# CompileOptions; jax's persistent cache always passes them through
+# (compilation_cache.get_executable_and_time).  Entries carry the options
+# proto and loading goes through an options-passing unpickler so the
+# reconstructed executable matches what the compiler produced.  (This is
+# necessary hygiene but NOT sufficient to make donated deserialization
+# safe on CPU — see deserialized_donation_safe.)
+
+
+def _serialize(compiled):
+    """(pickled-executable, CompileOptions proto, in_tree, out_tree) for a
+    jax.stages.Compiled.  Raises if the executable exposes no options —
+    storing an entry that can only be deserialized unsafely is worse than
+    recompiling."""
+    from jax.experimental import serialize_executable as _se
+    ser, in_tree, out_tree = _se.serialize(compiled)
+    opts = compiled._executable.xla_executable.compile_options()
+    return ser, opts.SerializeAsString(), in_tree, out_tree
+
+
+def _deserialize(ser, opts_blob, in_tree, out_tree):
+    """deserialize_and_load, except the backend gets the original
+    CompileOptions (see section comment)."""
+    import jax
+    from jax._src.lib import xla_client as _xc
+    from jax.experimental import serialize_executable as _se
+
+    backend = jax.devices()[0].client
+    opts = _xc.CompileOptions.ParseFromString(opts_blob)
+
+    class _Unpickler(_se._JaxPjrtUnpickler):
+        def persistent_load(self, pid):
+            if pid[0] == "exec":
+                return self.backend.deserialize_executable(pid[1], opts)
+            return super().persistent_load(pid)
+
+    unloaded, args_info_flat, no_kwargs = _Unpickler(
+        io.BytesIO(ser), backend).load()
+    return jax.stages.Compiled(unloaded.load(),
+                               in_tree.unflatten(args_info_flat),
+                               out_tree, no_kwargs=no_kwargs)
+
+
+def load(key):
+    """Deserialize the cached executable for ``key``.  Returns
+    ``(compiled, variant)`` or None (missing / unreadable /
+    version-skewed — any failure is a miss or a counted error).  An entry
+    whose variant this backend cannot safely execute (a ``donated`` blob
+    on a donation-unsafe backend, e.g. written under
+    MXTPU_AOT_FORCE_DONATED) is discarded, not executed."""
+    path = _path(key)
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        _telemetry.counter("aot.cache_misses").inc()
+        return None
+    try:
+        with _telemetry.span("aot.deserialize", cat="aot"):
+            fmt, var, ser, opts_blob, in_tree, out_tree = \
+                pickle.loads(blob)
+            if fmt != _FORMAT:
+                raise ValueError("format %r != %r" % (fmt, _FORMAT))
+            if var == VARIANT_DONATED and not deserialized_donation_safe():
+                raise ValueError("donated executable is not safe to "
+                                 "execute on this backend")
+            compiled = _deserialize(ser, opts_blob, in_tree, out_tree)
+    except Exception as e:
+        # a stale/corrupt entry must cost one compile, never the run.
+        # Unlink it so the next restart doesn't pay the failed parse
+        # again (content-addressed: the slot re-fills on re-store).
+        _telemetry.counter("aot.cache_errors").inc()
+        import logging
+        logging.warning("mxnet_tpu.aot_cache: discarding unusable cache "
+                        "entry %s (%s: %s)", path, type(e).__name__, e)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    _telemetry.counter("aot.cache_hits").inc()
+    return compiled, var
+
+
+def store(key, compiled, var):
+    """Serialize ``compiled`` into the cache atomically (tmp+rename via
+    the checkpoint layer's plain writer: cache entries must not consume
+    ckpt fault budgets or pollute checkpoint metrics).  Best-effort —
+    a read-only or full cache dir costs the warm start, not the run."""
+    try:
+        with _telemetry.span("aot.serialize", cat="aot"):
+            ser, opts_blob, in_tree, out_tree = _serialize(compiled)
+            blob = pickle.dumps((_FORMAT, var, ser, opts_blob, in_tree,
+                                 out_tree))
+        d = cache_dir()
+        os.makedirs(d, exist_ok=True)
+        from .checkpoint import _plain_atomic_write
+        _plain_atomic_write(_path(key), blob)
+        _telemetry.histogram("aot.entry_bytes").observe(len(blob))
+        return True
+    except Exception as e:
+        _telemetry.counter("aot.cache_errors").inc()
+        import logging
+        logging.warning("mxnet_tpu.aot_cache: failed to store entry "
+                        "(%s: %s); restarts will recompile",
+                        type(e).__name__, e)
+        return False
+
+
+# -- background work (twin compiles, stores) -------------------------------
+# Off-hot-path tasks the executor schedules: compiling the CPU twin after
+# the cold first step, compiling the donated program after a warm twin
+# start, serializing entries.  Daemon threads: a crash mid-task costs the
+# next restart a recompile, nothing else.
+
+_bg_threads = []
+_bg_lock = threading.Lock()
+
+
+def spawn_background(fn, name):
+    t = threading.Thread(target=fn, name=name, daemon=True)
+    # start BEFORE publishing: a concurrent drain() joining an unstarted
+    # thread raises RuntimeError
+    t.start()
+    with _bg_lock:
+        _bg_threads.append(t)
+        # drop finished threads so long trainers don't accumulate handles
+        _bg_threads[:] = [x for x in _bg_threads if x.is_alive() or x is t]
+    return t
+
+
+def drain(timeout=None):
+    """Join pending background work (tests; also safe to call before
+    process exit to maximise what the next restart finds in the cache).
+    ``timeout`` bounds the WHOLE drain, not each join — two wedged
+    threads cost ``timeout`` once, not twice."""
+    import time as _time
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    with _bg_lock:
+        threads = list(_bg_threads)
+    for t in threads:
+        if deadline is None:
+            t.join()
+        else:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                break
+            t.join(remaining)
+    with _bg_lock:
+        _bg_threads[:] = [x for x in _bg_threads if x.is_alive()]
+    return not _bg_threads

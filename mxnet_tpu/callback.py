@@ -18,7 +18,10 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
     """Checkpoint the Module each `period` epochs (reference callback.py:29).
 
     Writes are crash-safe (atomic + manifest, checkpoint.py); pass
-    ``keep_last`` to prune to the N newest complete checkpoints."""
+    ``keep_last`` to prune to the N newest complete checkpoints.  With
+    ``MXTPU_ASYNC_CKPT=1`` the write overlaps the next epoch's compute
+    (fit drains the queue at exit; writer errors surface on the next
+    step / epoch boundary)."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):

@@ -22,26 +22,48 @@ the checkpoint the unit of trust:
 - framed optimizer-state files (``write_state_file``/``read_state_file``):
   magic + sha256 + payload so a corrupt ``.states`` file raises MXNetError
   naming the path instead of a cryptic unpickling error.
+- **async checkpoint pipeline** (``MXTPU_ASYNC_CKPT=1``): ``save()``
+  snapshots params/opt-state to host memory at the step boundary
+  (device→host transfers started ``copy_to_host_async``-style, then
+  owned host copies — the next fused step DONATES the live buffers, so
+  the queued snapshot must not alias them), enqueues the write into a
+  bounded queue (``MXTPU_ASYNC_CKPT_DEPTH``, default 2; backpressure
+  blocks rather than growing memory), and a daemon writer thread runs
+  the exact same atomic tmp+fsync+rename+manifest sequence in the
+  background — serialization, sha256, and fsync leave the step loop.
+  Writer failures are sticky: the first error re-raises on the next
+  save / train step (``check_async_error``) or ``flush_async()``;
+  ``latest()``/``load()`` drain the queue first so recovery always sees
+  every completed write.
 
 Fault-injection sites (mxnet_tpu.fault): ``ckpt.write.ioerror`` (transient,
 retried), ``ckpt.write.torn`` / ``ckpt.write.crash`` (simulated crashes —
-never retried).  ROBUSTNESS.md documents layout + recovery semantics.
+never retried) — all of them fire identically under the async writer.
+ROBUSTNESS.md documents layout + recovery semantics.
 """
 from __future__ import annotations
 
+import atexit as _atexit
 import errno as _errno
+import functools
 import hashlib
 import json
 import os
+import queue as _queue_mod
+import random as _random_mod
 import re
+import threading
 import time
+
+import numpy as _np
 
 from . import fault as _fault
 from . import telemetry as _telemetry
 from .base import MXNetError
 
 __all__ = ["CheckpointManager", "atomic_write", "write_state_file",
-           "read_state_file", "load_state_file"]
+           "read_state_file", "load_state_file", "async_enabled",
+           "async_write_state_file", "flush_async", "check_async_error"]
 
 _STATE_MAGIC = b"MXTPUST1"  # framed optimizer-state container, version 1
 
@@ -55,9 +77,18 @@ _PERMANENT_ERRNO = frozenset(
      "ENAMETOOLONG", "EBADF", "ENOSPC") if hasattr(_errno, name))
 
 
+# per-process jittered backoff: N ranks restarted together by the
+# launcher hit the same sick filesystem at the same instant; pure
+# exponential backoff keeps them retrying in lockstep forever, jitter
+# decorrelates them.  Seeded per process, not per call — a fresh Random
+# per retry would re-correlate ranks that share a seed source.
+_jitter = _random_mod.Random((os.getpid() << 16) ^ time.time_ns())
+
+
 def _retry_io(fn, retries=4, backoff=0.05, max_backoff=2.0,
               retry_counter="ckpt.io_retries"):
-    """Run ``fn`` retrying transient OSError with exponential backoff.
+    """Run ``fn`` retrying transient OSError with exponential backoff
+    (jittered to 0.5-1.5x so restarting ranks don't retry in lockstep).
     FaultInjected is a simulated crash, not a transient error — it (and
     every non-OSError, and permanent-errno OSErrors) propagates
     immediately.  ``retry_counter=None`` skips the telemetry count
@@ -70,10 +101,13 @@ def _retry_io(fn, retries=4, backoff=0.05, max_backoff=2.0,
             raise
         except OSError as e:
             if e.errno in _PERMANENT_ERRNO or attempt == retries:
+                # the terminal attempt raises NOW — sleeping first would
+                # bolt a full backoff of dead latency onto an error the
+                # caller is about to see anyway
                 raise
             if retry_counter:
                 _telemetry.counter(retry_counter).inc()
-            time.sleep(delay)
+            time.sleep(delay * (0.5 + _jitter.random()))
             delay = min(delay * 2, max_backoff)
 
 
@@ -172,8 +206,247 @@ def atomic_write(path, data, retries=4, backoff=0.05):
     _telemetry.histogram("ckpt.write_bytes").observe(len(data))
 
 
+# -- async checkpoint pipeline ----------------------------------------------
+#
+# One daemon writer thread per process, shared by every CheckpointManager
+# and by async_write_state_file (gluon.Trainer states).  The hot path
+# only pays for the host snapshot + a bounded enqueue; serialization,
+# sha256, fsync, rename, manifest commit, and retention run behind it.
+# FIFO through a single queue keeps writes in submission order, so
+# keep-last-N retention and latest() see the same history sync saves
+# would have produced.
+_async_cv = threading.Condition()
+_async_queue = None       # created with the writer thread (lazy)
+_async_thread = None
+_async_pending = 0        # queued + in-flight jobs (bounds snapshot memory)
+_async_error = None       # first writer failure since last surfaced
+
+
+def async_enabled():
+    """True when MXTPU_ASYNC_CKPT opts checkpoint writes into the
+    background pipeline (the env var is the production switch; tests and
+    callers can also pass ``mode=`` explicitly)."""
+    v = os.environ.get("MXTPU_ASYNC_CKPT", "").strip().lower()
+    return v not in ("", "0", "false", "off")
+
+
+def async_depth():
+    """Bounded queue depth (MXTPU_ASYNC_CKPT_DEPTH, default 2, min 1).
+    Depth counts snapshots admitted to the queue — queued AND in-flight —
+    so backpressure, not memory growth, absorbs a slow disk.  A blocked
+    ``save()`` holds one more snapshot it has already materialized while
+    waiting for its slot, so peak host memory is depth+1 snapshots."""
+    try:
+        return max(1, int(os.environ.get("MXTPU_ASYNC_CKPT_DEPTH", "2")))
+    except ValueError:
+        return 2
+
+
+def _async_writer(q):
+    from . import watchdog as _watchdog
+    global _async_pending, _async_error
+    while True:
+        label, job = q.get()
+        try:
+            # the guard lease makes a wedged background write a
+            # diagnosable stall (exit 75), not a silently-stuck thread
+            # that stops checkpointing while training runs on
+            with _telemetry.span("ckpt.async_write", cat="checkpoint"), \
+                    _watchdog.guard("ckpt.async_write"):
+                job()
+        except BaseException as e:  # noqa: BLE001 — surfaced sticky
+            _telemetry.counter("ckpt.async_errors").inc()
+            # name the failed job NOW: check_async_error re-raises the
+            # original exception later from whatever step/save checks
+            # first, where "which checkpoint died" is no longer obvious
+            import logging
+            logging.error(
+                "mxnet_tpu.checkpoint: background write failed (%s): "
+                "%s: %s — will re-raise on the next save/step/flush",
+                label, type(e).__name__, e)
+            with _async_cv:
+                if _async_error is None:
+                    _async_error = (e, label)
+        finally:
+            with _async_cv:
+                _async_pending -= 1
+                _telemetry.gauge("ckpt.queue_depth").set(_async_pending)
+                _async_cv.notify_all()
+
+
+def _async_submit(label, job):
+    """Enqueue one write job, blocking (backpressure) while the queue is
+    at depth.  Surfaces any sticky writer error from an earlier job
+    FIRST — an async failure is raised on the next save, never lost."""
+    global _async_queue, _async_thread, _async_pending
+    check_async_error()
+    depth = async_depth()
+    with _telemetry.span("ckpt.async_wait", cat="checkpoint"):
+        with _async_cv:
+            if _async_thread is None or not _async_thread.is_alive():
+                # a dead writer (fork child inherits the globals but not
+                # the thread) strands whatever the old queue still held:
+                # forget its pending count too, or the backpressure loop
+                # below waits forever on jobs nothing will ever drain
+                _async_queue = _queue_mod.SimpleQueue()
+                _async_pending = 0
+                _telemetry.gauge("ckpt.queue_depth").set(0)
+                _async_thread = threading.Thread(
+                    target=_async_writer, args=(_async_queue,),
+                    daemon=True, name="mxtpu-ckpt-writer")
+                _async_thread.start()
+            while _async_pending >= depth:
+                _async_cv.wait(0.05)
+            _async_pending += 1
+            _telemetry.gauge("ckpt.queue_depth").set(_async_pending)
+            _async_queue.put((label, job))
+
+
+def check_async_error():
+    """Re-raise (once) the first async-writer failure since the last
+    surfacing.  Called from the train hot paths (Module.fit_step,
+    gluon.Trainer.step — one global None-check, no dispatches) and from
+    every save/flush, so a background write failure stops the run at the
+    next step instead of rotting silently.  The original exception
+    object is re-raised: FaultInjected / OSError / MXNetError keep their
+    types, and the traceback still points into the writer."""
+    global _async_error
+    if _async_error is None:
+        return
+    with _async_cv:
+        err, _async_error = _async_error, None
+    if err is not None:
+        raise err[0]
+
+
+def flush_async(raise_errors=True, timeout=None):
+    """Drain the async checkpoint queue: block until every submitted
+    write has completed (or ``timeout`` seconds passed).  Call at epoch
+    end / run exit / before handing checkpoint files to anything else;
+    ``latest()`` and ``load()`` call it themselves.  With
+    ``raise_errors`` the sticky writer error (if any) surfaces here."""
+    global _async_pending
+    if threading.current_thread() is _async_thread:
+        # a write job draining the queue would wait on ITSELF forever
+        # (its own job still counts in _async_pending): jobs are already
+        # in submission order on this thread, so there is nothing to
+        # drain ahead of it
+        return
+    deadline = None if timeout is None else time.monotonic() + timeout
+    if _async_pending:
+        with _telemetry.span("ckpt.async_wait", cat="checkpoint"):
+            with _async_cv:
+                while _async_pending:
+                    if _async_thread is None or \
+                            not _async_thread.is_alive():
+                        # fork child: the count rode the fork, the
+                        # writer thread did not — nothing will ever
+                        # drain these, so don't wait on them
+                        _async_pending = 0
+                        _telemetry.gauge("ckpt.queue_depth").set(0)
+                        break
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        break
+                    _async_cv.wait(0.1)
+    if raise_errors:
+        check_async_error()
+
+
+def _drain_at_exit():
+    # a run that ends while writes are queued must not lose them to
+    # daemon-thread teardown; bounded so a wedged disk can't hold the
+    # interpreter exit hostage (the watchdog guard diagnoses that case)
+    try:
+        flush_async(raise_errors=False, timeout=60.0)
+    except Exception:
+        pass
+
+
+_atexit.register(_drain_at_exit)
+
+
+def async_write_state_file(path, payload, retries=4, backoff=0.05):
+    """``write_state_file`` through the async pipeline: the framed bytes
+    are materialized here (donation-safe — bytes alias nothing) and the
+    atomic write runs on the writer thread.  Falls back to the sync
+    write when async checkpointing is off (``write_state_file`` drains
+    the queue first, keeping writes in submission order across mode
+    switches)."""
+    if not async_enabled():
+        return write_state_file(path, payload, retries=retries,
+                                backoff=backoff)
+    framed = _frame_state(payload)
+    _async_submit("state file %s" % path,
+                  functools.partial(atomic_write, path, framed,
+                                    retries, backoff))
+    return framed
+
+
+def _own_host_record(rec):
+    """Force a payload record to own its memory.  np.asarray over a
+    same-host jax array is a zero-copy view, and the next fused step
+    DONATES the underlying buffer — a queued snapshot aliasing it would
+    be reused out from under the writer."""
+    if isinstance(rec, tuple):  # sparse records: ("row_sparse"/"csr", ...)
+        return tuple(_own_host_record(p) if isinstance(p, _np.ndarray)
+                     else p for p in rec)
+    arr = _np.asarray(rec)
+    if arr.flags["OWNDATA"] and arr.flags["WRITEABLE"]:
+        return arr
+    return _np.array(arr, copy=True)
+
+
 def _sha256(data):
     return hashlib.sha256(data).hexdigest()
+
+
+# -- manifest-verification cache --------------------------------------------
+# validate() is the expensive half of recovery discovery (a full sha256
+# walk of every artifact); repeated latest() calls — retention loops,
+# per-restart probes, tests — revalidate checkpoints that haven't
+# changed.  The cache maps a manifest's path to (stat signature, ok):
+# any rewrite of any involved file changes size/mtime_ns/inode and
+# misses.  Shared across CheckpointManager instances on purpose (the
+# Module creates a fresh manager per save).
+_verify_lock = threading.Lock()
+_verify_cache = {}   # manifest abspath -> (sig tuple, bool)
+_symbol_cache = {}   # symbol abspath -> ((size, mtime_ns, ino), bool)
+
+
+def _stat_sig(path):
+    st = os.stat(path)
+    return (st.st_size, st.st_mtime_ns, st.st_ino)
+
+
+def _validate_symbol_json(path):
+    """The symbol file is shared and rewritten by every save, so
+    per-epoch hashes would go stale by design — but it must at least BE
+    a parseable JSON document, or recovery would hand back an epoch
+    whose Module.load crash-loops on it.  Parse result cached by stat
+    signature (the file is rewritten every epoch; the parse is cheap
+    but not free under a latest() poll loop)."""
+    try:
+        sig = _stat_sig(path)
+    except OSError:
+        return False
+    key = os.path.abspath(path)
+    with _verify_lock:
+        cached = _symbol_cache.get(key)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    try:
+        with open(path, "rb") as f:
+            json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        # not cached: an OSError can be a transient read blip under an
+        # unchanged stat sig (see validate()); re-probe next call
+        return False
+    with _verify_lock:
+        if len(_symbol_cache) > 1024:
+            _symbol_cache.clear()
+        _symbol_cache[key] = (sig, True)
+    return True
 
 
 def _frame_state(payload):
@@ -184,7 +457,16 @@ def _frame_state(payload):
 def write_state_file(path, payload, retries=4, backoff=0.05):
     """Atomically write optimizer-state ``payload`` (bytes) framed with a
     magic + checksum header so loads can verify integrity.  Returns the
-    framed bytes as written (manifests hash exactly these)."""
+    framed bytes as written (manifests hash exactly these).
+
+    Drains the async queue first: a state write enqueued while async
+    checkpointing WAS on must not complete on the writer thread after —
+    and clobber — this newer sync write to the same path (§1b: writes
+    stay in submission order across mode switches).  Safe ON the writer
+    thread too (an async checkpoint job's ``_write_snapshot`` writes its
+    .states file through here): ``flush_async`` is a no-op there — the
+    writer draining its own queue would deadlock."""
+    flush_async(raise_errors=False)
     framed = _frame_state(payload)
     atomic_write(path, framed, retries=retries, backoff=backoff)
     return framed
@@ -209,7 +491,10 @@ def read_state_file(path):
     """Read an optimizer-state file, verifying the checksum frame.  Files
     written before the frame existed (raw pickle) pass through unchanged;
     a framed file that fails verification raises MXNetError naming the
-    path."""
+    path.  Drains the async write queue first — a state load must never
+    race the background writer over the very file it reads."""
+    flush_async(raise_errors=False)
+
     def attempt():
         with open(path, "rb") as f:
             return f.read()
@@ -259,29 +544,83 @@ class CheckpointManager:
 
     # -- saving ------------------------------------------------------------
     def save(self, epoch, arg_params, aux_params, symbol=None,
-             optimizer_states=None):
+             optimizer_states=None, mode=None):
         """Write one complete checkpoint; the manifest is committed last,
         so a crash anywhere earlier leaves the previous checkpoint as the
-        newest *complete* one."""
+        newest *complete* one.
+
+        ``mode``: ``"sync"`` writes in this call (returns the manifest),
+        ``"async"`` snapshots to host memory here and hands the write to
+        the background pipeline (returns None; errors surface sticky on
+        the next save/step/flush), ``None`` follows MXTPU_ASYNC_CKPT."""
+        if mode is None:
+            mode = "async" if async_enabled() else "sync"
         with _telemetry.span("ckpt.save", cat="checkpoint"):
             _telemetry.counter("ckpt.saves").inc()
-            return self._save(epoch, arg_params, aux_params, symbol,
-                              optimizer_states)
+            if mode != "async":
+                # writes must land in submission order — a sync save
+                # overtaking queued async ones would hand retention and
+                # latest() a reordered history
+                flush_async()
+                return self._save(epoch, arg_params, aux_params, symbol,
+                                  optimizer_states)
+            _telemetry.counter("ckpt.async_saves").inc()
+            snap = self._snapshot(epoch, arg_params, aux_params, symbol,
+                                  optimizer_states, own=True)
+            _async_submit(
+                "ckpt save %s epoch %d" % (self.prefix, int(epoch)),
+                functools.partial(self._write_snapshot, *snap))
+            return None
 
     def _save(self, epoch, arg_params, aux_params, symbol,
               optimizer_states):
+        """The one-call sync body (save() routes sync mode through here,
+        so a subclass hook still sees every inline write)."""
+        return self._write_snapshot(*self._snapshot(
+            epoch, arg_params, aux_params, symbol, optimizer_states))
+
+    def _snapshot(self, epoch, arg_params, aux_params, symbol,
+                  optimizer_states, own=False):
+        """Host-side materialization of one checkpoint: everything the
+        write phase needs, detached from the device.  With ``own`` the
+        arrays are forced to own their memory — the async queue outlives
+        this step, and the next fused step donates (deletes/reuses) the
+        live param buffers a zero-copy view would alias."""
         from .ndarray import utils as _nd_utils
+        save_dict = {("arg:%s" % k): v for k, v in
+                     (arg_params or {}).items()}
+        save_dict.update({("aux:%s" % k): v for k, v in
+                          (aux_params or {}).items()})
+        with _telemetry.span("ckpt.snapshot", cat="checkpoint"):
+            if own:
+                # start every device→host transfer before the first
+                # blocking fetch so they overlap (a no-op hint on
+                # backends where arrays already live on the host)
+                for v in save_dict.values():
+                    start = getattr(getattr(v, "_data", None),
+                                    "copy_to_host_async", None)
+                    if start is not None:
+                        try:
+                            start()
+                        except Exception:
+                            pass  # a failed hint just costs overlap
+            arrays, names = _nd_utils._to_payload(save_dict)
+            if own:
+                arrays = [_own_host_record(a) for a in arrays]
+            sym_json = symbol.tojson() if symbol is not None else None
+        return epoch, arrays, names, optimizer_states, sym_json
+
+    def _write_snapshot(self, epoch, arrays, names, optimizer_states,
+                        sym_json):
+        """The write phase: serialization + atomic publishes + manifest
+        commit (+ retention).  Runs on the caller (sync) or the writer
+        thread (async) — same code, same fault sites, same telemetry."""
         from .ndarray import serialization as _ser
         files = {}
 
         # params first: the epoch's defining artifact is the natural torn-
         # write victim, and the shared symbol file is only touched once
         # the per-epoch data is safely down
-        save_dict = {("arg:%s" % k): v for k, v in
-                     (arg_params or {}).items()}
-        save_dict.update({("aux:%s" % k): v for k, v in
-                          (aux_params or {}).items()})
-        arrays, names = _nd_utils._to_payload(save_dict)
         payload = _ser.dumps_ndarray_list(arrays, names)
         atomic_write(self.params_path(epoch), payload,
                      retries=self._retries, backoff=self._backoff)
@@ -296,12 +635,14 @@ class CheckpointManager:
             files[os.path.basename(self.states_path(epoch))] = {
                 "sha256": _sha256(framed), "size": len(framed)}
 
-        if symbol is not None:
-            symbol.save(self.symbol_path())  # atomic (Symbol.save)
+        if sym_json is not None:
+            atomic_write(self.symbol_path(),
+                         sym_json.encode("utf-8"),
+                         retries=self._retries, backoff=self._backoff)
 
         manifest = {"version": 1, "epoch": int(epoch), "files": files,
                     "symbol": os.path.basename(self.symbol_path())
-                    if symbol is not None else None}
+                    if sym_json is not None else None}
         atomic_write(self.manifest_path(epoch),
                      json.dumps(manifest, indent=1).encode("utf-8"),
                      retries=self._retries, backoff=self._backoff)
@@ -335,18 +676,66 @@ class CheckpointManager:
     def validate(self, epoch):
         """True when epoch's manifest exists and every artifact it lists
         is present with matching size + sha256.  Hashes in fixed-size
-        chunks — recovery must not need checkpoint-sized host memory."""
+        chunks — recovery must not need checkpoint-sized host memory.
+
+        Hash results are cached per manifest, keyed by a cheap stat
+        signature (size + mtime_ns + inode of the manifest and every
+        listed artifact): a retention-heavy run calling ``latest()``
+        repeatedly must not re-sha256 every retained checkpoint each
+        time.  Any rewrite changes the signature (atomic publishes
+        always change the inode) and forces a re-hash; the shared,
+        rewritten-every-save symbol file is cached separately so its
+        churn doesn't evict the expensive per-epoch hashes."""
+        mpath = self.manifest_path(epoch)
         try:
-            with open(self.manifest_path(epoch), "rb") as f:
+            with open(mpath, "rb") as f:
                 manifest = json.loads(f.read().decode("utf-8"))
         except (OSError, ValueError):
             return False
         d = os.path.dirname(os.path.abspath(self.prefix)) or "."
-        for name, meta in (manifest.get("files") or {}).items():
-            path = os.path.join(d, name)
-            try:
-                if os.stat(path).st_size != meta.get("size"):
+        try:
+            sig = [_stat_sig(mpath)]
+            entries = []
+            for name, meta in sorted(
+                    (manifest.get("files") or {}).items()):
+                path = os.path.join(d, name)
+                s = _stat_sig(path)
+                if s[0] != meta.get("size"):
                     return False
+                entries.append((path, meta))
+                sig.append((name,) + s)
+            sig = tuple(sig)
+        except OSError:
+            return False
+        key = os.path.abspath(mpath)
+        with _verify_lock:
+            cached = _verify_cache.get(key)
+        if cached is not None and cached[0] == sig:
+            ok = cached[1]
+        else:
+            ok = self._verify_hashes(entries)
+            # cache only success: a False can mean a TRANSIENT read
+            # error (one EIO while hashing), and caching it under a stat
+            # sig the blip didn't change would make latest() skip a good
+            # checkpoint for the rest of the process.  Genuinely corrupt
+            # epochs re-hash per call — small sets, retention prunes
+            # them, correctness of recovery wins.
+            if ok:
+                with _verify_lock:
+                    if len(_verify_cache) > 1024:
+                        _verify_cache.clear()  # crude bound; re-warms
+                    _verify_cache[key] = (sig, ok)
+        if not ok:
+            return False
+        if manifest.get("symbol"):
+            return _validate_symbol_json(os.path.join(d,
+                                                      manifest["symbol"]))
+        return True
+
+    @staticmethod
+    def _verify_hashes(entries):
+        for path, meta in entries:
+            try:
                 h = hashlib.sha256()
                 with open(path, "rb") as f:
                     for chunk in iter(lambda: f.read(1 << 20), b""):
@@ -354,17 +743,6 @@ class CheckpointManager:
             except OSError:
                 return False
             if h.hexdigest() != meta.get("sha256"):
-                return False
-        if manifest.get("symbol"):
-            # the symbol file is shared and rewritten by every save, so
-            # per-epoch hashes would go stale by design — but it must at
-            # least BE a parseable JSON document, or recovery would hand
-            # back an epoch whose Module.load crash-loops on it.  It is
-            # small (KBs); a full parse is cheap.
-            try:
-                with open(os.path.join(d, manifest["symbol"]), "rb") as f:
-                    json.loads(f.read().decode("utf-8"))
-            except (OSError, ValueError):
                 return False
         return True
 
@@ -377,7 +755,13 @@ class CheckpointManager:
         None.  Torn/partial/corrupt checkpoints (no manifest, manifest
         over missing/damaged files) are skipped — recovery falls back to
         the previous complete one.  Prefixes written before manifests
-        existed fall back to a load-probe scan of ``prefix-*.params``."""
+        existed fall back to a load-probe scan of ``prefix-*.params``.
+
+        Drains the async write queue first (without raising — recovery
+        must stay usable after a writer failure; the sticky error still
+        surfaces on the next save/step) so every completed background
+        write is visible to discovery."""
+        flush_async(raise_errors=False)
         for epoch in reversed(self._manifest_epochs()):
             if self.validate(epoch):
                 return epoch
@@ -413,7 +797,9 @@ class CheckpointManager:
     def load(self, epoch=None):
         """Load (epoch, arg_params, aux_params).  With ``epoch=None`` the
         newest complete checkpoint is used; an explicit epoch must
-        verify."""
+        verify.  In-flight async writes are drained first — a load must
+        never race the writer over the very files it is reading."""
+        flush_async(raise_errors=False)
         if epoch is None:
             epoch = self.latest()
             if epoch is None:

@@ -213,11 +213,13 @@ class Executor:
         return graph_fn
 
     @staticmethod
-    def _instrument(fn):
+    def _instrument(fn, first_call_compiles=True):
         """Dispatch/compile accounting around a jitted program (shapes
-        are fixed at bind time, so first call == the one XLA compile)."""
+        are fixed at bind time, so first call == the one XLA compile —
+        except warm-loaded AOT executables, which never compile)."""
         from . import profiler as _profiler
-        return _profiler.instrument(fn)
+        return _profiler.instrument(
+            fn, first_call_compiles=first_call_compiles)
 
     def _fwd(self, train):
         fn = self._fwd_cache.get(train)
@@ -446,7 +448,8 @@ class Executor:
                 dst._set_data(g)
         return self.outputs
 
-    def make_fit_step(self, update_names, apply_fn):
+    def make_fit_step(self, update_names, apply_fn, opt_state=None,
+                      cache_extra=None):
         """Build the fused donated train-step program: forward + backward +
         tree-wide optimizer apply traced into ONE jitted XLA program.
 
@@ -461,6 +464,23 @@ class Executor:
         ``apply_fn(params, grads, state, lr, wd, rescale, t)``
                           — pure tree-wide optimizer apply
                             (ops.optimizer_ops.make_fused_apply).
+        ``opt_state``     — example optimizer-state tree (shapes/dtypes
+                            only are used) enabling the AOT warm-start
+                            path below.
+        ``cache_extra``   — the caller's optimizer-config hash folded
+                            into the AOT cache key (mults and
+                            hyperparameters are baked into the traced
+                            program, so they must invalidate it).
+
+        **AOT warm-start** (``MXTPU_AOT_CACHE_DIR`` set, single-device
+        bind, ``opt_state``/``cache_extra`` provided): the program is
+        lowered + compiled ahead of time and the executable serialized
+        into the content-addressed cache (mxnet_tpu.aot_cache); a
+        restarted rank with the same key deserializes it and skips
+        trace+compile entirely — time-to-first-step drops from an XLA
+        compile to a file read, and the watchdog is told its startup
+        grace can shrink.  Any cache failure falls back to the normal
+        jit path.
 
         The apply is wrapped in the divergence guard
         (ops.optimizer_ops.make_guarded_apply): an all-finite check over
@@ -506,8 +526,155 @@ class Executor:
 
         if self._staged:
             return step  # eager multi-device ctx_group binds can't donate
-        return self._instrument(
-            jax.jit(step, donate_argnums=(0, 1, 3)))
+        from . import aot_cache as _aot
+        if cache_extra is not None and opt_state is not None and \
+                self._mesh is None:
+            if _aot.enabled():
+                fn = self._aot_fit_step(step, update_names, opt_state,
+                                        cache_extra)
+                if fn is not None:
+                    return fn
+        # donated program compiling lazily at first dispatch: keep it out
+        # of jax's persistent cache on backends where replaying a donated
+        # executable from that cache corrupts the heap (aot_cache docs)
+        return self._instrument(_aot.donation_cache_guard(
+            jax.jit(step, donate_argnums=(0, 1, 3))))
+
+    def _aot_fit_step(self, step, update_names, opt_state, cache_extra):
+        """AOT-compile the fused step against the bound shapes and run it
+        through the persistent executable cache.  Returns the
+        instrumented program, or None to fall back to plain jit (any
+        cache/serialization trouble must never break training).
+
+        Three tiers (aot_cache module docs):
+
+        - **memo hit** — same-process rebuild: the original compiled
+          object, any backend, free;
+        - **disk hit, donated variant** (TPU-class): deserialize and run —
+          no trace, no compile;
+        - **disk hit, plain variant** (CPU): deserialize the donation-free
+          twin for the first steps, compile the donated program in the
+          background, hot-swap when ready (:meth:`_twin_hotswap`).
+
+        A miss compiles the donated program (outside jax's persistent
+        cache where donated replay is unsafe), then serializes this
+        backend's consumable variant off the hot path."""
+        from . import aot_cache as _aot
+        from . import telemetry as _telemetry
+        from . import watchdog as _watchdog
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        try:
+            in_update = set(update_names)
+            examples = (
+                {n: sds(self.arg_dict[n]._data) for n in update_names},
+                jax.tree_util.tree_map(sds, opt_state),
+                {n: sds(a._data) for n, a in self.arg_dict.items()
+                 if n not in in_update},
+                {n: sds(a._data) for n, a in self.aux_dict.items()},
+                jax.ShapeDtypeStruct((2,), _np.uint32),   # rng key
+                # lr/wd/rescale/t/poison lower as weak-typed Python
+                # floats, exactly what the hot path passes per step
+                0.01, 0.0, 1.0, 1.0, 0.0)
+            key = _aot.cache_key("fit_step", examples, extra=cache_extra)
+            memo = _aot.memo_get(key)
+            if memo is not None:
+                return self._instrument(memo, first_call_compiles=False)
+            loaded = _aot.load(key)
+            if loaded is not None:
+                compiled, var = loaded
+                # no trace, no (foreground) compile: the startup-grace
+                # window sized for XLA compilation can shrink
+                _watchdog.note_warm_start()
+                if var == _aot.VARIANT_DONATED:
+                    _aot.memo_put(key, compiled)
+                    return self._instrument(compiled,
+                                            first_call_compiles=False)
+                return self._twin_hotswap(step, examples, key, compiled)
+            with _telemetry.span("aot.compile", cat="aot"):
+                with _aot.bypass_persistent_cache():
+                    compiled = jax.jit(step, donate_argnums=(0, 1, 3)) \
+                        .lower(*examples).compile()
+            _aot.memo_put(key, compiled)
+            self._spawn_aot_store(step, examples, key, compiled)
+            return self._instrument(compiled)
+        except Exception as e:
+            import logging
+            logging.warning("mxnet_tpu.executor: AOT warm-start path "
+                            "unavailable (%s: %s); using plain jit",
+                            type(e).__name__, e)
+            return None
+
+    def _spawn_aot_store(self, step, examples, key, compiled):
+        """Serialize this backend's consumable variant into the cache off
+        the hot path.  Donation-safe backends store the donated program
+        as-is; CPU compiles the donation-free twin first (the only
+        variant a CPU restart can execute) — a real compile, so it runs
+        in a background thread with its backend-compile events kept out
+        of step accounting."""
+        from . import aot_cache as _aot
+        from . import telemetry as _telemetry
+
+        def work():
+            try:
+                if _aot.deserialized_donation_safe():
+                    _aot.store(key, compiled, _aot.VARIANT_DONATED)
+                    return
+                with _telemetry.suppress_compile_accounting():
+                    with _telemetry.span("aot.twin_compile", cat="aot"):
+                        twin = jax.jit(step).lower(*examples).compile()
+                _telemetry.counter("aot.twin_compiles").inc()
+                _aot.store(key, twin, _aot.VARIANT_PLAIN)
+            except Exception as e:
+                _telemetry.counter("aot.cache_errors").inc()
+                import logging
+                logging.warning("mxnet_tpu.executor: AOT background store "
+                                "failed (%s: %s); restarts will recompile",
+                                type(e).__name__, e)
+
+        _aot.spawn_background(work, "mxtpu-aot-store")
+
+    def _twin_hotswap(self, step, examples, key, twin):
+        """Warm CPU restart: run the deserialized donation-free twin NOW
+        (instant first step), compile the donated program in the
+        background, and swap it in between steps.  Until the swap the
+        twin costs an extra param-tree copy per step; after it, steady
+        state is identical to a cold start.  The swap is a single dict
+        read per call — no dispatches added, so steptrace's 1.0/step
+        contract holds through it."""
+        from . import aot_cache as _aot
+        from . import telemetry as _telemetry
+
+        cell = {"fn": twin}
+
+        def work():
+            try:
+                with _telemetry.suppress_compile_accounting():
+                    with _telemetry.span("aot.hotswap_compile",
+                                         cat="aot"):
+                        with _aot.bypass_persistent_cache():
+                            donated = jax.jit(
+                                step, donate_argnums=(0, 1, 3)) \
+                                .lower(*examples).compile()
+                _aot.memo_put(key, donated)
+                cell["fn"] = donated
+                _telemetry.counter("aot.hotswaps").inc()
+            except Exception as e:
+                _telemetry.counter("aot.cache_errors").inc()
+                import logging
+                logging.warning("mxnet_tpu.executor: donated hot-swap "
+                                "compile failed (%s: %s); continuing on "
+                                "the donation-free twin",
+                                type(e).__name__, e)
+
+        _aot.spawn_background(work, "mxtpu-aot-hotswap")
+
+        def call(*args):
+            return cell["fn"](*args)
+
+        return self._instrument(call, first_call_compiles=False)
 
     # -- parameter management ----------------------------------------------
     @property

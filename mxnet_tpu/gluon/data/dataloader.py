@@ -11,6 +11,7 @@ PrefetcherIter played for the C++ pipeline (src/io/iter_prefetcher.h).
 """
 from __future__ import annotations
 
+import os
 import queue as _queue
 import threading
 
@@ -108,22 +109,35 @@ class _PrefetchIter:
         self._worker.start()
 
     def close(self):
-        """Unblock and retire the worker; free queued batches."""
+        """Unblock and retire the worker; free queued batches.  Drops
+        every reference this iterator holds (queue, worker thread, batch
+        factory) — a closed-but-still-referenced loader iterator must
+        not pin queued host/device batches for the process lifetime."""
         self._done = True
+        stop, worker, q = self._stop, self._worker, self._q
+        if q is None:
+            return  # already closed (close is re-entrant; __del__ too —
+            # and must not touch the "data" lease again: a stale __del__
+            # would revoke the lease a LIVE successor iterator renews)
         _watchdog.release("data")  # no more progress expected from here
-        self._stop.set()
+        stop.set()
         try:
             # a put() already past its stop check can still land one item;
             # join first (the worker exits within one 0.1 s poll) so the
             # drain below really empties the queue
-            self._worker.join(timeout=2.0)
+            worker.join(timeout=2.0)
         except Exception:
             pass  # interpreter shutdown
         while True:
             try:
-                self._q.get_nowait()
+                q.get_nowait()
             except _queue.Empty:
                 break
+        # the drained queue object and the dead worker thread (whose
+        # frames closed over make_batches → dataset) are the last paths
+        # keeping batch memory reachable from this iterator
+        self._q = None
+        self._worker = None
 
     __del__ = close
 
@@ -141,7 +155,7 @@ class _PrefetchIter:
         return self
 
     def __next__(self):
-        if self._done:
+        if self._done or self._q is None:
             raise StopIteration
         # time the consumer actually spends starved waiting on the
         # producer — the "is the input pipeline keeping up" phase
@@ -165,10 +179,21 @@ class _PrefetchIter:
         return item
 
 
+def _default_prefetch():
+    """Prefetch depth when the ctor doesn't pin one: MXTPU_DATA_PREFETCH
+    overrides the built-in 2 — deployments tune pipeline depth per
+    workload (deep for slow storage, 0 to disable) without touching
+    model code."""
+    try:
+        return int(os.environ.get("MXTPU_DATA_PREFETCH", "2"))
+    except ValueError:
+        return 2
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
-                 batchify_fn=None, num_workers=0, prefetch=2):
+                 batchify_fn=None, num_workers=0, prefetch=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -194,7 +219,8 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn if batchify_fn is not None \
             else default_batchify_fn
-        self._prefetch = max(0, int(prefetch))
+        self._prefetch = max(0, int(prefetch if prefetch is not None
+                                    else _default_prefetch()))
 
     def _make_batches(self):
         batches = _telemetry.counter("data.batches")

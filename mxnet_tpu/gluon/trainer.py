@@ -99,7 +99,11 @@ class Trainer:
         """Apply one optimizer step, scaling grads by 1/batch_size."""
         from .. import fault as _fault
         from .. import watchdog as _watchdog
+        from ..checkpoint import check_async_error
         _fault.stall_if("worker.stall")
+        # a failed background save_states write surfaces on the next
+        # step (one global None-check; no dispatches)
+        check_async_error()
         self._resolve_pending_verdict()
         from ..ops.optimizer_ops import (max_consecutive_skips,
                                          raise_skip_limit_error)
@@ -189,13 +193,17 @@ class Trainer:
                     if i in self._updaters.states:
                         state[str(i)] = fused_state_from_updater(
                             kind, self._updaters.states[i], p.data())
+            from .. import aot_cache as _aot
             self._fused = {
                 "key": cache_key, "kind": kind, "state": state,
                 # same divergence guard as Module.fit_step: all-finite
-                # check + no-op select inside the ONE donated program
-                "step": _profiler.instrument(
+                # check + no-op select inside the ONE donated program,
+                # compiled outside jax's persistent cache on backends
+                # where replaying a donated executable from it corrupts
+                # the heap (aot_cache.donation_cache_guard)
+                "step": _profiler.instrument(_aot.donation_cache_guard(
                     jax.jit(make_guarded_apply(apply_fn),
-                            donate_argnums=(0, 2)))}
+                            donate_argnums=(0, 2))))}
 
         fused = self._fused
         params = {str(i): p.data()._data for i, p in live}
@@ -259,16 +267,19 @@ class Trainer:
                 fused_state_to_updater(kind, st)
 
     def save_states(self, fname):
-        """Atomic, checksummed write (checkpoint.write_state_file)."""
+        """Atomic, checksummed write (checkpoint.write_state_file).
+        Under MXTPU_ASYNC_CKPT=1 the framed payload is materialized here
+        (bytes — donation-safe) and the fsync+rename run on the async
+        writer thread; failures surface sticky on the next step()."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
             self._kv.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            from ..checkpoint import write_state_file
+            from ..checkpoint import async_write_state_file
             self._fused_flush_to_updater()
-            write_state_file(fname, self._updaters.get_states())
+            async_write_state_file(fname, self._updaters.get_states())
 
     def load_states(self, fname):
         """Validated read — corrupt state files raise MXNetError naming
@@ -277,6 +288,9 @@ class Trainer:
         # its state is replaced; a stale rollback applied to the restored
         # clock would corrupt Adam's t / the lr schedule
         self._resolve_pending_verdict()
+        from ..checkpoint import flush_async
+        # a load must never race the async writer over the same file
+        flush_async(raise_errors=False)
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:

@@ -33,11 +33,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .aot_cache import donation_cache_guard
+
 __all__ = ["TwoBitCompression", "create_compressor"]
 
 _SHIFTS = (0, 2, 4, 6)  # 4 two-bit codes per byte
 
 
+# compiles once per distinct gradient size, donated: every one of those
+# compiles must stay out of jax's persistent cache on backends where
+# replaying a donated executable deserialized corrupts the heap
+# (ROBUSTNESS.md §8; the guard defers its backend probe to first call,
+# so this import stays side-effect free)
+@donation_cache_guard
 @functools.partial(jax.jit, donate_argnums=(1,))
 def _compress_step(flat_grad, residual, threshold):
     """codes+residual in one fused program; returns (packed uint8, r')."""

@@ -98,17 +98,19 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    keep_last=None):
+                    keep_last=None, mode=None):
     """Write prefix-symbol.json + prefix-%04d.params (reference :340).
 
     Crash-safe via checkpoint.CheckpointManager: each artifact lands
     atomically and a manifest with content checksums commits the epoch
     LAST, so recovery (``CheckpointManager.latest()``) never picks up a
     torn half-written checkpoint.  ``keep_last`` prunes to the N newest
-    complete checkpoints."""
+    complete checkpoints.  Under ``MXTPU_ASYNC_CKPT=1`` the write runs
+    on the background pipeline: this call only snapshots to host memory
+    (checkpoint.py, "async checkpoint pipeline")."""
     from .checkpoint import CheckpointManager
     CheckpointManager(prefix, keep_last=keep_last).save(
-        epoch, arg_params, aux_params, symbol=symbol)
+        epoch, arg_params, aux_params, symbol=symbol, mode=mode)
 
 
 def load_params(prefix, epoch):

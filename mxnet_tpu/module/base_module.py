@@ -206,6 +206,12 @@ class BaseModule:
                              batch_end_callback, eval_end_callback,
                              eval_batch_end_callback, monitor,
                              begin_epoch, num_epoch)
+            # fit exit: every checkpoint enqueued by epoch callbacks must
+            # be durably on disk before fit() returns success — and a
+            # background write failure must fail the fit, not the exit
+            # status of some later unrelated save
+            from .. import checkpoint as _checkpoint
+            _checkpoint.flush_async()
         finally:
             if _armed_here:
                 _watchdog.disarm()
@@ -258,6 +264,12 @@ class BaseModule:
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
+            # surface any async checkpoint-writer failure at the epoch
+            # boundary WITHOUT draining the queue — draining here would
+            # serialize the write against the next epoch's compute and
+            # forfeit the overlap the async pipeline exists for
+            from .. import checkpoint as _checkpoint
+            _checkpoint.check_async_error()
 
             if eval_data:
                 res = self.score(eval_data, validation_metric,

@@ -91,14 +91,17 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
-                        keep_last=None):
+                        keep_last=None, mode=None):
         """Save symbol+params(+optimizer states) (reference :173).
 
         Crash-safe: every artifact is written atomically and the epoch's
         manifest commits last (checkpoint.CheckpointManager), so a crash
         mid-save can never produce a checkpoint that recovery would
         mistake for complete.  ``keep_last`` prunes to the N newest
-        complete checkpoints."""
+        complete checkpoints.  ``mode`` ("sync"/"async"/None→env): under
+        the async pipeline this call only snapshots to host memory and
+        the write overlaps subsequent training; writer failures surface
+        on the next fit_step/save/flush (checkpoint.py)."""
         from ..checkpoint import CheckpointManager
         states = None
         if save_optimizer_states:
@@ -106,7 +109,7 @@ class Module(BaseModule):
         arg_params, aux_params = self.get_params()
         CheckpointManager(prefix, keep_last=keep_last).save(
             epoch, arg_params, aux_params, symbol=self._symbol,
-            optimizer_states=states)
+            optimizer_states=states, mode=mode)
 
     # -- properties --------------------------------------------------------
     @property
@@ -426,10 +429,26 @@ class Module(BaseModule):
             state = self._fused["state"]  # mults changed; state carries
         else:
             state = self._fused_state_from_updater(kind, init_state, params)
+        # everything baked statically into the traced program feeds the
+        # AOT warm-start cache key (aot_cache.cache_key adds the backend
+        # fingerprint and the full input tree shapes/dtypes itself).
+        # The GRAPH must be in the key too: two networks with identical
+        # param names/shapes but different ops (relu vs tanh, a changed
+        # loss) would otherwise collide and a restart would silently
+        # train the wrong program
+        import hashlib as _hashlib
+        graph = _hashlib.sha256(
+            self._symbol.tojson().encode("utf-8")).hexdigest()
+        cache_extra = repr((graph, type(opt).__name__, kind,
+                            tuple(update_names),
+                            tuple(sorted(mults.items())),
+                            tuple(sorted(opt.fused_hyper().items()))))
         self._fused = {
             "key": key, "kind": kind, "update_names": update_names,
             "state": state,
-            "step": self._exec.make_fit_step(update_names, apply_fn),
+            "step": self._exec.make_fit_step(update_names, apply_fn,
+                                             opt_state=state,
+                                             cache_extra=cache_extra),
         }
         return self._fused
 
@@ -478,6 +497,11 @@ class Module(BaseModule):
         compiles (profiler.step_stats proves it)."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        from ..checkpoint import check_async_error
+        # a background checkpoint write that failed must stop the run at
+        # the NEXT step, not rot silently (one global None-check — no
+        # dispatches, steptrace's 1.0/step contract holds)
+        check_async_error()
         if not self._fused_eligible():
             return super().fit_step(data_batch)
         from .. import fault as _fault

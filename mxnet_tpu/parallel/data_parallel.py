@@ -111,6 +111,14 @@ def make_train_step(loss_fn, mesh, optimizer_apply=None, optimizer_init=None,
         return new_params, new_state, loss
 
     jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    if donate:
+        # donated program compiling lazily at first dispatch: keep it
+        # out of jax's persistent cache on backends where replaying a
+        # donated executable from that cache corrupts the heap
+        # (aot_cache docs, ROBUSTNESS.md §8) — launch.py exports that
+        # cache to every worker by default
+        from .. import aot_cache
+        jitted = aot_cache.donation_cache_guard(jitted)
 
     def step_fn(params, opt_state, batch, rng):
         batch = jax.tree_util.tree_map(

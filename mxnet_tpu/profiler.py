@@ -250,12 +250,18 @@ def _telemetry():
     return _telemetry_mod
 
 
-def instrument(fn):
+def instrument(fn, first_call_compiles=True):
     """Dispatch/compile accounting around a jitted program whose input
     shapes are fixed for its lifetime (executor programs are bound to one
     shape set; fused Trainer programs rebuild on shape change) — so the
     first invocation IS its one XLA compile, and every invocation is one
     dispatch.
+
+    ``first_call_compiles=False`` is for programs that arrive already
+    compiled — an AOT executable deserialized from the warm-start cache
+    (executor.make_fit_step): its first call dispatches without
+    compiling, and charging a phantom compile would hide exactly the
+    warm-vs-cold signal BENCH_MODE=restart measures.
 
     Steady-state recompiles — the cache key silently missing after
     warmup, the exact failure the 1-compile contract exists to catch —
@@ -269,7 +275,8 @@ def instrument(fn):
         count_dispatch()
         if not compiled:
             compiled.append(True)
-            count_compile()
+            if first_call_compiles:
+                count_compile()
             return fn(*args)
         t = _telemetry()
         pre = t._xla_compiles

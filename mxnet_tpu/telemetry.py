@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import atexit
 import collections
+import contextlib
 import json
 import math
 import os
@@ -63,7 +64,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "span", "report", "reset", "note_train_step",
            "note_fault", "mark_last_step_verdict", "flight_records",
            "flight_capacity", "dump_postmortem", "start_emitter",
-           "stop_emitter", "set_enabled", "enabled"]
+           "stop_emitter", "set_enabled", "enabled",
+           "suppress_compile_accounting"]
 
 SCHEMA_REPORT = "mxtpu-telemetry-1"
 SCHEMA_POSTMORTEM = "mxtpu-postmortem-1"
@@ -318,10 +320,30 @@ class span(object):
 # count_compile.  Never reset (delta readers depend on monotonicity).
 _xla_compiles = 0
 _compile_hook_installed = False
+_compile_suppress = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_compile_accounting():
+    """Mark this thread's backend compiles as intentional background work
+    (the AOT twin / hot-swap compiles, executor._twin_hotswap): they are
+    counted under ``xla.background_compiles`` instead of bumping the
+    monotonic ``_xla_compiles`` that profiler.instrument uses to charge
+    recompiles to in-flight steps — a deliberate off-hot-path compile is
+    exactly NOT the steady-state recompile that counter exists to catch."""
+    prev = getattr(_compile_suppress, "on", False)
+    _compile_suppress.on = True
+    try:
+        yield
+    finally:
+        _compile_suppress.on = prev
 
 
 def _on_jax_event(event, duration, **kw):
     if "backend_compile" in event:
+        if getattr(_compile_suppress, "on", False):
+            counter("xla.background_compiles").inc()
+            return
         global _xla_compiles
         _xla_compiles += 1
         counter("xla.compiles").inc()

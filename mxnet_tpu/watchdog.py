@@ -49,8 +49,8 @@ import traceback
 
 __all__ = ["EXIT_STALL", "EXIT_PORT_IN_USE", "arm", "maybe_arm", "disarm",
            "armed", "renew", "release", "guard", "stall_timeout",
-           "startup_grace", "dump_stacks", "snapshot", "start_heartbeat",
-           "stop_heartbeat", "heartbeat_path"]
+           "startup_grace", "note_warm_start", "dump_stacks", "snapshot",
+           "start_heartbeat", "stop_heartbeat", "heartbeat_path"]
 
 EXIT_STALL = 75         # EX_TEMPFAIL: stall detected — retryable by launcher
 EXIT_PORT_IN_USE = 76   # coordinator port bind failure — retryable, re-pick
@@ -69,6 +69,7 @@ _thread = None
 _on_stall = None
 _progress = {"step": 0, "phase": "startup"}   # heartbeat display state
 _hb = None             # (thread, stop_event, path)
+_warm_started = False  # AOT warm start seen (shrinks startup grace)
 
 
 def _env_float(name, default):
@@ -92,6 +93,34 @@ def startup_grace(timeout=None):
         return g
     t = stall_timeout() if timeout is None else timeout
     return max(4.0 * t, 120.0)
+
+
+def note_warm_start():
+    """An AOT warm start happened: the fused step deserialized from the
+    executable cache (executor.make_fit_step), so the dominant cost the
+    startup grace exists to cover — XLA compilation — is gone from this
+    process.  Shrink the armed watchdog's grace window to
+    ``max(2×timeout, 30s)`` so a wedged warm restart is diagnosed in
+    seconds instead of minutes.  Only ever shrinks (a cold program may
+    still compile later in mixed warm/cold processes), never drops below
+    the steady-state timeout, and an explicit MXTPU_STARTUP_GRACE wins
+    outright — the operator's number is a contract."""
+    global _grace, _warm_started
+    _warm_started = True  # a later arm() applies the shrink too
+    with _lock:
+        if _armed:
+            _grace = _warm_grace(_grace, _timeout)
+
+
+def _warm_grace(grace, timeout):
+    """The warm-start grace clamp, shared by note_warm_start (shrink an
+    armed watchdog in place) and arm (apply a shrink seen before
+    arming): only ever narrows ``grace``, never below the steady-state
+    ``timeout``; an explicit MXTPU_STARTUP_GRACE is an operator contract
+    and wins outright."""
+    if _env_float("MXTPU_STARTUP_GRACE", 0.0) > 0:
+        return grace
+    return max(timeout, min(grace, max(2.0 * timeout, 30.0)))
 
 
 # -- progress leases --------------------------------------------------------
@@ -193,6 +222,10 @@ def arm(timeout=None, grace=None, on_stall=None):
         _armed_at = time.monotonic()
         _timeout = t
         _grace = startup_grace(t) if grace is None else float(grace)
+        if _warm_started and grace is None:
+            # the fused step already warm-started from the AOT cache
+            # before arming: no compile left to cover (note_warm_start)
+            _grace = _warm_grace(_grace, t)
         _on_stall = on_stall or _default_on_stall
         # age accrued while nobody was watching must not count: a lease
         # last renewed long before arming (a Trainer that trained a
@@ -297,6 +330,7 @@ def snapshot():
     hb = _hb  # capture: stop_heartbeat may null the slot mid-snapshot
     return {
         "armed": _armed,
+        "warm_start": _warm_started,
         "timeout": _timeout if _armed else stall_timeout(),
         "grace": _grace if _armed else startup_grace(),
         "progress": dict(_progress),

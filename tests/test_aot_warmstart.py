@@ -1,0 +1,322 @@
+"""AOT executable warm-start: the compiled fit step as a persistable
+artifact (mxnet_tpu.aot_cache + executor.make_fit_step).
+
+Covers the satellite matrix: cache hit (restart skips the foreground
+trace+compile, numerics identical), miss, and stale-key invalidation —
+changed shapes, changed optimizer config, changed backend fingerprint —
+plus corrupt entries falling back to compile, the watchdog grace shrink
+on warm start, and the CPU-specific safety model: a warm CPU restart
+deserializes the donation-free twin and hot-swaps to a background-
+compiled donated program (executing a DESERIALIZED donated executable on
+this jaxlib's CPU backend corrupts the heap — ROBUSTNESS.md §8 — so the
+donated variant is refused at load and quarantined from jax's persistent
+compile cache).
+
+The suite itself is the regression test for that corruption: before the
+variant split, running ``test_disabled_without_env`` followed by the hit
+test segfaulted the interpreter roughly every other run.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import aot_cache, profiler, telemetry, watchdog
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "aot")
+    monkeypatch.setenv("MXTPU_AOT_CACHE_DIR", d)
+    # each test starts as a "fresh process": no in-process executables,
+    # so module builds exercise the disk path the way a restart would
+    aot_cache.clear_memo()
+    yield d
+    aot_cache.drain()
+    aot_cache.clear_memo()
+
+
+def _counters():
+    c = telemetry.report()["counters"]
+    return (c.get("aot.cache_hits", 0), c.get("aot.cache_misses", 0),
+            c.get("aot.cache_errors", 0))
+
+
+def _build(batch=32, dim=16, hidden=32, momentum=0.9, lr_mult=None):
+    rs = np.random.RandomState(0)
+    X = rs.randn(4 * batch, dim).astype(np.float32)
+    y = rs.randint(0, 4, 4 * batch).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name="softmax_label")
+    s = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=hidden, name="fc1"),
+        name="softmax")
+    mod = mx.mod.Module(s, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    opt = mx.optimizer.create("sgd", learning_rate=0.05,
+                              momentum=momentum, rescale_grad=1.0 / batch)
+    mod.init_optimizer(kvstore=None, optimizer=opt)
+    if lr_mult:  # after init_optimizer: it resets the mult tables
+        opt.set_lr_mult(lr_mult)
+    return mod, list(it)
+
+
+def _aot_files(cache_dir):
+    if not os.path.isdir(cache_dir):
+        return []
+    return sorted(n for n in os.listdir(cache_dir)
+                  if n.endswith(".aotx"))
+
+
+def test_disabled_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXTPU_AOT_CACHE_DIR", raising=False)
+    assert not aot_cache.enabled()
+    mod, batches = _build()
+    pre = _counters()
+    mod.fit_step(batches[0])
+    assert _counters() == pre  # the cache never engaged
+
+
+def test_miss_compiles_then_hit_skips_compile(cache_dir):
+    mx.random.seed(0)
+    mod, batches = _build()
+    h0, m0, e0 = _counters()
+    for b in batches:
+        mod.fit_step(b)
+    h1, m1, e1 = _counters()
+    assert (h1 - h0, m1 - m0, e1 - e0) == (0, 1, 0)
+    assert aot_cache.drain(timeout=60)  # twin serialization is bg work
+    assert len(_aot_files(cache_dir)) == 1
+    ref = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+
+    # "restart": a fresh process would have an empty memo; same config
+    # must deserialize the twin — no foreground trace or compile — and
+    # train to bit-identical parameters through the donated hot-swap
+    aot_cache.clear_memo()
+    c_pre = telemetry.report()["counters"]
+    mx.random.seed(0)
+    mod2, batches2 = _build()
+    profiler.reset_step_stats()
+    for b in batches2:
+        mod2.fit_step(b)
+    h2, m2, e2 = _counters()
+    assert (h2 - h1, m2 - m1, e2 - e1) == (1, 0, 0)
+    st = profiler.step_stats()
+    assert st["dispatch_count"] == len(batches2)   # 1.0/step holds
+    assert st["compile_count"] == 0                # the warm-start point
+    got = mod2.get_params()[0]["fc1_weight"].asnumpy()
+    np.testing.assert_array_equal(ref, got)
+    # the donated program arrived in the background and swapped in; its
+    # compile was charged to background accounting, not to any step
+    assert aot_cache.drain(timeout=60)
+    c_post = telemetry.report()["counters"]
+    assert c_post.get("aot.hotswaps", 0) - c_pre.get("aot.hotswaps", 0) \
+        == 1
+    assert c_post.get("xla.background_compiles", 0) > \
+        c_pre.get("xla.background_compiles", 0)
+    assert profiler.step_stats()["compile_count"] == 0
+    # steady state after the swap: donated program, numerics continue
+    mx.random.seed(0)
+    for b in batches2:
+        mod2.fit_step(b)
+    assert np.isfinite(
+        mod2.get_params()[0]["fc1_weight"].asnumpy()).all()
+
+
+def test_memo_rebuild_same_process(cache_dir):
+    """A same-process module rebuild (optimizer reconfig, divergence
+    recovery) reuses the ORIGINAL compiled object: no deserialization,
+    no compile, bit-identical numerics on any backend."""
+    mx.random.seed(0)
+    mod, batches = _build()
+    for b in batches:
+        mod.fit_step(b)
+    ref = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    pre = telemetry.report()["counters"].get("aot.memo_hits", 0)
+    mx.random.seed(0)
+    mod2, batches2 = _build()
+    profiler.reset_step_stats()
+    for b in batches2:
+        mod2.fit_step(b)
+    assert telemetry.report()["counters"]["aot.memo_hits"] == pre + 1
+    assert profiler.step_stats()["compile_count"] == 0
+    np.testing.assert_array_equal(
+        ref, mod2.get_params()[0]["fc1_weight"].asnumpy())
+
+
+def test_stale_key_changed_shapes(cache_dir):
+    mod, batches = _build(batch=32)
+    mod.fit_step(batches[0])
+    assert aot_cache.drain(timeout=60)
+    assert len(_aot_files(cache_dir)) == 1
+    mod2, batches2 = _build(batch=16)   # different batch axis
+    h0, m0, _ = _counters()
+    mod2.fit_step(batches2[0])
+    h1, m1, _ = _counters()
+    assert (h1 - h0, m1 - m0) == (0, 1)
+    assert aot_cache.drain(timeout=60)
+    assert len(_aot_files(cache_dir)) == 2
+
+
+def test_stale_key_changed_optimizer_config(cache_dir):
+    mod, batches = _build(momentum=0.9)
+    mod.fit_step(batches[0])
+    aot_cache.drain(timeout=60)
+    base = len(_aot_files(cache_dir))
+    # hyperparameter baked into the traced program -> new key
+    mod2, batches2 = _build(momentum=0.0)
+    h0, m0, _ = _counters()
+    mod2.fit_step(batches2[0])
+    h1, m1, _ = _counters()
+    assert (h1 - h0, m1 - m0) == (0, 1)
+    # static per-param mult tree -> new key too (index-keyed: the
+    # hand-built optimizer instance has no idx2name table)
+    mod3, batches3 = _build(momentum=0.9, lr_mult={0: 0.5})
+    mod3.fit_step(batches3[0])
+    assert aot_cache.drain(timeout=60)
+    assert len(_aot_files(cache_dir)) == base + 2
+
+
+def test_stale_key_changed_backend_fingerprint(cache_dir, monkeypatch):
+    mod, batches = _build()
+    mod.fit_step(batches[0])
+    assert aot_cache.drain(timeout=60)
+    assert len(_aot_files(cache_dir)) == 1
+    # a jaxlib/backend upgrade between restarts: same model, same
+    # shapes, but yesterday's executable is object code for another
+    # runtime — the key must miss
+    monkeypatch.setattr(aot_cache, "fingerprint",
+                        lambda: "other-backend|v0")
+    aot_cache.clear_memo()
+    mod2, batches2 = _build()
+    h0, m0, _ = _counters()
+    mod2.fit_step(batches2[0])
+    h1, m1, _ = _counters()
+    assert (h1 - h0, m1 - m0) == (0, 1)
+    assert aot_cache.drain(timeout=60)
+    assert len(_aot_files(cache_dir)) == 2
+
+
+def test_corrupt_entry_falls_back_to_compile(cache_dir):
+    mx.random.seed(0)
+    mod, batches = _build()
+    for b in batches:
+        mod.fit_step(b)
+    ref = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    assert aot_cache.drain(timeout=60)
+    (name,) = _aot_files(cache_dir)
+    with open(os.path.join(cache_dir, name), "wb") as f:
+        f.write(b"not a pickled executable")
+    aot_cache.clear_memo()
+    mx.random.seed(0)
+    mod2, batches2 = _build()
+    h0, m0, e0 = _counters()
+    for b in batches2:
+        mod2.fit_step(b)
+    h1, m1, e1 = _counters()
+    assert e1 - e0 == 1 and h1 - h0 == 0
+    np.testing.assert_array_equal(
+        ref, mod2.get_params()[0]["fc1_weight"].asnumpy())
+    # the poisoned entry was discarded and re-stored by the recompile
+    assert aot_cache.drain(timeout=60)
+    assert _aot_files(cache_dir) == [name]
+
+
+def test_donated_entry_refused_where_unsafe(cache_dir, monkeypatch):
+    """An entry carrying a donated executable must never be EXECUTED on a
+    backend where deserialized donation corrupts the heap (e.g. written
+    under MXTPU_AOT_FORCE_DONATED, or a future variant-policy change):
+    load discards it and the caller pays one compile."""
+    if aot_cache.deserialized_donation_safe():
+        pytest.skip("backend executes donated deserialized executables")
+    import jax
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return a + b, a * b
+
+    x = jnp.ones((4,), jnp.float32)
+    compiled = jax.jit(f, donate_argnums=(0,)).lower(x, x).compile()
+    key = aot_cache.cache_key("test", (x, x))
+    assert aot_cache.store(key, compiled, aot_cache.VARIANT_DONATED)
+    _, _, e0 = _counters()
+    assert aot_cache.load(key) is None
+    _, _, e1 = _counters()
+    assert e1 - e0 == 1
+    assert _aot_files(cache_dir) == []   # discarded, restart re-stores
+
+
+def test_donation_cache_guard_bypasses_persistent_cache(monkeypatch):
+    """On donation-unsafe backends EVERY call of a donated program runs
+    with jax's persistent compilation cache disabled — not just the
+    first: a shape-polymorphic jit recompiles on a new input shape, and
+    a cache hit there would execute a deserialized donated executable.
+    The flag is restored once no guarded call is in flight."""
+    import jax
+    if aot_cache.deserialized_donation_safe():
+        pytest.skip("backend executes donated deserialized executables")
+    seen = []
+
+    def fake(*args):
+        seen.append(jax.config.jax_enable_compilation_cache)
+        return args
+
+    prev = jax.config.jax_enable_compilation_cache
+    guarded = aot_cache.donation_cache_guard(fake)
+    guarded(1)
+    guarded(2)   # a retrace/recompile here must be bypassed too
+    assert seen == [False, False]
+    assert jax.config.jax_enable_compilation_cache == prev
+
+    # nested guarded calls (hot-swap thread vs foreground compile):
+    # depth-counted — the inner exit must not re-enable the cache
+    inner = aot_cache.donation_cache_guard(fake)
+
+    def outer(*args):
+        inner(*args)
+        seen.append(jax.config.jax_enable_compilation_cache)
+        return args
+
+    seen.clear()
+    aot_cache.donation_cache_guard(outer)(3)
+    assert seen == [False, False]
+    assert jax.config.jax_enable_compilation_cache == prev
+
+
+def test_warm_start_shrinks_watchdog_grace(cache_dir):
+    mod, batches = _build()
+    mod.fit_step(batches[0])   # populate the cache (cold)
+    assert aot_cache.drain(timeout=60)
+    aot_cache.clear_memo()
+    stalls = []
+    try:
+        assert watchdog.arm(timeout=5.0, grace=600.0,
+                            on_stall=lambda *a: stalls.append(a))
+        mod2, batches2 = _build()
+        mod2.fit_step(batches2[0])   # warm start under an armed watchdog
+        snap = watchdog.snapshot()
+        assert snap["warm_start"] is True
+        # grace shrank from the compile-sized 600s to max(2*t, 30)
+        assert snap["grace"] == 30.0
+    finally:
+        watchdog.disarm()
+    assert not stalls
+
+
+def test_explicit_startup_grace_wins_over_warm_start(cache_dir,
+                                                     monkeypatch):
+    mod, batches = _build()
+    mod.fit_step(batches[0])
+    assert aot_cache.drain(timeout=60)
+    aot_cache.clear_memo()
+    monkeypatch.setenv("MXTPU_STARTUP_GRACE", "444")
+    try:
+        assert watchdog.arm(timeout=5.0, on_stall=lambda *a: None)
+        mod2, batches2 = _build()
+        mod2.fit_step(batches2[0])
+        # the operator pinned the window; warm start must not shrink it
+        assert watchdog.snapshot()["grace"] == 444.0
+    finally:
+        watchdog.disarm()

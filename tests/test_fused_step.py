@@ -324,6 +324,53 @@ def test_dataloader_prefetch_abandoned_iteration_stops_worker():
     assert not worker.is_alive()
 
 
+def test_dataloader_prefetch_depth_env_override(monkeypatch):
+    ds = ArrayDataset(np.zeros((32, 3), np.float32),
+                      np.zeros(32, np.float32))
+    assert DataLoader(ds, batch_size=4)._prefetch == 2  # built-in
+    monkeypatch.setenv("MXTPU_DATA_PREFETCH", "5")
+    assert DataLoader(ds, batch_size=4)._prefetch == 5  # env override
+    # explicit ctor arg beats the env (model code stays authoritative)
+    assert DataLoader(ds, batch_size=4, prefetch=1)._prefetch == 1
+    monkeypatch.setenv("MXTPU_DATA_PREFETCH", "0")
+    loader = DataLoader(ds, batch_size=4)
+    assert loader._prefetch == 0  # env can disable prefetching outright
+    assert len(list(loader)) == 8
+
+
+def test_dataloader_close_drops_batch_references():
+    """A closed iterator must not pin queued batches (or the dataset,
+    through the worker closure) for the process lifetime."""
+    import gc
+    import weakref
+
+    class Tracked:
+        def __init__(self, n):
+            self.data = np.zeros((n, 3), np.float32)
+            self.label = np.zeros(n, np.float32)
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, idx):
+            return self.data[idx], self.label[idx]
+
+    ds = Tracked(64)
+    ref = weakref.ref(ds)
+    loader = DataLoader(ds, batch_size=4, prefetch=2)
+    it = iter(loader)
+    next(it)  # spin the worker up and fill the queue
+    it.close()
+    assert it._q is None and it._worker is None
+    it.close()  # re-entrant (and __del__ after close must be a no-op)
+    with pytest.raises(StopIteration):
+        next(it)
+    del loader, ds
+    gc.collect()
+    assert ref() is None, \
+        "closed loader iterator still pins the dataset/batches"
+
+
 def test_dataloader_prefetch_propagates_errors():
     class Bad:
         def __len__(self):

@@ -460,7 +460,9 @@ def test_prefetch_iter_context_manager_frees_worker():
     it = iter(_loader())
     with it:
         next(it)
-    assert not it._worker.is_alive()
+    # close() both retires the worker and drops the reference (a closed
+    # iterator must not pin queued batches — PR 5)
+    assert it._worker is None
     with pytest.raises(StopIteration):
         next(it)  # closed iterator stays closed
 
@@ -469,7 +471,7 @@ def test_prefetch_iter_close_idempotent_and_on_exhaustion():
     it = iter(_loader())
     for _ in it:
         pass
-    assert not it._worker.is_alive()  # released at exhaustion, not GC
+    assert it._worker is None  # released at exhaustion, not GC
     it.close()
     it.close()
 
@@ -484,7 +486,7 @@ def test_prefetch_worker_exception_chains_original_traceback():
     frames = traceback.extract_tb(exc_info.value.__traceback__)
     # the surfaced traceback reaches back into the worker thread
     assert any("dataloader" in f.filename for f in frames)
-    assert not it._worker.is_alive()
+    assert it._worker is None  # closed (and dereferenced) on re-raise
 
 
 # -- launcher ----------------------------------------------------------------

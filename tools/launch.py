@@ -22,6 +22,10 @@ Failure handling: worker exits are classified retryable/permanent
 caught by the per-rank heartbeat monitor (--heartbeat-timeout, files
 touched by mxnet_tpu.watchdog under MXTPU_HEARTBEAT_DIR) and by the
 in-process watchdog's stall exit code 75 — see ROBUSTNESS.md §5/§7.
+Restarts warm-start: every attempt shares one AOT executable cache
+(--aot-cache-dir → MXTPU_AOT_CACHE_DIR + jax's persistent compile
+cache), so a restarted rank deserializes the compiled fit step instead
+of paying trace+compile again — see PERF.md §12.
 - On real TPU pods, prefer the platform launcher (GKE/queued resources):
   every pod VM already runs one process; pass --use-env-ranks to adopt
   the platform-provided rank env instead of spawning.
@@ -43,6 +47,35 @@ import time
 # launcher must work without the package importable on this host)
 STALL_EXIT = 75         # EX_TEMPFAIL: watchdog stall — retryable
 PORT_IN_USE_EXIT = 76   # coordinator port bind failure — retryable
+
+
+def _cache_env(args):
+    """Warm-start env for workers: the AOT executable cache
+    (mxnet_tpu.aot_cache — restarted ranks deserialize the compiled fit
+    step instead of re-tracing + re-compiling it) plus jax's own
+    persistent compilation cache as the fallback layer for every other
+    program.  The dir is created once per launch invocation and reused
+    across restart attempts — that persistence IS the feature.  Values
+    already exported by the operator are never overridden."""
+    if not getattr(args, "aot_cache_dir", None):
+        return {}
+    # Always export the resolved dir: main() already made the operator's
+    # choice (explicit flag > their env > auto temp dir), and ssh workers
+    # see ONLY this env string — the launcher's environment does not ride
+    # along, so "already exported locally" must not suppress the export.
+    # Operator-set jax cache knobs are forwarded verbatim for the same
+    # reason; the min-compile-time default of 0 exists because jax's own
+    # threshold (1s) would skip most of a small model's programs, and a
+    # restart wants them all.
+    return {
+        "MXTPU_AOT_CACHE_DIR": args.aot_cache_dir,
+        "JAX_COMPILATION_CACHE_DIR":
+            os.environ.get("JAX_COMPILATION_CACHE_DIR") or
+            os.path.join(args.aot_cache_dir, "xla"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS":
+            os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                           "0"),
+    }
 
 
 def _free_port():
@@ -201,6 +234,7 @@ def _run_local_once(args, cmd, attempt):
                 "DMLC_NUM_SERVER": "0",
                 "DMLC_WORKER_ID": str(rank),
             })
+            env.update(_cache_env(args))
             if args.cpu_fake_devices:
                 env["JAX_PLATFORMS"] = "cpu"
                 env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -322,13 +356,17 @@ def _ssh_commands(args, cmd, attempt=0):
     port = args.port or _free_port()
     coordinator = "%s:%d" % (socket.gethostname(), port)
     out = []
+    # warm-start caches assume a shared filesystem across hosts (the
+    # usual pod setup); a host-local path just cold-starts harmlessly
+    cache_envs = "".join(" %s=%s" % (k, shlex.quote(v))
+                         for k, v in sorted(_cache_env(args).items()))
     for rank, host in enumerate(hosts):
         envs = ("MXTPU_COORDINATOR=%s MXTPU_NUM_WORKERS=%d "
                 "MXTPU_WORKER_RANK=%d MXTPU_RESTART_ATTEMPT=%d "
                 "DMLC_ROLE=worker DMLC_NUM_WORKER=%d "
-                "DMLC_WORKER_ID=%d"
+                "DMLC_WORKER_ID=%d%s"
                 % (shlex.quote(coordinator), args.num_workers, rank,
-                   attempt, args.num_workers, rank))
+                   attempt, args.num_workers, rank, cache_envs))
         remote = "cd %s; %s %s" % (shlex.quote(os.getcwd()), envs,
                                    " ".join(shlex.quote(c) for c in cmd))
         # -tt forces a remote tty so the remote process group dies with
@@ -386,6 +424,8 @@ def _mpi_command(args, cmd):
              "-x", "MXTPU_RANK_FROM_MPI=1",
              "-x", "DMLC_ROLE=worker",
              "-x", "DMLC_NUM_WORKER=%d" % args.num_workers]
+    for k, v in _cache_env(args).items():
+        argv += ["-x", "%s=%s" % (k, v)]
     return argv + list(cmd)
 
 
@@ -443,16 +483,65 @@ def main(argv=None):
     parser.add_argument("--kill-grace", type=float, default=5.0,
                         help="seconds to wait between teardown "
                         "escalation steps (SIGINT/SIGTERM → SIGKILL)")
+    parser.add_argument("--aot-cache-dir", default=None,
+                        help="compiled-executable warm-start cache "
+                        "exported to workers as MXTPU_AOT_CACHE_DIR (+ "
+                        "JAX_COMPILATION_CACHE_DIR fallback); persists "
+                        "across restart attempts so a restarted rank "
+                        "deserializes the fused step instead of "
+                        "recompiling it.  Default: a per-job temp dir; "
+                        "pass 'off' to disable")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command for launching the program")
     args = parser.parse_args(argv)
     cmd = [c for c in args.command if c != "--"]
     assert cmd, "no command given"
-    if args.launcher == "local":
-        return launch_local(args, cmd)
-    if args.launcher == "mpi":
-        return launch_mpi(args, cmd)
-    return launch_ssh(args, cmd)
+    auto_cache_dir = None
+    if args.aot_cache_dir == "off":
+        args.aot_cache_dir = None
+    elif not args.aot_cache_dir:
+        # one dir per launch INVOCATION, shared by every restart attempt
+        # — the whole point is that attempt N+1 finds attempt N's
+        # compiled executables (operator env wins when already set)
+        args.aot_cache_dir = os.environ.get("MXTPU_AOT_CACHE_DIR")
+        if not args.aot_cache_dir:
+            args.aot_cache_dir = auto_cache_dir = \
+                tempfile.mkdtemp(prefix="mxtpu-aot-")
+    try:
+        if args.launcher == "local":
+            return launch_local(args, cmd)
+        if args.launcher == "mpi":
+            return launch_mpi(args, cmd)
+        return launch_ssh(args, cmd)
+    finally:
+        # the auto-created cache only serves restart attempts of THIS
+        # invocation; leaving serialized executables + a min-compile-
+        # time-0 XLA cache in /tmp per launch would leak without bound.
+        # Operator-provided dirs (flag or env) are theirs to keep.
+        if auto_cache_dir:
+            shutil.rmtree(auto_cache_dir, ignore_errors=True)
+            if args.launcher in ("ssh", "mpi") and args.hostfile:
+                # without a shared filesystem every remote host grew its
+                # own copy at the exported path; rm it there too (the
+                # path is launcher-generated, never operator data; mpi
+                # hostfile hosts are reachable over ssh in every mpirun
+                # deployment this launcher targets)
+                _cleanup_remote_cache(args, auto_cache_dir)
+
+
+def _cleanup_remote_cache(args, path):
+    """Best-effort rm of the auto-created cache dir on each ssh host."""
+    try:
+        with open(args.hostfile) as f:
+            # first token only: mpi hostfiles carry "host slots=N"
+            hosts = sorted({h.split()[0] for h in f if h.strip()})
+    except OSError:
+        return
+    for host in hosts:
+        subprocess.call(
+            ["ssh", "-o", "StrictHostKeyChecking=no", "-o",
+             "BatchMode=yes", host, "rm -rf %s" % shlex.quote(path)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
 if __name__ == "__main__":

@@ -6,9 +6,11 @@ parameter), printing profiler.step_stats() for each so dispatch-count
 regressions are visible at a glance.
 
 Usage: JAX_PLATFORMS=cpu python tools/perf_probe/steptrace.py
-Prints one JSON object: {"fused": {...}, "unfused": {...}} where each
-side carries steady-state dispatches_per_step, compile_count and
-step_time_ema_ms.
+Prints one JSON object: {"fused": {...}, "fused_async_ckpt": {...},
+"unfused": {...}} where each side carries steady-state
+dispatches_per_step, compile_count and step_time_ema_ms — the
+fused_async_ckpt trace runs a per-epoch MXTPU_ASYNC_CKPT=1 checkpoint
+inside the loop and asserts the save path adds zero dispatches.
 """
 import json
 import os
@@ -18,20 +20,27 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-def build_module(batch=64, dim=32, classes=4, hidden=64):
+def build_module(batch=64, dim=32, classes=4, hidden=64, depth=2,
+                 n_batches=8):
+    """The probe family's MLP fit-loop fixture (restart_probe reuses it
+    with bigger sizes): ``depth-1`` hidden relu layers + a softmax
+    head."""
     import numpy as np
     import mxnet_tpu as mx
 
     rs = np.random.RandomState(0)
-    X = rs.randn(8 * batch, dim).astype(np.float32)
-    y = rs.randint(0, classes, size=8 * batch).astype(np.float32)
+    X = rs.randn(n_batches * batch, dim).astype(np.float32)
+    y = rs.randint(0, classes, size=n_batches * batch).astype(np.float32)
     train = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False,
                               label_name="softmax_label")
-    data = mx.sym.Variable("data")
-    fc1 = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
-    act = mx.sym.Activation(fc1, act_type="relu")
-    fc2 = mx.sym.FullyConnected(act, num_hidden=classes, name="fc2")
-    s = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    net = mx.sym.Variable("data")
+    for i in range(1, depth):
+        net = mx.sym.FullyConnected(net, num_hidden=hidden,
+                                    name="fc%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+    out = mx.sym.FullyConnected(net, num_hidden=classes,
+                                name="fc%d" % depth)
+    s = mx.sym.SoftmaxOutput(out, name="softmax")
     mod = mx.mod.Module(s, context=mx.cpu())
     mod.bind(data_shapes=train.provide_data,
              label_shapes=train.provide_label)
@@ -78,6 +87,9 @@ def trace(step_fn, batches, epochs=3):
 
 
 def run():
+    import shutil
+    import tempfile
+
     mod, train = build_module()
     batches = list(train)
 
@@ -93,6 +105,40 @@ def run():
 
     unfused = trace(split_step, batches)
     n_params = len(mod._param_names)
+
+    # fused loop WITH async checkpointing live: a save per epoch, the
+    # write overlapping the following steps.  The snapshot (host fetch +
+    # owned copies) and enqueue must add ZERO compiled-program
+    # dispatches — the 1.0 dispatch/step contract is asserted on this
+    # trace exactly like the plain fused one (bench.py BENCH_MODE=
+    # steptrace hard-fails otherwise).
+    from mxnet_tpu import checkpoint as _ckpt
+    mod3, _ = build_module()
+    ckdir = tempfile.mkdtemp(prefix="steptrace-ckpt-")
+    prev = os.environ.get("MXTPU_ASYNC_CKPT")
+    os.environ["MXTPU_ASYNC_CKPT"] = "1"
+    seen = [0]
+
+    def fused_ckpt_step(b):
+        mod3.fit_step(b)
+        seen[0] += 1
+        if seen[0] % len(batches) == 0:  # one checkpoint per epoch
+            mod3.save_checkpoint(os.path.join(ckdir, "ck"),
+                                 seen[0] // len(batches),
+                                 save_optimizer_states=True)
+
+    try:
+        fused_async = trace(fused_ckpt_step, batches)
+        _ckpt.flush_async()
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_ASYNC_CKPT", None)
+        else:
+            os.environ["MXTPU_ASYNC_CKPT"] = prev
+        shutil.rmtree(ckdir, ignore_errors=True)
+    # the dispatch-rate contract itself (1.0/step, async saves in-loop)
+    # is asserted by bench.py BENCH_MODE=steptrace, same as the plain
+    # fused contract — one home per check
 
     # the telemetry layer must agree with the profiler's step counters:
     # every fused dispatch produced exactly one fit_step.dispatch /
@@ -111,7 +157,8 @@ def run():
         "flight recorder held %d records for %d fused steps (ring cap %d)"
         % (fused["flight_len"], n, fused["flight_maxlen"]))
 
-    return {"fused": fused, "unfused": unfused, "n_params": n_params}
+    return {"fused": fused, "fused_async_ckpt": fused_async,
+            "unfused": unfused, "n_params": n_params}
 
 
 if __name__ == "__main__":

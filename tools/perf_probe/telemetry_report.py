@@ -95,6 +95,46 @@ def render_report(doc, out, context=""):
     if gauges:
         out.write("  gauges: " + "  ".join(
             "%s=%s" % kv for kv in sorted(gauges.items())) + "\n")
+    _render_ckpt_pipeline(doc, out)
+
+
+# phases the step loop actually blocks on under async checkpointing vs
+# the work the writer thread absorbs — the split telemetry_report exists
+# to make visible (PERF.md §12)
+_CKPT_HOT = ("ckpt.save", "ckpt.snapshot", "ckpt.async_wait")
+_CKPT_BG = ("ckpt.async_write", "ckpt.write", "ckpt.fsync", "ckpt.rename")
+
+
+def _render_ckpt_pipeline(doc, out):
+    """Checkpoint-pipeline digest: queue depth, save counts, and the
+    step-visible stall (hot-path spans) vs background write time.  Note
+    ``ckpt.save`` encloses snapshot+enqueue under async but the whole
+    write under sync — the per-span rows tell the two apart."""
+    c = doc.get("counters") or {}
+    phases = doc.get("phases") or {}
+    saves = c.get("ckpt.saves", 0)
+    if not saves and not any(
+            (phases.get(k) or {}).get("count") for k in _CKPT_HOT):
+        return
+    g = doc.get("gauges") or {}
+    out.write("\n  checkpoint pipeline: saves=%d async=%d errors=%d "
+              "io_retries=%d queue_depth=%s\n"
+              % (saves, c.get("ckpt.async_saves", 0),
+                 c.get("ckpt.async_errors", 0),
+                 c.get("ckpt.io_retries", 0),
+                 g.get("ckpt.queue_depth", "-")))
+    rows = []
+    for group, names in (("step-visible", _CKPT_HOT),
+                         ("background", _CKPT_BG)):
+        for name in names:
+            h = phases.get(name)
+            if not h or not h["count"]:
+                continue
+            rows.append((name, group, h["count"],
+                         _fmt_s(h["sum"] / h["count"]), _fmt_s(h["p50"]),
+                         _fmt_s(h["p99"]), _fmt_s(h["max"])))
+    _table(("span", "where", "count", "mean", "p50", "p99", "max"),
+           rows, out)
 
 
 def render_postmortem(doc, out):
