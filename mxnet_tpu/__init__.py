@@ -40,6 +40,7 @@ from . import callback
 from . import io
 from . import kvstore
 from . import kvstore as kv
+from . import elastic
 from . import fault
 from . import telemetry
 from . import watchdog

@@ -117,13 +117,31 @@ def fingerprint():
     the avals Python scalars lower to, and compile-affecting environment
     (XLA_FLAGS, libtpu tuning args — jax's own persistent cache folds
     XLA flags into its key for the same reason) changes what the
-    compiler would have produced."""
+    compiler would have produced.
+
+    The **device topology** is part of that identity too: an executable
+    embeds its device assignment (global device ids out of a
+    process_count × local_device_count world), so a blob compiled by
+    rank 1 of a 3-process job can neither run on rank 0 nor in the
+    2-process world an elastic restart shrank to — before this was
+    keyed, an elastic world-size change made every rank overwrite the
+    shared entry with its own topology's blob and every OTHER topology
+    deserialize-fail on it (discarding the entry, so the cache never
+    warmed).  Keyed per (world, rank position, local device set), a
+    survivor re-hits its own entry across restarts at the same world
+    size — the "where shapes allow" half of the elastic warm-start
+    contract (ROBUSTNESS.md §9)."""
     import jax
     import jaxlib
-    dev = jax.devices()[0]
+    local = jax.local_devices()
+    dev = local[0]
     return "|".join((_FORMAT, jax.__version__, jaxlib.__version__,
                      dev.platform, dev.device_kind,
                      "x64" if jax.config.jax_enable_x64 else "x32",
+                     "proc%d/%d" % (jax.process_index(),
+                                    jax.process_count()),
+                     "dev%s/%d" % (",".join(str(d.id) for d in local),
+                                   jax.device_count()),
                      os.environ.get("XLA_FLAGS", ""),
                      os.environ.get("LIBTPU_INIT_ARGS", "")))
 

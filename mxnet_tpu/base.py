@@ -55,6 +55,24 @@ def _distributed_initialized(jax):
         return False
 
 
+def _membership_env_changed(jax):
+    """Does the env membership contract disagree with the live mesh?
+    An elastic restart re-exports MXTPU_NUM_WORKERS/MXTPU_WORKER_RANK
+    for the re-ranked survivors; a process that joined under the OLD
+    contract must not silently keep using it."""
+    import os
+    try:
+        want_num = int(os.environ["MXTPU_NUM_WORKERS"])
+        want_rank = int(os.environ["MXTPU_WORKER_RANK"])
+    except (KeyError, ValueError):
+        return False  # no/garbled contract: nothing to compare against
+    try:
+        return (jax.process_count() != want_num or
+                jax.process_index() != want_rank)
+    except Exception:
+        return False  # backend not up yet; initialize() will see env
+
+
 def _coordinator_port_free(coord):
     """Rank 0 pre-probe: can the coordinator port still be bound?  A
     restarted job can race a dying predecessor (or another tenant) for a
@@ -112,7 +130,33 @@ def _maybe_init_distributed():
         return
     import jax
     if _distributed_initialized(jax):
-        return  # the import-time call already joined; re-calls are no-ops
+        # already joined — but an elastic restart may have re-exported
+        # the membership env (tools/launch.py --elastic re-ranks the
+        # survivors and changes MXTPU_NUM_WORKERS between attempts).
+        # Each elastic attempt is a fresh PROCESS, so normally this path
+        # never sees a mismatch.  When it does (a harness re-exporting
+        # env inside one process), say so loudly and KEEP the old mesh:
+        # jax pins the process topology for the process lifetime
+        # (process_count/process_index are lru_cached over the frozen
+        # backend), so a shutdown+re-initialize here would neither
+        # update what jax reports nor ever clear the mismatch — it
+        # would just re-run bring-up on every later call.  The only
+        # supported way to change this process's membership is to exit
+        # and let the launcher respawn it (retryable exits exist for
+        # exactly that).
+        if _membership_env_changed(jax):
+            import logging
+            logging.warning(
+                "mxnet_tpu: membership env (MXTPU_NUM_WORKERS/"
+                "MXTPU_WORKER_RANK=%s/%s) no longer matches the mesh "
+                "this process joined (%d processes, rank %d); jax "
+                "cannot re-join in-process — keeping the existing "
+                "mesh. Exit the process and let tools/launch.py "
+                "respawn it under the new membership.",
+                os.environ.get("MXTPU_NUM_WORKERS"),
+                os.environ.get("MXTPU_WORKER_RANK"),
+                jax.process_count(), jax.process_index())
+        return  # re-calls are no-ops
     if os.environ.get("MXTPU_RANK_FROM_MPI") == "1" and \
             "MXTPU_WORKER_RANK" not in os.environ:
         # mpi launcher (tools/launch.py --launcher mpi): adopt the rank
@@ -186,7 +230,13 @@ def _maybe_init_distributed():
     except Exception as e:  # jax wraps grpc errors inconsistently
         msg = str(e).lower()
         if "should only be called once" in msg:
-            return  # raced another in-process initializer: already joined
+            # raced another in-process initializer: already joined —
+            # still publish the membership (the race winner may have
+            # been user code calling jax.distributed.initialize
+            # directly, which records nothing)
+            from . import elastic
+            elastic.note_membership(num, rank)
+            return
         if rank == 0 and ("address already in use" in msg or
                           "address in use" in msg or
                           "failed to bind" in msg):
@@ -196,6 +246,12 @@ def _maybe_init_distributed():
             "%s. Exiting so the launcher can restart the job instead "
             "of hanging in bring-up forever." % (coord, rank, num, e)
         ) from e
+    # joined: publish the membership this process runs under — feeds the
+    # elastic.world_size gauge / elastic.transitions counter (a restart
+    # at a different world size counts via MXTPU_PREV_WORLD_SIZE) and
+    # the postmortem membership block
+    from . import elastic
+    elastic.note_membership(num, rank)
 
 
 def _wait_for_coordinator(coord, deadline_s):

@@ -640,9 +640,22 @@ class CheckpointManager:
                          sym_json.encode("utf-8"),
                          retries=self._retries, backoff=self._backoff)
 
-        manifest = {"version": 1, "epoch": int(epoch), "files": files,
+        # membership stamp (elastic resume, ROBUSTNESS.md §9): which
+        # world wrote this checkpoint.  Informational for the replicated
+        # data-parallel path — latest()/load() accept ANY world_size
+        # (resume at N±k re-partitions only the data shard assignment,
+        # elastic.shard_for_epoch) — and the future sharded-update
+        # (ZeRO-1) reshard will key off it.  Legacy version-1 manifests
+        # without these fields keep loading: every reader treats them
+        # as optional.
+        from . import elastic as _elastic
+        mem = _elastic.membership()
+        manifest = {"version": 2, "epoch": int(epoch), "files": files,
                     "symbol": os.path.basename(self.symbol_path())
-                    if sym_json is not None else None}
+                    if sym_json is not None else None,
+                    "world_size": mem["world_size"],
+                    "rank": mem["rank"],
+                    "attempt": mem["attempt"]}
         atomic_write(self.manifest_path(epoch),
                      json.dumps(manifest, indent=1).encode("utf-8"),
                      retries=self._retries, backoff=self._backoff)
@@ -749,6 +762,23 @@ class CheckpointManager:
     def complete_epochs(self):
         """All epochs whose checkpoints fully verify, ascending."""
         return [e for e in self._manifest_epochs() if self.validate(e)]
+
+    def manifest_info(self, epoch):
+        """The commit record for ``epoch`` as a dict, or None when no
+        manifest exists/parses.  Carries the membership stamp for
+        version-2 manifests (``world_size``/``rank``/``attempt``);
+        readers must treat those keys as optional — version-1 manifests
+        (pre-elastic) lack them, and such checkpoints still load at any
+        world size (test_checkpoint_compat pins this).  Drains the async
+        write queue first, like every other read path: the manifest of a
+        checkpoint just saved under MXTPU_ASYNC_CKPT=1 may still be in
+        flight."""
+        flush_async(raise_errors=False)
+        try:
+            with open(self.manifest_path(epoch), "rb") as f:
+                return json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError):
+            return None
 
     def latest(self):
         """Newest epoch with a complete, checksum-verified checkpoint, or

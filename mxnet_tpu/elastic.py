@@ -1,0 +1,189 @@
+"""Elastic job membership: world size as a per-restart decision.
+
+The launcher (``tools/launch.py --elastic``) may drop a permanently
+failing rank from the next restart attempt and re-admit it later, so a
+worker can come up in a job whose world size differs from the one that
+wrote its checkpoints.  This module is the worker-side half of that
+contract:
+
+- **membership**: the env-described identity of this worker inside the
+  current attempt — contiguous ``rank`` in a ``world_size``-process job,
+  plus the stable ``slot`` id the launcher tracks across evictions
+  (``MXTPU_WORKER_SLOT``; a re-ranked survivor keeps its slot while its
+  rank shifts down) and the restart ``attempt`` counter.
+- **transition accounting**: ``note_membership`` feeds the
+  ``elastic.world_size`` gauge and the ``elastic.transitions`` counter
+  (a transition = the world size this process observes differs from the
+  previous observation, including the previous *attempt*'s world via
+  ``MXTPU_PREV_WORLD_SIZE`` exported by the launcher).  The flight
+  recorder's crash postmortem carries :func:`snapshot` so "what did the
+  job look like when it died" is always in the record.
+- **deterministic reshard**: :func:`shard_for_epoch` partitions an
+  epoch's sample indices over the *current* world.  The permutation is
+  seeded by the epoch alone — never by the world size — so the union of
+  all ranks' shards is every sample exactly once for ANY world size,
+  and a job resumed at N−1 (or re-grown to N) mid-run replays the epoch
+  with full, non-overlapping coverage.  Params/opt-state are replicated
+  in the data-parallel path, so this re-partition IS the whole resume
+  story; sharded-update regimes (ZeRO-1, arXiv 2004.13336) will layer a
+  state reshard on top of the same membership signal.
+
+Everything here reads plain env/process state — no jax import — so the
+checkpoint layer and the launcher-side tests can use it before (or
+without) a backend.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as _np
+
+__all__ = ["membership", "note_membership", "snapshot", "shard_for_epoch",
+           "transitions"]
+
+_lock = threading.Lock()
+_last_world = None      # last world size this process observed
+_last_rank = None       # rank passed with that observation (live mesh
+                        # state — authoritative over env when they skew)
+_transitions = 0        # world-size changes observed by this process
+
+
+def _env_int(name, default=None):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def membership():
+    """The launch-contract view of this worker, re-read from env on
+    every call (a restarted process sees the new attempt's exports; an
+    in-process world change re-reads them too).  Keys:
+
+    - ``world_size`` / ``rank``: the contiguous per-attempt contract
+      (``MXTPU_NUM_WORKERS`` / ``MXTPU_WORKER_RANK``; 1 / 0 standalone).
+    - ``slot``: launcher-stable worker identity across re-rankings
+      (``MXTPU_WORKER_SLOT``; equals rank when the launcher predates
+      elastic mode or the job never changed size).
+    - ``attempt``: restart attempt (``MXTPU_RESTART_ATTEMPT``, 0 based).
+    - ``prev_world_size``: the previous attempt's world size as exported
+      by the launcher (None on attempt 0 / non-elastic launchers).
+    - ``coordinator``: the jax.distributed coordinator address, if any.
+    """
+    world = _env_int("MXTPU_NUM_WORKERS", 1) or 1
+    rank = _env_int("MXTPU_WORKER_RANK", 0) or 0
+    return {
+        "world_size": world,
+        "rank": rank,
+        "slot": _env_int("MXTPU_WORKER_SLOT", rank),
+        "attempt": _env_int("MXTPU_RESTART_ATTEMPT", 0) or 0,
+        "prev_world_size": _env_int("MXTPU_PREV_WORLD_SIZE"),
+        "coordinator": os.environ.get("MXTPU_COORDINATOR"),
+    }
+
+
+def note_membership(world_size=None, rank=None):
+    """Record the membership this process is running under (called from
+    distributed bring-up and from the KVStore's world-change check).
+    Sets the ``elastic.world_size`` gauge; increments
+    ``elastic.transitions`` when the observed world size differs from
+    the last observation — seeding the "last" value from
+    ``MXTPU_PREV_WORLD_SIZE`` so the first observation of a freshly
+    restarted process counts the cross-attempt reshard too."""
+    global _last_world, _last_rank, _transitions
+    mem = membership()
+    if world_size is None:
+        world_size = mem["world_size"]
+    if rank is None:
+        rank = mem["rank"]
+    changed = False
+    with _lock:
+        prev = _last_world
+        if prev is None:
+            prev = mem["prev_world_size"]
+        if prev is not None and prev != world_size:
+            _transitions += 1
+            changed = True
+        _last_world = world_size
+        _last_rank = rank
+    try:
+        from . import telemetry as _telemetry
+        _telemetry.gauge("elastic.world_size").set(world_size)
+        if changed:
+            _telemetry.counter("elastic.transitions").inc()
+    except Exception:
+        pass  # interpreter teardown; membership note must never raise
+    return changed
+
+
+def transitions():
+    """World-size changes observed by this process (incl. the one
+    implied by MXTPU_PREV_WORLD_SIZE at restart)."""
+    with _lock:
+        return _transitions
+
+
+def snapshot():
+    """Membership block for the crash postmortem / health dumps: the
+    current env contract plus this process's transition count and the
+    last live-mesh observation (``note_membership``'s arguments — the
+    authoritative world/rank when the env and the joined mesh skew,
+    e.g. a harness re-exported env inside one process)."""
+    doc = membership()
+    with _lock:
+        doc["transitions"] = _transitions
+        doc["last_noted_world_size"] = _last_world
+        doc["last_noted_rank"] = _last_rank
+    return doc
+
+
+def shard_for_epoch(num_samples, epoch, rank=None, world_size=None,
+                    seed=None):
+    """Deterministic, world-size-agnostic data shard for one epoch.
+
+    Returns the sample indices rank ``rank`` owns in an epoch of
+    ``num_samples`` samples under a ``world_size``-way split (both
+    default to the current membership).  Properties the elastic resume
+    path depends on:
+
+    - The epoch permutation is seeded by ``(seed, epoch)`` ONLY — two
+      jobs at different world sizes draw the *same* permutation, so the
+      shards are a contiguous partition of one fixed order: across all
+      ranks every sample appears exactly once, for any world size.  A
+      mid-epoch reshard replays the epoch from its checkpoint with full
+      coverage and no duplicates.
+    - Epoch-seeded, not constant: consecutive epochs see different
+      orders (the usual shuffle), and a restart replays the interrupted
+      epoch's order bit-identically.
+    - Remainder samples go to the lowest ranks (rank < num_samples %
+      world_size owns one extra) — still a partition, just uneven by at
+      most one.
+
+    ``seed`` defaults to ``MXTPU_DATA_SEED`` (0 when unset).
+    """
+    mem = None
+    if rank is None or world_size is None:
+        mem = membership()
+    if rank is None:
+        rank = mem["rank"]
+    if world_size is None:
+        world_size = mem["world_size"]
+    if world_size < 1:
+        raise ValueError("world_size must be >= 1, got %d" % world_size)
+    if not 0 <= rank < world_size:
+        raise ValueError("rank %d outside world of %d" % (rank, world_size))
+    if seed is None:
+        seed = _env_int("MXTPU_DATA_SEED", 0) or 0
+    # RandomState (MT19937) is stable across numpy versions by contract;
+    # mixing epoch into the seed keeps one draw per epoch, order-free
+    order = _np.random.RandomState(
+        (int(seed) * 1_000_003 + int(epoch)) % (2 ** 32)).permutation(
+            int(num_samples))
+    base, extra = divmod(int(num_samples), int(world_size))
+    start = rank * base + min(rank, extra)
+    stop = start + base + (1 if rank < extra else 0)
+    return order[start:stop]

@@ -35,6 +35,13 @@ Sites wired in this package:
 - ``kv.hang``             wedge inside a KVStore collective/barrier
                           (peer-loss deadlock stand-in).
 - ``ckpt.write.stall``    wedge an atomic_write (stuck NFS stand-in).
+- ``worker.lost``         permanent rank death: hard ``os._exit(77)``
+                          from the fit loop — no atexit hooks, no
+                          cleanup, exactly a host vanishing.  Exit 77
+                          is retryable to tools/launch.py, and elastic
+                          mode (--elastic) evicts the rank after
+                          ``--evict-after`` consecutive losses so the
+                          job resumes at N-1 (ROBUSTNESS.md §9).
 
 The ``*.stall``/``kv.hang`` sites simulate HANGS, not crashes: they
 sleep ``MXTPU_FAULT_STALL_SECS`` (default 3600) without renewing any
@@ -56,8 +63,13 @@ import zlib
 
 from .base import MXNetError
 
-__all__ = ["FaultInjected", "configure", "reset", "is_active", "trigger",
-           "check", "stall_if", "fire_count", "fire_counts"]
+__all__ = ["FaultInjected", "EXIT_WORKER_LOST", "configure", "reset",
+           "is_active", "trigger", "check", "stall_if", "exit_if",
+           "fire_count", "fire_counts"]
+
+# exit-code contract with tools/launch.py (WORKER_LOST_EXIT there):
+# retryable, and the elastic policy counts it toward eviction
+EXIT_WORKER_LOST = 77
 
 
 class FaultInjected(MXNetError):
@@ -191,6 +203,23 @@ def stall_if(site):
     end = _time.monotonic() + secs
     while _time.monotonic() < end:
         _time.sleep(min(0.5, max(0.0, end - _time.monotonic())))
+
+
+def exit_if(site, code=EXIT_WORKER_LOST):
+    """Simulate PERMANENT worker loss when ``site`` triggers: one stderr
+    line naming the site, then ``os._exit(code)`` — hard, skipping
+    atexit/excepthook/postmortem dumps, because the failure this stands
+    in for (host dies, kernel OOM-kill, preemption) runs no cleanup
+    either.  The launcher sees a retryable exit; with ``--elastic`` the
+    rank is evicted once its consecutive-failure streak crosses
+    ``--evict-after`` and the job resumes at N-1."""
+    if not trigger(site):
+        return
+    import sys
+    print("mxnet_tpu.fault: [fault injection] site %r fired — "
+          "simulating permanent worker loss, hard exit %d"
+          % (site, code), file=sys.stderr, flush=True)
+    os._exit(code)
 
 
 def fire_count(site):

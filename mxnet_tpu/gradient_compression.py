@@ -93,6 +93,18 @@ class TwoBitCompression:
         self._residuals = {}
         self._decode_sum_jit = None
 
+    def reset_state(self):
+        """Drop all world-coupled state: the error-feedback residuals
+        (each rank's residual encodes quantization error against a sum
+        over a SPECIFIC worker set — after an elastic world-size change
+        it would silently corrupt the first compressed push) and the
+        decode-sum program (its ``out_shardings`` bake in the old worker
+        mesh).  Called by ``KVStore._check_world`` on membership change;
+        losing the residuals costs one step of quantization error, the
+        same price a fresh rank pays."""
+        self._residuals.clear()
+        self._decode_sum_jit = None
+
     # -- local (single-process) path ------------------------------------
     def compress(self, key, data):
         """Quantize ``data`` (a jax.Array) against key's residual.

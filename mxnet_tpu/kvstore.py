@@ -71,6 +71,7 @@ class KVStore:
         self._compressor = None
         self._worker_mesh = None
         self._allreduce_jit = None
+        self._cached_world = None  # world size the caches were built for
 
     # -- identity ----------------------------------------------------------
     @property
@@ -90,6 +91,32 @@ class KVStore:
             import jax
             return jax.process_count()
         return 1
+
+    def _check_world(self):
+        """Invalidate every world-size-derived cache when the process
+        count changed since it was built (an elastic restart re-joined
+        the mesh at N±k inside the same process, or a test re-pointed
+        the backend).  The worker mesh and the jitted allreduce bake the
+        OLD device set into their shardings — executing them would
+        reduce over ranks that no longer exist; and the gradient-
+        compression error-feedback residuals belong to the old world's
+        quantization stream — replaying them into the first post-reshard
+        push would silently corrupt it (each rank's residual encodes
+        error against a sum over a different worker set)."""
+        world = self.num_workers
+        if self._cached_world is None:
+            self._cached_world = world
+            return
+        if world == self._cached_world:
+            return
+        self._worker_mesh = None
+        self._allreduce_jit = None
+        if self._compressor is not None:
+            self._compressor.reset_state()
+        from . import elastic as _elastic
+        _elastic.note_membership(world, self.rank)
+        _telemetry.counter("kv.world_changes").inc()
+        self._cached_world = world
 
     # -- core ops ----------------------------------------------------------
     def init(self, key, value):
@@ -198,6 +225,7 @@ class KVStore:
     def _push(self, key, value):
         keys, vals = _flatten_pairs(key, value)
         _telemetry.counter("kv.push_keys").inc(len(keys))
+        self._check_world()
         for k in keys:
             if k not in self._store:
                 raise MXNetError("key %s was not initialized" % str(k))
@@ -294,11 +322,19 @@ class KVStore:
 
         Idempotent: calling again with identical params keeps the live
         compressor (rebuilding would silently discard the accumulated
-        error-feedback residuals mid-training, ADVICE r3)."""
+        error-feedback residuals mid-training, ADVICE r3) — UNLESS the
+        world size changed since, in which case the residual stream
+        belongs to the old worker set and keeping it would corrupt the
+        first post-reshard push (the elastic-restart bug this check
+        exists for; ``_check_world`` resets the live compressor the
+        same way mid-training)."""
         from .gradient_compression import create_compressor
         params = dict(compression_params)
         if getattr(self, "_compressor", None) is not None \
                 and params == self._compress_params:
+            # _check_world no-ops on a matching world and drops the
+            # stale residuals + mesh caches on a changed one
+            self._check_world()
             return
         self._compress_params = params
         self._compressor = create_compressor(self._compress_params)
@@ -309,6 +345,7 @@ class KVStore:
         # or dead) becomes a diagnosed stall instead of an eternal hang
         with _watchdog.guard("kv.barrier", timeout=_collective_timeout()):
             _fault.stall_if("kv.hang")
+            self._check_world()
             if self._kind.startswith("dist") and self.num_workers > 1:
                 from jax.experimental import multihost_utils
                 multihost_utils.sync_global_devices("kvstore_barrier")

@@ -15,6 +15,7 @@ import time
 
 import numpy as _np
 
+from .. import fault as _fault
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from ..base import MXNetError
@@ -233,6 +234,10 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
+                # deterministic permanent-rank-death injection: a hard
+                # os._exit(77) between steps (the elastic runbook's
+                # "kill a rank mid-run", ROBUSTNESS.md §9)
+                _fault.exit_if("worker.lost")
                 self.fit_step(data_batch)
                 # progress lease for the split fallback path too
                 # (Module.fit_step renews on the fused path; renewal is

@@ -670,6 +670,14 @@ def dump_postmortem(reason, path=None):
         doc["watchdog"] = _watchdog.snapshot()
     except Exception:
         pass  # interpreter teardown
+    try:
+        # elastic context: world_size/rank/slot/attempt at the moment of
+        # death — a postmortem from a resharded job must say which
+        # membership it died under (ROBUSTNESS.md §9)
+        from . import elastic as _elastic
+        doc["membership"] = _elastic.snapshot()
+    except Exception:
+        pass  # interpreter teardown
     # the plain writer: a ckpt.write.* fault armed for the checkpoint
     # layer must not fire here and tear the record of the crash itself
     from .checkpoint import _plain_atomic_write

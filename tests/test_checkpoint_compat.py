@@ -237,6 +237,107 @@ def test_load_py2_long_tuple_conv_json(tmp_path):
     assert out[0].shape == (1, 4, 8, 8)
 
 
+def _train_module(tmp_path, seed=0):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(seed)
+    X = rs.randn(8, 6).astype(np.float32)
+    it = mx.io.NDArrayIter(X, np.zeros(8, np.float32), batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian"))
+    mod.init_optimizer(kvstore=None, optimizer="adam")
+    for b in it:
+        mod.fit_step(b)
+    return mod
+
+
+@pytest.mark.elastic
+def test_manifest_records_world_size_and_legacy_manifest_still_loads(
+        tmp_path, monkeypatch):
+    """Version-2 manifests stamp the writing membership; a manifest
+    WITHOUT the stamp (pre-elastic version 1) must keep validating and
+    loading — the legacy-probe compatibility contract."""
+    import json
+    from mxnet_tpu.checkpoint import CheckpointManager
+    monkeypatch.setenv("MXTPU_NUM_WORKERS", "4")
+    monkeypatch.setenv("MXTPU_WORKER_RANK", "1")
+    monkeypatch.setenv("MXTPU_RESTART_ATTEMPT", "2")
+    mod = _train_module(tmp_path)
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    mgr = CheckpointManager(prefix)
+    info = mgr.manifest_info(1)
+    assert info["version"] == 2 and info["world_size"] == 4
+    assert info["rank"] == 1 and info["attempt"] == 2
+    # strip the stamp back to a version-1 manifest in place
+    for k in ("world_size", "rank", "attempt"):
+        info.pop(k)
+    info["version"] = 1
+    with open(mgr.manifest_path(1), "w") as f:
+        json.dump(info, f)
+    mgr2 = CheckpointManager(prefix)
+    assert mgr2.validate(1) and mgr2.latest() == 1
+    epoch, args, auxs = mgr2.load()
+    assert epoch == 1 and "fc_weight" in args
+    assert mgr2.manifest_info(1).get("world_size") is None
+    assert mgr2.load_optimizer_states(1)  # framed states unaffected
+
+
+@pytest.mark.elastic
+def test_save_at_4_load_at_2_and_8_bit_identical(tmp_path, monkeypatch):
+    """Params and opt-state are replicated in the data-parallel path:
+    a checkpoint written at world 4 loads BIT-identically at world 2
+    and world 8 (elastic resume re-partitions only the data shards)."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+    monkeypatch.setenv("MXTPU_NUM_WORKERS", "4")
+    monkeypatch.setenv("MXTPU_WORKER_RANK", "0")
+    mod = _train_module(tmp_path)
+    want_args = {k: v.asnumpy().copy()
+                 for k, v in mod.get_params()[0].items()}
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3, save_optimizer_states=True)
+    want_states = CheckpointManager(prefix).load_optimizer_states(3)
+    for world in ("2", "8"):
+        monkeypatch.setenv("MXTPU_NUM_WORKERS", world)
+        mgr = CheckpointManager(prefix)
+        assert mgr.latest() == 3  # any-world manifests are acceptable
+        _, args, _ = mgr.load(3)
+        assert set(args) == set(want_args)
+        for k, want in want_args.items():
+            got = args[k].asnumpy()
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(got, want)  # bitwise
+        assert mgr.load_optimizer_states(3) == want_states
+
+
+@pytest.mark.elastic
+def test_mixed_progress_elects_newest_complete_any_world(tmp_path,
+                                                         monkeypatch):
+    """A crash that left checkpoints from different world sizes (and a
+    torn newest one) elects the newest COMPLETE checkpoint regardless
+    of which world wrote it."""
+    import os
+    from mxnet_tpu.checkpoint import CheckpointManager
+    mod = _train_module(tmp_path)
+    prefix = str(tmp_path / "model")
+    monkeypatch.setenv("MXTPU_NUM_WORKERS", "3")
+    mod.save_checkpoint(prefix, 1)
+    monkeypatch.setenv("MXTPU_NUM_WORKERS", "2")
+    mod.save_checkpoint(prefix, 2)
+    mod.save_checkpoint(prefix, 3)
+    with open(prefix + "-0003.params", "r+b") as f:
+        f.truncate(16)  # epoch 3 torn mid-crash
+    mgr = CheckpointManager(prefix)
+    assert mgr.latest() == 2
+    assert mgr.manifest_info(2)["world_size"] == 2
+    assert mgr.manifest_info(1)["world_size"] == 3
+    epoch, args, _ = mgr.load()
+    assert epoch == 2 and "fc_weight" in args
+    assert os.path.exists(prefix + "-0003.manifest.json")
+
+
 def test_module_checkpoint_binary_roundtrip(tmp_path):
     data = mx.sym.Variable("data")
     net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")

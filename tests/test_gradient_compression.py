@@ -84,6 +84,62 @@ def test_unsupported_compression_type_raises():
         kv.set_gradient_compression({"type": "fp8"})
 
 
+@pytest.mark.elastic
+def test_world_change_invalidates_residuals_and_allreduce_caches(
+        monkeypatch):
+    """The elastic bugfix: an in-process world-size change (elastic
+    restart rejoin) must drop every world-coupled KVStore cache — the
+    error-feedback residuals encode quantization error against a sum
+    over the OLD worker set (replaying them would silently corrupt the
+    first post-reshard push), and the cached worker mesh / jitted
+    allreduce / decode-sum programs bake the old device set into their
+    shardings."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.kvstore import KVStore
+    kv = mx.kv.create("dist_sync")  # no coordinator: world is 1
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    comp = kv._compressor
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.push("w", mx.nd.full((4,), 0.3))  # residual 0.3 accumulates
+    assert np.allclose(np.asarray(comp._residuals["w"]), 0.3)
+    # plant sentinels for the world-coupled jit/mesh caches
+    kv._allreduce_jit = object()
+    kv._worker_mesh = object()
+    comp._decode_sum_jit = object()
+    # same world: idempotent re-set keeps the live compressor AND its
+    # residuals (the ADVICE-r3 contract, still intact)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert kv._compressor is comp and "w" in comp._residuals
+    # world changes 1 -> 2: every cache drops
+    monkeypatch.setattr(KVStore, "num_workers",
+                        property(lambda self: 2))
+    kv._check_world()
+    assert kv._allreduce_jit is None and kv._worker_mesh is None
+    assert comp._residuals == {} and comp._decode_sum_jit is None
+    assert kv._cached_world == 2
+    from mxnet_tpu import telemetry
+    assert telemetry.counter("kv.world_changes").value >= 1
+
+
+@pytest.mark.elastic
+def test_set_gradient_compression_world_aware(monkeypatch):
+    """Re-calling set_gradient_compression with identical params after
+    a world change must NOT keep the stale residual stream (the bug:
+    the idempotence early-return ignored the world)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.kvstore import KVStore
+    kv = mx.kv.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    comp = kv._compressor
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.push("w", mx.nd.full((4,), 0.3))
+    assert "w" in comp._residuals
+    monkeypatch.setattr(KVStore, "num_workers",
+                        property(lambda self: 3))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert comp._residuals == {}  # stale stream dropped, not carried
+
+
 COMPRESSED_WORKER = """
 import os, sys
 sys.path.insert(0, %(repo)r)

@@ -26,6 +26,21 @@ Restarts warm-start: every attempt shares one AOT executable cache
 (--aot-cache-dir → MXTPU_AOT_CACHE_DIR + jax's persistent compile
 cache), so a restarted rank deserializes the compiled fit step instead
 of paying trace+compile again — see PERF.md §12.
+
+Elastic mode (``--elastic``, ROBUSTNESS.md §9): world size becomes a
+per-restart decision.  Each worker slot (its stable identity across
+attempts — hostfile line for ssh, original index locally) accumulates a
+consecutive-failure count; when the same slot is blamed ``--evict-after``
+times in a row, or its exit classifies permanent from attempt 1 on
+(attempt-0 permanent failures still fail the job fast — a usage/import
+error hits every rank identically), the next attempt drops
+it — survivors are re-ranked contiguously (fresh
+MXTPU_NUM_WORKERS/MXTPU_WORKER_RANK/DMLC_* exports, fresh coordinator
+port) and resume from the newest complete checkpoint at N-1.  Evicted
+slots sit out ``--readmit-after`` attempts, then rejoin (scale back up
+toward ``-n``); ``--min-workers`` floors the shrink.  Every transition
+is recorded in ``<run-dir>/membership.json``
+(``tools/perf_probe/telemetry_report.py`` renders it).
 - On real TPU pods, prefer the platform launcher (GKE/queued resources):
   every pod VM already runs one process; pass --use-env-ranks to adopt
   the platform-provided rank env instead of spawning.
@@ -33,6 +48,7 @@ of paying trace+compile again — see PERF.md §12.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shlex
 import shutil
@@ -43,10 +59,105 @@ import sys
 import tempfile
 import time
 
-# exit-code contract with mxnet_tpu/watchdog.py (kept literal here: the
-# launcher must work without the package importable on this host)
+# exit-code contract with mxnet_tpu/watchdog.py and mxnet_tpu/fault.py
+# (kept literal here: the launcher must work without the package
+# importable on this host)
 STALL_EXIT = 75         # EX_TEMPFAIL: watchdog stall — retryable
 PORT_IN_USE_EXIT = 76   # coordinator port bind failure — retryable
+WORKER_LOST_EXIT = 77   # worker.lost fault site: simulated permanent
+                        # rank death — retryable; elastic mode evicts
+
+
+class _Membership:
+    """Which worker slots are in the job, attempt by attempt.
+
+    A *slot* is a worker's stable identity across the whole launch
+    (locally its original index 0..n-1; over ssh its hostfile line), as
+    opposed to its *rank*, the contiguous per-attempt index survivors
+    are re-packed into.  Tracks per-slot consecutive-failure counts,
+    evictions, and re-admissions, and journals every transition into
+    ``<run-dir>/membership.json`` (schema ``mxtpu-membership-1``) so the
+    job's shape over time survives the launcher process."""
+
+    def __init__(self, args):
+        self.total = args.num_workers
+        self.active = list(range(args.num_workers))
+        # consecutive-failure streak: only the LAST blamed slot can have
+        # one (a failure blamed on any other slot resets it), so two
+        # scalars state the invariant a per-slot map would only obscure
+        self.blamed_slot = None
+        self.streak = 0
+        self.evicted_at = {}     # slot -> attempt whose failure evicted it
+        self.transitions = []
+        self.path = None
+        run_dir = getattr(args, "run_dir", None)
+        if run_dir:
+            self.path = os.path.join(run_dir, "membership.json")
+        self.record(0, "launch")
+
+    @property
+    def world_size(self):
+        return len(self.active)
+
+    def slot_of(self, rank):
+        """Map a per-attempt contiguous rank back to its stable slot."""
+        if 0 <= rank < len(self.active):
+            return self.active[rank]
+        return rank
+
+    def record(self, attempt, event, **extra):
+        entry = {"time": time.time(), "attempt": attempt, "event": event,
+                 "world_size": self.world_size,
+                 "active_slots": list(self.active),
+                 "evicted_slots": sorted(self.evicted_at)}
+        entry.update(extra)
+        self.transitions.append(entry)
+        self._flush()
+
+    def _flush(self):
+        if not self.path:
+            return
+        doc = {"schema": "mxtpu-membership-1", "total_slots": self.total,
+               "transitions": self.transitions}
+        tmp = "%s.tmp-%d" % (self.path, os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, self.path)
+        except OSError as e:  # the journal must never take the job down
+            print("launch.py: could not write %s: %s" % (self.path, e),
+                  file=sys.stderr, flush=True)
+
+    def note_failure(self, attempt, rank, rc, kind, reason):
+        """Blame ``rank``'s slot for this attempt's failure; the streak
+        is *consecutive* — a failure blamed on a different slot restarts
+        it at 1.  Returns the blamed slot."""
+        slot = self.slot_of(rank)
+        self.streak = self.streak + 1 if slot == self.blamed_slot else 1
+        self.blamed_slot = slot
+        self.record(attempt, "failure", slot=slot, rank=rank, rc=rc,
+                    kind=kind, reason=reason,
+                    consecutive_failures=self.streak)
+        return slot
+
+    def evict(self, attempt, slot, reason):
+        self.active.remove(slot)
+        self.evicted_at[slot] = attempt
+        self.record(attempt, "evict", slot=slot, reason=reason)
+
+    def readmit_due(self, attempt, sit_out):
+        """Evicted slots whose sit-out has elapsed by ``attempt``: a slot
+        evicted after attempt k sits out attempts k+1..k+sit_out and is
+        due again at k+sit_out+1."""
+        return sorted(s for s, at in self.evicted_at.items()
+                      if attempt > at + sit_out)
+
+    def readmit(self, attempt, slot):
+        del self.evicted_at[slot]
+        if self.blamed_slot == slot:
+            self.blamed_slot, self.streak = None, 0  # fresh on rejoin
+        self.active = sorted(self.active + [slot])
+        self.record(attempt, "readmit", slot=slot)
 
 
 def _cache_env(args):
@@ -203,24 +314,52 @@ def _monitor_procs(args, procs, heartbeat_dir=None, label="worker"):
         return -1, 1
 
 
-def _run_local_once(args, cmd, attempt):
-    """One local job attempt: spawn N workers wired to a fresh
+def _worker_env(args, mem, world, rank, slot, attempt, prev_world):
+    """The per-worker env contract for one attempt.  ``rank`` is the
+    contiguous per-attempt index (what jax.distributed and DMLC_* see);
+    ``slot`` is the launch-stable identity elastic eviction tracks —
+    equal until a membership change re-packs the survivors."""
+    env = {
+        "MXTPU_NUM_WORKERS": str(world),
+        "MXTPU_WORKER_RANK": str(rank),
+        "MXTPU_WORKER_SLOT": str(slot),
+        "MXTPU_RESTART_ATTEMPT": str(attempt),
+        # lets a restarted worker count the cross-attempt world change
+        # in its elastic.transitions telemetry (mxnet_tpu/elastic.py).
+        # Always set — "" reads as unset — so a stale value inherited
+        # from the launcher's own environment (nested launch, debug
+        # shell reusing a worker env) can't fabricate a transition.
+        "MXTPU_PREV_WORLD_SIZE":
+            "" if prev_world is None else str(prev_world),
+        # reference env contract (dmlc_tracker) for script compat
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(world),
+        "DMLC_NUM_SERVER": "0",
+        "DMLC_WORKER_ID": str(rank),
+    }
+    env.update(_cache_env(args))
+    return env
+
+
+def _run_local_once(args, cmd, attempt, mem, prev_world=None):
+    """One local job attempt: spawn the active workers wired to a fresh
     coordinator port (``--port 0`` re-picks per attempt, so a port left
     wedged by the previous attempt is simply abandoned) plus a fresh
     heartbeat run dir, then monitor to completion or teardown."""
     port = args.port or _free_port()
     coordinator = "127.0.0.1:%d" % port
     hb_dir = tempfile.mkdtemp(prefix="mxtpu-hb-")
+    world = mem.world_size
+    mem.record(attempt, "attempt_start", port=port)
     procs = []
     try:
-        for rank in range(args.num_workers):
+        for rank, slot in enumerate(mem.active):
             env = dict(os.environ)
+            env.update(_worker_env(args, mem, world, rank, slot,
+                                   attempt, prev_world))
             env.update({
                 # JAX multi-process coordination
                 "MXTPU_COORDINATOR": coordinator,
-                "MXTPU_NUM_WORKERS": str(args.num_workers),
-                "MXTPU_WORKER_RANK": str(rank),
-                "MXTPU_RESTART_ATTEMPT": str(attempt),
                 # per-rank heartbeat files — exported even when
                 # --heartbeat-timeout is 0: the files are the "where
                 # was it" record on any kill, and the worker watchdog's
@@ -228,13 +367,7 @@ def _run_local_once(args, cmd, attempt):
                 # MXTPU_POSTMORTEM_DIR is unset (cost: one small write
                 # per worker per second)
                 "MXTPU_HEARTBEAT_DIR": hb_dir,
-                # reference env contract (dmlc_tracker) for script compat
-                "DMLC_ROLE": "worker",
-                "DMLC_NUM_WORKER": str(args.num_workers),
-                "DMLC_NUM_SERVER": "0",
-                "DMLC_WORKER_ID": str(rank),
             })
-            env.update(_cache_env(args))
             if args.cpu_fake_devices:
                 env["JAX_PLATFORMS"] = "cpu"
                 env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -291,6 +424,10 @@ def classify_exit(rc):
     if rc == PORT_IN_USE_EXIT:
         return "retryable", ("exit code 76: coordinator port in use — "
                              "restart re-picks the port (--port 0)")
+    if rc == WORKER_LOST_EXIT:
+        return "retryable", ("exit code 77: worker lost (fault site "
+                             "worker.lost — simulated permanent rank "
+                             "death; --elastic evicts repeat offenders)")
     if rc == 2:
         return "permanent", ("exit code 2: usage/import-time error — "
                              "would fail identically on every attempt")
@@ -300,22 +437,84 @@ def classify_exit(rc):
 
 
 def _restart_loop(args, run_once, cmd):
-    """The classify → backoff → restart-from-checkpoints policy, shared
-    by the local and ssh launchers."""
+    """The classify → (evict/readmit) → backoff → restart-from-
+    checkpoints policy, shared by the local and ssh launchers.  With
+    ``--elastic`` the membership for each attempt is recomputed here:
+    a slot blamed for ``--evict-after`` consecutive failures (or one
+    permanent exit) is dropped and the survivors re-ranked; evicted
+    slots rejoin after sitting out ``--readmit-after`` attempts."""
+    mem = _Membership(args)
+    elastic = getattr(args, "elastic", False)
+    prev_world = None
     for attempt in range(args.max_restarts + 1):
-        failed_rank, rc = run_once(args, cmd, attempt)
+        if elastic and attempt:
+            for slot in mem.readmit_due(attempt, args.readmit_after):
+                if mem.world_size >= args.num_workers:
+                    break  # never above the launch size
+                mem.readmit(attempt, slot)
+                print("launch.py: re-admitting recovered worker slot %d "
+                      "for attempt %d (world size back up to %d)"
+                      % (slot, attempt, mem.world_size),
+                      file=sys.stderr, flush=True)
+        world = mem.world_size
+        failed_rank, rc = run_once(args, cmd, attempt, mem, prev_world)
         if failed_rank is None:
+            mem.record(attempt, "complete")
             return 0
-        if failed_rank == -1 or attempt == args.max_restarts:
+        if failed_rank == -1:
+            mem.record(attempt, "interrupted")
             return rc or 1
         kind, reason = classify_exit(rc)
-        print("launch.py: worker %d failure classified %s (%s)"
-              % (failed_rank, kind, reason), file=sys.stderr, flush=True)
+        slot = mem.note_failure(attempt, failed_rank, rc, kind, reason)
+        print("launch.py: attempt %d (world size %d): worker rank %d "
+              "(slot %d) failure classified %s (%s)"
+              % (attempt, world, failed_rank, slot, kind, reason),
+              file=sys.stderr, flush=True)
+        if attempt == args.max_restarts:
+            mem.record(attempt, "gave_up", rc=rc)
+            return rc or 1
+        evicted_now = []
+        if elastic:
+            # a PERMANENT exit evicts only once the job has proven it
+            # can run at all (attempt >= 1): exit codes cannot tell a
+            # bad HOST from a bad COMMAND, and a usage/import error hits
+            # every rank identically on the very first attempt — evicting
+            # healthy slots one per attempt would burn the whole restart
+            # budget re-proving it, so attempt-0 permanent failures fail
+            # fast below (and must not slip through the streak branch
+            # either — with --evict-after 1 a streak of 1 would).  A
+            # host that goes permanently bad mid-job still gets dropped
+            # on any later attempt.
+            if kind == "permanent":
+                should_evict = attempt > 0
+            else:
+                should_evict = mem.streak >= args.evict_after
+            if should_evict and slot in mem.active:
+                if world - 1 >= max(1, args.min_workers):
+                    why = ("exit classified permanent" if
+                           kind == "permanent" else
+                           "%d consecutive failures (--evict-after %d)"
+                           % (mem.streak, args.evict_after))
+                    mem.evict(attempt, slot, why)
+                    evicted_now.append(slot)
+                    print("launch.py: evicting worker slot %d (%s); "
+                          "next attempt runs at world size %d"
+                          % (slot, why, mem.world_size),
+                          file=sys.stderr, flush=True)
+                    # a permanent single-rank failure is survivable once
+                    # the rank is out of the job
+                    kind = "retryable"
+                elif kind != "permanent":
+                    print("launch.py: NOT evicting slot %d — world size "
+                          "%d already at --min-workers %d floor"
+                          % (slot, world, args.min_workers),
+                          file=sys.stderr, flush=True)
         if kind == "permanent":
             print("launch.py: not restarting — failure is not retryable "
                   "(%d restart attempts preserved)"
                   % (args.max_restarts - attempt),
                   file=sys.stderr, flush=True)
+            mem.record(attempt, "gave_up", rc=rc)
             return rc or 1
         # exponential backoff: crash loops (a flaky host, a wedged
         # coordinator port) get geometrically more breathing room
@@ -326,9 +525,13 @@ def _restart_loop(args, run_once, cmd):
                   file=sys.stderr, flush=True)
             time.sleep(delay)
         print("launch.py: restarting job from checkpoints "
-              "(attempt %d/%d) after worker %d failure"
-              % (attempt + 1, args.max_restarts, failed_rank),
+              "(attempt %d/%d) after worker %d failure: world size "
+              "%d -> %d, evicted now %s, sitting out %s"
+              % (attempt + 1, args.max_restarts, failed_rank, world,
+                 mem.world_size, evicted_now or "none",
+                 sorted(mem.evicted_at) or "none"),
               file=sys.stderr, flush=True)
+        prev_world = world
     return 1
 
 
@@ -336,48 +539,55 @@ def launch_local(args, cmd):
     if args.dry_run:
         port = args.port or _free_port()
         for rank in range(args.num_workers):
-            envs = ("MXTPU_COORDINATOR=127.0.0.1:%d MXTPU_NUM_WORKERS=%d "
-                    "MXTPU_WORKER_RANK=%d DMLC_ROLE=worker "
-                    "DMLC_NUM_WORKER=%d DMLC_WORKER_ID=%d"
-                    % (port, args.num_workers, rank, args.num_workers,
-                       rank))
+            # the real per-worker contract, so a pasted line reproduces
+            # what a launched worker actually sees
+            env = _worker_env(args, None, args.num_workers, rank, rank,
+                              0, None)
+            env["MXTPU_COORDINATOR"] = "127.0.0.1:%d" % port
+            envs = " ".join("%s=%s" % (k, shlex.quote(v))
+                            for k, v in sorted(env.items()))
             print("%s %s" % (envs,
                              " ".join(shlex.quote(c) for c in cmd)))
         return 0
     return _restart_loop(args, _run_local_once, cmd)
 
 
-def _ssh_commands(args, cmd, attempt=0):
-    """→ [ssh argv per worker] — one worker per hostfile entry."""
+def _ssh_commands(args, cmd, attempt=0, mem=None, prev_world=None):
+    """→ [ssh argv per worker] — one worker per ACTIVE slot's hostfile
+    entry (elastic mode drops an evicted slot's host from the attempt
+    and readmits it later; the slot→host binding is stable)."""
     assert args.hostfile, "--launcher ssh requires -H hostfile"
     with open(args.hostfile) as f:
         hosts = [h.strip() for h in f if h.strip()]
     hosts = (hosts * args.num_workers)[:args.num_workers]
+    slots = list(mem.active) if mem is not None \
+        else list(range(args.num_workers))
+    world = len(slots)
     port = args.port or _free_port()
     coordinator = "%s:%d" % (socket.gethostname(), port)
+    if mem is not None:
+        mem.record(attempt, "attempt_start", port=port)
     out = []
-    # warm-start caches assume a shared filesystem across hosts (the
-    # usual pod setup); a host-local path just cold-starts harmlessly
-    cache_envs = "".join(" %s=%s" % (k, shlex.quote(v))
-                         for k, v in sorted(_cache_env(args).items()))
-    for rank, host in enumerate(hosts):
-        envs = ("MXTPU_COORDINATOR=%s MXTPU_NUM_WORKERS=%d "
-                "MXTPU_WORKER_RANK=%d MXTPU_RESTART_ATTEMPT=%d "
-                "DMLC_ROLE=worker DMLC_NUM_WORKER=%d "
-                "DMLC_WORKER_ID=%d%s"
-                % (shlex.quote(coordinator), args.num_workers, rank,
-                   attempt, args.num_workers, rank, cache_envs))
+    for rank, slot in enumerate(slots):
+        # _worker_env covers the cache exports too: warm-start caches
+        # assume a shared filesystem across hosts (the usual pod setup);
+        # a host-local path just cold-starts harmlessly
+        env = _worker_env(args, mem, world, rank, slot, attempt,
+                          prev_world)
+        env["MXTPU_COORDINATOR"] = coordinator
+        envs = " ".join("%s=%s" % (k, shlex.quote(v))
+                        for k, v in sorted(env.items()))
         remote = "cd %s; %s %s" % (shlex.quote(os.getcwd()), envs,
                                    " ".join(shlex.quote(c) for c in cmd))
         # -tt forces a remote tty so the remote process group dies with
         # the ssh client when the monitor tears the job down — without
         # it one remote worker failing leaves the others running forever
         out.append(["ssh", "-tt", "-o", "StrictHostKeyChecking=no",
-                    "-o", "BatchMode=yes", host, remote])
+                    "-o", "BatchMode=yes", hosts[slot], remote])
     return out
 
 
-def _run_ssh_once(args, cmd, attempt):
+def _run_ssh_once(args, cmd, attempt, mem, prev_world=None):
     """One ssh job attempt, monitored like the local launcher: the first
     remote worker failing (its ssh client exits nonzero) tears the whole
     job down and reports the failed rank, instead of the old
@@ -385,7 +595,8 @@ def _run_ssh_once(args, cmd, attempt):
     No heartbeat files here — they are host-local; stall defense on ssh
     jobs is the in-process watchdog (exit 75 propagates through ssh)."""
     procs = [subprocess.Popen(argv)
-             for argv in _ssh_commands(args, cmd, attempt)]
+             for argv in _ssh_commands(args, cmd, attempt, mem,
+                                       prev_world)]
     return _monitor_procs(args, procs, label="ssh worker")
 
 
@@ -462,6 +673,35 @@ def main(argv=None):
                         help="virtual devices per worker process "
                         "(xla_force_host_platform_device_count; test "
                         "multi-chip-per-host jobs without hardware)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="make world size a per-restart decision: a "
+                        "worker slot blamed for --evict-after "
+                        "consecutive failures (or one permanent exit) "
+                        "is dropped from the next attempt — survivors "
+                        "re-ranked contiguously, job resumes from "
+                        "checkpoints at N-1 — and re-admitted after "
+                        "sitting out --readmit-after attempts; "
+                        "transitions recorded in <run-dir>/"
+                        "membership.json")
+    parser.add_argument("--min-workers", type=int, default=1,
+                        help="elastic shrink floor: never evict below "
+                        "this many workers (default 1)")
+    parser.add_argument("--evict-after", type=int, default=2,
+                        help="consecutive failures of the same worker "
+                        "slot before elastic mode evicts it (default 2; "
+                        "a permanent exit evicts immediately from "
+                        "attempt 1 on — an attempt-0 permanent failure "
+                        "still fails the job fast, since a usage/import "
+                        "error hits every rank identically)")
+    parser.add_argument("--readmit-after", type=int, default=1,
+                        help="attempts an evicted slot sits out before "
+                        "being re-admitted (default 1)")
+    parser.add_argument("--run-dir", default=None,
+                        help="job run dir holding membership.json (the "
+                        "elastic transition journal; render with "
+                        "tools/perf_probe/telemetry_report.py).  "
+                        "Default: a per-launch temp dir when --elastic, "
+                        "else none")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="restart the whole job this many times when "
                         "a worker dies (workers resume from their own "
@@ -496,6 +736,23 @@ def main(argv=None):
     args = parser.parse_args(argv)
     cmd = [c for c in args.command if c != "--"]
     assert cmd, "no command given"
+    if args.elastic and args.launcher == "mpi":
+        print("launch.py: --elastic is a local/ssh launcher feature "
+              "(mpirun owns process placement; use your MPI runtime's "
+              "fault tolerance there) — ignoring it", file=sys.stderr,
+              flush=True)
+        args.elastic = False
+    if args.elastic and not args.run_dir:
+        # the membership journal is the record of what the job looked
+        # like over time — keep it after exit (unlike the heartbeat
+        # dirs), and say where it lives
+        args.run_dir = tempfile.mkdtemp(prefix="mxtpu-run-")
+    if args.run_dir and args.launcher != "mpi":
+        # (mpi bypasses _restart_loop/_Membership: no journal to announce)
+        os.makedirs(args.run_dir, exist_ok=True)
+        print("launch.py: membership journal at %s"
+              % os.path.join(args.run_dir, "membership.json"),
+              file=sys.stderr, flush=True)
     auto_cache_dir = None
     if args.aot_cache_dir == "off":
         args.aot_cache_dir = None

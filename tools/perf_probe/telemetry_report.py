@@ -10,8 +10,16 @@ Input files are either:
   flight recorder into ``MXTPU_POSTMORTEM_DIR`` — rendered as the crash
   reason, step_stats, fault firings, and the last-K per-step table.
 
+or:
+
+- an elastic membership journal (schema ``mxtpu-membership-1``) written
+  by ``tools/launch.py --elastic`` into ``<run-dir>/membership.json`` —
+  rendered as the world-size transition timeline (attempt starts,
+  failures with blamed slot/exit, evictions, re-admissions).
+
 Usage:
-    python tools/perf_probe/telemetry_report.py RUN.jsonl [POSTMORTEM.json ...]
+    python tools/perf_probe/telemetry_report.py RUN.jsonl [POSTMORTEM.json \
+        MEMBERSHIP.json ...]
 
 See OBSERVABILITY.md for the metric-name and schema contract.
 """
@@ -137,10 +145,56 @@ def _render_ckpt_pipeline(doc, out):
            rows, out)
 
 
+def render_membership(doc, out):
+    """The elastic membership journal as a timeline: one row per
+    transition, so "what did the job's world look like over time" reads
+    straight down (the launcher-side sibling of the in-worker
+    ``elastic.*`` metrics)."""
+    trans = doc.get("transitions") or []
+    n_evict = sum(1 for t in trans if t.get("event") == "evict")
+    n_readmit = sum(1 for t in trans if t.get("event") == "readmit")
+    out.write("== MEMBERSHIP: %d slot(s), %d transition(s), %d "
+              "eviction(s), %d re-admission(s) ==\n"
+              % (doc.get("total_slots", 0), len(trans), n_evict,
+                 n_readmit))
+    t0 = trans[0].get("time", 0) if trans else 0
+    rows = []
+    for t in trans:
+        event = t.get("event", "?")
+        detail = ""
+        if event == "failure":
+            detail = "slot %s rank %s rc=%s %s" % (
+                t.get("slot"), t.get("rank"), t.get("rc"),
+                t.get("kind", ""))
+        elif event in ("evict", "readmit"):
+            detail = "slot %s%s" % (
+                t.get("slot"),
+                (": " + t["reason"]) if t.get("reason") else "")
+        elif event == "attempt_start":
+            detail = "port %s" % t.get("port")
+        rows.append(("+" + _fmt_s(t.get("time", 0) - t0),
+                     t.get("attempt"), event, t.get("world_size"),
+                     ",".join(str(s) for s in
+                              t.get("active_slots", [])) or "-",
+                     ",".join(str(s) for s in
+                              t.get("evicted_slots", [])) or "-",
+                     detail))
+    _table(("when", "attempt", "event", "world", "active", "evicted",
+            "detail"), rows, out)
+
+
 def render_postmortem(doc, out):
     """Pretty-print a flight-recorder crash postmortem."""
     out.write("== POSTMORTEM (pid %s) ==\n" % doc.get("pid"))
     out.write("  reason: %s\n" % doc.get("reason"))
+    mem = doc.get("membership") or {}
+    if mem.get("coordinator") or (mem.get("world_size") or 1) > 1 or \
+            mem.get("transitions"):
+        out.write("  membership: world_size=%s rank=%s slot=%s "
+                  "attempt=%s transitions=%s\n"
+                  % (mem.get("world_size"), mem.get("rank"),
+                     mem.get("slot"), mem.get("attempt"),
+                     mem.get("transitions")))
     ss = doc.get("step_stats") or {}
     out.write("  step_stats: %s\n" % json.dumps(ss))
     wd = doc.get("watchdog") or {}
@@ -207,6 +261,9 @@ def render_file(path, out=sys.stdout):
     last = docs[-1]
     if last.get("schema") == "mxtpu-postmortem-1":
         render_postmortem(last, out)
+        return
+    if last.get("schema") == "mxtpu-membership-1":
+        render_membership(last, out)
         return
     ctx = ""
     if len(docs) > 1:
