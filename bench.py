@@ -101,6 +101,15 @@ PEAK_FLOPS = {
 }
 
 
+def _perf_probe_path():
+    """Put tools/perf_probe on sys.path once (steptrace/restart_probe
+    imports for the probe-backed bench modes)."""
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "perf_probe")
+    if d not in sys.path:
+        sys.path.insert(0, d)
+
+
 def bench_attention():
     """BENCH_MODE=attention: Pallas flash-attention step vs chip peak.
 
@@ -426,8 +435,7 @@ def bench_steptrace():
     path must stay at exactly 1 dispatch/step, 0 steady-state compiles;
     see PERF.md, "Fused train step")."""
     import jax
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), "tools", "perf_probe"))
+    _perf_probe_path()
     import steptrace as _steptrace
 
     jax.devices()
@@ -460,6 +468,63 @@ def bench_steptrace():
     }))
 
 
+def bench_spmd():
+    """BENCH_MODE=spmd: the mesh-native ZeRO-1 fused step on an 8-device
+    host mesh (tools/perf_probe/steptrace.run_spmd).  Hard contracts:
+
+    - exactly 1.0 dispatch/step — the reduce-scatter, sharded update and
+      all-gather all live INSIDE the one donated program;
+    - 0 steady-state compiles;
+    - opt-state bytes/device ~= 1/N of the total (replicated fallbacks
+      for indivisible leaves get a small tolerance).
+    """
+    import jax
+    _perf_probe_path()
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags and \
+            jax.device_count() < 8:
+        raise RuntimeError(
+            "BENCH_MODE=spmd: fewer than 8 devices and no "
+            "--xla_force_host_platform_device_count in XLA_FLAGS")
+    import steptrace as _steptrace
+
+    jax.devices()
+    _disarm_watchdog()
+    result = _steptrace.run_spmd()
+    n = result["n_devices"]
+    if result["dispatches_per_step"] != 1.0:
+        raise AssertionError(
+            "ZeRO-1 fused step dispatched %.3f programs/step (contract: "
+            "exactly 1.0 — reduce-scatter/update/all-gather must stay "
+            "inside the one donated program)"
+            % result["dispatches_per_step"])
+    if result["compile_count"] != 0:
+        raise AssertionError(
+            "ZeRO-1 fused step recompiled %d time(s) in steady state"
+            % result["compile_count"])
+    ratio = result["opt_state_total_bytes"] / \
+        max(1, result["opt_state_bytes_per_device"])
+    # the MLP's (4,) softmax bias state replicates (nothing divides 8);
+    # everything else must be 1/N — so the aggregate factor sits just
+    # under N but far above N/2
+    if ratio < n / 2:
+        raise AssertionError(
+            "opt-state bytes/device %d vs total %d (factor %.2f): state "
+            "is not sharded ~1/%d across the mesh"
+            % (result["opt_state_bytes_per_device"],
+               result["opt_state_total_bytes"], ratio, n))
+    print(json.dumps({
+        "metric": "zero1_opt_state_shard_factor",
+        "value": round(ratio, 3),
+        "unit": "x smaller per device (n=%d, %d/%d leaves sharded, "
+                "1.0 dispatch/step)"
+                % (n, result["opt_state_leaves_sharded"],
+                   result["opt_state_leaves"]),
+        "vs_baseline": round(ratio / n, 3),
+        "spmd": result,
+    }))
+
+
 def bench_telemetry():
     """BENCH_MODE=telemetry: always-on telemetry cost + phase breakdown.
 
@@ -473,8 +538,7 @@ def bench_telemetry():
     histograms).  Contract (OBSERVABILITY.md): overhead < 1% of the
     fused step, dispatch rate untouched at exactly 1.0/step."""
     import jax
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), "tools", "perf_probe"))
+    _perf_probe_path()
     import steptrace as _steptrace
     from mxnet_tpu import profiler, telemetry
 
@@ -561,8 +625,7 @@ def bench_restart():
     faster warm).  Headline value is the p50 stall ratio;
     vs_baseline is that ratio against the 5× contract."""
     import jax
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), "tools", "perf_probe"))
+    _perf_probe_path()
     import restart_probe
 
     jax.devices()
@@ -598,6 +661,7 @@ def main():
         "attention": ("flash_attention_train_tflops", "TFLOP/s"),
         "pipeline": ("input_pipeline_images_per_sec", "img/s"),
         "steptrace": ("fused_step_dispatches_per_step", "dispatches/step"),
+        "spmd": ("zero1_opt_state_shard_factor", "x"),
         "telemetry": ("telemetry_overhead_pct", "%"),
         "restart": ("ckpt_stall_sync_over_async", "x"),
         "transformer": (_gpt_metric()[1] if mode == "transformer"
@@ -645,6 +709,9 @@ def _run_mode(mode, network):
         return
     if mode == "steptrace":
         bench_steptrace()
+        return
+    if mode == "spmd":
+        bench_spmd()
         return
     if mode == "telemetry":
         bench_telemetry()

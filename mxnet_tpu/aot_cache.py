@@ -62,6 +62,7 @@ Telemetry (OBSERVABILITY.md): ``aot.cache_hits`` / ``aot.cache_misses``
 """
 from __future__ import annotations
 
+import atexit
 import contextlib
 import hashlib
 import io
@@ -73,8 +74,9 @@ from . import telemetry as _telemetry
 
 __all__ = ["cache_dir", "enabled", "fingerprint", "cache_key", "load",
            "store", "variant", "deserialized_donation_safe",
-           "bypass_persistent_cache", "donation_cache_guard",
-           "memo_get", "memo_put", "clear_memo", "drain"]
+           "deserialized_spmd_safe", "bypass_persistent_cache",
+           "donation_cache_guard", "memo_get", "memo_put", "clear_memo",
+           "drain"]
 
 _FORMAT = "mxtpu-aot-3"  # bump to orphan every existing entry
 
@@ -105,6 +107,24 @@ def deserialized_donation_safe():
     return jax.devices()[0].platform != "cpu"
 
 
+def deserialized_spmd_safe():
+    """Can this backend EXECUTE a deserialized MULTI-DEVICE (SPMD)
+    executable at all?  False on CPU: beyond the donated hazard above,
+    even the donation-FREE twin of an 8-device mesh program replayed
+    from bytes flakily corrupts the heap ("corrupted double-linked
+    list" aborts mid `execute_sharded`) or deadlocks its collective
+    rendezvous (participants waiting forever at the all-gather) —
+    reproduced standalone under MALLOC_CHECK_=3 against jaxlib 0.4.36,
+    PR-7 root cause (ROBUSTNESS.md §8).  So on such backends mesh
+    programs are never stored to or loaded from disk — the in-process
+    memo (the ORIGINAL compiled object) is their only warm tier, and a
+    cross-process restart pays one compile.  TPU-class PJRT
+    serialization remains the supported production path.  Shares the
+    ``MXTPU_AOT_FORCE_DONATED=1`` override (one jaxlib upgrade gate
+    for both hazards)."""
+    return deserialized_donation_safe()
+
+
 def variant():
     """Which executable variant this process stores and loads."""
     return VARIANT_DONATED if deserialized_donation_safe() \
@@ -130,7 +150,17 @@ def fingerprint():
     warmed).  Keyed per (world, rank position, local device set), a
     survivor re-hits its own entry across restarts at the same world
     size — the "where shapes allow" half of the elastic warm-start
-    contract (ROBUSTNESS.md §9)."""
+    contract (ROBUSTNESS.md §9).
+
+    The SAME device set under a different **mesh shape / input
+    sharding** is likewise a different program — that half of the
+    identity is per-program, not per-process, so it rides the
+    ``extra`` argument of :func:`cache_key`:
+    ``Executor._mesh_cache_extra`` folds mesh axes+sizes, flat device
+    order, every input's PartitionSpec and the ZeRO-1 state specs into
+    the key (a dp=8 and a dp=4 bind over one 8-device pool must never
+    clobber each other — the same class of bug as the elastic topology
+    clobber above)."""
     import jax
     import jaxlib
     local = jax.local_devices()
@@ -205,6 +235,37 @@ def clear_memo():
 _bypass_lock = threading.Lock()
 _bypass_depth = 0
 _bypass_prev = None
+_spmd_quarantined = False
+
+
+def quarantine_persistent_cache_for_spmd():
+    """Permanently disable jax's persistent compilation cache in THIS
+    process — called from mesh construction (parallel.mesh.make_mesh)
+    on backends where a deserialized SPMD executable is unsound
+    (:func:`deserialized_spmd_safe`).  Once a mesh exists, ANY jitted
+    op touching mesh-sharded arrays (per-op nd dispatches on outputs,
+    metric updates, eval forwards) becomes an SPMD program; with
+    ``JAX_COMPILATION_CACHE_DIR`` exported (tools/launch.py does by
+    default) the NEXT process would replay them all from bytes and
+    flakily corrupt its heap — observed as restart attempts dying with
+    SIGSEGV/SIGABRT mid-fit while reruns pass.  Sacrificing jax's
+    persistent cache in mesh processes on such backends is the only
+    sound option; our own executable cache (independent machinery) and
+    the in-process memo are unaffected.  No-op where deserialized SPMD
+    execution is safe."""
+    global _spmd_quarantined
+    if _spmd_quarantined or deserialized_spmd_safe():
+        return
+    import jax
+    with _bypass_lock:
+        _spmd_quarantined = True
+        jax.config.update("jax_enable_compilation_cache", False)
+    import logging
+    logging.info(
+        "mxnet_tpu.aot_cache: mesh created on a backend that cannot "
+        "replay deserialized SPMD executables — jax's persistent "
+        "compilation cache is disabled for this process (the AOT "
+        "executable cache and in-process memo still apply)")
 
 
 @contextlib.contextmanager
@@ -239,8 +300,10 @@ def bypass_persistent_cache():
         with _bypass_lock:
             _bypass_depth -= 1
             if _bypass_depth == 0:
+                # a quarantine that landed while this bypass was active
+                # must win over the captured pre-bypass state
                 jax.config.update("jax_enable_compilation_cache",
-                                  _bypass_prev)
+                                  _bypass_prev and not _spmd_quarantined)
 
 
 def donation_cache_guard(fn):
@@ -392,6 +455,17 @@ def store(key, compiled, var):
 
 _bg_threads = []
 _bg_lock = threading.Lock()
+
+
+@atexit.register
+def _drain_at_exit():
+    """Bounded join of in-flight background compiles/stores at interpreter
+    exit.  Daemon threads torn down MID-XLA-COMPILE make the runtime call
+    std::terminate (observed with the SPMD fused step's hot-swap compile
+    on CPU) — turning a clean exit into an abort.  Ten seconds covers any
+    realistic twin/store; a genuinely wedged thread still only delays
+    exit, never hangs it."""
+    drain(timeout=10)
 
 
 def spawn_background(fn, name):

@@ -544,7 +544,7 @@ class CheckpointManager:
 
     # -- saving ------------------------------------------------------------
     def save(self, epoch, arg_params, aux_params, symbol=None,
-             optimizer_states=None, mode=None):
+             optimizer_states=None, mode=None, sharding=None):
         """Write one complete checkpoint; the manifest is committed last,
         so a crash anywhere earlier leaves the previous checkpoint as the
         newest *complete* one.
@@ -552,7 +552,15 @@ class CheckpointManager:
         ``mode``: ``"sync"`` writes in this call (returns the manifest),
         ``"async"`` snapshots to host memory here and hands the write to
         the background pipeline (returns None; errors surface sticky on
-        the next save/step/flush), ``None`` follows MXTPU_ASYNC_CKPT."""
+        the next save/step/flush), ``None`` follows MXTPU_ASYNC_CKPT.
+
+        ``sharding``: optional JSON-able stamp describing how the RUN
+        held this state in memory (zero stage, mesh axes, per-param
+        specs — Module._sharding_stamp).  Recorded in the manifest so a
+        resume knows the layout that produced the checkpoint; the
+        PAYLOAD is always written gathered/full-size (ZeRO-1 state is
+        all-gathered by the host fetch), which is what lets an elastic
+        restart reshard it onto ANY world size at load."""
         if mode is None:
             mode = "async" if async_enabled() else "sync"
         with _telemetry.span("ckpt.save", cat="checkpoint"):
@@ -563,24 +571,26 @@ class CheckpointManager:
                 # latest() a reordered history
                 flush_async()
                 return self._save(epoch, arg_params, aux_params, symbol,
-                                  optimizer_states)
+                                  optimizer_states, sharding)
             _telemetry.counter("ckpt.async_saves").inc()
             snap = self._snapshot(epoch, arg_params, aux_params, symbol,
-                                  optimizer_states, own=True)
+                                  optimizer_states, own=True,
+                                  sharding=sharding)
             _async_submit(
                 "ckpt save %s epoch %d" % (self.prefix, int(epoch)),
                 functools.partial(self._write_snapshot, *snap))
             return None
 
     def _save(self, epoch, arg_params, aux_params, symbol,
-              optimizer_states):
+              optimizer_states, sharding=None):
         """The one-call sync body (save() routes sync mode through here,
         so a subclass hook still sees every inline write)."""
         return self._write_snapshot(*self._snapshot(
-            epoch, arg_params, aux_params, symbol, optimizer_states))
+            epoch, arg_params, aux_params, symbol, optimizer_states,
+            sharding=sharding))
 
     def _snapshot(self, epoch, arg_params, aux_params, symbol,
-                  optimizer_states, own=False):
+                  optimizer_states, own=False, sharding=None):
         """Host-side materialization of one checkpoint: everything the
         write phase needs, detached from the device.  With ``own`` the
         arrays are forced to own their memory — the async queue outlives
@@ -608,10 +618,11 @@ class CheckpointManager:
             if own:
                 arrays = [_own_host_record(a) for a in arrays]
             sym_json = symbol.tojson() if symbol is not None else None
-        return epoch, arrays, names, optimizer_states, sym_json
+        return (epoch, arrays, names, optimizer_states, sym_json,
+                sharding)
 
     def _write_snapshot(self, epoch, arrays, names, optimizer_states,
-                        sym_json):
+                        sym_json, sharding=None):
         """The write phase: serialization + atomic publishes + manifest
         commit (+ retention).  Runs on the caller (sync) or the writer
         thread (async) — same code, same fault sites, same telemetry."""
@@ -656,6 +667,11 @@ class CheckpointManager:
                     "world_size": mem["world_size"],
                     "rank": mem["rank"],
                     "attempt": mem["attempt"]}
+        if sharding is not None:
+            # in-memory layout stamp (ZeRO stage, mesh axes, specs) —
+            # payloads are gathered on disk, so this is metadata for the
+            # resume path's reshard decision, never a load precondition
+            manifest["sharding"] = sharding
         atomic_write(self.manifest_path(epoch),
                      json.dumps(manifest, indent=1).encode("utf-8"),
                      retries=self._retries, backoff=self._backoff)

@@ -37,11 +37,12 @@ __all__ = ["Executor"]
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
                  group2ctx=None, shared_exec=None, mesh=None,
-                 batch_names=None, dp_axis="dp"):
+                 batch_names=None, dp_axis="dp", partition_rules=None):
         self._symbol = symbol
         self._ctx = ctx
         self._mesh = mesh
         self._dp_axis = dp_axis
+        self._partition_rules = partition_rules
         self._batch_names = frozenset(batch_names or ())
         self.arg_dict = dict(args)
         self.grad_dict = dict(args_grad) if args_grad else {}
@@ -75,16 +76,20 @@ class Executor:
 
     # -- SPMD placement ----------------------------------------------------
     def _build_shardings(self):
-        """Mesh layout: batch args sharded over ``dp``, everything else
-        replicated.  This single placement decision replaces the reference's
+        """Mesh layout, resolved ONCE at bind: batch args sharded over
+        ``dp`` (sharding.batch_spec), every other array placed by the
+        bind's partition rules (sharding.match_partition_rules — regex
+        rules over the named param tree, replicated when none matches).
+        This single placement decision replaces the reference's
         DataParallelExecutorGroup batch slicing
         (/root/reference/python/mxnet/module/executor_group.py:296-378) —
         XLA GSPMD partitions the one compiled program across the mesh and
         inserts the gradient all-reduce (vjp of a replicated parameter
         against dp-sharded activations IS a psum over ``dp``)."""
+        from .parallel import sharding as _shd
         mesh, axis = self._mesh, self._dp_axis
         ndev = mesh.shape[axis]
-        shardings = {}
+        batch, ruled = {}, {}
         for name, arr in list(self.arg_dict.items()) + \
                 list(self.aux_dict.items()):
             if name in self._batch_names and arr.ndim >= 1:
@@ -93,21 +98,57 @@ class Executor:
                         "batch axis of %r (shape %s) not divisible by the "
                         "%d-device data-parallel mesh" %
                         (name, arr.shape, ndev))
-                spec = _P(axis, *([None] * (arr.ndim - 1)))
+                batch[name] = _shd.batch_spec(arr.ndim, axis)
             else:
-                spec = _P()
-            shardings[name] = NamedSharding(mesh, spec)
-        return shardings
+                ruled[name] = arr
+        specs = _shd.match_partition_rules(
+            self._partition_rules or [], ruled, mesh=mesh)
+        specs.update(batch)
+        return {name: NamedSharding(mesh, spec)
+                for name, spec in specs.items()}
+
+    def param_spec(self, name):
+        """The bound PartitionSpec of ``name`` (P() when unsharded /
+        no mesh) — the base the ZeRO-1 state placement composes with."""
+        s = self._shardings.get(name)
+        return s.spec if s is not None else _P()
+
+    def zero_shardings(self, update_names):
+        """{name: NamedSharding} placing each updated param's optimizer
+        state / reduce-scattered gradient 1/N over the data-parallel
+        axis (parallel.sharding.zero1_partition), or None when this bind
+        has no mesh / no dp axis to shard over.  Leaves that cannot
+        shard (no dim divisible by the axis) come back replicated —
+        counted on ``sharding.fallbacks``."""
+        mesh = self._mesh
+        if mesh is None or self._dp_axis not in mesh.shape or \
+                mesh.shape[self._dp_axis] <= 1:
+            return None
+        from .parallel.sharding import zero1_partition
+        shapes = {n: self.arg_dict[n]._data for n in update_names}
+        base = {n: self.param_spec(n) for n in update_names}
+        specs = zero1_partition(shapes, mesh, axis=self._dp_axis,
+                                base_specs=base)
+        return {n: NamedSharding(mesh, s) for n, s in specs.items()}
 
     def _placed(self, name, data):
         """Reshard ``data`` to its mesh placement (no-op when it already
-        lives there, or when no mesh is attached)."""
+        lives there, or when no mesh is attached).  Batch feeds move
+        with a plain device_put (they are never donated); params/aux
+        feed the fused step's DONATED trees, so their placement must
+        materialize fresh XLA-owned buffers — an eager device_put can
+        alias the source (e.g. checkpoint-loaded arrays still held by
+        Module._arg_params) and donating an aliased buffer corrupts the
+        heap (parallel.sharding.fresh_device_put, PR-7 root cause)."""
         target = self._shardings.get(name)
         if target is None:
             return data
         if getattr(data, "sharding", None) == target:
             return data
-        return jax.device_put(data, target)
+        if name in self._batch_names:
+            return jax.device_put(data, target)
+        from .parallel.sharding import fresh_device_put
+        return fresh_device_put(data, target)
 
     # -- graph compilation -------------------------------------------------
     def _build_plan(self):
@@ -229,9 +270,22 @@ class Executor:
             if not self._staged:
                 # staged (multi-device ctx_group) binds run eagerly:
                 # jit would collapse placement onto one device
-                fn = self._instrument(jax.jit(fn))
+                fn = self._instrument(self._guard_mesh_cache(jax.jit(fn)))
             self._fwd_cache[train] = fn
         return fn
+
+    def _guard_mesh_cache(self, fn):
+        """Keep MESH programs out of jax's persistent compilation cache
+        on backends where a replayed (deserialized) SPMD executable is
+        unsound even donation-free (aot_cache.deserialized_spmd_safe —
+        the launcher exports JAX_COMPILATION_CACHE_DIR by default, so
+        without this every restarted rank would re-execute its mesh
+        forwards from bytes).  No-op for single-device binds and on
+        donation/SPMD-safe backends."""
+        if self._mesh is None:
+            return fn
+        from . import aot_cache as _aot
+        return _aot.donation_cache_guard(fn)
 
     def _diff_names(self):
         return tuple(sorted(
@@ -274,8 +328,10 @@ class Executor:
             return vjp(tuple(ograds))[0]
 
         if not self._staged:
-            fwd_lin = self._instrument(jax.jit(fwd_lin))
-            bwd_apply = self._instrument(jax.jit(bwd_apply))
+            fwd_lin = self._instrument(
+                self._guard_mesh_cache(jax.jit(fwd_lin)))
+            bwd_apply = self._instrument(
+                self._guard_mesh_cache(jax.jit(bwd_apply)))
         self._lin_fns = (fwd_lin, bwd_apply)
         return self._lin_fns
 
@@ -289,7 +345,8 @@ class Executor:
             return outs, new_aux, grads
 
         if not self._staged:
-            grad_fn = self._instrument(jax.jit(grad_fn))
+            grad_fn = self._instrument(
+                self._guard_mesh_cache(jax.jit(grad_fn)))
         self._grad_fn = grad_fn
         return grad_fn
 
@@ -449,7 +506,7 @@ class Executor:
         return self.outputs
 
     def make_fit_step(self, update_names, apply_fn, opt_state=None,
-                      cache_extra=None):
+                      cache_extra=None, zero_shardings=None):
         """Build the fused donated train-step program: forward + backward +
         tree-wide optimizer apply traced into ONE jitted XLA program.
 
@@ -472,15 +529,17 @@ class Executor:
                             hyperparameters are baked into the traced
                             program, so they must invalidate it).
 
-        **AOT warm-start** (``MXTPU_AOT_CACHE_DIR`` set, single-device
-        bind, ``opt_state``/``cache_extra`` provided): the program is
-        lowered + compiled ahead of time and the executable serialized
-        into the content-addressed cache (mxnet_tpu.aot_cache); a
-        restarted rank with the same key deserializes it and skips
-        trace+compile entirely — time-to-first-step drops from an XLA
-        compile to a file read, and the watchdog is told its startup
-        grace can shrink.  Any cache failure falls back to the normal
-        jit path.
+        **AOT warm-start** (``MXTPU_AOT_CACHE_DIR`` set,
+        ``opt_state``/``cache_extra`` provided; single-device AND mesh
+        binds — the key folds in mesh axes, device order and every
+        input/ZeRO sharding, so reshaped meshes miss instead of
+        colliding): the program is lowered + compiled ahead of time and
+        the executable serialized into the content-addressed cache
+        (mxnet_tpu.aot_cache); a restarted rank with the same key
+        deserializes it and skips trace+compile entirely —
+        time-to-first-step drops from an XLA compile to a file read,
+        and the watchdog is told its startup grace can shrink.  Any
+        cache failure falls back to the normal jit path.
 
         The apply is wrapped in the divergence guard
         (ops.optimizer_ops.make_guarded_apply): an all-finite check over
@@ -489,6 +548,17 @@ class Executor:
         a tree-wide no-op.  ``poison`` (0.0 normally, NaN when the
         grad.nan fault-injection site fires) is a dynamic scalar, so
         injected and production steps share one compiled program.
+
+        **Mesh binds** compile the same ONE donated program with explicit
+        ``in_shardings``/``out_shardings`` resolved from the bind's
+        partition rules (params/opt-state/aux per rule, batch over
+        ``dp``): XLA GSPMD partitions it across the mesh and the gradient
+        all-reduce rides inside.  With ``zero_shardings`` (the ZeRO-1
+        mode, ops.optimizer_ops docs) the optimizer state lives sharded
+        1/N over ``dp``, gradients are reduce-scattered, the update
+        applies on the local 1/N shard, and only the updated params are
+        all-gathered — the divergence guard's skip/rollback semantics
+        run INSIDE the sharded program unchanged.
 
         Returns ``step(param_vals, opt_state, other_vals, aux_vals, rng,
         lr, wd, rescale, t, poison) -> (outs, new_params, new_state,
@@ -499,7 +569,12 @@ class Executor:
         from .ops.optimizer_ops import make_guarded_apply
         plan = self._plan
         update_names = tuple(update_names)
-        guarded = make_guarded_apply(apply_fn)
+        if zero_shardings is not None and self._mesh is None:
+            raise MXNetError("zero_shardings requires a mesh bind")
+        param_shardings = {n: self._shardings[n] for n in update_names} \
+            if zero_shardings is not None else None
+        guarded = make_guarded_apply(apply_fn, zero_shardings=zero_shardings,
+                                     param_shardings=param_shardings)
 
         def step(param_vals, opt_state, other_vals, aux_vals, rng,
                  lr, wd, rescale, t, poison):
@@ -527,20 +602,150 @@ class Executor:
         if self._staged:
             return step  # eager multi-device ctx_group binds can't donate
         from . import aot_cache as _aot
-        if cache_extra is not None and opt_state is not None and \
-                self._mesh is None:
+        mk_jit = self._fit_step_jit_factory(step, update_names, opt_state,
+                                            zero_shardings)
+        if cache_extra is not None and opt_state is not None:
             if _aot.enabled():
-                fn = self._aot_fit_step(step, update_names, opt_state,
-                                        cache_extra)
+                # the mesh layout is part of the executable's identity:
+                # same devices under a different mesh shape / different
+                # input shardings is a different program (the PR-6
+                # topology-clobber class of bug, aot_cache.fingerprint
+                # docs) — fold it into the key alongside the caller's
+                # optimizer-config hash.  Mesh programs on backends that
+                # cannot execute ANY deserialized SPMD executable
+                # (aot_cache.deserialized_spmd_safe: CPU heap
+                # corruption / rendezvous deadlock, even donation-free)
+                # use only the in-process memo tier — no disk
+                disk_ok = self._mesh is None or \
+                    _aot.deserialized_spmd_safe()
+                fn = self._aot_fit_step(
+                    step, update_names, opt_state,
+                    cache_extra + self._mesh_cache_extra(zero_shardings),
+                    mk_jit, disk_ok=disk_ok)
                 if fn is not None:
                     return fn
         # donated program compiling lazily at first dispatch: keep it out
         # of jax's persistent cache on backends where replaying a donated
         # executable from that cache corrupts the heap (aot_cache docs)
-        return self._instrument(_aot.donation_cache_guard(
-            jax.jit(step, donate_argnums=(0, 1, 3))))
+        return self._instrument(_aot.donation_cache_guard(mk_jit()))
 
-    def _aot_fit_step(self, step, update_names, opt_state, cache_extra):
+    def _fit_step_jit_factory(self, step, update_names, opt_state,
+                              zero_shardings):
+        """One place that turns the traced step into a jit: non-mesh
+        binds keep the bare donated jit; mesh binds add the explicit
+        in/out shardings so the SAME factory serves the lazy dispatch
+        path, the AOT ``.lower(examples)`` path (ShapeDtypeStructs carry
+        no committed placement — without explicit shardings the lowered
+        program would be single-device), and the donation-free twin."""
+        shardings = self._fit_step_shardings(update_names, opt_state,
+                                             zero_shardings)
+
+        def mk_jit(donated=True):
+            kw = {}
+            if shardings is not None:
+                kw["in_shardings"], kw["out_shardings"] = shardings
+            if donated:
+                kw["donate_argnums"] = (0, 1, 3)
+            return jax.jit(step, **kw)
+
+        if self._mesh is not None:
+            self._note_sharding_telemetry(update_names, opt_state,
+                                          zero_shardings)
+        return mk_jit
+
+    def _fit_step_shardings(self, update_names, opt_state, zero_shardings):
+        """(in_shardings, out_shardings) for the fused step on this
+        bind's mesh, or None for single-device binds.  Opt-state
+        shardings are pytree PREFIXES ({name: NamedSharding} broadcasting
+        over e.g. Adam's (mean, var) tuple); scalar step inputs
+        (lr/wd/rescale/t/poison) pass None = unconstrained."""
+        if self._mesh is None:
+            return None
+        rep = NamedSharding(self._mesh, _P())
+        params_sh = {n: self._shardings[n] for n in update_names}
+        state_sh = dict(zero_shardings) if zero_shardings is not None \
+            else {n: params_sh[n] for n in update_names}
+        in_update = set(update_names)
+        other_sh = {n: self._shardings[n] for n in self.arg_dict
+                    if n not in in_update}
+        aux_sh = {n: self._shardings[n] for n in self.aux_dict}
+        in_sh = (params_sh, state_sh, other_sh, aux_sh, rep,
+                 None, None, None, None, None)
+        # outs stay unconstrained (loss heads come out dp-sharded with
+        # the batch; pinning them replicated would buy an all-gather of
+        # logits every step); params/state/aux must land exactly where
+        # their donated inputs lived
+        out_sh = (None, params_sh, state_sh, aux_sh, rep)
+        return in_sh, out_sh
+
+    def _mesh_cache_extra(self, zero_shardings):
+        """Cache-key text for the mesh layout: axis names+sizes, the flat
+        device order, every input's PartitionSpec, and the ZeRO specs.
+        Folded into the AOT key so executables from different mesh
+        shapes over the SAME device set can never collide."""
+        if self._mesh is None:
+            return ""
+        mesh = self._mesh
+        specs = sorted((n, str(s.spec)) for n, s in self._shardings.items())
+        zspecs = sorted((n, str(s.spec)) for n, s in
+                        (zero_shardings or {}).items())
+        return "|mesh:%s|dev:%s|in:%s|zero:%s" % (
+            tuple(mesh.shape.items()),
+            ",".join(str(d.id) for d in mesh.devices.flat), specs, zspecs)
+
+    def _note_sharding_telemetry(self, update_names, opt_state,
+                                 zero_shardings):
+        """Publish the step's sharding economics (OBSERVABILITY.md):
+
+        - ``sharding.opt_state_bytes_per_device`` — bytes of optimizer
+          state each device actually holds (1/N of the sharded leaves +
+          all of the replicated fallbacks);
+        - ``sharding.collective_bytes_per_step`` — per-device bytes the
+          weight-update collectives move each step (ring-collective
+          model): reduce-scatter B(N-1)/N + all-gather B(N-1)/N per
+          ZeRO-sharded param vs all-reduce 2B(N-1)/N per replicated one
+          — equal totals, but ZeRO holds 1/N of the state and runs 1/N
+          of the update math."""
+        from . import telemetry as _telemetry
+        mesh = self._mesh
+        n = mesh.shape.get(self._dp_axis, 1)
+
+        def shard_factor(spec):
+            """How many ways a leaf with ``spec`` is split across the
+            mesh: the product of EVERY named axis in the spec (a
+            P('tp','dp') leaf on a dp=4,tp=2 mesh occupies 1/8 per
+            device, not 1/4)."""
+            f = 1
+            for entry in tuple(spec or ()):
+                if entry is None:
+                    continue
+                for a in (entry if isinstance(entry, tuple) else (entry,)):
+                    f *= mesh.shape[a]
+            return f
+
+        state_bytes = 0
+        if opt_state is not None:
+            for name, sub in opt_state.items():
+                if zero_shardings is not None and name in zero_shardings:
+                    f = shard_factor(zero_shardings[name].spec)
+                else:
+                    f = shard_factor(self.param_spec(name))
+                for leaf in jax.tree_util.tree_leaves(sub):
+                    state_bytes += getattr(leaf, "nbytes", 0) // f
+        coll_bytes = 0
+        if n > 1:
+            for name in update_names:
+                b = self.arg_dict[name]._data.nbytes
+                coll_bytes += 2 * b * (n - 1) // n
+        _telemetry.gauge("sharding.opt_state_bytes_per_device") \
+            .set(state_bytes)
+        _telemetry.gauge("sharding.collective_bytes_per_step") \
+            .set(coll_bytes)
+        _telemetry.gauge("sharding.zero_stage").set(
+            1 if zero_shardings is not None else 0)
+
+    def _aot_fit_step(self, step, update_names, opt_state, cache_extra,
+                      mk_jit, disk_ok=True):
         """AOT-compile the fused step against the bound shapes and run it
         through the persistent executable cache.  Returns the
         instrumented program, or None to fall back to plain jit (any
@@ -582,7 +787,7 @@ class Executor:
             memo = _aot.memo_get(key)
             if memo is not None:
                 return self._instrument(memo, first_call_compiles=False)
-            loaded = _aot.load(key)
+            loaded = _aot.load(key) if disk_ok else None
             if loaded is not None:
                 compiled, var = loaded
                 # no trace, no (foreground) compile: the startup-grace
@@ -592,13 +797,13 @@ class Executor:
                     _aot.memo_put(key, compiled)
                     return self._instrument(compiled,
                                             first_call_compiles=False)
-                return self._twin_hotswap(step, examples, key, compiled)
+                return self._twin_hotswap(mk_jit, examples, key, compiled)
             with _telemetry.span("aot.compile", cat="aot"):
                 with _aot.bypass_persistent_cache():
-                    compiled = jax.jit(step, donate_argnums=(0, 1, 3)) \
-                        .lower(*examples).compile()
+                    compiled = mk_jit().lower(*examples).compile()
             _aot.memo_put(key, compiled)
-            self._spawn_aot_store(step, examples, key, compiled)
+            if disk_ok:
+                self._spawn_aot_store(mk_jit, examples, key, compiled)
             return self._instrument(compiled)
         except Exception as e:
             import logging
@@ -607,7 +812,7 @@ class Executor:
                             type(e).__name__, e)
             return None
 
-    def _spawn_aot_store(self, step, examples, key, compiled):
+    def _spawn_aot_store(self, mk_jit, examples, key, compiled):
         """Serialize this backend's consumable variant into the cache off
         the hot path.  Donation-safe backends store the donated program
         as-is; CPU compiles the donation-free twin first (the only
@@ -624,7 +829,8 @@ class Executor:
                     return
                 with _telemetry.suppress_compile_accounting():
                     with _telemetry.span("aot.twin_compile", cat="aot"):
-                        twin = jax.jit(step).lower(*examples).compile()
+                        twin = mk_jit(donated=False) \
+                            .lower(*examples).compile()
                 _telemetry.counter("aot.twin_compiles").inc()
                 _aot.store(key, twin, _aot.VARIANT_PLAIN)
             except Exception as e:
@@ -636,7 +842,7 @@ class Executor:
 
         _aot.spawn_background(work, "mxtpu-aot-store")
 
-    def _twin_hotswap(self, step, examples, key, twin):
+    def _twin_hotswap(self, mk_jit, examples, key, twin):
         """Warm CPU restart: run the deserialized donation-free twin NOW
         (instant first step), compile the donated program in the
         background, and swap it in between steps.  Until the swap the
@@ -655,8 +861,7 @@ class Executor:
                     with _telemetry.span("aot.hotswap_compile",
                                          cat="aot"):
                         with _aot.bypass_persistent_cache():
-                            donated = jax.jit(
-                                step, donate_argnums=(0, 1, 3)) \
+                            donated = mk_jit() \
                                 .lower(*examples).compile()
                 _aot.memo_put(key, donated)
                 cell["fn"] = donated
@@ -740,4 +945,5 @@ class Executor:
         return Executor(self._symbol, self._ctx, new_args, args_grad,
                         grad_req, new_aux, group2ctx=self._group2ctx,
                         mesh=self._mesh, batch_names=self._batch_names,
-                        dp_axis=self._dp_axis)
+                        dp_axis=self._dp_axis,
+                        partition_rules=self._partition_rules)

@@ -271,7 +271,10 @@ def packed_positions(segments):
     change = jnp.concatenate(
         [jnp.ones_like(seg[:, :1], dtype=bool),
          seg[:, 1:] != seg[:, :-1]], axis=1)
-    start = jnp.maximum.accumulate(jnp.where(change, idx, 0), axis=1)
+    # lax.cummax, not jnp.maximum.accumulate: ufunc .accumulate methods
+    # only exist in newer jax than this build (0.4.37)
+    from jax import lax as _lax
+    start = _lax.cummax(jnp.where(change, idx, 0), axis=1)
     return (idx - start).astype(jnp.int32)
 
 

@@ -25,12 +25,18 @@ class DeferredInitializationError(MXNetError):
 
 
 def _replicate_over(ctx_list, data):
-    """Replicate a raw array over the dp mesh formed by ``ctx_list``."""
-    import jax
+    """Replicate a raw array over the dp mesh formed by ``ctx_list``.
+
+    Fresh buffers, not device_put: these params feed the Trainer's
+    DONATED update tree, and an eager same-device device_put may hand
+    back replica shards aliasing the source (loaded/initialized arrays
+    other code still references) — donating an aliased buffer corrupts
+    the heap (parallel.sharding.fresh_device_put, PR-7)."""
     from jax.sharding import NamedSharding, PartitionSpec
     from ..parallel.mesh import dp_mesh_from_ctx
+    from ..parallel.sharding import fresh_device_put
     mesh = dp_mesh_from_ctx(ctx_list)
-    return jax.device_put(data, NamedSharding(mesh, PartitionSpec()))
+    return fresh_device_put(data, NamedSharding(mesh, PartitionSpec()))
 
 
 class Parameter:

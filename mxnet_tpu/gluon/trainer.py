@@ -139,6 +139,39 @@ class Trainer:
             _watchdog.renew("trainer_step", phase="train")
 
     # -- fused tree-wide step ----------------------------------------------
+    def _zero_shardings(self, live):
+        """ZeRO-1 state placement for the gluon path ({updater-index-key:
+        NamedSharding}), or None.  Engages when MXTPU_ZERO>=1 and every
+        live parameter resides on one NamedSharding mesh with a >1 ``dp``
+        axis (gluon params land there via initialize(ctx=[N devices]) /
+        shard_and_load); anything else — single device, mixed meshes,
+        host arrays — keeps the replicated-state program."""
+        from ..ops.optimizer_ops import zero_stage
+        if zero_stage() < 1:
+            return None
+        from jax.sharding import NamedSharding
+        from ..parallel.mesh import AXIS_DP
+        mesh = None
+        for _, p in live:
+            s = getattr(p.data()._data, "sharding", None)
+            if not isinstance(s, NamedSharding):
+                return None
+            if mesh is None:
+                mesh = s.mesh
+            elif s.mesh != mesh:
+                return None
+        if mesh is None or AXIS_DP not in mesh.shape or \
+                mesh.shape[AXIS_DP] <= 1:
+            return None
+        from ..parallel.sharding import zero1_spec
+        out = {}
+        for i, p in live:
+            arr = p.data()._data
+            spec = zero1_spec(arr.shape, mesh, axis=AXIS_DP,
+                              base=arr.sharding.spec, name=p.name)
+            out[str(i)] = NamedSharding(mesh, spec)
+        return out
+
     def _fused_step(self):
         """Apply the whole optimizer step as ONE donated jitted program
         over the parameter pytree.  Returns False when the configuration
@@ -175,35 +208,75 @@ class Trainer:
         keys = [str(i) for i, _ in live]
         idx2key = {i: str(i) for i, _ in live}
         mults = optimizer.fused_mults(idx2key)
+        from ..ops.optimizer_ops import zero_stage
+        want_zero = zero_stage() >= 1
         cache_key = (id(optimizer), kind, tuple(keys),
                      tuple(sorted(mults.items())),
                      tuple(sorted(optimizer.fused_hyper().items())),
-                     tuple(p.shape for _, p in live))
+                     tuple(p.shape for _, p in live),
+                     want_zero)
         if self._fused is None or self._fused["key"] != cache_key:
+            # sharding resolution only on rebuild — step() is hot
+            zero = self._zero_shardings(live) if want_zero else None
             # a reconfiguration (new mults, frozen param...) rebuilds the
             # program; park accumulated momentum/Adam state in the Updater
             # first so the re-seed below picks it up instead of zeros
             self._fused_flush_to_updater()
-            init_state, apply_fn = optimizer.make_fused_apply(idx2key)
+            init_state, apply_fn = optimizer.make_fused_apply(
+                idx2key, zero_shardings=zero)
             raw = {k: p.data()._data for k, (_, p) in zip(keys, live)}
             state = init_state(raw)
             if self._updaters.states:
                 from ..optimizer import fused_state_from_updater
                 for i, p in live:
                     if i in self._updaters.states:
-                        state[str(i)] = fused_state_from_updater(
+                        st = fused_state_from_updater(
                             kind, self._updaters.states[i], p.data())
+                        if zero is not None:
+                            # loaded states are full-size (saves gather);
+                            # reshard onto this param's 1/N dp slice —
+                            # fresh buffers, the tree is donated while
+                            # the Updater keeps the loaded arrays
+                            # (sharding.fresh_device_put docs)
+                            from ..parallel.sharding import \
+                                fresh_device_put
+                            st = jax.tree_util.tree_map(
+                                lambda s, _t=zero[str(i)]:
+                                fresh_device_put(s, _t), st)
+                        state[str(i)] = st
             from .. import aot_cache as _aot
+            jit_kw = {"donate_argnums": (0, 2)}
+            if zero is not None:
+                # ZeRO-1 (ops.optimizer_ops docs): explicit shardings —
+                # params stay on their resident (replicated) placement,
+                # state in/out lives on its 1/N dp shard, grads arrive
+                # replicated and the guard's constraints do the
+                # reduce-scatter / sharded update / all-gather inside
+                # the ONE donated program
+                from jax.sharding import NamedSharding
+                param_sh = {str(i): p.data()._data.sharding
+                            for i, p in live}
+                mesh = next(iter(param_sh.values())).mesh
+                rep = NamedSharding(mesh,
+                                    jax.sharding.PartitionSpec())
+                jit_kw["in_shardings"] = (param_sh, param_sh, dict(zero),
+                                          None, None, None, None, None)
+                jit_kw["out_shardings"] = (param_sh, dict(zero), rep)
+            else:
+                param_sh = None
             self._fused = {
                 "key": cache_key, "kind": kind, "state": state,
+                "zero": zero,
                 # same divergence guard as Module.fit_step: all-finite
                 # check + no-op select inside the ONE donated program,
                 # compiled outside jax's persistent cache on backends
                 # where replaying a donated executable from it corrupts
                 # the heap (aot_cache.donation_cache_guard)
                 "step": _profiler.instrument(_aot.donation_cache_guard(
-                    jax.jit(make_guarded_apply(apply_fn),
-                            donate_argnums=(0, 2))))}
+                    jax.jit(make_guarded_apply(
+                        apply_fn, zero_shardings=zero,
+                        param_shardings=param_sh),
+                        **jit_kw)))}
 
         fused = self._fused
         params = {str(i): p.data()._data for i, p in live}

@@ -31,8 +31,14 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, partition_rules=None):
+        """``partition_rules``: optional parallel.sharding rule list
+        ((pattern, PartitionSpec[, ndim]) tuples or PartitionRule
+        objects) resolved over the named param tree at bind — model code
+        stays sharding-agnostic while a multi-context bind places every
+        param per rule (replicated when no rule matches)."""
         super().__init__(logger=logger)
+        self._partition_rules = partition_rules
         if context is None:
             context = ctx_mod.current_context()
         if isinstance(context, ctx_mod.Context):
@@ -109,7 +115,29 @@ class Module(BaseModule):
         arg_params, aux_params = self.get_params()
         CheckpointManager(prefix, keep_last=keep_last).save(
             epoch, arg_params, aux_params, symbol=self._symbol,
-            optimizer_states=states, mode=mode)
+            optimizer_states=states, mode=mode,
+            sharding=self._sharding_stamp())
+
+    def _sharding_stamp(self):
+        """Manifest stamp for the run's in-memory layout (SCALING.md):
+        {"zero_stage", "mesh", "opt_state", "specs"} when the fused step
+        runs ZeRO-1 on a mesh, else None.  The state PAYLOAD on disk is
+        always full-size — `_optimizer_states_bytes` flushes through the
+        Updater, and converting a dp-sharded jax array to host bytes IS
+        the all-gather-on-save — so the stamp documents provenance and
+        lets an elastic resume at a different world size reshard
+        deliberately instead of guessing."""
+        fused = self._fused
+        if not fused or not fused.get("zero"):
+            return None
+        mesh = self._exec._mesh
+        return {
+            "zero_stage": 1,
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+            "opt_state": "gathered",
+            "specs": {name: str(s.spec)
+                      for name, s in fused["zero"].items()},
+        }
 
     # -- properties --------------------------------------------------------
     @property
@@ -282,9 +310,9 @@ class Module(BaseModule):
             from ..parallel.mesh import dp_mesh_from_ctx
             mesh = dp_mesh_from_ctx(self._context)
             batch_names = self._data_names + self._label_names
-        self._exec = self._symbol.simple_bind(ctx, grad_req=req, mesh=mesh,
-                                              batch_names=batch_names,
-                                              **shape_kwargs)
+        self._exec = self._symbol.simple_bind(
+            ctx, grad_req=req, mesh=mesh, batch_names=batch_names,
+            partition_rules=self._partition_rules, **shape_kwargs)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             self._arg_params = shared_module._arg_params
@@ -411,24 +439,50 @@ class Module(BaseModule):
         (optimizer identity/kind and the per-param mult aux tree);
         lr / wd / rescale_grad / t stay dynamic so schedulers never force
         a rebuild."""
+        from ..ops.optimizer_ops import zero_stage
         opt = self._optimizer
         kind = opt.fused_kind()
         update_names = self._fused_update_names()
         idx2name = {i: n for i, n in enumerate(self._param_names)
                     if n in set(update_names)}
         mults = opt.fused_mults(idx2name)
+        # ZeRO-1 (MXTPU_ZERO=1, SCALING.md): optimizer state sharded 1/N
+        # over the dp mesh axis.  The env value is part of the cache key
+        # — toggling it across a re-setup must rebuild the program AND
+        # re-place the state — but the sharding resolution itself runs
+        # only on rebuild (this method is on the per-step path)
+        # the SAME gate zero_shardings applies (mesh with a >1 dp axis),
+        # so the key flag always equals the resolved (zero is not None)
+        # and the state-carry fast path stays live on dp-less meshes
+        mesh = self._exec._mesh
+        want_zero = zero_stage() >= 1 and mesh is not None and \
+            self._exec._dp_axis in mesh.shape and \
+            mesh.shape[self._exec._dp_axis] > 1
         key = (id(opt), kind, tuple(update_names),
                tuple(sorted(mults.items())),
-               tuple(sorted(opt.fused_hyper().items())))
+               tuple(sorted(opt.fused_hyper().items())),
+               want_zero)
         if self._fused is not None and self._fused["key"] == key:
             return self._fused
-        init_state, apply_fn = opt.make_fused_apply(idx2name)
+        zero = self._exec.zero_shardings(update_names) \
+            if want_zero else None
+        init_state, apply_fn = opt.make_fused_apply(idx2name,
+                                                    zero_shardings=zero)
         params = {n: self._exec.arg_dict[n] for n in update_names}
         if self._fused is not None and self._fused["kind"] == kind and \
+                self._fused["key"][-1] == (zero is not None) and \
                 set(self._fused["state"]) == set(update_names):
             state = self._fused["state"]  # mults changed; state carries
         else:
-            state = self._fused_state_from_updater(kind, init_state, params)
+            # park accumulated momentum/Adam moments in the Updater
+            # FIRST (same discipline as Trainer._fused_step): a rebuild
+            # that can't carry state directly (kind change, MXTPU_ZERO
+            # toggled between steps) re-seeds from the Updater, and
+            # without this flush the re-seed would silently rewind to
+            # whatever the Updater last saw
+            self._fused_flush_to_updater()
+            state = self._fused_state_from_updater(kind, init_state, params,
+                                                   zero_shardings=zero)
         # everything baked statically into the traced program feeds the
         # AOT warm-start cache key (aot_cache.cache_key adds the backend
         # fingerprint and the full input tree shapes/dtypes itself).
@@ -445,16 +499,23 @@ class Module(BaseModule):
                             tuple(sorted(opt.fused_hyper().items()))))
         self._fused = {
             "key": key, "kind": kind, "update_names": update_names,
-            "state": state,
+            "state": state, "zero": zero,
             "step": self._exec.make_fit_step(update_names, apply_fn,
                                              opt_state=state,
-                                             cache_extra=cache_extra),
+                                             cache_extra=cache_extra,
+                                             zero_shardings=zero),
         }
         return self._fused
 
-    def _fused_state_from_updater(self, kind, init_state, params):
+    def _fused_state_from_updater(self, kind, init_state, params,
+                                  zero_shardings=None):
         """Seed fused optimizer state, adopting any state the Updater
-        already holds (e.g. from load_optimizer_states)."""
+        already holds (e.g. from load_optimizer_states).  Under ZeRO-1
+        every leaf — freshly-initialized AND Updater-loaded (checkpoint
+        states are saved gathered) — is placed onto its 1/N dp sharding:
+        this is the reshard-on-load half of the elastic contract (a
+        checkpoint written at world N loads at world M because the state
+        payload is always full-size on disk)."""
         # _raw commits params to their mesh placement first, so
         # zeros_like state inherits it (mixed committed devices would
         # fail the jitted fused step)
@@ -468,12 +529,20 @@ class Module(BaseModule):
                         kind, self._updater.states[i], params[name])
         if self._exec._mesh is not None:
             # align every state leaf (incl. Updater-loaded ones) with its
-            # param's sharding
+            # param's sharding — or its ZeRO-1 shard placement.  Fresh
+            # buffers (not device_put): this tree is DONATED on the next
+            # fit_step while the Updater keeps referencing the loaded
+            # arrays (sharding.fresh_device_put docs — the resume-crash
+            # root cause)
             import jax
-            state = {
-                name: jax.tree_util.tree_map(
-                    lambda s: jax.device_put(s, raw[name].sharding), st)
-                for name, st in state.items()}
+            from ..parallel.sharding import fresh_device_put
+            placed = {}
+            for name, st in state.items():
+                target = (zero_shardings or {}).get(name,
+                                                    raw[name].sharding)
+                placed[name] = jax.tree_util.tree_map(
+                    lambda s, _t=target: fresh_device_put(s, _t), st)
+            state = placed
         return state
 
     def _fused_flush_to_updater(self):

@@ -161,12 +161,31 @@ def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
 FUSED_KINDS = ("sgd", "sgd_mom", "adam")
 
 
+def zero_stage(default=0):
+    """The cross-replica weight-update sharding stage (arXiv 2004.13336 /
+    ZeRO-1): 0 = replicated optimizer state, 1 = optimizer state +
+    update sharded 1/N over the ``dp`` mesh axis (grads reduce-scattered,
+    updated params all-gathered — still ONE donated program per step).
+    Env contract: ``MXTPU_ZERO=1`` (SCALING.md)."""
+    try:
+        return int(os.environ.get("MXTPU_ZERO", "") or default)
+    except ValueError:
+        return default
+
+
 def make_fused_apply(kind, mults, momentum=0.0, beta1=0.9, beta2=0.999,
-                     epsilon=1e-8, clip_gradient=None):
+                     epsilon=1e-8, clip_gradient=None, zero_shardings=None):
     """Build (init_state, apply) for a tree-wide optimizer update.
 
     ``kind``  — one of FUSED_KINDS.
     ``mults`` — static dict name -> (lr_mult, wd_mult).
+    ``zero_shardings`` — ZeRO-1 mode: {name: NamedSharding} placing each
+        param's optimizer state 1/N over the data-parallel mesh axis;
+        init_state then materializes state ALREADY sharded (a replicated
+        zeros tree for a billion-param model would defeat the point of
+        sharding it).  The matching gradient reduce-scatter / param
+        all-gather live in :func:`make_guarded_apply` — the apply body
+        itself stays placement-agnostic arithmetic.
 
     init_state(params) -> state dict (name -> per-param state pytree)
     apply(params, grads, state, lr, wd, rescale_grad, t)
@@ -180,12 +199,22 @@ def make_fused_apply(kind, mults, momentum=0.0, beta1=0.9, beta2=0.999,
     clip = float(clip_gradient) if clip_gradient is not None and \
         clip_gradient > 0 else None
 
+    def _placed(name, z):
+        if zero_shardings is None or name not in zero_shardings:
+            return z
+        # fresh buffers, not device_put: this state tree is DONATED by
+        # the fused step (sharding.fresh_device_put docs)
+        from ..parallel.sharding import fresh_device_put
+        return fresh_device_put(z, zero_shardings[name])
+
     def init_state(params):
         if kind == "sgd":
             return {name: () for name in params}
         if kind == "sgd_mom":
-            return {name: jnp.zeros_like(w) for name, w in params.items()}
-        return {name: (jnp.zeros_like(w), jnp.zeros_like(w))
+            return {name: _placed(name, jnp.zeros_like(w))
+                    for name, w in params.items()}
+        return {name: (_placed(name, jnp.zeros_like(w)),
+                       _placed(name, jnp.zeros_like(w)))
                 for name, w in params.items()}
 
     def apply(params, grads, state, lr, wd, rescale_grad, t):
@@ -243,7 +272,7 @@ def all_finite(tree):
     return ok
 
 
-def make_guarded_apply(apply_fn):
+def make_guarded_apply(apply_fn, zero_shardings=None, param_shardings=None):
     """Wrap a tree-wide ``apply`` (from make_fused_apply) with the
     divergence guard.
 
@@ -254,16 +283,44 @@ def make_guarded_apply(apply_fn):
     0.0 in production, NaN when the ``grad.nan`` fault-injection site
     fires — so tests drive the skip path through the very same compiled
     program, with no trace divergence between guarded and injected runs.
+
+    **ZeRO-1** (``zero_shardings`` = {name: NamedSharding} over the dp
+    axis, ``param_shardings`` = each param's resident sharding, normally
+    replicated): the guard becomes the cross-replica weight-update
+    sharding of arXiv 2004.13336, still inside the ONE donated program —
+
+    - gradients are constrained onto ``zero_shardings`` straight out of
+      the backward pass: XLA lowers the dp gradient sum as a
+      reduce-scatter instead of an all-reduce (each replica keeps only
+      its 1/N slice, at half the all-reduce's bytes);
+    - the all-finite verdict reduces over the SHARDED grads (each device
+      scans 1/N, one tiny cross-replica AND joins the verdicts);
+    - the optimizer arithmetic — and the guard's no-op select — runs on
+      the 1/N shards against the sharded optimizer state;
+    - only the final updated params are constrained back to
+      ``param_shardings``, the one all-gather of the step.
+
+    The skip/rollback contract is untouched: the select happens before
+    the all-gather, so a non-finite batch republishes the OLD param
+    shards and the gathered result is bit-identical to never updating.
     """
+    def _wsc(tree, shardings):
+        return {name: jax.lax.with_sharding_constraint(v, shardings[name])
+                for name, v in tree.items()} if shardings else tree
+
     def guarded(params, grads, state, lr, wd, rescale_grad, t, poison):
         grads = {name: g + poison for name, g in grads.items()}
+        grads = _wsc(grads, zero_shardings)  # dp grad sum → reduce-scatter
         ok = all_finite(grads)
         new_params, new_state = apply_fn(params, grads, state, lr, wd,
                                          rescale_grad, t)
+        new_params = _wsc(new_params, zero_shardings)  # 1/N update compute
         new_params = jax.tree_util.tree_map(
             lambda n, o: jnp.where(ok, n, o), new_params, params)
         new_state = jax.tree_util.tree_map(
             lambda n, o: jnp.where(ok, n, o), new_state, state)
+        if zero_shardings:
+            new_params = _wsc(new_params, param_shardings)  # all-gather
         return new_params, new_state, ok
 
     return guarded

@@ -158,14 +158,17 @@ class Optimizer:
             out[name] = (lm, wm)
         return out
 
-    def make_fused_apply(self, index_to_name):
+    def make_fused_apply(self, index_to_name, zero_shardings=None):
         """(init_state, apply) over the named parameter tree, or None when
-        this optimizer configuration cannot fuse."""
+        this optimizer configuration cannot fuse.  ``zero_shardings``
+        (ZeRO-1, {name: NamedSharding}) makes init_state materialize the
+        state tree sharded 1/N over the dp mesh axis."""
         kind = self.fused_kind()
         if kind is None:
             return None
         from .ops.optimizer_ops import make_fused_apply as _make
         return _make(kind, self.fused_mults(index_to_name),
+                     zero_shardings=zero_shardings,
                      **self.fused_hyper())
 
     def fused_base_lr(self):

@@ -34,7 +34,8 @@ from .collectives import (allreduce, allgather, reduce_scatter, alltoall,
                           ring_permute, axis_index, axis_size)
 from . import sharding
 from .sharding import (PartitionRule, make_sharding_rules, shard_params,
-                       named_sharding, replicated, logical_to_mesh)
+                       named_sharding, replicated, logical_to_mesh,
+                       match_partition_rules, zero1_spec, zero1_partition)
 from . import data_parallel
 from .data_parallel import make_train_step, DataParallelTrainer
 from . import ring_attention

@@ -23,7 +23,15 @@ def axis_index(axis):
 
 
 def axis_size(axis):
-    return lax.axis_size(axis)
+    """Static size of a named mesh axis, resolvable inside shard_map.
+
+    ``lax.axis_size`` only exists in newer jax; on this build (0.4.37)
+    the canonical spelling is ``psum(1, axis)``, which jax special-cases
+    to a Python int at trace time — so it stays usable as a loop bound
+    (the pipeline/ring kernels unroll over it)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
 
 
 def allreduce(x, axis, op="sum"):
@@ -62,14 +70,20 @@ def ring_permute(x, axis, shift=1):
     The building block of ring attention and of bandwidth-optimal
     allreduce: on TPU the ring maps to physical ICI links.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
 
-def broadcast_from(x, axis, root=0):
-    """Every device gets root's shard (KVStore pull semantics)."""
-    n = lax.axis_size(axis)
-    idx = lax.axis_index(axis)
+def broadcast_from(x, axis, root=0, idx=None):
+    """Every device gets root's shard (KVStore pull semantics).
+
+    ``idx`` overrides the device's own coordinate on the axis — callers
+    under partial-manual shard_map pass a data-fed index because
+    ``lax.axis_index`` lowers to a PartitionId instruction the SPMD
+    partitioner (still running for the auto axes) cannot place."""
+    n = axis_size(axis)
+    if idx is None:
+        idx = lax.axis_index(axis)
     zeroed = jnp.where(idx == root, x, jnp.zeros_like(x))
     return lax.psum(zeroed, axis)

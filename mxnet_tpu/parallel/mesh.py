@@ -87,6 +87,13 @@ def make_mesh(axes=None, devices=None, **axis_sizes):
     shape = tuple(spec.values())
     if math.prod(shape) != n:
         raise ValueError("mesh shape %s != %d devices" % (shape, n))
+    if n > 1:
+        # once a mesh exists, every jitted op over its arrays is an SPMD
+        # program; backends that cannot safely replay deserialized SPMD
+        # executables must keep them out of jax's persistent compile
+        # cache for the rest of the process (aot_cache docs, PR-7)
+        from .. import aot_cache as _aot
+        _aot.quarantine_persistent_cache_for_spmd()
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, tuple(spec.keys()))
 

@@ -17,6 +17,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from ._shard_map import shard_map
 
+from .collectives import axis_size
 from .mesh import AXIS_EP
 
 
@@ -65,7 +66,7 @@ def moe_dense(x, gate_w, w1, b1, w2, b2, capacity_factor=2.0,
 
 def _moe_local(x, gate_w, w1, b1, w2, b2, axis, capacity_factor, act):
     """Inside shard_map.  x: [T_local, D]; experts sharded: w1 [E_local,...]."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     t, d = x.shape
     e_local = w1.shape[0]
     e = e_local * n

@@ -20,6 +20,7 @@ from jax.sharding import PartitionSpec as P
 from ._shard_map import shard_map
 
 from . import collectives
+from .collectives import axis_size
 from .mesh import AXIS_PP
 
 
@@ -30,7 +31,7 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis):
     on every stage; only stage 0 reads it).  Output collected on the last
     stage and broadcast.
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = axis_size(axis)
     stage = lax.axis_index(axis)
     n_micro = microbatches.shape[0]
 
@@ -95,7 +96,7 @@ def _strip_stage_dim(stage_params, microbatches, stage_fn, axis):
 # ---------------------------------------------------------------------------
 
 def _pipeline_1f1b_local(stage_params, microbatches, targets, stage_fn,
-                         loss_fn, axis):
+                         loss_fn, axis, stage_idx=None):
     """Explicit interleaved forward/backward pipeline (inside shard_map).
 
     Round r, stage s (S stages, M microbatches):
@@ -112,8 +113,8 @@ def _pipeline_1f1b_local(stage_params, microbatches, targets, stage_fn,
 
     Returns (summed loss, grads pytree like stage_params).
     """
-    n_stages = lax.axis_size(axis)
-    stage = lax.axis_index(axis)
+    n_stages = axis_size(axis)
+    stage = lax.axis_index(axis) if stage_idx is None else stage_idx
     n_micro = microbatches.shape[0]
     stash_len = 2 * n_stages
 
@@ -179,7 +180,7 @@ def _pipeline_1f1b_local(stage_params, microbatches, targets, stage_fn,
         0, n_micro + 2 * n_stages - 2, tick,
         (act, cot, stash, grads, loss_acc))
     loss_total = collectives.broadcast_from(loss_acc, axis,
-                                            root=n_stages - 1)
+                                            root=n_stages - 1, idx=stage)
     return loss_total, grads
 
 
@@ -188,7 +189,8 @@ def _pipeline_1f1b_local(stage_params, microbatches, targets, stage_fn,
 # ---------------------------------------------------------------------------
 
 def _pipeline_1f1b_het_local(stage_params, microbatches, targets,
-                             stage_fns, loss_fn, wire, axis):
+                             stage_fns, loss_fn, wire, axis,
+                             stage_idx=None):
     """1F1B whose stages may differ in function AND in input/output type.
 
     The homogeneous schedule above requires every stage to map the same
@@ -216,11 +218,11 @@ def _pipeline_1f1b_het_local(stage_params, microbatches, targets,
     the loss cotangent (loss_seed=1) instead of the wire register, whose
     content it never reads.
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = axis_size(axis)
     if len(stage_fns) != n_stages:
         raise ValueError("got %d stage_fns for a %d-stage pipeline"
                          % (len(stage_fns), n_stages))
-    stage = lax.axis_index(axis)
+    stage = lax.axis_index(axis) if stage_idx is None else stage_idx
     tmap = jax.tree_util.tree_map
     # microbatches/targets may be PYTREES of [n_micro, ...] leaves
     # (e.g. packed rows feed (tokens, segments) to every stage)
@@ -315,7 +317,7 @@ def _pipeline_1f1b_het_local(stage_params, microbatches, targets,
         0, n_micro + 2 * n_stages - 2, tick,
         (act, cot, stash, grads, loss_acc))
     loss_total = collectives.broadcast_from(loss_acc, axis,
-                                            root=n_stages - 1)
+                                            root=n_stages - 1, idx=stage)
     return loss_total, grads
 
 
@@ -345,9 +347,10 @@ def pipeline_apply_1f1b_het(stage_params, microbatches, targets,
                                         targets, stage_fns, loss_fn,
                                         wire, axis)
 
-    def local_call(local, mb, tg):
+    def local_call(local, mb, tg, stage_idx=None):
         return _pipeline_1f1b_het_local(local, mb, tg, stage_fns,
-                                        loss_fn, wire, axis)
+                                        loss_fn, wire, axis,
+                                        stage_idx=stage_idx)
     return _shardmap_1f1b(local_call, stage_params, microbatches,
                           targets, mesh, axis, batch_axis,
                           param_inner_specs=param_inner_specs)
@@ -403,9 +406,15 @@ def _shardmap_1f1b(local_call, stage_params, microbatches, targets,
     mb_specs = tmap(lambda a: data_spec, microbatches)
     tg_specs = tmap(lambda a: data_spec, targets)
 
-    def fn(sp, mb, tg):
+    def fn(sp, mb, tg, sid):
         local = tmap(lambda p: p[0], sp)
-        loss, grads = local_call(local, mb, tg)
+        # partial-manual mode feeds each stage its own index as data
+        # (the [1] shard of a P(axis)-sharded arange): lax.axis_index
+        # lowers to a PartitionId instruction the SPMD partitioner
+        # running for the AUTO axes cannot place on this jax/XLA build
+        loss, grads = local_call(
+            local, mb, tg,
+            stage_idx=None if sid is None else sid[0])
         if batch_axis is not None:
             # each batch shard computed its slice's loss/grads; the
             # replicated out_specs promise the TOTAL — sum them
@@ -413,9 +422,17 @@ def _shardmap_1f1b(local_call, stage_params, microbatches, targets,
             grads = tmap(lambda g: lax.psum(g, batch_axis), grads)
         grads = tmap(lambda g: g[None], grads)
         return loss, grads
+    if axis_names is None:
+        stage_ids = None
+        sid_spec = None
+    else:
+        stage_ids = jax.device_put(
+            jnp.arange(mesh.shape[axis], dtype=jnp.int32),
+            NamedSharding(mesh, P(axis)))
+        sid_spec = P(axis)
     mapped = shard_map(
         fn, mesh=mesh,
-        in_specs=(param_specs, mb_specs, tg_specs),
+        in_specs=(param_specs, mb_specs, tg_specs, sid_spec),
         out_specs=(P(), param_specs),
         check_rep=False,
         axis_names=axis_names)
@@ -425,7 +442,7 @@ def _shardmap_1f1b(local_call, stage_params, microbatches, targets,
         # specs merged over the auto axes and trips the manual-axes
         # check); jit also lets GSPMD propagate the inner tp shardings
         mapped = jax.jit(mapped)
-    return mapped(stage_params, microbatches, targets)
+    return mapped(stage_params, microbatches, targets, stage_ids)
 
 
 def pipeline_apply_1f1b(stage_params, microbatches, targets, stage_fn,
@@ -444,8 +461,8 @@ def pipeline_apply_1f1b(stage_params, microbatches, targets, stage_fn,
         return _pipeline_1f1b_local(stage_params, microbatches, targets,
                                     stage_fn, loss_fn, axis)
 
-    def local_call(local, mb, tg):
+    def local_call(local, mb, tg, stage_idx=None):
         return _pipeline_1f1b_local(local, mb, tg, stage_fn, loss_fn,
-                                    axis)
+                                    axis, stage_idx=stage_idx)
     return _shardmap_1f1b(local_call, stage_params, microbatches,
                           targets, mesh, axis, batch_axis)

@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 from ._shard_map import shard_map
 
 from . import collectives
+from .collectives import axis_size
 from .mesh import AXIS_SP
 
 _NEG_INF = -1e30
@@ -60,7 +61,7 @@ def _ring_attention_local(q, k, v, axis, causal, scale, qseg=None,
     """Runs inside shard_map: q/k/v are the local sequence blocks.
     ``qseg``/``kseg`` ([B, T_local] int32) add the packing mask; kseg
     rotates around the ring in lock-step with its K/V block."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     tq, tk = q.shape[2], k.shape[2]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -117,7 +118,7 @@ def _ring_flash_fwd_local(q, k, v, axis, causal, scale, qseg=None,
     flash-backward residual.
     """
     from ..ops.pallas.flash_attention import flash_forward_with_lse
-    n = lax.axis_size(axis)  # static: mesh axis sizes are trace-time ints
+    n = axis_size(axis)  # static: mesh axis sizes are trace-time ints
     idx = lax.axis_index(axis)
 
     o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
@@ -172,7 +173,7 @@ def _ring_flash_bwd_local(q, k, v, out, lse, g, axis, causal, scale,
     sees its own diagonal block).  Per-block dk/dv rotate around the
     ring in lock-step with k/v, landing home after n hops."""
     from ..ops.pallas.flash_attention import _flash_bwd
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     b, h, tq, d = q.shape
     dvdim = v.shape[-1]

@@ -396,7 +396,7 @@ class Symbol:
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     group2ctx=None, shared_arg_names=None, shared_exec=None,
                     shared_buffer=None, mesh=None, batch_names=None,
-                    **kwargs):
+                    partition_rules=None, **kwargs):
         from ..executor import Executor
         from ..context import current_context
         from .. import nd
@@ -420,7 +420,8 @@ class Symbol:
                 for name, a in args.items()}
         return Executor(self, ctx, args, args_grad, grad_req, aux,
                         group2ctx=group2ctx, shared_exec=shared_exec,
-                        mesh=mesh, batch_names=batch_names)
+                        mesh=mesh, batch_names=batch_names,
+                        partition_rules=partition_rules)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):

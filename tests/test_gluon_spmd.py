@@ -75,3 +75,167 @@ def test_gluon_spmd_matches_single_device():
     x8 = gluon.utils.shard_and_load(X, [mx.cpu(i) for i in range(8)])
     acc = (net8(x8).asnumpy().argmax(1) == Y).mean()
     assert acc > 0.95
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded weight update on the gluon Trainer path
+# ---------------------------------------------------------------------------
+
+def _trainer_of(net):
+    return gluon.Trainer(net.collect_params(), "adam",
+                         {"learning_rate": 0.05})
+
+
+def _train_zero(ctx, X, Y, steps=15, opt="adam", lr=0.05):
+    np.random.seed(1)
+    mx.random.seed(1)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), opt,
+                            {"learning_rate": lr})
+    multi = isinstance(ctx, (list, tuple)) and len(ctx) > 1
+    for _ in range(steps):
+        if multi:
+            x = gluon.utils.shard_and_load(X, ctx)
+            y = gluon.utils.shard_and_load(Y, ctx)
+        else:
+            x, y = nd.array(X), nd.array(Y)
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(X.shape[0])
+    return net, trainer
+
+
+def test_gluon_zero1_state_sharded(monkeypatch):
+    """MXTPU_ZERO=1 + initialize(ctx=[8 devices]): Adam mean/var live
+    1/8 per device; the (2,)-bias state falls back replicated; params
+    stay replicated (ZeRO-1, not FSDP)."""
+    monkeypatch.setenv("MXTPU_ZERO", "1")
+    X, Y = _problem()
+    ctx = [mx.cpu(i) for i in range(8)]
+    net, trainer = _train_zero(ctx, X, Y, steps=3)
+    assert trainer._fused["zero"] is not None
+    sharded = 0
+    for key, st in trainer._fused["state"].items():
+        for leaf in jax.tree_util.tree_leaves(st):
+            assert len(leaf.addressable_shards) == 8
+            if not leaf.sharding.is_fully_replicated:
+                sharded += 1
+    assert sharded >= 4  # dense0 weight/bias + dense1 weight, mean+var
+    for _, p in enumerate(net.collect_params().values()):
+        assert p.data()._data.sharding.is_fully_replicated
+
+
+def test_gluon_zero1_matches_single_device(monkeypatch):
+    """15 ZeRO-1 Trainer steps track the single-device fused Trainer
+    bit-tolerantly (the reduce-scatter/all-gather reassociation bound,
+    same contract as the Module path)."""
+    X, Y = _problem()
+    net1, _ = _train_zero(mx.cpu(0), X, Y)
+    monkeypatch.setenv("MXTPU_ZERO", "1")
+    net8, tr8 = _train_zero([mx.cpu(i) for i in range(8)], X, Y)
+    p1 = net1.collect_params()
+    p8 = net8.collect_params()
+    for n1, n8 in zip(sorted(p1.keys()), sorted(p8.keys())):
+        np.testing.assert_allclose(p1[n1].data().asnumpy(),
+                                   p8[n8].data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg="param %s diverged" % n1)
+
+
+def test_gluon_zero1_one_dispatch_per_step(monkeypatch):
+    """The sharded gluon update stays one donated program: exactly one
+    dispatch per trainer.step in steady state."""
+    from mxnet_tpu import profiler
+    monkeypatch.setenv("MXTPU_ZERO", "1")
+    X, Y = _problem()
+    ctx = [mx.cpu(i) for i in range(8)]
+    np.random.seed(1)
+    mx.random.seed(1)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9})
+
+    def fwd_bwd():
+        x = gluon.utils.shard_and_load(X, ctx)
+        y = gluon.utils.shard_and_load(Y, ctx)
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+
+    def one_step():
+        fwd_bwd()
+        trainer.step(X.shape[0])
+
+    one_step()  # warm: fwd/bwd + fused update compile here
+    one_step()
+    # baseline: what fwd/bwd alone dispatches per iteration
+    stats0 = profiler.step_stats()
+    for _ in range(4):
+        fwd_bwd()
+    base = profiler.step_stats()["dispatch_count"] - \
+        stats0["dispatch_count"]
+    stats1 = profiler.step_stats()
+    for _ in range(4):
+        one_step()
+    stats = profiler.step_stats()
+    assert stats["compile_count"] == stats0["compile_count"]
+    # the ZeRO-1 update contributes EXACTLY one dispatch per step on top
+    # of fwd/bwd (regression: a per-param loop costs one per parameter)
+    assert stats["dispatch_count"] - stats1["dispatch_count"] == base + 4
+
+
+def test_gluon_zero1_state_save_load_roundtrip(monkeypatch, tmp_path):
+    """Trainer.save_states gathers ZeRO-1 state to a full-size payload;
+    load_states into a fresh ZeRO trainer reshards it back 1/N with
+    values preserved exactly."""
+    monkeypatch.setenv("MXTPU_ZERO", "1")
+    X, Y = _problem()
+    ctx = [mx.cpu(i) for i in range(8)]
+    net, trainer = _train_zero(ctx, X, Y, steps=5)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    saved = {k: [np.asarray(l) for l in jax.tree_util.tree_leaves(st)]
+             for k, st in trainer._fused["state"].items()}
+
+    net2, trainer2 = _train_zero(ctx, X, Y, steps=1)
+    trainer2.load_states(fname)
+    # the LOADED pre-step values made it in bit-exact: the Updater holds
+    # the gathered payload the fused rebuild will reshard from
+    for k, leaves in saved.items():
+        got = trainer2._updaters.states[int(k)]
+        got_leaves = [np.asarray(g._data) for g in
+                      (got if isinstance(got, tuple) else (got,))]
+        for want, have in zip(leaves, got_leaves):
+            np.testing.assert_array_equal(want, have,
+                                          err_msg="state %s changed "
+                                                  "across save->load" % k)
+    # force the fused rebuild that re-seeds + reshards from the Updater
+    x = gluon.utils.shard_and_load(X, ctx)
+    y = gluon.utils.shard_and_load(Y, ctx)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = loss_fn(net2(x), y)
+    loss.backward()
+    trainer2.step(X.shape[0])
+    # ...and the resharded leaves hold 1/8 per device again (the tiny
+    # (2,)-bias states legitimately replicate — shardedness is asserted
+    # over the tree, per-key only the placement on all 8 devices)
+    st2 = trainer2._fused["state"]
+    sharded = 0
+    for k in saved:
+        leaves2 = jax.tree_util.tree_leaves(st2[k])
+        assert all(len(l.addressable_shards) == 8 for l in leaves2)
+        sharded += sum(not l.sharding.is_fully_replicated
+                       for l in leaves2)
+    assert sharded >= 4

@@ -55,6 +55,7 @@ def test_gpt_moe_trains_single_device():
     assert np.isfinite(g_gate).all() and np.abs(g_gate).max() > 0
 
 
+@pytest.mark.slow
 def test_gpt_moe_expert_parallel_matches_dense():
     """ep-sharded experts == local experts when capacity doesn't bind
     (capacity_factor = num_experts): loss AND grads equal."""
@@ -105,6 +106,7 @@ def test_gpt_moe_generate_matches_recompute():
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow
 def test_gpt_moe_dp_times_ep_matches_dense():
     """ep composes with dp in one mesh (tokens sharded over both for
     dispatch): loss equals the local-expert oracle (no-drop config)."""

@@ -60,6 +60,7 @@ def _check_grads(named, ref_named):
             rtol=2e-4, atol=2e-5, err_msg="gpt 1f1b grad %s" % k)
 
 
+@pytest.mark.slow
 def test_gpt_1f1b_matches_sequential_pp4():
     """4 stages (embed+blk | blk | blk | blk+head), every grad exact."""
     net, vocab, t = _make_net(n_layers=4)
@@ -75,6 +76,7 @@ def test_gpt_1f1b_matches_sequential_pp4():
     _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
 
 
+@pytest.mark.slow
 def test_gpt_1f1b_pp_times_dp():
     """pp=2 x dp=2 composition: batch-sharded microbatches, psum'd
     grads — still exactly the sequential answer."""
@@ -92,6 +94,7 @@ def test_gpt_1f1b_pp_times_dp():
     _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
 
 
+@pytest.mark.slow
 def test_gpt_1f1b_tied_update_step():
     """One SGD step on the union params keeps the two wte slots tied."""
     net, vocab, t = _make_net(n_layers=2)
@@ -156,6 +159,7 @@ def test_gpt_1f1b_3d_pp_dp_tp():
     _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
 
 
+@pytest.mark.slow
 def test_gpt_1f1b_fewer_microbatches_than_stages():
     """M < S (deep pipeline, small batch): the schedule's validity
     masks must keep gradients exact through the mostly-bubble rounds."""
@@ -172,6 +176,7 @@ def test_gpt_1f1b_fewer_microbatches_than_stages():
     _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
 
 
+@pytest.mark.slow
 def test_gpt_single_stage_matches_sequential():
     """pp=1 degenerate pipeline (embed->blocks->head fused in one
     stage) still equals the sequential model — guards the blocks from
@@ -189,6 +194,7 @@ def test_gpt_single_stage_matches_sequential():
     _check_grads(par.gpt_pp.grads_by_name(grads, names), ref_named)
 
 
+@pytest.mark.slow
 def test_gpt_1f1b_remat_identical():
     """remat=True (per-block checkpoint inside stages) changes memory,
     not math: loss and grads equal the non-remat pipeline bitwise-ish."""
@@ -212,6 +218,7 @@ def test_gpt_1f1b_remat_identical():
                                    rtol=1e-5, atol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow
 def test_gpt_1f1b_packed_matches_sequential():
     """Packing composes with the pipeline: segments ride the
     per-microbatch feed to every stage's segment-masked attention and

@@ -288,7 +288,10 @@ def test_tensor_parallel_param_sharding():
     # indivisible dim falls back to replication
     params2 = {"dense1_weight": _rand(41, 15, 8)}
     sharded2 = par.shard_params(params2, mesh, par.sharding.DEFAULT_TP_RULES)
-    assert sharded2["dense1_weight"].sharding.spec == P(None, None)
+    # replication fallback is canonically P() now (zero1_spec composes
+    # with base specs, so "all dims None" and "empty" must be one value)
+    assert sharded2["dense1_weight"].sharding.spec == P()
+    assert sharded2["dense1_weight"].sharding.is_fully_replicated
 
 
 def test_tp_matmul_correctness():
@@ -431,3 +434,119 @@ def test_pipeline_1f1b_batch_axis_sums_shards():
         np.testing.assert_allclose(np.asarray(g_dp[k]),
                                    np.asarray(g_rep[k]),
                                    rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Partition-rule resolver + ZeRO-1 spec layer (parallel/sharding.py)
+# ---------------------------------------------------------------------------
+
+def test_match_partition_rules_resolves_tree():
+    """The rule-driven front door: first matching rule wins, unmatched
+    leaves replicate, scalars are never partitioned, and with a mesh the
+    specs are validated against leaf shapes."""
+    mesh = par.make_mesh(dp=4, tp=2)
+    params = {
+        "block0_dense_weight": _rand(1, 16, 8),
+        "block0_dense_bias": jnp.zeros(16),
+        "embedding_weight": _rand(2, 32, 8),
+        "norm_gamma": jnp.ones(8),
+        "t_scalar": jnp.zeros(()),
+    }
+    rules = [(r"dense.*weight$", P("tp", None), 2),
+             (r"embedding.*weight$", P(None, "tp"), 2),
+             (r"(gamma|beta)$", P(), 1)]
+    specs = par.match_partition_rules(rules, params, mesh=mesh)
+    assert specs["block0_dense_weight"] == P("tp", None)
+    assert specs["embedding_weight"] == P(None, "tp")
+    assert specs["norm_gamma"] == P()
+    assert specs["block0_dense_bias"] == P()   # no rule -> replicated
+    assert specs["t_scalar"] == P()            # scalars never partition
+
+
+def test_match_partition_rules_validates_indivisible():
+    mesh = par.make_mesh(dp=4, tp=2)
+    params = {"odd_dense_weight": _rand(3, 15, 8)}  # 15 % 2 != 0
+    specs = par.match_partition_rules(
+        [(r"dense.*weight$", P("tp", None), 2)], params, mesh=mesh)
+    assert specs["odd_dense_weight"] == P()
+
+
+def test_zero1_spec_picks_first_divisible_free_dim():
+    mesh = par.make_mesh(dp=8)
+    assert par.zero1_spec((32, 16), mesh) == P("dp", None)
+    assert par.zero1_spec((4, 32), mesh) == P(None, "dp")
+    assert par.zero1_spec((4,), mesh) == P()            # fallback
+    # composes with an existing (tp) base: dp lands on a FREE dim
+    mesh2 = par.make_mesh(dp=4, tp=2)
+    assert par.zero1_spec((16, 8), mesh2, base=P("tp", None)) == \
+        P("tp", "dp")
+    # base fully occupies the only divisible dims -> base preserved
+    assert par.zero1_spec((16, 3), mesh2, base=P("tp", None)) == \
+        P("tp", None)
+
+
+def test_zero1_partition_counts_fallbacks():
+    from mxnet_tpu import telemetry
+    mesh = par.make_mesh(dp=8)
+    before = telemetry.report()["counters"].get("sharding.fallbacks", 0)
+    specs = par.zero1_partition(
+        {"w": _rand(5, 32, 16), "tiny": jnp.zeros(3)}, mesh)
+    assert specs["w"] == P("dp", None)
+    assert specs["tiny"] == P()
+    after = telemetry.report()["counters"]["sharding.fallbacks"]
+    assert after == before + 1
+
+
+def test_validate_spec_fallback_warns_once(caplog):
+    """Satellite contract: a mis-sized mesh is VISIBLE — one warning per
+    param name (not one per placement call), every fallback counted."""
+    import logging as _logging
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.parallel import sharding as shd
+    mesh = par.make_mesh(dp=8)
+    name = "warn_once_probe_%d" % np.random.randint(1 << 30)
+    before = telemetry.report()["counters"].get("sharding.fallbacks", 0)
+    with caplog.at_level(_logging.WARNING):
+        shd._validate_spec(P("dp"), (3,), mesh, name=name)
+        shd._validate_spec(P("dp"), (3,), mesh, name=name)
+    after = telemetry.report()["counters"]["sharding.fallbacks"]
+    assert after == before + 2          # every decision counted
+    hits = [r for r in caplog.records if name in r.getMessage()]
+    assert len(hits) == 1               # ...but warned once
+
+
+def test_shard_params_donate_frees_source():
+    """Satellite bugfix: donate=True actually retires the source buffer
+    on a resharding device_put (the old signature accepted and ignored
+    it).  donate=False keeps the source alive."""
+    mesh = par.make_mesh(dp=8)
+    src = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                         NamedSharding(mesh, P()))
+    kept = np.asarray(src).copy()
+    out = par.shard_params({"w": src}, mesh,
+                           [(r"w", P("dp", None), 2)], donate=True)
+    assert src.is_deleted()
+    np.testing.assert_array_equal(np.asarray(out["w"]), kept)
+    assert out["w"].sharding.spec == P("dp", None)
+
+    src2 = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                          NamedSharding(mesh, P()))
+    out2 = par.shard_params({"w": src2}, mesh,
+                            [(r"w", P("dp", None), 2)], donate=False)
+    assert not src2.is_deleted()
+    np.testing.assert_array_equal(np.asarray(out2["w"]), kept)
+
+    # already on target: nothing to move, nothing deleted
+    out3 = par.shard_params({"w": out["w"]}, mesh,
+                            [(r"w", P("dp", None), 2)], donate=True)
+    assert not out["w"].is_deleted()
+    assert out3["w"].sharding.spec == P("dp", None)
+
+    # source committed to ONE device (the checkpoint-load shape): the
+    # donate path must widen onto the mesh, not reject the narrow input
+    src3 = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                          jax.devices()[0])
+    out4 = par.shard_params({"w": src3}, mesh,
+                            [(r"w", P("dp", None), 2)], donate=True)
+    assert src3.is_deleted()
+    np.testing.assert_array_equal(np.asarray(out4["w"]), kept)

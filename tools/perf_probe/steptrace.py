@@ -21,10 +21,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
 def build_module(batch=64, dim=32, classes=4, hidden=64, depth=2,
-                 n_batches=8):
+                 n_batches=8, ctx=None, optimizer="sgd",
+                 opt_params=(("learning_rate", 0.05), ("momentum", 0.9))):
     """The probe family's MLP fit-loop fixture (restart_probe reuses it
     with bigger sizes): ``depth-1`` hidden relu layers + a softmax
-    head."""
+    head.  ``ctx`` may be a device list — the BENCH_MODE=spmd probe
+    passes the whole 8-device host mesh."""
     import numpy as np
     import mxnet_tpu as mx
 
@@ -41,13 +43,12 @@ def build_module(batch=64, dim=32, classes=4, hidden=64, depth=2,
     out = mx.sym.FullyConnected(net, num_hidden=classes,
                                 name="fc%d" % depth)
     s = mx.sym.SoftmaxOutput(out, name="softmax")
-    mod = mx.mod.Module(s, context=mx.cpu())
+    mod = mx.mod.Module(s, context=mx.cpu() if ctx is None else ctx)
     mod.bind(data_shapes=train.provide_data,
              label_shapes=train.provide_label)
     mod.init_params(mx.initializer.Uniform(0.1))
-    mod.init_optimizer(kvstore=None, optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.05),
-                                         ("momentum", 0.9)))
+    mod.init_optimizer(kvstore=None, optimizer=optimizer,
+                       optimizer_params=opt_params)
     return mod, train
 
 
@@ -161,5 +162,77 @@ def run():
             "unfused": unfused, "n_params": n_params}
 
 
+def run_spmd(n_dev=8):
+    """BENCH_MODE=spmd body: the ZeRO-1 fused step on an n_dev host
+    mesh.  Returns per-step dispatch stats plus the sharded-state
+    economics (opt-state bytes per device vs total, the estimated
+    per-step collective bytes, fallback count) so bench.py can assert
+    the 1.0 dispatch/step and 1/N-state contracts."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    if jax.device_count() < n_dev:
+        raise RuntimeError(
+            "BENCH_MODE=spmd needs %d devices (run under "
+            "--xla_force_host_platform_device_count=%d or on real "
+            "chips); have %d" % (n_dev, n_dev, jax.device_count()))
+    prev = os.environ.get("MXTPU_ZERO")
+    os.environ["MXTPU_ZERO"] = "1"
+    try:
+        ctx = [mx.cpu(i) for i in range(n_dev)]
+        # adam: two state leaves per param — the sharpest 1/N contrast
+        mod, train = build_module(ctx=ctx, optimizer="adam",
+                                  opt_params=(("learning_rate", 0.01),))
+        batches = list(train)
+        spmd = trace(mod.fit_step, batches)
+
+        fused = mod._fused
+        assert fused["zero"] is not None, \
+            "MXTPU_ZERO=1 on a mesh bind must engage ZeRO-1"
+        # trace() resets telemetry after warmup, wiping the setup-time
+        # sharding gauges — republish them for the report below
+        mod._exec._note_sharding_telemetry(
+            tuple(fused["update_names"]), fused["state"], fused["zero"])
+        total = 0
+        per_device = 0
+        sharded_leaves = 0
+        leaves = 0
+        for name, sub in fused["state"].items():
+            for leaf in jax.tree_util.tree_leaves(sub):
+                leaves += 1
+                nb = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+                total += nb
+                shards = {s.data.shape for s in leaf.addressable_shards}
+                per_device += int(np.prod(next(iter(shards)))) * \
+                    leaf.dtype.itemsize
+                if not leaf.sharding.is_fully_replicated:
+                    sharded_leaves += 1
+        rep = telemetry.report()
+        spmd.update({
+            "n_devices": n_dev,
+            "opt_state_total_bytes": total,
+            "opt_state_bytes_per_device": per_device,
+            "opt_state_leaves": leaves,
+            "opt_state_leaves_sharded": sharded_leaves,
+            "gauge_opt_state_bytes_per_device":
+                rep["gauges"].get("sharding.opt_state_bytes_per_device"),
+            "gauge_collective_bytes_per_step":
+                rep["gauges"].get("sharding.collective_bytes_per_step"),
+            "sharding_fallbacks":
+                rep["counters"].get("sharding.fallbacks", 0),
+        })
+        return spmd
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_ZERO", None)
+        else:
+            os.environ["MXTPU_ZERO"] = prev
+
+
 if __name__ == "__main__":
-    print(json.dumps(run()))
+    if os.environ.get("STEPTRACE_SPMD") == "1":
+        print(json.dumps(run_spmd()))
+    else:
+        print(json.dumps(run()))
