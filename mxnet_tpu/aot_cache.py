@@ -78,7 +78,7 @@ __all__ = ["cache_dir", "enabled", "fingerprint", "cache_key", "load",
            "donation_cache_guard", "memo_get", "memo_put", "clear_memo",
            "drain"]
 
-_FORMAT = "mxtpu-aot-3"  # bump to orphan every existing entry
+_FORMAT = "mxtpu-aot-4"  # bump to orphan every existing entry
 
 #: variants an entry can carry (exactly one per entry; the writer picks
 #: what its own backend can safely consume on restart)
@@ -383,11 +383,14 @@ def _deserialize(ser, opts_blob, in_tree, out_tree):
 
 def load(key):
     """Deserialize the cached executable for ``key``.  Returns
-    ``(compiled, variant)`` or None (missing / unreadable /
+    ``(compiled, variant, meta)`` or None (missing / unreadable /
     version-skewed — any failure is a miss or a counted error).  An entry
     whose variant this backend cannot safely execute (a ``donated`` blob
     on a donation-unsafe backend, e.g. written under
-    MXTPU_AOT_FORCE_DONATED) is discarded, not executed."""
+    MXTPU_AOT_FORCE_DONATED) is discarded, not executed.  ``meta`` is
+    the writer's JSON-able sidecar (compile-time cost/memory analysis —
+    a deserialized executable cannot always re-derive it, so the
+    original compile's numbers ride along)."""
     path = _path(key)
     try:
         with open(path, "rb") as f:
@@ -397,7 +400,7 @@ def load(key):
         return None
     try:
         with _telemetry.span("aot.deserialize", cat="aot"):
-            fmt, var, ser, opts_blob, in_tree, out_tree = \
+            fmt, var, ser, opts_blob, in_tree, out_tree, meta = \
                 pickle.loads(blob)
             if fmt != _FORMAT:
                 raise ValueError("format %r != %r" % (fmt, _FORMAT))
@@ -419,19 +422,22 @@ def load(key):
             pass
         return None
     _telemetry.counter("aot.cache_hits").inc()
-    return compiled, var
+    return compiled, var, meta
 
 
-def store(key, compiled, var):
+def store(key, compiled, var, meta=None):
     """Serialize ``compiled`` into the cache atomically (tmp+rename via
     the checkpoint layer's plain writer: cache entries must not consume
-    ckpt fault budgets or pollute checkpoint metrics).  Best-effort —
-    a read-only or full cache dir costs the warm start, not the run."""
+    ckpt fault budgets or pollute checkpoint metrics).  ``meta`` is an
+    optional JSON-able sidecar stored alongside (the compile-time
+    cost/memory attribution, republished as gauges on a warm load).
+    Best-effort — a read-only or full cache dir costs the warm start,
+    not the run."""
     try:
         with _telemetry.span("aot.serialize", cat="aot"):
             ser, opts_blob, in_tree, out_tree = _serialize(compiled)
             blob = pickle.dumps((_FORMAT, var, ser, opts_blob, in_tree,
-                                 out_tree))
+                                 out_tree, meta))
         d = cache_dir()
         os.makedirs(d, exist_ok=True)
         from .checkpoint import _plain_atomic_write

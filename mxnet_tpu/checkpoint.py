@@ -844,15 +844,46 @@ class CheckpointManager:
         """Load (epoch, arg_params, aux_params).  With ``epoch=None`` the
         newest complete checkpoint is used; an explicit epoch must
         verify.  In-flight async writes are drained first — a load must
-        never race the writer over the very files it is reading."""
+        never race the writer over the very files it is reading.
+
+        **Concurrent retention**: under async checkpointing the writer
+        thread commits newer epochs and keep-last-N prunes older ones
+        while a recovery poller loads — so "the newest complete epoch"
+        can be pruned between this call's ``latest()`` and its file
+        reads (newer epochs landed in between, pushing it past the
+        retention cutoff).  The ``epoch=None`` path therefore RETRIES
+        against a re-resolved ``latest()`` whenever the failed epoch is
+        no longer the newest; only a failure on a STABLE newest epoch —
+        genuine corruption — propagates.  An explicit ``epoch`` is the
+        caller's pin and never retries: pruned-underfoot surfaces as the
+        documented recovery error."""
         flush_async(raise_errors=False)
-        if epoch is None:
+        if epoch is not None:
+            return self._load_epoch(epoch)
+        # epoch=None: follow the newest complete checkpoint wherever
+        # concurrent retention moves it.  Bounded: each retry requires
+        # latest() to have ADVANCED past the epoch that just failed, and
+        # it only advances while the writer is actively committing.
+        last_err = None
+        for _ in range(16):
             epoch = self.latest()
             if epoch is None:
                 raise MXNetError(
                     "no complete checkpoint found for prefix %s"
                     % self.prefix)
-        elif os.path.exists(self.manifest_path(epoch)) and \
+            try:
+                return self._load_epoch(epoch)
+            except MXNetError as e:
+                last_err = e
+                if self.latest() == epoch:
+                    raise  # stable target: a real recovery failure
+        raise last_err
+
+    def _load_epoch(self, epoch):
+        """The single-epoch load body (validation + read + key split);
+        ``load()`` owns target resolution and the retention-race
+        retry."""
+        if os.path.exists(self.manifest_path(epoch)) and \
                 not self.validate(epoch):
             raise MXNetError(
                 "checkpoint %s failed validation (torn or corrupt); "

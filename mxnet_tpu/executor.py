@@ -602,30 +602,50 @@ class Executor:
         if self._staged:
             return step  # eager multi-device ctx_group binds can't donate
         from . import aot_cache as _aot
+        # each fused program gets fresh attribution: a rebuild on this
+        # bind (optimizer reconfigured) must not republish the previous
+        # program's cost/memory numbers
+        self._cost_doc = None
         mk_jit = self._fit_step_jit_factory(step, update_names, opt_state,
                                             zero_shardings)
-        if cache_extra is not None and opt_state is not None:
-            if _aot.enabled():
-                # the mesh layout is part of the executable's identity:
-                # same devices under a different mesh shape / different
-                # input shardings is a different program (the PR-6
-                # topology-clobber class of bug, aot_cache.fingerprint
-                # docs) — fold it into the key alongside the caller's
-                # optimizer-config hash.  Mesh programs on backends that
-                # cannot execute ANY deserialized SPMD executable
-                # (aot_cache.deserialized_spmd_safe: CPU heap
-                # corruption / rendezvous deadlock, even donation-free)
-                # use only the in-process memo tier — no disk
-                disk_ok = self._mesh is None or \
-                    _aot.deserialized_spmd_safe()
-                fn = self._aot_fit_step(
-                    step, update_names, opt_state,
-                    cache_extra + self._mesh_cache_extra(zero_shardings),
-                    mk_jit, disk_ok=disk_ok)
-                if fn is not None:
-                    return fn
-        # donated program compiling lazily at first dispatch: keep it out
-        # of jax's persistent cache on backends where replaying a donated
+        if opt_state is not None:
+            # every fused bind with an example state tree goes through
+            # the AOT compile path, cache or no cache: the same compile
+            # the lazy jit would pay at first dispatch happens eagerly,
+            # and the compiled handle is what cost/memory attribution
+            # (compiled.cost_analysis / memory_analysis → xla.cost.* /
+            # xla.memory.* gauges, OBSERVABILITY.md §8) and the
+            # in-process memo need.  The disk tiers additionally need
+            # the cache dir and the caller's config hash — and the mesh
+            # layout is part of the executable's identity: same devices
+            # under a different mesh shape / different input shardings
+            # is a different program (the PR-6 topology-clobber class of
+            # bug, aot_cache.fingerprint docs), folded into the key
+            # alongside the optimizer-config hash.  Mesh programs on
+            # backends that cannot execute ANY deserialized SPMD
+            # executable (aot_cache.deserialized_spmd_safe: CPU heap
+            # corruption / rendezvous deadlock, even donation-free) use
+            # only the in-process memo tier — no disk.
+            # cache_extra IS the program's identity (graph + optimizer
+            # hash): without it the key would cover only backend +
+            # shapes, and two same-shape different-graph binds would
+            # collide in the memo/disk tiers — so a None cache_extra
+            # keeps the eager compile (cost capture) but serves NO
+            # cache tier, exactly the per-bind isolation the old lazy
+            # path gave such callers
+            identity_ok = cache_extra is not None
+            disk_ok = identity_ok and _aot.enabled() and \
+                (self._mesh is None or _aot.deserialized_spmd_safe())
+            fn = self._aot_fit_step(
+                step, update_names, opt_state,
+                (cache_extra or "") +
+                self._mesh_cache_extra(zero_shardings),
+                mk_jit, disk_ok=disk_ok, memo_ok=identity_ok)
+            if fn is not None:
+                return fn
+        # donated program compiling lazily at first dispatch (no example
+        # opt-state tree, or the AOT path failed): keep it out of jax's
+        # persistent cache on backends where replaying a donated
         # executable from that cache corrupts the heap (aot_cache docs)
         return self._instrument(_aot.donation_cache_guard(mk_jit()))
 
@@ -739,13 +759,173 @@ class Executor:
                 coll_bytes += 2 * b * (n - 1) // n
         _telemetry.gauge("sharding.opt_state_bytes_per_device") \
             .set(state_bytes)
+        # the ring MODEL: what the weight-update collectives should move
+        # if the program contains exactly the collectives the ZeRO/DP
+        # design predicts.  sharding.collective_bytes_per_step starts as
+        # this model and is OVERWRITTEN by the measurement from the
+        # compiled program's actual collective ops once the fused step
+        # compiles (_publish_cost_telemetry) — the modeled gauge stays
+        # for comparison (a large gap means the compiler emitted
+        # different collectives than the design assumes).
+        _telemetry.gauge("sharding.collective_bytes_modeled") \
+            .set(coll_bytes)
         _telemetry.gauge("sharding.collective_bytes_per_step") \
             .set(coll_bytes)
         _telemetry.gauge("sharding.zero_stage").set(
             1 if zero_shardings is not None else 0)
 
+    # -- compile-time cost attribution (OBSERVABILITY.md §8) ---------------
+    _DTYPE_BYTES = {"pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+                    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+                    "s32": 4, "u32": 4, "f32": 4,
+                    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+                    # fp8 families (quantized-comm collectives must not
+                    # count as zero-payload opaque types)
+                    "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3fnuz": 1,
+                    "f8e4m3b11fnuz": 1, "f8e5m2": 1, "f8e5m2fnuz": 1,
+                    "f8e3m4": 1, "f8e8m0fnu": 1}
+
+    @classmethod
+    def _hlo_collective_bytes(cls, hlo_text, n):
+        """Measured per-device collective traffic of one step, from the
+        compiled (post-GSPMD, post-optimization) HLO: every collective
+        op's OUTPUT shape — per-device in the partitioned module —
+        converted to ring-equivalent bytes moved with ``n``
+        participants:
+
+        - all-reduce: ``2·B·(n-1)/n`` (ring RS+AG of the full buffer B =
+          output size),
+        - all-gather: ``B·(n-1)/n`` (B = gathered output),
+        - reduce-scatter: ``B_full·(n-1)/n = B_out·(n-1)`` (output is the
+          1/n shard),
+        - all-to-all: ``B·(n-1)/n``; collective-permute: ``B``.
+
+        ``n`` is approximated by the bind's data-parallel axis size
+        (collectives over other mesh axes get the same factor — close
+        enough for the gauge's job of replacing a formula that guessed
+        at the program's very structure).  Async pairs count once (the
+        ``-done`` op carries the result; ``-start`` outputs are
+        bookkeeping tuples).  Returns ``(bytes, {op: count})``."""
+        import re
+        total = 0
+        counts = {}
+        op_re = re.compile(
+            r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+            r"(all-reduce|all-gather|reduce-scatter|all-to-all|"
+            r"collective-permute)((?:-start|-done)?)\(")
+        shape_re = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+        for m in op_re.finditer(hlo_text):
+            shapes, op, suffix = m.group(1), m.group(2), m.group(3)
+            if suffix == "-start":
+                continue
+            b = 0
+            for dt, dims in shape_re.findall(shapes):
+                size = cls._DTYPE_BYTES.get(dt)
+                if size is None:
+                    continue  # token/opaque types carry no payload
+                numel = 1
+                for d in dims.split(","):
+                    if d:
+                        numel *= int(d)
+                b += numel * size
+            if n > 1:
+                factor = {"all-reduce": 2.0 * (n - 1) / n,
+                          "all-gather": (n - 1) / n,
+                          "reduce-scatter": float(n - 1),
+                          "all-to-all": (n - 1) / n,
+                          "collective-permute": 1.0}[op]
+            else:
+                factor = 0.0
+            total += int(b * factor)
+            counts[op] = counts.get(op, 0) + 1
+        return total, counts
+
+    def _analyze_compiled(self, compiled):
+        """JSON-able compile-time attribution of the fused step, from
+        the backend's own accounting of the AOT-compiled program:
+        ``cost_analysis`` (flops / bytes-accessed per execution),
+        ``memory_analysis`` (argument / output / temp / alias /
+        generated-code bytes resident per device), and the measured
+        collective bytes (mesh binds).  Every field is best-effort —
+        a backend that reports nothing yields None, never an error."""
+        doc = {}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if ca:
+                cost = {"flops": ca.get("flops"),
+                        "bytes_accessed": ca.get("bytes accessed"),
+                        "transcendentals": ca.get("transcendentals")}
+                doc["cost"] = {k: v for k, v in cost.items()
+                               if v is not None}
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                doc["memory"] = {
+                    "argument_bytes": int(ma.argument_size_in_bytes),
+                    "output_bytes": int(ma.output_size_in_bytes),
+                    "temp_bytes": int(ma.temp_size_in_bytes),
+                    "alias_bytes": int(ma.alias_size_in_bytes),
+                    "generated_code_bytes":
+                        int(ma.generated_code_size_in_bytes),
+                }
+        except Exception:
+            pass
+        if self._mesh is not None:
+            try:
+                n = self._mesh.shape.get(self._dp_axis, 1)
+                bytes_, counts = self._hlo_collective_bytes(
+                    compiled.as_text(), n)
+                doc["collectives"] = {"bytes_per_step": bytes_,
+                                      "ops": counts,
+                                      "participants": n}
+            except Exception:
+                pass
+        return doc or None
+
+    def _capture_cost_telemetry(self, compiled):
+        """Derive (once per bind) and publish the attribution doc for
+        the fused step.  Returns the doc — the AOT cache stores it as
+        entry metadata so a warm restart republishes the original
+        compile's numbers without a compiled object that can re-derive
+        them."""
+        doc = getattr(self, "_cost_doc", None)
+        if doc is None:
+            doc = self._analyze_compiled(compiled)
+        return self._publish_cost_telemetry(doc)
+
+    def _publish_cost_telemetry(self, doc):
+        """Set the xla.cost.* / xla.memory.* gauges (and overwrite the
+        modeled collective-bytes gauge with the measured value) from an
+        attribution doc.  Idempotent; kept separate from capture so
+        probes that reset the registry mid-run can republish
+        (:meth:`publish_cost_telemetry`)."""
+        if not doc:
+            return None
+        self._cost_doc = doc
+        from . import telemetry as _telemetry
+        for k, v in (doc.get("cost") or {}).items():
+            _telemetry.gauge("xla.cost.%s_per_step" % k).set(v)
+        for k, v in (doc.get("memory") or {}).items():
+            _telemetry.gauge("xla.memory.%s" % k).set(v)
+        coll = doc.get("collectives")
+        if coll and coll.get("bytes_per_step") is not None:
+            _telemetry.gauge("sharding.collective_bytes_per_step") \
+                .set(coll["bytes_per_step"])
+        return doc
+
+    def publish_cost_telemetry(self):
+        """Re-publish the bind's attribution gauges (no-op before the
+        fused step compiled).  For probes (steptrace) that reset the
+        telemetry registry after warmup."""
+        return self._publish_cost_telemetry(
+            getattr(self, "_cost_doc", None))
+
     def _aot_fit_step(self, step, update_names, opt_state, cache_extra,
-                      mk_jit, disk_ok=True):
+                      mk_jit, disk_ok=True, memo_ok=True):
         """AOT-compile the fused step against the bound shapes and run it
         through the persistent executable cache.  Returns the
         instrumented program, or None to fall back to plain jit (any
@@ -784,15 +964,23 @@ class Executor:
                 # floats, exactly what the hot path passes per step
                 0.01, 0.0, 1.0, 1.0, 0.0)
             key = _aot.cache_key("fit_step", examples, extra=cache_extra)
-            memo = _aot.memo_get(key)
+            memo = _aot.memo_get(key) if memo_ok else None
             if memo is not None:
+                # original compiled object: cost attribution re-derives
+                # (or a prior capture on this executor already published)
+                self._capture_cost_telemetry(memo)
                 return self._instrument(memo, first_call_compiles=False)
             loaded = _aot.load(key) if disk_ok else None
             if loaded is not None:
-                compiled, var = loaded
+                compiled, var, meta = loaded
                 # no trace, no (foreground) compile: the startup-grace
                 # window sized for XLA compilation can shrink
                 _watchdog.note_warm_start()
+                # a deserialized executable cannot always re-derive its
+                # analyses — republish the original compile's numbers
+                # from the entry sidecar
+                self._publish_cost_telemetry(
+                    meta or self._analyze_compiled(compiled))
                 if var == _aot.VARIANT_DONATED:
                     _aot.memo_put(key, compiled)
                     return self._instrument(compiled,
@@ -801,9 +989,12 @@ class Executor:
             with _telemetry.span("aot.compile", cat="aot"):
                 with _aot.bypass_persistent_cache():
                     compiled = mk_jit().lower(*examples).compile()
-            _aot.memo_put(key, compiled)
+            meta = self._capture_cost_telemetry(compiled)
+            if memo_ok:
+                _aot.memo_put(key, compiled)
             if disk_ok:
-                self._spawn_aot_store(mk_jit, examples, key, compiled)
+                self._spawn_aot_store(mk_jit, examples, key, compiled,
+                                      meta)
             return self._instrument(compiled)
         except Exception as e:
             import logging
@@ -812,27 +1003,32 @@ class Executor:
                             type(e).__name__, e)
             return None
 
-    def _spawn_aot_store(self, mk_jit, examples, key, compiled):
+    def _spawn_aot_store(self, mk_jit, examples, key, compiled,
+                         meta=None):
         """Serialize this backend's consumable variant into the cache off
         the hot path.  Donation-safe backends store the donated program
         as-is; CPU compiles the donation-free twin first (the only
         variant a CPU restart can execute) — a real compile, so it runs
         in a background thread with its backend-compile events kept out
-        of step accounting."""
+        of step accounting.  ``meta`` (the donated compile's cost/memory
+        attribution) rides along either way: the donated and twin
+        programs share one computation, and a warm restart republishes
+        these numbers without re-deriving them."""
         from . import aot_cache as _aot
         from . import telemetry as _telemetry
 
         def work():
             try:
                 if _aot.deserialized_donation_safe():
-                    _aot.store(key, compiled, _aot.VARIANT_DONATED)
+                    _aot.store(key, compiled, _aot.VARIANT_DONATED,
+                               meta)
                     return
                 with _telemetry.suppress_compile_accounting():
                     with _telemetry.span("aot.twin_compile", cat="aot"):
                         twin = mk_jit(donated=False) \
                             .lower(*examples).compile()
                 _telemetry.counter("aot.twin_compiles").inc()
-                _aot.store(key, twin, _aot.VARIANT_PLAIN)
+                _aot.store(key, twin, _aot.VARIANT_PLAIN, meta)
             except Exception as e:
                 _telemetry.counter("aot.cache_errors").inc()
                 import logging

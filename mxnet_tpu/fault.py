@@ -42,12 +42,31 @@ Sites wired in this package:
                           mode (--elastic) evicts the rank after
                           ``--evict-after`` consecutive losses so the
                           job resumes at N-1 (ROBUSTNESS.md §9).
+- ``step.slow``           bounded per-step delay inside Module.fit_step's
+                          dispatch window (``MXTPU_FAULT_DELAY_SECS``,
+                          default 0.05): a straggling rank — slow host,
+                          thermal throttle, noisy neighbor — whose
+                          inflated ``fit_step.dispatch`` p50 the job
+                          aggregator's straggler blame must name
+                          (tools/perf_probe/job_report.py).
+- ``data.slow``           same bounded delay in the DataLoader prefetch
+                          producer: input-starvation flavor of the
+                          straggler (shows in ``data.prefetch_wait``,
+                          not in the step phases).
 
-The ``*.stall``/``kv.hang`` sites simulate HANGS, not crashes: they
+The ``*.slow`` DELAY sites are per-event and bounded (the run limps,
+correctly); the ``*.stall``/``kv.hang`` sites simulate HANGS — they
 sleep ``MXTPU_FAULT_STALL_SECS`` (default 3600) without renewing any
 watchdog lease, so only the hang-defense layer (mxnet_tpu/watchdog.py,
 tools/launch.py heartbeats) can end the run — exactly the production
 failure mode they stand in for.
+
+**Per-rank scoping**: ``MXTPU_FAULT_SLOTS="1,3"`` restricts an
+env-provided ``MXTPU_FAULT`` spec to the worker slots listed (the
+launcher exports one environment per job, but a straggler/loss drill
+wants exactly one victim; slots are elastic-stable where ranks re-pack).
+Explicit ``configure(spec)`` calls are never scoped — a worker script
+that arms its own rule means it.
 
 ``FaultInjected`` deliberately subclasses MXNetError, NOT OSError: the
 retry loops treat OSError as transient but must never retry a simulated
@@ -64,8 +83,8 @@ import zlib
 from .base import MXNetError
 
 __all__ = ["FaultInjected", "EXIT_WORKER_LOST", "configure", "reset",
-           "is_active", "trigger", "check", "stall_if", "exit_if",
-           "fire_count", "fire_counts"]
+           "is_active", "trigger", "check", "stall_if", "delay_if",
+           "exit_if", "fire_count", "fire_counts"]
 
 # exit-code contract with tools/launch.py (WORKER_LOST_EXIT there):
 # retryable, and the elastic policy counts it toward eviction
@@ -118,12 +137,29 @@ def _parse(spec):
     return rules
 
 
+def _scoped_out_by_slot():
+    """True when MXTPU_FAULT_SLOTS names specific worker slots and this
+    process's slot (MXTPU_WORKER_SLOT, falling back to rank) is not one
+    of them — the env spec then applies to OTHER ranks of the job."""
+    slots = os.environ.get("MXTPU_FAULT_SLOTS", "").strip()
+    if not slots:
+        return False
+    mine = os.environ.get(
+        "MXTPU_WORKER_SLOT",
+        os.environ.get("MXTPU_WORKER_RANK", "0")).strip() or "0"
+    return mine not in {s.strip() for s in slots.split(",") if s.strip()}
+
+
 def configure(spec=None):
     """Install fault rules from ``spec`` (or the MXTPU_FAULT env when
-    None).  Replaces any previous configuration; fire counters reset."""
+    None).  Replaces any previous configuration; fire counters reset.
+    Env-provided specs honor MXTPU_FAULT_SLOTS (module docstring);
+    explicit specs always apply."""
     global _rules, _fired, _loaded_env
     if spec is None:
         spec = os.environ.get("MXTPU_FAULT", "")
+        if spec and _scoped_out_by_slot():
+            spec = ""
     with _lock:
         _rules = _parse(spec)
         _fired = {}
@@ -203,6 +239,24 @@ def stall_if(site):
     end = _time.monotonic() + secs
     while _time.monotonic() < end:
         _time.sleep(min(0.5, max(0.0, end - _time.monotonic())))
+
+
+def delay_if(site, default_secs=0.05):
+    """Inject a bounded per-event DELAY when ``site`` triggers: sleep
+    ``MXTPU_FAULT_DELAY_SECS`` (default 0.05 s) and return.  Unlike
+    :func:`stall_if` the run keeps making (slow) progress — this is the
+    straggler stand-in, not the hang one: armed on one rank (via
+    MXTPU_FAULT_SLOTS) it inflates that rank's phase percentiles so the
+    job aggregator's skew detection has a deterministic victim to
+    blame."""
+    if not trigger(site):
+        return
+    try:
+        secs = float(os.environ.get("MXTPU_FAULT_DELAY_SECS",
+                                    str(default_secs)))
+    except ValueError:
+        secs = default_secs
+    _time.sleep(max(0.0, secs))
 
 
 def exit_if(site, code=EXIT_WORKER_LOST):

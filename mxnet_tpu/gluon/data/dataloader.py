@@ -88,6 +88,10 @@ class _PrefetchIter:
                     # consumer-side "data" lease expires and the watchdog
                     # diagnoses the stall
                     _fault.stall_if("data.stall")
+                    # bounded per-batch delay (straggler stand-in): the
+                    # consumer's data.prefetch_wait percentiles inflate
+                    # on this rank only
+                    _fault.delay_if("data.slow")
                     _fault.check("data.prefetch",
                                  "prefetch worker failure")
                     # start (don't wait for) the host→device copy; the

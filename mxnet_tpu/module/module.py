@@ -619,6 +619,11 @@ class Module(BaseModule):
 
         rng = _random.next_key()
         t0 = time.perf_counter_ns()
+        # straggler stand-in: a bounded delay INSIDE the timed dispatch
+        # window, so the injected slowness shows exactly where a slow
+        # host's would — in this rank's fit_step.dispatch percentiles
+        # (job_report.py's straggler blame keys off them)
+        _fault.delay_if("step.slow")
         outs, new_params, new_state, new_aux, ok = fused["step"](
             param_vals, fused["state"], other_vals, aux_vals, rng,
             lr, wd, rescale, t, poison)

@@ -37,9 +37,21 @@ needs instead:
   ``report()`` JSON line every ``interval`` seconds (default 10) so a
   soak run leaves a machine-readable timeline behind.
 
-``tools/perf_probe/telemetry_report.py`` renders both artifacts
-(JSON-lines timeline and postmortem) for humans; OBSERVABILITY.md is the
-metric-name / span-taxonomy / schema contract.
+**Job scope** (schema ``mxtpu-telemetry-2``, OBSERVABILITY.md §8): every
+report line and postmortem carries an ``identity`` block (world size /
+rank / slot / attempt / pid from :mod:`mxnet_tpu.elastic`'s launch
+contract) and a ``clock`` anchor — the one ``(unix, perf_counter_ns)``
+base pair every perf-stamp in this process is relative to — so
+``tools/perf_probe/job_report.py`` can merge N ranks' streams into one
+job timeline and one cross-rank chrome trace on a common clock.  The
+emitter's final line additionally carries the flight ring
+(``last_steps``) so a cleanly-exited rank leaves its recent per-step
+spans behind the way a crashed rank leaves them in its postmortem.
+
+``tools/perf_probe/telemetry_report.py`` renders the per-rank artifacts
+(JSON-lines timeline and postmortem) for humans;
+``tools/perf_probe/job_report.py`` aggregates a whole run dir;
+OBSERVABILITY.md is the metric-name / span-taxonomy / schema contract.
 
 Env vars: ``MXTPU_TELEMETRY``, ``MXTPU_POSTMORTEM_DIR``,
 ``MXTPU_FLIGHT_RECORDER_STEPS`` (ring size, default 64),
@@ -64,11 +76,11 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "span", "report", "reset", "note_train_step",
            "note_fault", "mark_last_step_verdict", "flight_records",
            "flight_capacity", "dump_postmortem", "start_emitter",
-           "stop_emitter", "set_enabled", "enabled",
-           "suppress_compile_accounting"]
+           "stop_emitter", "set_enabled", "enabled", "identity",
+           "clock_anchor", "suppress_compile_accounting"]
 
-SCHEMA_REPORT = "mxtpu-telemetry-1"
-SCHEMA_POSTMORTEM = "mxtpu-postmortem-1"
+SCHEMA_REPORT = "mxtpu-telemetry-2"
+SCHEMA_POSTMORTEM = "mxtpu-postmortem-2"
 
 
 def _env_int(name, default):
@@ -571,10 +583,42 @@ def flight_capacity():
 
 
 # -- reporting -------------------------------------------------------------
+def identity():
+    """Who this stream belongs to inside the job: the elastic launch
+    contract (world_size / rank / slot / attempt, re-read from env so a
+    post-reshard process stamps its NEW membership) plus the pid.  The
+    job aggregator keys every line by this block — a re-ranked survivor
+    keeps its slot while its rank shifts, and the attempt field is what
+    segments a merged timeline at elastic transitions."""
+    try:
+        from . import elastic as _elastic
+        mem = _elastic.membership()
+        return {"world_size": mem["world_size"], "rank": mem["rank"],
+                "slot": mem["slot"], "attempt": mem["attempt"],
+                "pid": os.getpid()}
+    except Exception:
+        # interpreter teardown: a final emitter line / late postmortem
+        # must still be a complete document
+        return {"world_size": None, "rank": None, "slot": None,
+                "attempt": None, "pid": os.getpid()}
+
+
+def clock_anchor():
+    """The monotonic↔unix correspondence of this process: every
+    perf_counter_ns stamp in its records maps to wall-clock time as
+    ``unix + (perf_ns_stamp - perf_ns) * 1e-9`` — the base pair the
+    flight recorder already uses for ``t_unix``.  Published on every
+    report line so a cross-rank trace merge shares one time axis without
+    trusting each rank's trace-local origin."""
+    return {"unix": _unix_base, "perf_ns": _perf_base,
+            "mono_ns": time.monotonic_ns() - time.perf_counter_ns()}
+
+
 def report():
     """One JSON-able snapshot of everything: counters, gauges, phase
     histograms (from spans / train steps), free histograms, profiler
-    step_stats, and flight-ring occupancy.  This is the emitter's line
+    step_stats, flight-ring occupancy, and the job-scope identity +
+    clock anchor (schema mxtpu-telemetry-2).  This is the emitter's line
     format and StepStatsMonitor's data source."""
     _drain_steps()
     with _reg_lock:
@@ -586,6 +630,8 @@ def report():
         "schema": SCHEMA_REPORT,
         "time_unix": time.time(),
         "pid": os.getpid(),
+        "identity": identity(),
+        "clock": clock_anchor(),
         "counters": counters,
         "gauges": gauges,
         "phases": {n: h.snapshot() for n, h in hists.items()
@@ -729,6 +775,11 @@ def install_crash_hooks():
 
 # -- periodic JSON-lines emitter -------------------------------------------
 _emitter = None
+# serializes line emission: the periodic thread, the stop-path final
+# line, and any future explicit flush must never interleave their bytes
+# in the stream file (a report line easily exceeds stdio's buffer, so
+# two concurrent buffered writers WOULD interleave mid-line)
+_emit_lock = threading.Lock()
 
 
 def _parse_emitter_spec(spec):
@@ -744,10 +795,38 @@ def _parse_emitter_spec(spec):
     return spec, 10.0
 
 
-def _emit_line(path):
+def _emit_line(path, final=False, lock_timeout=None):
+    """Append one report line as a SINGLE ``os.write`` on an O_APPEND
+    fd: all-or-nothing against a crash (``os._exit``, SIGKILL) landing
+    mid-line, where a buffered ``f.write`` flushes in stdio-buffer-sized
+    chunks and a death between chunks leaves a torn line the reader must
+    skip.  The final line (stop/atexit path) carries the flight ring —
+    the same last-K per-step records a crash postmortem gets — plus a
+    ``final`` marker, so the job aggregator can trace a cleanly-exited
+    rank's recent steps too.
+
+    ``lock_timeout`` bounds the ``_emit_lock`` acquire — the
+    stop_emitter fallback runs at atexit and must skip its line rather
+    than hang shutdown behind a thread wedged mid-write (e.g. os.write
+    to a hung mount) still holding the lock."""
     try:
-        with open(path, "a") as f:
-            f.write(json.dumps(report()) + "\n")
+        doc = report()
+        if final:
+            doc["final"] = True
+            doc["last_steps"] = flight_records()
+        data = (json.dumps(doc) + "\n").encode("utf-8")
+        if not _emit_lock.acquire(
+                timeout=-1 if lock_timeout is None else lock_timeout):
+            return
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                         0o644)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
+        finally:
+            _emit_lock.release()
     except Exception:
         pass  # telemetry must never take the run down
 
@@ -758,16 +837,23 @@ def start_emitter(path, interval=10.0):
     global _emitter
     stop_emitter()
     stop = threading.Event()
+    state = {"final": False}
 
     def loop():
         while not stop.wait(interval):
             _emit_line(path)
-        _emit_line(path)  # final line so short runs still leave a trace
+        # final line so short runs still leave a trace; the flag keeps
+        # the stop path from double-writing it when the join times out —
+        # set only AFTER the write returns, so a thread wedged INSIDE
+        # its final flush (report() blocked on a lock, os.write to a
+        # hung mount) still looks unfinished to stop_emitter's fallback
+        _emit_line(path, final=True)
+        state["final"] = True
 
     t = threading.Thread(target=loop, daemon=True,
                          name="mxtpu-telemetry-emitter")
     t.start()
-    _emitter = (t, stop)
+    _emitter = (t, stop, path, state)
     return t
 
 
@@ -775,10 +861,19 @@ def stop_emitter():
     global _emitter
     if _emitter is None:
         return
-    t, stop = _emitter
+    t, stop, path, state = _emitter
     _emitter = None
     stop.set()
     t.join(timeout=5.0)
+    if t.is_alive() and not state["final"]:
+        # emitter thread wedged mid-report (it never reached its final
+        # flush): write the final line from the caller — bounded lock
+        # acquire, because the wedged thread may be stuck INSIDE a
+        # write still holding _emit_lock, and this path runs at atexit
+        # where blocking forever would convert a lost final line into a
+        # hung shutdown.  If the lock does come, the two lines land
+        # whole, never interleaved.
+        _emit_line(path, final=True, lock_timeout=2.0)
 
 
 def _maybe_start_emitter():

@@ -135,7 +135,7 @@ def test_telemetry_registry_semantics():
     assert s2 == s1
 
     rep = telemetry.report()
-    assert rep["schema"] == "mxtpu-telemetry-1"
+    assert rep["schema"] == "mxtpu-telemetry-2"
     assert rep["counters"]["t.c"] == 3
     assert rep["gauges"]["t.g"] == 7
     assert rep["histograms"]["t.h"]["count"] == 101
@@ -196,8 +196,17 @@ def test_telemetry_emitter(tmp_path):
     telemetry.stop_emitter()
     lines = [json.loads(ln) for ln in open(path) if ln.strip()]
     assert len(lines) >= 2  # periodic lines plus the final flush
-    assert lines[-1]["schema"] == "mxtpu-telemetry-1"
+    assert lines[-1]["schema"] == "mxtpu-telemetry-2"
     assert lines[-1]["counters"]["emit.test"] == 5
+    # the job-scope transport contract (OBSERVABILITY.md §8): every
+    # line carries identity + clock anchor; only the final line carries
+    # the flight ring
+    for ln in lines:
+        assert ln["identity"]["pid"] == os.getpid()
+        assert ln["clock"]["perf_ns"] > 0
+    assert lines[-1]["final"] is True
+    assert "last_steps" in lines[-1]
+    assert all("last_steps" not in ln for ln in lines[:-1])
     assert telemetry._parse_emitter_spec("a/b.jsonl:2.5") == \
         ("a/b.jsonl", 2.5)
     assert telemetry._parse_emitter_spec("a:b/c.jsonl") == \
@@ -252,7 +261,8 @@ def test_postmortem_on_fault_injected_crash(tmp_path):
     files = os.listdir(pm_dir)
     assert len(files) == 1 and files[0].startswith("postmortem-")
     doc = json.load(open(os.path.join(pm_dir, files[0])))
-    assert doc["schema"] == "mxtpu-postmortem-1"
+    assert doc["schema"] == "mxtpu-postmortem-2"
+    assert doc["identity"]["pid"] == doc["pid"]  # job-scope stamp
     assert doc["reason"].startswith("MXNetError")
     assert "divergence guard" in doc["reason"]
     # every step fired grad.nan and was skipped; the crash came on the

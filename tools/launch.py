@@ -41,6 +41,13 @@ slots sit out ``--readmit-after`` attempts, then rejoin (scale back up
 toward ``-n``); ``--min-workers`` floors the shrink.  Every transition
 is recorded in ``<run-dir>/membership.json``
 (``tools/perf_probe/telemetry_report.py`` renders it).
+Job-scope telemetry (``--telemetry-dir``, OBSERVABILITY.md §8): with a
+run dir, every rank's JSON-lines telemetry stream (append-only per
+slot), crash postmortem, and stall-stacks land in
+``<run-dir>/telemetry/`` next to membership.json — one tree
+``tools/perf_probe/job_report.py`` merges into a job timeline with
+straggler blame and a cross-rank chrome trace.
+
 - On real TPU pods, prefer the platform launcher (GKE/queued resources):
   every pod VM already runs one process; pass --use-env-ranks to adopt
   the platform-provided rank env instead of spawning.
@@ -186,6 +193,32 @@ def _cache_env(args):
         "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS":
             os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                            "0"),
+    }
+
+
+def _telemetry_env(args, slot):
+    """Job-scope telemetry exports for one worker slot: a per-slot
+    JSON-lines stream under ``<run-dir>/telemetry/`` plus the postmortem
+    dir, so every rank's timeline, crash postmortem, and stall-stacks
+    land in ONE tree next to membership.json (the input contract of
+    tools/perf_probe/job_report.py).  Streams are keyed by SLOT, not
+    rank: a slot's identity is stable across elastic re-rankings, the
+    file is opened append-only by the worker, and every line carries the
+    writing attempt's identity block — so attempt N's lines never
+    overwrite attempt N-1's (schema mxtpu-telemetry-2).  Operator-set
+    MXTPU_TELEMETRY / MXTPU_POSTMORTEM_DIR win (forwarded verbatim, for
+    the same ssh-env reason as _cache_env)."""
+    d = getattr(args, "telemetry_dir", None)
+    if not d:
+        return {}
+    spec = os.environ.get("MXTPU_TELEMETRY")
+    if not spec:
+        spec = "%s:%s" % (os.path.join(d, "stream-slot%d.jsonl" % slot),
+                          args.telemetry_interval)
+    return {
+        "MXTPU_TELEMETRY": spec,
+        "MXTPU_POSTMORTEM_DIR":
+            os.environ.get("MXTPU_POSTMORTEM_DIR") or d,
     }
 
 
@@ -338,6 +371,7 @@ def _worker_env(args, mem, world, rank, slot, attempt, prev_world):
         "DMLC_WORKER_ID": str(rank),
     }
     env.update(_cache_env(args))
+    env.update(_telemetry_env(args, slot))
     return env
 
 
@@ -702,6 +736,21 @@ def main(argv=None):
                         "tools/perf_probe/telemetry_report.py).  "
                         "Default: a per-launch temp dir when --elastic, "
                         "else none")
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="job-scope telemetry tree: each worker "
+                        "slot's JSON-lines stream (MXTPU_TELEMETRY, "
+                        "append-only per slot), crash postmortems and "
+                        "stall-stacks (MXTPU_POSTMORTEM_DIR) all land "
+                        "here, next to membership.json — the input of "
+                        "tools/perf_probe/job_report.py.  Default: "
+                        "<run-dir>/telemetry when --run-dir is set "
+                        "(incl. the --elastic auto run dir); pass 'off' "
+                        "to disable.  Operator-set MXTPU_TELEMETRY / "
+                        "MXTPU_POSTMORTEM_DIR env always wins")
+    parser.add_argument("--telemetry-interval", type=float, default=10.0,
+                        help="seconds between telemetry stream lines "
+                        "per worker (the [:interval] half of the "
+                        "MXTPU_TELEMETRY spec; default 10)")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="restart the whole job this many times when "
                         "a worker dies (workers resume from their own "
@@ -753,6 +802,22 @@ def main(argv=None):
         print("launch.py: membership journal at %s"
               % os.path.join(args.run_dir, "membership.json"),
               file=sys.stderr, flush=True)
+    if args.telemetry_dir == "off":
+        args.telemetry_dir = None
+    elif not args.telemetry_dir and args.run_dir and \
+            args.launcher != "mpi":
+        args.telemetry_dir = os.path.join(args.run_dir, "telemetry")
+    if args.telemetry_dir and args.launcher != "mpi":
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        print("launch.py: job telemetry tree at %s (render with "
+              "tools/perf_probe/job_report.py)" % args.telemetry_dir,
+              file=sys.stderr, flush=True)
+    elif args.telemetry_dir:
+        # mpi has no slot contract to key the per-worker streams by
+        print("launch.py: --telemetry-dir is a local/ssh launcher "
+              "feature — ignoring it under mpi", file=sys.stderr,
+              flush=True)
+        args.telemetry_dir = None
     auto_cache_dir = None
     if args.aot_cache_dir == "off":
         args.aot_cache_dir = None

@@ -1,0 +1,385 @@
+"""Job-scope telemetry aggregator: one report for an N-rank run.
+
+A ``tools/launch.py --run-dir`` job leaves one telemetry tree behind
+(``<run-dir>/telemetry/`` next to ``membership.json``): per-slot
+JSON-lines streams (schema ``mxtpu-telemetry-2`` — every line carries a
+rank/slot/attempt/world identity block and a monotonic↔unix clock
+anchor), crash postmortems, and stall-stacks dumps.
+``telemetry_report.py`` renders each artifact faithfully; THIS tool
+answers the job-level questions none of them can alone:
+
+- **who is slow, and why** — a per-rank matrix (steps, step-time EMA,
+  ``fit_step.dispatch``/``fit_step.sync``/``data.prefetch_wait`` p50s,
+  guard skips, recompiles) per attempt segment, with **straggler blame**:
+  a rank whose ``fit_step.dispatch + fit_step.sync`` p50 exceeds the job
+  median by ``--straggler-factor`` (default 2.0) is named, with the
+  ratio.  The ``step.slow`` / ``data.slow`` fault sites
+  (``MXTPU_FAULT_SLOTS`` scopes them to one victim rank) make the
+  detector drillable end-to-end.
+- **one merged trace** — every rank's recent per-step spans (the flight
+  ring each rank leaves in its stream's final line, or in its postmortem
+  when it crashed) rendered into a single Perfetto/chrome-tracing file
+  on the common unix clock: one process row per SLOT (elastic-stable),
+  one thread row per attempt, membership transitions as instant events
+  on a ``job`` track (``--trace-out``).
+- **the job's shape over time** — the timeline is segmented at elastic
+  transitions: each attempt renders as its own section with its world
+  size, the membership events that ended it, and its own rank matrix —
+  so "rank 1 was slow in attempt 0, evicted before attempt 1" reads
+  straight down.
+
+Usage:
+    python tools/perf_probe/job_report.py RUN_DIR \
+        [--straggler-factor 2.0] [--trace-out job-trace.json]
+
+OBSERVABILITY.md §8 is the schema/threshold contract.
+"""
+import argparse
+import json
+import os
+import sys
+from statistics import median
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import telemetry_report as _tr  # noqa: E402 (sibling module)
+
+#: synthetic chrome-trace pid for the job-level (membership) track —
+#: real tracks use the worker slot as pid, which is always small
+JOB_TRACK_PID = 999999
+
+
+def _identity(doc):
+    ident = doc.get("identity") or {}
+    return {
+        "rank": ident.get("rank"),
+        "slot": ident.get("slot"),
+        "attempt": ident.get("attempt") or 0,
+        "world_size": ident.get("world_size"),
+        "pid": ident.get("pid") or doc.get("pid"),
+    }
+
+
+def _slot_from_path(path):
+    """Fallback identity for schema-1 lines: the launcher names streams
+    ``stream-slot<K>.jsonl``."""
+    base = os.path.basename(path)
+    if base.startswith("stream-slot"):
+        digits = base[len("stream-slot"):].split(".")[0]
+        if digits.isdigit():
+            return int(digits)
+    return None
+
+
+def load_job(run_dir):
+    """Parse every artifact of the run dir into one structure:
+    ``streams`` — every stream line tagged with its identity (falling
+    back to the per-slot filename), ``postmortems`` — parsed docs,
+    ``membership`` — the journal doc or None, plus parser notes (torn
+    lines)."""
+    found = _tr.discover_run_dir(run_dir)
+    notes = []
+    membership = None
+    if found["membership"]:
+        docs = _tr.parse_artifact(found["membership"], notes)
+        membership = docs[-1] if docs else None
+    streams = []
+    for path in found["streams"]:
+        slot = _slot_from_path(path)
+        for doc in _tr.parse_artifact(path, notes):
+            ident = _identity(doc)
+            if ident["slot"] is None:
+                ident["slot"] = slot
+            if ident["rank"] is None:
+                ident["rank"] = slot
+            doc["_ident"] = ident
+            doc["_path"] = path
+            streams.append(doc)
+    postmortems = []
+    for path in found["postmortems"]:
+        docs = _tr.parse_artifact(path, notes)
+        if docs:
+            doc = docs[-1]
+            doc["_ident"] = _identity(doc)
+            doc["_path"] = path
+            postmortems.append(doc)
+    return {"run_dir": run_dir, "membership": membership,
+            "streams": streams, "postmortems": postmortems,
+            "stall_stacks": found["stall_stacks"], "notes": notes}
+
+
+def group_attempts(job):
+    """{attempt: {rank: [stream docs, time-ordered]}} — the segmented
+    view.  Each attempt is a fresh set of worker processes, so the
+    cumulative counters inside one (attempt, rank) group restart from
+    zero at the group's first line."""
+    attempts = {}
+    for doc in job["streams"]:
+        ident = doc["_ident"]
+        rank = ident["rank"] if ident["rank"] is not None else -1
+        attempts.setdefault(ident["attempt"], {}) \
+            .setdefault(rank, []).append(doc)
+    for ranks in attempts.values():
+        for docs in ranks.values():
+            docs.sort(key=lambda d: d.get("time_unix", 0))
+    return attempts
+
+
+def _phase_p50(doc, name):
+    h = (doc.get("phases") or {}).get(name)
+    return h.get("p50") if h and h.get("count") else None
+
+
+def rank_rows(ranks):
+    """Per-rank summary rows for one attempt segment, from each rank's
+    LAST line (cumulative within the attempt's process lifetime).
+    Returns ``[{rank, slot, world, steps, ema_s, dispatch_p50, sync_p50,
+    data_wait_p50, skipped, compiles, score}]`` sorted by rank; ``score``
+    is the straggler-blame metric (dispatch+sync p50)."""
+    rows = []
+    for rank in sorted(ranks):
+        last = ranks[rank][-1]
+        ident = last["_ident"]
+        ss = last.get("step_stats") or {}
+        dispatch = _phase_p50(last, "fit_step.dispatch")
+        sync = _phase_p50(last, "fit_step.sync")
+        score = None
+        if dispatch is not None:
+            score = dispatch + (sync or 0.0)
+        rows.append({
+            "rank": rank, "slot": ident.get("slot"),
+            "world": ident.get("world_size"),
+            "steps": ss.get("steps"),
+            "ema_s": ss.get("step_time_ema_s"),
+            "dispatch_p50": dispatch, "sync_p50": sync,
+            "data_wait_p50": _phase_p50(last, "data.prefetch_wait"),
+            "skipped": ss.get("skipped_steps"),
+            "compiles": ss.get("compile_count"),
+            "score": score,
+        })
+    return rows
+
+
+def find_stragglers(rows, factor):
+    """Skew detection: ranks whose dispatch+sync p50 exceeds the job
+    median by ``factor``.  Returns ``[(row, ratio)]``, worst first.
+
+    The baseline for each candidate is the median of the OTHER ranks'
+    scores (leave-one-out): a straggling minority cannot drag the
+    baseline up to hide itself, and — decisive at world size 2 — a
+    candidate's own score never caps its ratio (with scores [h, s] a
+    plain median is (h+s)/2, so s/median < 2 for ANY slowdown and a
+    2-rank job could never cross the default factor)."""
+    scored = [r for r in rows if r["score"]]
+    if len(scored) < 2:
+        return []
+    out = []
+    for r in scored:
+        baseline = median(o["score"] for o in scored if o is not r)
+        if baseline > 0 and r["score"] > factor * baseline:
+            out.append((r, r["score"] / baseline))
+    return sorted(out, key=lambda p: -p[1])
+
+
+def _flight_sources(job):
+    """Every (ident, last_steps) span source in the job: each stream
+    line that carries the flight ring (final lines; one per attempt per
+    rank) and each postmortem (a crashed rank's equivalent record).
+
+    Deduplicated per (slot, attempt, pid): a rank that dies on an
+    uncaught exception leaves the SAME ring twice — in its excepthook
+    postmortem and in its atexit final stream line — and without the
+    dedup every span of that process would render twice on its track.
+    The fuller record wins (the later dump may hold more steps)."""
+    best = {}
+    order = []
+    for doc in job["streams"] + job["postmortems"]:
+        recs = doc.get("last_steps")
+        if not recs:
+            continue
+        ident = doc["_ident"]
+        key = (ident.get("slot"), ident.get("attempt"),
+               ident.get("pid"))
+        cur = best.get(key)
+        if cur is None:
+            order.append(key)
+        if cur is None or len(recs) > len(cur[1]):
+            best[key] = (ident, recs)
+    return [best[k] for k in order]
+
+
+def merged_trace(job):
+    """One chrome-tracing document for the whole job on the common unix
+    clock: per-step ``fit_step.dispatch``/``fit_step.sync`` spans from
+    every rank's flight records (pid = slot, tid = attempt — slots are
+    elastic-stable, so a re-ranked survivor keeps its track), plus the
+    membership journal's transitions as instant events on a ``job``
+    track.  Returns ``(doc, t0_unix)``; t0 is the earliest stamp so
+    Perfetto's axis starts at ~0."""
+    sources = _flight_sources(job)
+    stamps = [rec["t_unix"] for _, recs in sources for rec in recs]
+    trans = (job["membership"] or {}).get("transitions") or []
+    stamps += [t.get("time", 0) for t in trans]
+    t0 = min(stamps) if stamps else 0.0
+    events = [{"ph": "M", "name": "process_name", "pid": JOB_TRACK_PID,
+               "args": {"name": "job (membership)"}}]
+    seen_tracks = set()
+    for ident, recs in sources:
+        slot = ident.get("slot") if ident.get("slot") is not None else -1
+        attempt = ident.get("attempt") or 0
+        if slot not in seen_tracks:
+            seen_tracks.add(slot)
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": slot,
+                           "args": {"name": "slot %s" % slot}})
+        events.append({"ph": "M", "name": "thread_name", "pid": slot,
+                       "tid": attempt,
+                       "args": {"name": "attempt %d (rank %s, world %s)"
+                                % (attempt, ident.get("rank"),
+                                   ident.get("world_size"))}})
+        for rec in recs:
+            ts = (rec["t_unix"] - t0) * 1e6
+            dur = (rec.get("dispatch_s") or 0.0) * 1e6
+            args = {"step": rec.get("step")}
+            if rec.get("skipped"):
+                args["skipped"] = True
+            if rec.get("loss") is not None:
+                args["loss"] = rec["loss"]
+            if rec.get("faults"):
+                args["faults"] = list(rec["faults"])
+            events.append({"name": "fit_step.dispatch", "cat": "step",
+                           "ph": "X", "pid": slot, "tid": attempt,
+                           "ts": ts, "dur": dur, "args": args})
+            if rec.get("sync_s") is not None:
+                events.append({"name": "fit_step.sync", "cat": "step",
+                               "ph": "X", "pid": slot, "tid": attempt,
+                               "ts": ts + dur,
+                               "dur": rec["sync_s"] * 1e6,
+                               "args": {"step": rec.get("step")}})
+    for t in trans:
+        name = t.get("event", "?")
+        if name in ("failure", "evict", "readmit"):
+            name = "%s slot %s" % (name, t.get("slot"))
+        elif name == "attempt_start":
+            name = "attempt %s start (world %s)" % (t.get("attempt"),
+                                                    t.get("world_size"))
+        events.append({"name": name, "cat": "membership", "ph": "i",
+                       "s": "g", "pid": JOB_TRACK_PID, "tid": 0,
+                       "ts": (t.get("time", 0) - t0) * 1e6,
+                       "args": {k: v for k, v in t.items()
+                                if k != "time"}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}, t0
+
+
+def _fmt(v, fmt="%s"):
+    return "-" if v is None else fmt % v
+
+
+def render(job, out, factor=2.0):
+    """The job report: membership summary, then one section per attempt
+    (world size, membership events, rank matrix, straggler verdict),
+    then the crash/stall inventory."""
+    attempts = group_attempts(job)
+    trans = (job["membership"] or {}).get("transitions") or []
+    n_ranks = {ident for doc in job["streams"]
+               for ident in [(doc["_ident"]["attempt"],
+                              doc["_ident"]["rank"])]}
+    out.write("== JOB REPORT %s ==\n" % job["run_dir"])
+    out.write("  %d stream line(s) from %d (attempt, rank) pair(s); "
+              "%d attempt segment(s); %d postmortem(s); %d stall-stack "
+              "dump(s)\n"
+              % (len(job["streams"]), len(n_ranks), len(attempts),
+                 len(job["postmortems"]), len(job["stall_stacks"])))
+    for note in job["notes"]:
+        out.write("  %s\n" % note)
+    all_stragglers = []
+    for attempt in sorted(attempts):
+        ranks = attempts[attempt]
+        rows = rank_rows(ranks)
+        world = next((r["world"] for r in rows
+                      if r["world"] is not None), len(rows))
+        t_lo = min(d.get("time_unix", 0) for docs in ranks.values()
+                   for d in docs)
+        t_hi = max(d.get("time_unix", 0) for docs in ranks.values()
+                   for d in docs)
+        out.write("\n-- attempt %d (world size %s, %s observed) --\n"
+                  % (attempt, world, _tr._fmt_s(t_hi - t_lo)))
+        for t in trans:
+            if t.get("attempt") == attempt and \
+                    t.get("event") not in ("attempt_start", "launch"):
+                detail = ""
+                if t.get("slot") is not None:
+                    detail = " slot %s" % t.get("slot")
+                    if t.get("rc") is not None:
+                        detail += " (rc=%s)" % t.get("rc")
+                out.write("  membership: %s%s\n"
+                          % (t.get("event"), detail))
+        table = [(r["rank"], r["slot"], _fmt(r["steps"]),
+                  _tr._fmt_s(r["ema_s"]),
+                  _tr._fmt_s(r["dispatch_p50"]),
+                  _tr._fmt_s(r["sync_p50"]),
+                  _tr._fmt_s(r["data_wait_p50"]),
+                  _fmt(r["skipped"]), _fmt(r["compiles"]))
+                 for r in rows]
+        _tr._table(("rank", "slot", "steps", "step_ema", "disp_p50",
+                    "sync_p50", "data_wait", "skipped", "compiles"),
+                   table, out)
+        stragglers = find_stragglers(rows, factor)
+        for row, ratio in stragglers:
+            out.write("  STRAGGLER: rank %s (slot %s) — "
+                      "dispatch+sync p50 %s is %.1fx the other ranks' "
+                      "median (threshold %.1fx)\n"
+                      % (row["rank"], row["slot"],
+                         _tr._fmt_s(row["score"]), ratio, factor))
+            all_stragglers.append((attempt, row, ratio))
+        if len(rows) >= 2 and not stragglers:
+            out.write("  no straggler: every rank within %.1fx of the "
+                      "other ranks' median dispatch+sync p50\n" % factor)
+    for doc in job["postmortems"]:
+        ident = doc["_ident"]
+        out.write("\n  postmortem: rank %s slot %s attempt %s — %s\n"
+                  % (ident.get("rank"), ident.get("slot"),
+                     ident.get("attempt"),
+                     str(doc.get("reason", ""))[:120]))
+    return all_stragglers
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge an N-rank run's telemetry into one job "
+        "report: per-rank matrix, straggler blame, merged chrome trace")
+    ap.add_argument("run_dir", help="tools/launch.py --run-dir (holds "
+                    "membership.json and the telemetry/ tree)")
+    ap.add_argument("--straggler-factor", type=float, default=2.0,
+                    help="blame a rank when its fit_step dispatch+sync "
+                    "p50 exceeds the job median by this factor "
+                    "(default 2.0)")
+    ap.add_argument("--trace-out", default=None,
+                    help="also write the merged cross-rank chrome trace "
+                    "(Perfetto-loadable) to this path")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        sys.stderr.write("job_report.py: %s is not a run dir\n"
+                         % args.run_dir)
+        return 2
+    job = load_job(args.run_dir)
+    if not job["streams"] and not job["postmortems"]:
+        sys.stderr.write("job_report.py: no telemetry streams or "
+                         "postmortems under %s (launch with --run-dir/"
+                         "--telemetry-dir?)\n" % args.run_dir)
+        return 1
+    render(job, sys.stdout, factor=args.straggler_factor)
+    if args.trace_out:
+        doc, t0 = merged_trace(job)
+        with open(args.trace_out, "w") as f:
+            json.dump(doc, f)
+        n_spans = sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
+        sys.stdout.write("\n  merged trace: %s (%d span(s) across %d "
+                         "track(s), t0=%.3f)\n"
+                         % (args.trace_out, n_spans,
+                            len({e["pid"] for e in doc["traceEvents"]
+                                 if e["ph"] == "X"}), t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

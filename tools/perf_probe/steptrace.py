@@ -192,9 +192,16 @@ def run_spmd(n_dev=8):
         assert fused["zero"] is not None, \
             "MXTPU_ZERO=1 on a mesh bind must engage ZeRO-1"
         # trace() resets telemetry after warmup, wiping the setup-time
-        # sharding gauges — republish them for the report below
+        # sharding + cost-attribution gauges — republish both for the
+        # report below
         mod._exec._note_sharding_telemetry(
             tuple(fused["update_names"]), fused["state"], fused["zero"])
+        mod._exec.publish_cost_telemetry()
+
+        def per_device_bytes(leaf):
+            shards = {s.data.shape for s in leaf.addressable_shards}
+            return int(np.prod(next(iter(shards)))) * leaf.dtype.itemsize
+
         total = 0
         per_device = 0
         sharded_leaves = 0
@@ -204,11 +211,21 @@ def run_spmd(n_dev=8):
                 leaves += 1
                 nb = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
                 total += nb
-                shards = {s.data.shape for s in leaf.addressable_shards}
-                per_device += int(np.prod(next(iter(shards)))) * \
-                    leaf.dtype.itemsize
+                per_device += per_device_bytes(leaf)
                 if not leaf.sharding.is_fully_replicated:
                     sharded_leaves += 1
+        # every OTHER fused-step input, per device, from the live
+        # arrays' actual shard shapes: params/data/label/aux.  Together
+        # with the 1/N state this is what the compiled program's
+        # xla.memory.argument_bytes must agree with (±20%,
+        # BENCH_MODE=spmd) — the measured cross-check of the ZeRO-1
+        # state economics (scalars/rng are a few tens of bytes, inside
+        # the tolerance).
+        expected_args = per_device
+        exe = mod._exec
+        for d in (exe.arg_dict, exe.aux_dict):
+            for name, arr in d.items():
+                expected_args += per_device_bytes(arr._data)
         rep = telemetry.report()
         spmd.update({
             "n_devices": n_dev,
@@ -216,10 +233,20 @@ def run_spmd(n_dev=8):
             "opt_state_bytes_per_device": per_device,
             "opt_state_leaves": leaves,
             "opt_state_leaves_sharded": sharded_leaves,
+            "expected_argument_bytes_per_device": expected_args,
             "gauge_opt_state_bytes_per_device":
                 rep["gauges"].get("sharding.opt_state_bytes_per_device"),
             "gauge_collective_bytes_per_step":
                 rep["gauges"].get("sharding.collective_bytes_per_step"),
+            "gauge_collective_bytes_modeled":
+                rep["gauges"].get("sharding.collective_bytes_modeled"),
+            "gauge_xla_memory_argument_bytes":
+                rep["gauges"].get("xla.memory.argument_bytes"),
+            "gauge_xla_cost_flops":
+                rep["gauges"].get("xla.cost.flops_per_step"),
+            "collective_ops":
+                (mod._exec._cost_doc or {}).get("collectives", {})
+                .get("ops"),
             "sharding_fallbacks":
                 rep["counters"].get("sharding.fallbacks", 0),
         })

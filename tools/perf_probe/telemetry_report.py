@@ -1,29 +1,37 @@
 """Render telemetry artifacts for humans.
 
-Input files are either:
+Each positional argument is a file OR a run directory.  Files are
+sniffed per artifact type:
 
 - a JSON-lines timeline written by the periodic emitter
   (``MXTPU_TELEMETRY=path[:interval]``) — one ``report()`` object per
-  line (schema ``mxtpu-telemetry-1``); the summary covers the LAST line
-  (cumulative totals) and notes the line count / wall span, or
-- a crash postmortem (schema ``mxtpu-postmortem-1``) dumped by the
-  flight recorder into ``MXTPU_POSTMORTEM_DIR`` — rendered as the crash
-  reason, step_stats, fault firings, and the last-K per-step table.
-
-or:
-
+  line (schema ``mxtpu-telemetry-2``; ``-1`` lines from older runs still
+  render); the summary covers the LAST line (cumulative totals) and
+  notes the line count / wall span, or
+- a crash postmortem (schema ``mxtpu-postmortem-2`` / ``-1``) dumped by
+  the flight recorder into ``MXTPU_POSTMORTEM_DIR`` — rendered as the
+  crash reason, step_stats, fault firings, and the last-K per-step
+  table, or
 - an elastic membership journal (schema ``mxtpu-membership-1``) written
-  by ``tools/launch.py --elastic`` into ``<run-dir>/membership.json`` —
-  rendered as the world-size transition timeline (attempt starts,
-  failures with blamed slot/exit, evictions, re-admissions).
+  by ``tools/launch.py`` into ``<run-dir>/membership.json`` — rendered
+  as the world-size transition timeline (attempt starts, failures with
+  blamed slot/exit, evictions, re-admissions).
+
+A **run directory** (``tools/launch.py --run-dir``) renders everything
+it holds together — the membership journal, every rank's stream, every
+postmortem, and a stall-stacks inventory — so one command digests a
+whole job.  ``job_report.py`` (same directory) goes further: it MERGES
+the rank streams into one job timeline with straggler blame and a
+cross-rank chrome trace; this tool renders each artifact faithfully,
+one at a time.
 
 Usage:
-    python tools/perf_probe/telemetry_report.py RUN.jsonl [POSTMORTEM.json \
-        MEMBERSHIP.json ...]
+    python tools/perf_probe/telemetry_report.py RUN_DIR_OR_FILE ...
 
 See OBSERVABILITY.md for the metric-name and schema contract.
 """
 import json
+import os
 import sys
 
 
@@ -65,9 +73,23 @@ def _table(header, rows, out):
             str(c).ljust(w) for c, w in zip(r, widths)).rstrip() + "\n")
 
 
+def _identity_line(doc):
+    """`` [rank 1/3 slot 2 attempt 0]`` from a schema-2 identity block
+    (empty for schema-1 artifacts / standalone runs)."""
+    ident = doc.get("identity") or {}
+    if ident.get("rank") is None:
+        return ""
+    if (ident.get("world_size") or 1) <= 1 and not ident.get("attempt"):
+        return ""  # standalone process: no job context to show
+    return " [rank %s/%s slot %s attempt %s]" % (
+        ident.get("rank"), ident.get("world_size"), ident.get("slot"),
+        ident.get("attempt"))
+
+
 def render_report(doc, out, context=""):
     """Phase-time breakdown + histogram percentiles of one report()."""
-    out.write("== telemetry report%s ==\n" % context)
+    out.write("== telemetry report%s%s ==\n"
+              % (_identity_line(doc), context))
     ss = doc.get("step_stats") or {}
     out.write("  steps %s  dispatches %s  compiles %s  skipped %s  "
               "step_ema %s\n" % (
@@ -185,7 +207,8 @@ def render_membership(doc, out):
 
 def render_postmortem(doc, out):
     """Pretty-print a flight-recorder crash postmortem."""
-    out.write("== POSTMORTEM (pid %s) ==\n" % doc.get("pid"))
+    out.write("== POSTMORTEM (pid %s)%s ==\n"
+              % (doc.get("pid"), _identity_line(doc)))
     out.write("  reason: %s\n" % doc.get("reason"))
     mem = doc.get("membership") or {}
     if mem.get("coordinator") or (mem.get("world_size") or 1) > 1 or \
@@ -231,19 +254,19 @@ def render_postmortem(doc, out):
     render_report(doc, out, context=" (at crash)")
 
 
-def render_file(path, out=sys.stdout):
+def parse_artifact(path, notes=None):
+    """Parse one telemetry artifact file → list of JSON docs (one for a
+    postmortem/journal, one per line for an emitter stream).  Torn lines
+    (a process killed mid-append — the exact crash this tooling serves)
+    are skipped and counted into ``notes`` (a list of strings)."""
     with open(path) as f:
         text = f.read()
     if not text.strip():
-        out.write("%s: empty\n" % path)
-        return
+        return []
     try:
         # a postmortem is one (indented, multi-line) JSON document
-        docs = [json.loads(text)]
+        return [json.loads(text)]
     except ValueError:
-        # emitter timeline: one report per line; a process killed
-        # mid-append leaves a torn final line — the exact crash this
-        # tooling serves — so skip unparseable lines with a note
         docs, skipped = [], 0
         for ln in text.splitlines():
             if not ln.strip():
@@ -252,17 +275,27 @@ def render_file(path, out=sys.stdout):
                 docs.append(json.loads(ln))
             except ValueError:
                 skipped += 1
-        if skipped:
-            out.write("  (%d unparseable line(s) skipped — torn "
-                      "mid-append write)\n" % skipped)
-        if not docs:
-            out.write("%s: no parseable JSON\n" % path)
-            return
+        if skipped and notes is not None:
+            notes.append("(%d unparseable line(s) skipped in %s — torn "
+                         "mid-append write)" % (skipped, path))
+        return docs
+
+
+def render_file(path, out=sys.stdout):
+    notes = []
+    docs = parse_artifact(path, notes)
+    for note in notes:
+        out.write("  %s\n" % note)
+    if not docs:
+        out.write("%s: %s\n" % (path, "empty" if not notes
+                                else "no parseable JSON"))
+        return
     last = docs[-1]
-    if last.get("schema") == "mxtpu-postmortem-1":
+    schema = str(last.get("schema") or "")
+    if schema.startswith("mxtpu-postmortem-"):
         render_postmortem(last, out)
         return
-    if last.get("schema") == "mxtpu-membership-1":
+    if schema.startswith("mxtpu-membership-"):
         render_membership(last, out)
         return
     ctx = ""
@@ -271,6 +304,61 @@ def render_file(path, out=sys.stdout):
         ctx = " (%d samples over %s)" % (len(docs), _fmt_s(span))
     _render_watchdog_timeline(docs, out)
     render_report(last, out, context=ctx)
+
+
+def discover_run_dir(run_dir):
+    """Inventory a launch.py run dir: the membership journal, every
+    per-slot stream, every postmortem, every stall-stacks dump — looking
+    both at the top level and under ``telemetry/`` (the launcher's
+    default tree).  Returns ``{"membership": path|None, "streams": [...],
+    "postmortems": [...], "stall_stacks": [...]}`` with sorted lists.
+    Shared with job_report.py (its input contract)."""
+    roots = [run_dir, os.path.join(run_dir, "telemetry")]
+    found = {"membership": None, "streams": [], "postmortems": [],
+             "stall_stacks": []}
+    for root in roots:
+        try:
+            names = sorted(os.listdir(root))
+        except OSError:
+            continue
+        for name in names:
+            path = os.path.join(root, name)
+            if not os.path.isfile(path):
+                continue
+            if name == "membership.json":
+                found["membership"] = found["membership"] or path
+            elif name.endswith(".jsonl"):
+                found["streams"].append(path)
+            elif name.startswith("postmortem-") and \
+                    name.endswith(".json"):
+                found["postmortems"].append(path)
+            elif name.startswith("stall-stacks-"):
+                found["stall_stacks"].append(path)
+    return found
+
+
+def render_run_dir(run_dir, out=sys.stdout):
+    """Render every artifact of one run dir, membership journal first
+    (the job's shape over time), then each rank stream, then each
+    postmortem, with a stall-stacks inventory line at the end."""
+    found = discover_run_dir(run_dir)
+    if not (found["membership"] or found["streams"]
+            or found["postmortems"]):
+        out.write("%s: no telemetry artifacts (membership.json, "
+                  "*.jsonl, postmortem-*.json)\n" % run_dir)
+        return
+    out.write("== RUN DIR %s ==\n" % run_dir)
+    first = True
+    for path in ([found["membership"]] if found["membership"] else []) \
+            + found["streams"] + found["postmortems"]:
+        if not first:
+            out.write("\n")
+        first = False
+        out.write("-- %s --\n" % os.path.relpath(path, run_dir))
+        render_file(path, out)
+    if found["stall_stacks"]:
+        out.write("\n  stall-stacks dumps: %s\n" % ", ".join(
+            os.path.relpath(p, run_dir) for p in found["stall_stacks"]))
 
 
 def _render_watchdog_timeline(docs, out):
@@ -305,7 +393,10 @@ def main(argv):
     for i, path in enumerate(argv):
         if i:
             sys.stdout.write("\n")
-        render_file(path)
+        if os.path.isdir(path):
+            render_run_dir(path)
+        else:
+            render_file(path)
     return 0
 
 
