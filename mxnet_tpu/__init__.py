@@ -66,6 +66,7 @@ from . import gluon
 from . import config
 from . import predictor
 from .predictor import Predictor
+from . import serving
 from . import plugin
 from . import rtc
 

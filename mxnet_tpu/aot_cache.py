@@ -76,7 +76,7 @@ __all__ = ["cache_dir", "enabled", "fingerprint", "cache_key", "load",
            "store", "variant", "deserialized_donation_safe",
            "deserialized_spmd_safe", "bypass_persistent_cache",
            "donation_cache_guard", "memo_get", "memo_put", "clear_memo",
-           "drain"]
+           "drain", "spawn_variant_store", "twin_hotswap_cell"]
 
 _FORMAT = "mxtpu-aot-4"  # bump to orphan every existing entry
 
@@ -451,6 +451,79 @@ def store(key, compiled, var, meta=None):
                         "(%s: %s); restarts will recompile",
                         type(e).__name__, e)
         return False
+
+
+# -- the shared §8 tiers: variant store + twin hot-swap --------------------
+# ONE copy of the donated-deserialize policy's moving parts, used by every
+# consumer of this cache (executor.make_fit_step, serving.ServingEngine).
+# The hazard rules here have been patched repeatedly (ROBUSTNESS.md §8,
+# PR 5/6/7); a per-caller copy would silently miss the next fix.
+
+
+def spawn_variant_store(mk_jit, examples, key, compiled, meta=None,
+                        where="aot_cache"):
+    """Serialize this backend's consumable variant of ``compiled`` into
+    the cache off the hot path.  Donation-safe backends store the
+    donated program as-is; on hazard (CPU) backends a donation-free twin
+    — the only variant a restart there can execute — is compiled first
+    in the background, with its backend-compile events kept out of step
+    accounting.  ``mk_jit(donated=False)`` must build the twin jit;
+    ``meta`` (compile-time cost attribution) rides along either way."""
+    from . import telemetry as _tel
+
+    def work():
+        try:
+            if deserialized_donation_safe():
+                store(key, compiled, VARIANT_DONATED, meta)
+                return
+            with _tel.suppress_compile_accounting():
+                with _tel.span("aot.twin_compile", cat="aot"):
+                    twin = mk_jit(donated=False) \
+                        .lower(*examples).compile()
+            _tel.counter("aot.twin_compiles").inc()
+            store(key, twin, VARIANT_PLAIN, meta)
+        except Exception as e:
+            _tel.counter("aot.cache_errors").inc()
+            import logging
+            logging.warning("%s: AOT background store failed (%s: %s); "
+                            "restarts will recompile", where,
+                            type(e).__name__, e)
+
+    return spawn_background(work, "mxtpu-aot-store")
+
+
+def twin_hotswap_cell(mk_jit, examples, key, twin, where="aot_cache"):
+    """Warm hazard-backend start: run the deserialized donation-free
+    ``twin`` NOW (instant first step), compile the donated program in
+    the background (outside jax's persistent cache — §8), and swap it in
+    between steps.  Returns a plain callable whose per-call cost is one
+    dict read — callers wrap it in their own instrumentation."""
+    from . import telemetry as _tel
+
+    cell = {"fn": twin}
+
+    def work():
+        try:
+            with _tel.suppress_compile_accounting():
+                with _tel.span("aot.hotswap_compile", cat="aot"):
+                    with bypass_persistent_cache():
+                        donated = mk_jit().lower(*examples).compile()
+            memo_put(key, donated)
+            cell["fn"] = donated
+            _tel.counter("aot.hotswaps").inc()
+        except Exception as e:
+            _tel.counter("aot.cache_errors").inc()
+            import logging
+            logging.warning("%s: donated hot-swap compile failed "
+                            "(%s: %s); continuing on the donation-free "
+                            "twin", where, type(e).__name__, e)
+
+    spawn_background(work, "mxtpu-aot-hotswap")
+
+    def call(*args):
+        return cell["fn"](*args)
+
+    return call
 
 
 # -- background work (twin compiles, stores) -------------------------------

@@ -1006,75 +1006,28 @@ class Executor:
     def _spawn_aot_store(self, mk_jit, examples, key, compiled,
                          meta=None):
         """Serialize this backend's consumable variant into the cache off
-        the hot path.  Donation-safe backends store the donated program
-        as-is; CPU compiles the donation-free twin first (the only
-        variant a CPU restart can execute) — a real compile, so it runs
-        in a background thread with its backend-compile events kept out
-        of step accounting.  ``meta`` (the donated compile's cost/memory
-        attribution) rides along either way: the donated and twin
-        programs share one computation, and a warm restart republishes
-        these numbers without re-deriving them."""
+        the hot path — ONE shared implementation of the §8 variant
+        policy (``aot_cache.spawn_variant_store``; the serving engine
+        uses the same one).  ``meta`` (the donated compile's cost/memory
+        attribution) rides along: the donated and twin programs share
+        one computation, and a warm restart republishes these numbers
+        without re-deriving them."""
         from . import aot_cache as _aot
-        from . import telemetry as _telemetry
-
-        def work():
-            try:
-                if _aot.deserialized_donation_safe():
-                    _aot.store(key, compiled, _aot.VARIANT_DONATED,
-                               meta)
-                    return
-                with _telemetry.suppress_compile_accounting():
-                    with _telemetry.span("aot.twin_compile", cat="aot"):
-                        twin = mk_jit(donated=False) \
-                            .lower(*examples).compile()
-                _telemetry.counter("aot.twin_compiles").inc()
-                _aot.store(key, twin, _aot.VARIANT_PLAIN, meta)
-            except Exception as e:
-                _telemetry.counter("aot.cache_errors").inc()
-                import logging
-                logging.warning("mxnet_tpu.executor: AOT background store "
-                                "failed (%s: %s); restarts will recompile",
-                                type(e).__name__, e)
-
-        _aot.spawn_background(work, "mxtpu-aot-store")
+        _aot.spawn_variant_store(mk_jit, examples, key, compiled, meta,
+                                 where="mxnet_tpu.executor")
 
     def _twin_hotswap(self, mk_jit, examples, key, twin):
         """Warm CPU restart: run the deserialized donation-free twin NOW
         (instant first step), compile the donated program in the
-        background, and swap it in between steps.  Until the swap the
-        twin costs an extra param-tree copy per step; after it, steady
-        state is identical to a cold start.  The swap is a single dict
-        read per call — no dispatches added, so steptrace's 1.0/step
-        contract holds through it."""
+        background, and swap it in between steps
+        (``aot_cache.twin_hotswap_cell`` — shared with the serving
+        engine).  Until the swap the twin costs an extra param-tree copy
+        per step; after it, steady state is identical to a cold start.
+        The swap is a single dict read per call — no dispatches added,
+        so steptrace's 1.0/step contract holds through it."""
         from . import aot_cache as _aot
-        from . import telemetry as _telemetry
-
-        cell = {"fn": twin}
-
-        def work():
-            try:
-                with _telemetry.suppress_compile_accounting():
-                    with _telemetry.span("aot.hotswap_compile",
-                                         cat="aot"):
-                        with _aot.bypass_persistent_cache():
-                            donated = mk_jit() \
-                                .lower(*examples).compile()
-                _aot.memo_put(key, donated)
-                cell["fn"] = donated
-                _telemetry.counter("aot.hotswaps").inc()
-            except Exception as e:
-                _telemetry.counter("aot.cache_errors").inc()
-                import logging
-                logging.warning("mxnet_tpu.executor: donated hot-swap "
-                                "compile failed (%s: %s); continuing on "
-                                "the donation-free twin",
-                                type(e).__name__, e)
-
-        _aot.spawn_background(work, "mxtpu-aot-hotswap")
-
-        def call(*args):
-            return cell["fn"](*args)
-
+        call = _aot.twin_hotswap_cell(mk_jit, examples, key, twin,
+                                      where="mxnet_tpu.executor")
         return self._instrument(call, first_call_compiles=False)
 
     # -- parameter management ----------------------------------------------

@@ -30,7 +30,8 @@ from ..block import HybridBlock
 
 __all__ = ["GPTBlock", "GPTLM", "get_gpt", "gpt2_tiny",
            "gpt2_tiny_moe", "gpt2_small", "gpt2_medium",
-           "pack_sequences", "packed_positions", "generate"]
+           "pack_sequences", "packed_positions", "generate",
+           "decode_params", "paged_decode_step", "paged_prefill"]
 
 
 class GPTBlock(HybridBlock):
@@ -574,6 +575,129 @@ def generate(net, prompt_ids, n_new, temperature=0.0, seed=0, top_k=0,
     toks = run(p, prompt, jax.random.PRNGKey(seed),
                jnp.float32(max(temperature, 1e-6)))
     return np.asarray(jnp.concatenate([prompt, toks.T], axis=1))
+
+
+# ---------------------------------------------------------------------------
+# paged / slot-addressable decoding (the serving runtime's model half)
+# ---------------------------------------------------------------------------
+#
+# mxnet_tpu/serving/ keeps KV history in fixed-size pages with per-slot
+# block tables (serving/kv_cache.py) so requests of any length share one
+# decode program.  These two pure functions are the model's contract with
+# that runtime: same parameter dict (_decode_params) and per-layer math
+# (_block_qkv/_block_finish) as generate()'s dense-cache path — the
+# equivalence tests in tests/test_serving.py pin the three paths (dense
+# generate, paged decode, training forward) together.
+
+
+def decode_params(net):
+    """Public alias of the decode-path parameter indexer (fp32 values
+    keyed by layer) — the tree ``paged_decode_step``/``paged_prefill``
+    take as ``p``, and what :class:`mxnet_tpu.serving.ServingEngine`
+    snapshots at construction."""
+    return _decode_params(net)
+
+
+def paged_decode_step(p, tokens, positions, active, kv_pages,
+                      block_tables, n_heads):
+    """ONE decode step for every serving slot — the whole resident batch
+    advances one token in one traced program.
+
+    - ``tokens``: int32 [S] — each slot's current token (garbage where
+      inactive);
+    - ``positions``: int32 [S] — the position this token occupies (== the
+      slot's context length before this step);
+    - ``active``: bool [S] — slot occupancy mask.  Inactive slots write
+      their K/V to physical page 0 (the allocator's scratch page) and
+      attend over nothing, so occupancy changes can NEVER perturb a
+      resident slot's math (bit-checked by tests);
+    - ``kv_pages``: list of per-layer ``(k_pages, v_pages)``, each
+      [num_pages, page_size, H, D] — donated by the caller's jit;
+    - ``block_tables``: int32 [S, max_pages_per_seq].
+
+    Returns ``(logits [S, V] fp32, next_tokens [S] int32 greedy,
+    new_kv_pages)``.
+    """
+    import jax.numpy as jnp
+
+    s_n = tokens.shape[0]
+    page_size = kv_pages[0][0].shape[1]
+    from ...ops.pallas.paged_attention import paged_attention
+
+    x = p["wte"][tokens][:, None] + p["wpe"][positions][:, None]
+    c = x.shape[-1]
+    # where each slot's new K/V lands: (physical page, in-page offset);
+    # inactive slots are routed to scratch page 0
+    logical = positions // page_size
+    phys = jnp.where(active,
+                     jnp.take_along_axis(block_tables, logical[:, None],
+                                         axis=1)[:, 0], 0)
+    offs = positions % page_size
+    # the kernel masks keys at position >= ctx; this step's own token is
+    # key position `positions`, so the inclusive context is positions+1
+    ctx = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+    new_pages = []
+    for lp, (kc, vc) in zip(p["layers"], kv_pages):
+        q, k, v = _block_qkv(lp, x, n_heads)          # [S, H, 1, D]
+        kc = kc.at[phys, offs].set(k[:, :, 0, :])
+        vc = vc.at[phys, offs].set(v[:, :, 0, :])
+        o = paged_attention(q[:, :, 0, :], kc, vc, block_tables, ctx)
+        x = _block_finish(lp, x, o.reshape(s_n, 1, c))
+        new_pages.append((kc, vc))
+    h = _ln(x[:, 0], p["lnf_g"], p["lnf_b"])
+    logits = h @ p["wte"].T
+    return logits, logits.argmax(-1).astype(jnp.int32), new_pages
+
+
+def paged_prefill(p, tokens, prompt_len, block_table_row, kv_pages,
+                  n_heads):
+    """Admit one request: a single batched causal pass over its (padded)
+    prompt that scatters every position's K/V into the slot's pages and
+    returns the last prompt position's logits — the first generated
+    token costs one forward, not ``prompt_len`` decode steps.
+
+    - ``tokens``: int32 [T_pad] — prompt padded to the engine's static
+      prefill length (one compiled program for every prompt length);
+    - ``prompt_len``: int32 scalar (traced — no per-length recompiles);
+    - ``block_table_row``: int32 [max_pages_per_seq] for this slot.
+
+    Pad positions (>= prompt_len) are masked out of attention and their
+    K/V is scattered to scratch page 0.  Returns ``(logits [V] fp32,
+    first_token int32, new_kv_pages)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    t_pad = tokens.shape[0]
+    page_size = kv_pages[0][0].shape[1]
+    x = (p["wte"][tokens] + p["wpe"][:t_pad])[None]   # [1, T_pad, C]
+    c = x.shape[-1]
+    d = c // n_heads
+    pos = jnp.arange(t_pad)
+    valid = pos < prompt_len
+    mask = (jnp.tril(jnp.ones((t_pad, t_pad), bool))
+            & valid[None, :])[None, None]
+    phys = jnp.where(valid, block_table_row[pos // page_size], 0)
+    offs = pos % page_size
+    new_pages = []
+    for lp, (kc, vc) in zip(p["layers"], kv_pages):
+        q, k, v = _block_qkv(lp, x, n_heads)          # [1, H, T_pad, D]
+        st = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.float32(d))
+        st = jnp.where(mask, st, -1e30)
+        pr = jax.nn.softmax(st, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pr, v)
+        o = o.transpose(0, 2, 1, 3).reshape(1, t_pad, c)
+        kc = kc.at[phys, offs].set(k[0].transpose(1, 0, 2))
+        vc = vc.at[phys, offs].set(v[0].transpose(1, 0, 2))
+        x = _block_finish(lp, x, o)
+        new_pages.append((kc, vc))
+    h = _ln(x[0], p["lnf_g"], p["lnf_b"])             # [T_pad, C]
+    last = lax.dynamic_index_in_dim(h, prompt_len - 1, 0,
+                                    keepdims=False)
+    logits = last @ p["wte"].T
+    return logits, logits.argmax(-1).astype(jnp.int32), new_pages
 
 
 def get_gpt(num_layers, units, num_heads, vocab_size=50257, max_len=1024,

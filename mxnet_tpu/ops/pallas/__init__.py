@@ -4,8 +4,11 @@ The reference's hand-written CUDA kernels (src/operator/*.cu) map to XLA
 lowerings almost everywhere — XLA's fusion already covers what mshadow
 kernel launches did.  The kernels here cover the cases XLA does NOT fuse
 well: flash attention (online-softmax blockwise attention, the long-
-context workhorse the 2017 reference predates).
+context workhorse the 2017 reference predates) and ragged paged
+attention (the serving runtime's block-table decode gather, SERVING.md).
 """
 from .flash_attention import flash_attention, flash_attention_reference
+from .paged_attention import paged_attention, paged_attention_reference
 
-__all__ = ["flash_attention", "flash_attention_reference"]
+__all__ = ["flash_attention", "flash_attention_reference",
+           "paged_attention", "paged_attention_reference"]

@@ -1,0 +1,191 @@
+"""Ragged paged attention as a Pallas TPU kernel (decode path).
+
+The serving runtime (mxnet_tpu/serving/) keeps every resident sequence's
+KV history in fixed-size PAGES drawn from one shared pool
+(``k_pages``/``v_pages``: [num_pages, page_size, H, D]) with a
+per-sequence BLOCK TABLE mapping logical page index -> physical page id
+— the vLLM/"Ragged Paged Attention" memory model (PAPERS.md, arXiv
+2604.15464) that lets mixed-length sequences share one kernel launch
+with zero padding waste beyond the last partial page.
+
+Kernel shape (one launch serves ALL resident slots, any lengths):
+
+- grid ``(num_slots, max_pages_per_seq)`` with the page axis as the
+  sequential innermost dimension, exactly like ``flash_attention.py``'s
+  k-block sweep: each step streams ONE physical K/V page HBM->VMEM
+  while the online-softmax state (o, m, l) rides in VMEM scratch; the
+  head axis is an unrolled 2-D-matmul loop INSIDE the cell (all heads
+  of a slot read the same physical page — one fetch, H-fold fewer grid
+  cells);
+- the block table and per-slot context lengths arrive via scalar
+  prefetch (``pltpu.PrefetchScalarGridSpec``) so the BlockSpec index
+  maps can do the logical->physical page translation — the gather IS
+  the pipeline's address computation, no materialized per-sequence
+  contiguous KV ever exists;
+- pages at or beyond a slot's context length are skipped with
+  ``pl.when`` (raggedness costs control flow, not FLOPs) and the final
+  in-range page is masked per position.
+
+A slot with ``context_len == 0`` (an empty serving slot) attends to
+nothing and emits zeros.  Off-TPU the same kernel runs under the Pallas
+interpreter, so CPU tests exercise the identical code path.
+
+All matmuls accumulate in fp32 (MXU ``preferred_element_type``), same
+discipline as flash_attention.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import _use_interpret
+
+_NEG_INF = -1e30
+
+
+def _pl():
+    from jax.experimental import pallas as pl
+    return pl
+
+
+def _scratch(shape):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                   o_acc, m_acc, l_acc, *, page_size, n_heads, scale):
+    """One (slot, page) grid step: online-softmax accumulate the
+    physical page the block table routed in.  The head axis is an
+    UNROLLED loop of 2-D matmuls inside the cell (per-head rows of the
+    VMEM scratch), not a grid dimension: all heads of a slot read the
+    same physical page, so folding them into one cell fetches the page
+    once and cuts grid-cell overhead H-fold — which on the interpret
+    (CPU) path is most of the decode step's cost.  ``ctx_ref``/
+    ``bt_ref`` are the scalar-prefetched context lengths and block
+    table (the index maps already consumed ``bt_ref`` for the page
+    gather; only masking reads it here)."""
+    pl = _pl()
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    ctx = ctx_ref[s]
+
+    @pl.when(j == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+
+    @pl.when(j * page_size < ctx)
+    def _accumulate():
+        # positions past the context length (the ragged tail of the
+        # slot's final in-range page) contribute nothing
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        in_range = pos < ctx
+        for h in range(n_heads):
+            q = q_ref[0, h:h + 1, :].astype(jnp.float32) * scale  # (1,D)
+            k = k_ref[0, :, h, :].astype(jnp.float32)     # (page, D)
+            v = v_ref[0, :, h, :].astype(jnp.float32)     # (page, D)
+            st = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # (1, page)
+            st = jnp.where(in_range, st, _NEG_INF)
+            m_prev = m_acc[h:h + 1, :]
+            m_new = jnp.maximum(m_prev, st.max(axis=-1, keepdims=True))
+            p = jnp.exp(st - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_acc[h:h + 1, :] = l_acc[h:h + 1, :] * corr + \
+                p.sum(axis=-1, keepdims=True)
+            o_acc[h:h + 1, :] = o_acc[h:h + 1, :] * corr + \
+                jax.lax.dot_general(
+                    p, v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            m_acc[h:h + 1, :] = m_new
+
+    @pl.when(j == nj - 1)
+    def _emit():
+        # an empty slot (ctx == 0) never accumulated: l == 0, emit zeros
+        l_safe = jnp.maximum(l_acc[...], 1e-30)
+        o_ref[0] = (o_acc[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale=None):
+    """Decode attention for every resident slot in ONE kernel launch.
+
+    - ``q``: [S, H, D] — the current token's query per slot;
+    - ``k_pages``/``v_pages``: [num_pages, page_size, H, D] — the shared
+      physical page pools (page 0 is the serving allocator's scratch
+      page, never referenced by an in-range block-table entry);
+    - ``block_tables``: int32 [S, max_pages_per_seq] — logical page j of
+      slot s lives in physical page ``block_tables[s, j]``;
+    - ``context_lens``: int32 [S] — tokens of history per slot (0 for an
+      empty slot, whose output row is zeros).
+
+    Returns [S, H, D] in ``q``'s dtype.  Raggedness is free of FLOPs:
+    pages past ``context_lens[s]`` are skipped, the final partial page
+    is masked per position.
+    """
+    pl = _pl()
+    from jax.experimental.pallas import tpu as pltpu
+    s_n, h, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    ctx = jnp.asarray(context_lens, jnp.int32)
+    bt = jnp.asarray(block_tables, jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_n, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda s, j, c, b: (s, 0, 0)),
+            pl.BlockSpec((1, page_size, h, d),
+                         lambda s, j, c, b: (b[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, h, d),
+                         lambda s, j, c, b: (b[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda s, j, c, b: (s, 0, 0)),
+        scratch_shapes=[_scratch((h, d)), _scratch((h, 1)),
+                        _scratch((h, 1))],
+    )
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=page_size,
+                          n_heads=h, scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_n, h, d), q.dtype),
+        interpret=_use_interpret(),
+    )(ctx, bt, q, k_pages, v_pages)
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables,
+                              context_lens, scale=None):
+    """O(S·T) jnp oracle: gather each slot's pages contiguous, dense
+    masked softmax attention.  Tests pin the kernel against this and
+    against ``flash_attention`` on the densely-packed equivalent."""
+    s_n, h, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    bt = jnp.asarray(block_tables, jnp.int32)
+    ctx = jnp.asarray(context_lens, jnp.int32)
+    # [S, max_pages, page, H, D] -> [S, T_max, H, D]
+    k_seq = k_pages[bt].reshape(s_n, max_pages * page_size, h, d)
+    v_seq = v_pages[bt].reshape(s_n, max_pages * page_size, h, d)
+    st = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                    k_seq.astype(jnp.float32)) * scale
+    mask = (jnp.arange(max_pages * page_size)[None, None, :]
+            < ctx[:, None, None])
+    st = jnp.where(mask, st, _NEG_INF)
+    p = jax.nn.softmax(st, axis=-1)
+    # empty slots (ctx == 0): softmax over all -inf is uniform garbage —
+    # zero those rows to match the kernel's empty-slot contract
+    p = jnp.where(ctx[:, None, None] > 0, p, 0.0)
+    out = jnp.einsum("sht,sthd->shd", p, v_seq.astype(jnp.float32))
+    return out.astype(q.dtype)

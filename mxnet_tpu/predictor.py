@@ -65,13 +65,30 @@ class Predictor:
     def from_checkpoint(cls, prefix, epoch, input_shapes, ctx=None,
                         type_dict=None):
         """Load `prefix-symbol.json` + `prefix-%04d.params` (the
-        two-artifact contract, reference python/mxnet/model.py:340)."""
-        with open("%s-symbol.json" % prefix) as f:
-            sym_json = f.read()
-        with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
-            param_bytes = f.read()
-        return cls(sym_json, param_bytes, input_shapes, ctx=ctx,
+        two-artifact contract, reference python/mxnet/model.py:340)
+        through :class:`~mxnet_tpu.checkpoint.CheckpointManager` — NOT a
+        bare ``open()``: the manager drains any in-flight async
+        checkpoint writes and verifies the epoch's sha256 manifest
+        first, so a serving replica pointed at a LIVE training job's
+        prefix can never bind a torn or still-being-written checkpoint
+        (manifest-less pre-manager checkpoints still load via the
+        legacy parse-probe path).  ``epoch=None`` follows the newest
+        complete checkpoint."""
+        from .checkpoint import CheckpointManager
+        mgr = CheckpointManager(prefix)
+        _, arg_params, aux_params = mgr.load(epoch)
+        try:
+            with open(mgr.symbol_path()) as f:
+                sym_json = f.read()
+        except OSError as e:
+            raise MXNetError(
+                "checkpoint prefix %s has no symbol file %s: %s"
+                % (prefix, mgr.symbol_path(), e)) from e
+        pred = cls(sym_json, None, input_shapes, ctx=ctx,
                    type_dict=type_dict)
+        pred._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=True)
+        return pred
 
     def set_input(self, name, value):
         """MXPredSetInput: stage one named input."""

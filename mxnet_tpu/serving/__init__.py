@@ -1,0 +1,25 @@
+"""Production inference serving: continuous batching + paged KV cache.
+
+The inference half of the roadmap (item 2): the predictor path is
+one-request-at-a-time; this package is the serving runtime "millions of
+users" needs —
+
+- :class:`~mxnet_tpu.serving.kv_cache.PagedKVAllocator` — fixed-size KV
+  pages, per-sequence block tables, free-list reuse, OOM-aware
+  admission;
+- :class:`~mxnet_tpu.serving.scheduler.ContinuousBatchingScheduler` —
+  FIFO admission queue over fixed decode slots; requests join/leave
+  between decode steps with zero recompiles;
+- :class:`~mxnet_tpu.serving.engine.ServingEngine` — ONE donated XLA
+  program per decode step over the ragged paged-attention kernel
+  (ops/pallas/paged_attention.py), AOT-warm-started from the executable
+  cache, instrumented through telemetry.
+
+See SERVING.md for architecture, sizing, and the env contract.
+"""
+from .kv_cache import PagedKVAllocator
+from .scheduler import ContinuousBatchingScheduler, Request
+from .engine import ServingEngine
+
+__all__ = ["PagedKVAllocator", "ContinuousBatchingScheduler",
+           "Request", "ServingEngine"]

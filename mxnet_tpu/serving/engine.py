@@ -1,0 +1,378 @@
+"""ServingEngine: continuous batching + paged KV cache on one model.
+
+The inference counterpart of the fused train step (PR 1): every decode
+step is ONE donated XLA program that advances EVERY resident sequence by
+one token —
+
+    (params, kv_pages*, tokens, positions, active, block_tables)
+        -> (logits, next_tokens, kv_pages')        [* donated]
+
+with the paged-attention Pallas kernel (ops/pallas/paged_attention.py)
+doing the ragged gather inside.  Requests join between steps via one
+prefill dispatch (static padded prompt shape, traced length — no
+per-length recompiles) and leave by releasing pages; occupancy is a
+mask, never a shape, so request churn causes ZERO recompiles.
+
+Donation discipline (ROBUSTNESS.md §8): the KV page pools are donated
+every step, so
+
+- every lazily-compiling path is wrapped in
+  ``aot_cache.donation_cache_guard`` and every eager compile runs under
+  ``bypass_persistent_cache`` — a donated program must never be replayed
+  from jax's persistent cache on the hazard (CPU) backends;
+- the pools are born as jitted-zeros outputs — fresh XLA-owned buffers
+  by construction; anything ever restored into them from host data must
+  go through ``parallel.sharding.fresh_device_put`` instead (the eager
+  device_put aliasing hazard, ROBUSTNESS.md §8c).
+
+AOT warm-start (the PR-5/PR-6 machinery applied to the predictor path):
+both serving programs (prefill, decode) run through ``aot_cache`` —
+keyed by runtime fingerprint + full input tree + an engine-config hash —
+so a serving replica restarted with ``MXTPU_AOT_CACHE_DIR`` reaches its
+first token with 0 foreground compiles (on CPU via the donation-free
+twin + background hot-swap, exactly like executor.make_fit_step).
+
+Telemetry (OBSERVABILITY.md §9): ``serving.ttft`` / ``serving.tpot`` /
+``serving.queue_wait`` histograms, ``serving.batch_occupancy`` /
+``serving.kv_pages_free`` gauges, ``serving.requests`` /
+``serving.tokens`` / ``serving.prefills`` counters, and one flight-
+recorder record per decode step (``where="serve_step"``) so a crashed
+replica's postmortem carries its recent decode cadence.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as _np
+
+from .. import aot_cache as _aot
+from .. import profiler as _profiler
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from .kv_cache import PagedKVAllocator
+from .scheduler import ContinuousBatchingScheduler, FINISHED
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    """Continuous-batching greedy-decode server over a model-zoo GPTLM.
+
+    ``num_slots`` decode slots, a shared pool of ``num_pages`` KV pages
+    of ``page_size`` tokens; prompts are padded to ``max_prefill_len``
+    (one prefill program) and ``prompt + max_new <= max_seq_len`` per
+    request.  Greedy argmax decoding (deterministic — the join/leave
+    bit-exactness invariant is testable), optional ``eos_id`` early
+    stop.
+
+    ``record_logits=True`` keeps every request's per-token logits rows
+    (tests bit-check them across occupancy changes); off in production.
+    """
+
+    def __init__(self, net, num_slots=4, page_size=16, num_pages=None,
+                 max_prefill_len=32, max_seq_len=None, eos_id=None,
+                 record_logits=False):
+        from ..gluon.model_zoo import gpt as _gpt
+
+        self._gpt = _gpt
+        self._net = net
+        self._p = _gpt.decode_params(net)
+        self._n_heads = net.blocks._children[0].attn._num_heads
+        self._n_layers = len(self._p["layers"])
+        self._units = int(self._p["wte"].shape[1])
+        self._vocab = int(self._p["wte"].shape[0])
+        self._head_dim = self._units // self._n_heads
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.max_prefill_len = int(max_prefill_len)
+        self.max_seq_len = int(max_seq_len if max_seq_len is not None
+                               else net._max_len)
+        if self.max_seq_len > net._max_len:
+            raise ValueError("max_seq_len %d exceeds the model's "
+                             "max_len %d" % (self.max_seq_len,
+                                             net._max_len))
+        if self.max_prefill_len > self.max_seq_len:
+            raise ValueError("max_prefill_len > max_seq_len")
+        self.max_pages_per_seq = -(-self.max_seq_len // self.page_size)
+        if num_pages is None:
+            # full capacity + scratch: every slot can hold a max-length
+            # sequence.  Pass a smaller pool to get real admission
+            # pressure (the OOM-aware path).
+            num_pages = self.num_slots * self.max_pages_per_seq + 1
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self._record_logits = bool(record_logits)
+
+        self.alloc = PagedKVAllocator(num_pages, self.page_size)
+        self.sched = ContinuousBatchingScheduler(
+            self.num_slots, self.alloc, self.max_pages_per_seq,
+            max_seq_len=self.max_seq_len)
+
+        self._kv = self._init_pages()
+        self.decode_steps = 0
+        self.prefills = 0
+        self._build_programs()
+        _telemetry.gauge("serving.kv_pages_free").set(
+            self.alloc.free_pages)
+        _telemetry.gauge("serving.batch_occupancy").set(0)
+
+    # -- device state ------------------------------------------------------
+    def _init_pages(self):
+        """Per-layer (k_pages, v_pages) pools as FRESH XLA-owned buffers
+        — they are donated every step, and a donated buffer must not
+        alias anything a caller still references (ROBUSTNESS.md §8c).
+        A jitted zeros program guarantees that by construction (each
+        execution allocates fresh outputs); anything ever RESTORED into
+        pages from host data must instead go through
+        ``parallel.sharding.fresh_device_put`` — an eager device_put can
+        alias its source, and donating the alias frees the source's
+        memory out from under it."""
+        import jax
+        import jax.numpy as jnp
+
+        shape = (self.alloc.num_pages, self.page_size, self._n_heads,
+                 self._head_dim)
+        mk = jax.jit(lambda: jnp.zeros(shape, jnp.float32))
+        return [(mk(), mk()) for _ in range(self._n_layers)]
+
+    # -- program construction ---------------------------------------------
+    def _config_hash(self):
+        """Everything about this engine that changes the traced programs
+        but not the input shapes — goes into the AOT cache key the way
+        Module passes its symbol/optimizer hash."""
+        return ("serve|L%d|h%d|u%d|v%d|ps%d|np%d|slots%d|mp%d|pf%d|%s"
+                % (self._n_layers, self._n_heads, self._units,
+                   self._vocab, self.page_size, self.alloc.num_pages,
+                   self.num_slots, self.max_pages_per_seq,
+                   self.max_prefill_len, type(self._net).__name__))
+
+    def _build_programs(self):
+        import jax
+
+        gpt = self._gpt
+        n_heads = self._n_heads
+
+        def decode(p, kv_pages, tokens, positions, active,
+                   block_tables):
+            return gpt.paged_decode_step(p, tokens, positions, active,
+                                         kv_pages, block_tables,
+                                         n_heads)
+
+        def prefill(p, kv_pages, tokens, prompt_len, bt_row):
+            return gpt.paged_prefill(p, tokens, prompt_len, bt_row,
+                                     kv_pages, n_heads)
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        p_ex = jax.tree_util.tree_map(sds, self._p)
+        kv_ex = jax.tree_util.tree_map(sds, self._kv)
+        s, mp, tp = self.num_slots, self.max_pages_per_seq, \
+            self.max_prefill_len
+        i32 = _np.int32
+        decode_ex = (p_ex, kv_ex,
+                     jax.ShapeDtypeStruct((s,), i32),
+                     jax.ShapeDtypeStruct((s,), i32),
+                     jax.ShapeDtypeStruct((s,), _np.bool_),
+                     jax.ShapeDtypeStruct((s, mp), i32))
+        prefill_ex = (p_ex, kv_ex,
+                      jax.ShapeDtypeStruct((tp,), i32),
+                      jax.ShapeDtypeStruct((), i32),
+                      jax.ShapeDtypeStruct((mp,), i32))
+        extra = self._config_hash()
+        self._decode = self._compile("decode", decode, decode_ex, extra)
+        self._prefill = self._compile("prefill", prefill, prefill_ex,
+                                      extra)
+
+    def _compile(self, name, fn, examples, extra):
+        """AOT-compile one serving program through the executable cache
+        (the executor._aot_fit_step tiers, serving flavor):
+
+        - memo hit: same-process rebuild, the original compiled object;
+        - disk hit, donated variant (TPU-class): deserialize + run;
+        - disk hit, plain variant (CPU): run the donation-free twin now,
+          hot-swap the donated program in when its background compile
+          lands — first token never waits on XLA;
+        - miss: compile the donated program (outside jax's persistent
+          cache on hazard backends), then store this backend's
+          consumable variant off the hot path.
+
+        Every tier returns a ``profiler.instrument``-wrapped callable so
+        steady-state dispatch/recompile accounting holds engine-wide.
+        Any cache failure falls back to guarded lazy jit — the cache can
+        make spin-up faster, never break serving."""
+        import jax
+
+        def mk_jit(donated=True):
+            return jax.jit(fn, donate_argnums=(1,) if donated else ())
+
+        try:
+            key = _aot.cache_key("serve_" + name, examples, extra=extra)
+            memo = _aot.memo_get(key)
+            if memo is not None:
+                return _profiler.instrument(memo,
+                                            first_call_compiles=False)
+            if _aot.enabled():
+                loaded = _aot.load(key)
+                if loaded is not None:
+                    compiled, var, _meta = loaded
+                    from .. import watchdog as _watchdog
+                    _watchdog.note_warm_start()
+                    if var == _aot.VARIANT_DONATED:
+                        _aot.memo_put(key, compiled)
+                        return _profiler.instrument(
+                            compiled, first_call_compiles=False)
+                    # warm hazard-backend spin-up: serve on the twin
+                    # now, hot-swap the donated program in when its
+                    # background compile lands (§8 shared machinery)
+                    return _profiler.instrument(
+                        _aot.twin_hotswap_cell(mk_jit, examples, key,
+                                               compiled,
+                                               where="mxnet_tpu.serving"),
+                        first_call_compiles=False)
+            with _telemetry.span("serving.compile", cat="serving"):
+                with _aot.bypass_persistent_cache():
+                    compiled = mk_jit().lower(*examples).compile()
+            _aot.memo_put(key, compiled)
+            if _aot.enabled():
+                _aot.spawn_variant_store(mk_jit, examples, key,
+                                         compiled,
+                                         where="mxnet_tpu.serving")
+            # the compile happened HERE (eagerly), so the instrumented
+            # first call must not charge a second phantom compile
+            _profiler.count_compile()
+            return _profiler.instrument(compiled,
+                                        first_call_compiles=False)
+        except Exception as e:
+            import logging
+            logging.warning(
+                "mxnet_tpu.serving: AOT path unavailable for %s "
+                "(%s: %s); using guarded lazy jit", name,
+                type(e).__name__, e)
+            return _profiler.instrument(
+                _aot.donation_cache_guard(mk_jit()))
+
+    # -- request intake ----------------------------------------------------
+    def submit(self, prompt, max_new):
+        """Enqueue one request (prompt: 1-d int token array).  Returns
+        the Request handle; tokens appear on it as the engine steps."""
+        prompt = _np.asarray(prompt, _np.int32).reshape(-1)
+        if prompt.size > self.max_prefill_len:
+            raise ValueError(
+                "prompt length %d exceeds max_prefill_len %d"
+                % (prompt.size, self.max_prefill_len))
+        req = self.sched.submit(prompt, max_new)
+        if self._record_logits:
+            req.logits_trace = []
+        _telemetry.counter("serving.requests").inc()
+        return req
+
+    # -- the serving loop --------------------------------------------------
+    def _admit_and_prefill(self):
+        """Join phase: place queued requests into free slots and run one
+        prefill dispatch each (pages donated through; the request's
+        first generated token comes back with it)."""
+        placed = self.sched.admit()
+        for req in placed:
+            _telemetry.histogram("serving.queue_wait").observe(
+                req.queue_wait_s)
+            toks = _np.zeros(self.max_prefill_len, _np.int32)
+            toks[:req.prompt.size] = req.prompt
+            t0 = time.perf_counter_ns()
+            logits, first, self._kv = self._prefill(
+                self._p, self._kv, toks,
+                _np.int32(req.prompt.size),
+                self.sched.block_tables[req.slot].copy())
+            t1 = time.perf_counter_ns()
+            first = int(first)          # device sync
+            t2 = time.perf_counter_ns()
+            _telemetry.note_train_step(t0, t1, t2,
+                                       where="serve_prefill")
+            self.prefills += 1
+            _telemetry.counter("serving.prefills").inc()
+            self._note_token(req, first,
+                             _np.asarray(logits) if self._record_logits
+                             else None)
+        return placed
+
+    def _note_token(self, req, token, logits_row=None):
+        now = time.perf_counter()
+        req.tokens.append(int(token))
+        req.token_times.append(now)
+        if req.first_token_t is None:
+            req.first_token_t = now
+            _telemetry.histogram("serving.ttft").observe(req.ttft_s)
+        else:
+            _telemetry.histogram("serving.tpot").observe(
+                now - req.token_times[-2])
+        _telemetry.counter("serving.tokens").inc()
+        if self._record_logits and logits_row is not None:
+            req.logits_trace.append(_np.array(logits_row, _np.float32))
+        if len(req.tokens) >= req.max_new or \
+                (self.eos_id is not None and int(token) == self.eos_id):
+            self.sched.finish(req, FINISHED)
+
+    def step(self):
+        """One serving iteration: admit+prefill joins, then ONE donated
+        decode dispatch advancing every resident slot.  Returns the
+        number of tokens produced (0 == idle)."""
+        placed = self._admit_and_prefill()
+        # every placed request produced exactly one token in its prefill
+        produced = len(placed)
+        running = self.sched.running
+        if not running:
+            self._publish_gauges()
+            return produced
+
+        s = self.num_slots
+        tokens = _np.zeros(s, _np.int32)
+        positions = _np.zeros(s, _np.int32)
+        active = _np.zeros(s, _np.bool_)
+        for req in running:
+            tokens[req.slot] = req.tokens[-1]
+            # context already in pages: prompt + generated-but-last; the
+            # last generated token is what this step feeds in, at
+            # position prompt_len + (n_generated - 1)
+            positions[req.slot] = req.prompt.size + len(req.tokens) - 1
+            active[req.slot] = True
+
+        t0 = time.perf_counter_ns()
+        logits, nxt, self._kv = self._decode(
+            self._p, self._kv, tokens, positions, active,
+            self.sched.block_tables.copy())
+        t1 = time.perf_counter_ns()
+        nxt = _np.asarray(nxt)           # device sync barrier
+        t2 = time.perf_counter_ns()
+        _telemetry.note_train_step(t0, t1, t2, where="serve_step")
+        self.decode_steps += 1
+        logits_np = _np.asarray(logits) if self._record_logits else None
+        for req in list(running):
+            self._note_token(
+                req, nxt[req.slot],
+                None if logits_np is None else logits_np[req.slot])
+            produced += 1
+        self._publish_gauges()
+        return produced
+
+    def _publish_gauges(self):
+        _telemetry.gauge("serving.batch_occupancy").set(
+            self.sched.occupancy)
+        _telemetry.gauge("serving.kv_pages_free").set(
+            self.alloc.free_pages)
+
+    def run_until_idle(self, max_steps=100000):
+        """Drive step() until queue and slots are empty (tests and batch
+        jobs; a live server would call step() forever)."""
+        for _ in range(max_steps):
+            if self.sched.idle:
+                return
+            self.step()
+        raise MXNetError("serving loop did not drain in %d steps"
+                         % max_steps)
+
+    # -- convenience -------------------------------------------------------
+    def generate(self, prompts, max_new):
+        """Batch convenience: submit everything, drain, return token
+        lists (prompt excluded) in submit order."""
+        reqs = [self.submit(p, max_new) for p in prompts]
+        self.run_until_idle()
+        return [r.tokens for r in reqs]

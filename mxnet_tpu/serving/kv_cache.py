@@ -1,0 +1,101 @@
+"""Paged KV-cache allocator: fixed-size blocks, block tables, free-list.
+
+The device-side page pools (``[num_pages, page_size, H, D]`` per layer,
+owned by the serving engine and donated through every decode step) are
+dumb storage; THIS object is the authority over which physical page
+belongs to whom.  Design follows the vLLM/"Ragged Paged Attention"
+memory model (PAPERS.md, arXiv 2604.15464):
+
+- **fixed-size blocks** — a sequence of length L owns
+  ``ceil(L / page_size)`` pages; internal fragmentation is bounded by
+  one partial page per sequence instead of ``max_len - L`` slots of a
+  dense cache;
+- **free-list reuse** — released pages go back LIFO, so a churning
+  workload keeps re-touching the same hot pages;
+- **reservation-based admission** — a request is admitted only when
+  pages for its WORST CASE (prompt + max_new_tokens) are free, reserved
+  up front.  Decode can then never OOM mid-flight: admission is the
+  single choke point, and a rejected request waits in the queue instead
+  of killing resident sequences (OOM-aware admission, ISSUE 9).
+
+**Page 0 is reserved as the scratch page**: inactive serving slots and
+prompt padding scatter their K/V writes there, and no in-range block-
+table entry ever points at it — that is what makes slot join/leave
+invisible (bit-exact) to resident slots.  The allocator simply never
+hands page 0 out.
+
+Pure host-side bookkeeping (lists of ints); nothing here touches jax.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["PagedKVAllocator"]
+
+#: physical page id every masked/inactive write is routed to
+SCRATCH_PAGE = 0
+
+
+class PagedKVAllocator:
+    def __init__(self, num_pages, page_size):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved scratch page)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list, scratch page excluded.  Reversed so the first
+        # allocations hand out low page ids (stable, test-friendly).
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._allocated = set()
+
+    # -- sizing ------------------------------------------------------------
+    def pages_for(self, tokens):
+        """Pages a ``tokens``-long sequence occupies (>= 1 so even an
+        empty reservation owns its first page)."""
+        return max(1, -(-int(tokens) // self.page_size))
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        return len(self._allocated)
+
+    # -- admission ---------------------------------------------------------
+    def can_reserve(self, n):
+        """Would ``allocate(n)`` succeed right now?  The scheduler's
+        OOM-aware admission check: a request whose worst case does not
+        fit stays queued."""
+        return int(n) <= len(self._free)
+
+    def allocate(self, n):
+        """Take ``n`` pages off the free list.  Raises MXNetError when
+        the pool cannot satisfy the request — callers are expected to
+        have asked :meth:`can_reserve` first (the scheduler does), so
+        this raising means an accounting bug, not load."""
+        n = int(n)
+        if n > len(self._free):
+            raise MXNetError(
+                "paged KV cache OOM: requested %d pages, %d free of %d "
+                "(admission should have rejected this request)"
+                % (n, len(self._free), self.num_pages - 1))
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def release(self, pages):
+        """Return a sequence's pages to the free list (LIFO).  Double
+        frees and frees of never-allocated ids raise — both are
+        use-after-free bugs that would silently corrupt ANOTHER
+        sequence's history if let through."""
+        for p in pages:
+            p = int(p)
+            if p not in self._allocated:
+                raise MXNetError(
+                    "release of page %d which is not allocated (double "
+                    "free or scratch/foreign page)" % p)
+            self._allocated.remove(p)
+            self._free.append(p)

@@ -1,0 +1,196 @@
+"""Admission queue + continuous-batching scheduler.
+
+Fixed-capacity decode SLOTS (static ``num_slots`` — the decode program
+compiles once, for one shape) with dynamic OCCUPANCY: requests join a
+free slot between decode steps (one prefill dispatch fills their pages)
+and leave the instant they finish (pages released, slot free for the
+next queued request).  No recompiles, no barrier on the longest
+sequence — the continuous-batching scheme of Orca/vLLM applied to the
+predictor path (ROADMAP item 2).
+
+Admission is FIFO and OOM-aware: the head of the queue is admitted only
+when (a) a slot is free and (b) the paged allocator can reserve its
+worst case (``prompt + max_new`` tokens) up front — see
+kv_cache.PagedKVAllocator.  Head-of-line blocking is deliberate: FIFO
+keeps per-request latency predictable and starvation impossible, the
+usual serving trade.
+
+Host-side control plane only; the engine owns every device object.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+import numpy as _np
+
+from .kv_cache import PagedKVAllocator, SCRATCH_PAGE
+
+__all__ = ["Request", "ContinuousBatchingScheduler"]
+
+#: request lifecycle states
+QUEUED, RUNNING, FINISHED, REJECTED = \
+    "queued", "running", "finished", "rejected"
+
+
+class Request:
+    """One inference request: a prompt plus a decode budget, and the
+    latency stamps the serving histograms are built from."""
+
+    __slots__ = ("rid", "prompt", "max_new", "submit_t", "admit_t",
+                 "first_token_t", "finish_t", "tokens", "state", "slot",
+                 "pages", "logits_trace", "token_times")
+
+    def __init__(self, rid, prompt, max_new):
+        self.rid = rid
+        self.prompt = _np.asarray(prompt, _np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new = int(max_new)
+        if self.max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        self.submit_t = time.perf_counter()
+        self.admit_t = None
+        self.first_token_t = None
+        self.finish_t = None
+        self.tokens = []          # generated token ids (ints)
+        self.token_times = []     # perf_counter per generated token
+        self.state = QUEUED
+        self.slot = None
+        self.pages = None
+        self.logits_trace = None  # engine fills when record_logits=True
+
+    @property
+    def ttft_s(self):
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def queue_wait_s(self):
+        if self.admit_t is None:
+            return None
+        return self.admit_t - self.submit_t
+
+    @property
+    def tpot_s(self):
+        """Mean time per output token AFTER the first (decode cadence);
+        None until two tokens exist."""
+        if len(self.token_times) < 2:
+            return None
+        span = self.token_times[-1] - self.token_times[0]
+        return span / (len(self.token_times) - 1)
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, num_slots, allocator, max_pages_per_seq,
+                 max_seq_len=None):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if not isinstance(allocator, PagedKVAllocator):
+            raise TypeError("allocator must be a PagedKVAllocator")
+        self.num_slots = int(num_slots)
+        self.alloc = allocator
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.max_seq_len = (int(max_seq_len) if max_seq_len is not None
+                            else self.max_pages_per_seq
+                            * allocator.page_size)
+        self._queue = collections.deque()
+        self._slots = [None] * self.num_slots   # slot -> Request | None
+        self._next_rid = 0
+        # block tables live here (the scheduler owns placement); the
+        # engine uploads this array every step.  SCRATCH_PAGE everywhere
+        # a slot holds no real page — masked reads/writes route there.
+        self.block_tables = _np.full(
+            (self.num_slots, self.max_pages_per_seq), SCRATCH_PAGE,
+            _np.int32)
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, prompt, max_new):
+        """Enqueue a request (never blocks, never rejects for load — the
+        queue is the backpressure).  Rejects only requests that can
+        NEVER run: worst case beyond the per-sequence page budget."""
+        req = Request(self._next_rid, prompt, max_new)
+        self._next_rid += 1
+        worst = req.prompt.size + req.max_new
+        if worst > self.max_seq_len:
+            req.state = REJECTED
+            raise ValueError(
+                "request needs %d tokens (prompt %d + max_new %d) but "
+                "the engine serves at most %d per sequence"
+                % (worst, req.prompt.size, req.max_new,
+                   self.max_seq_len))
+        need = self.alloc.pages_for(worst)
+        if need > self.alloc.num_pages - 1:
+            # admission could never reserve this many pages even with
+            # the pool idle — queueing it would deadlock the queue head
+            req.state = REJECTED
+            raise ValueError(
+                "request needs %d KV pages but the pool only has %d "
+                "usable — enlarge num_pages or lower max_new"
+                % (need, self.alloc.num_pages - 1))
+        self._queue.append(req)
+        return req
+
+    # -- placement ---------------------------------------------------------
+    def admit(self):
+        """Move queued requests into free slots while both a slot AND
+        the worst-case page reservation are available (FIFO; stops at
+        the first request that doesn't fit — no reordering).  Returns
+        the newly-placed requests; the engine prefills each."""
+        placed = []
+        while self._queue:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            head = self._queue[0]
+            need = self.alloc.pages_for(head.prompt.size + head.max_new)
+            if not self.alloc.can_reserve(need):
+                break  # OOM-aware admission: wait, don't evict
+            self._queue.popleft()
+            head.pages = self.alloc.allocate(need)
+            head.slot = slot
+            head.admit_t = time.perf_counter()
+            head.state = RUNNING
+            self._slots[slot] = head
+            row = self.block_tables[slot]
+            row[:] = SCRATCH_PAGE
+            row[:len(head.pages)] = head.pages
+            placed.append(head)
+        return placed
+
+    def finish(self, req, state=FINISHED):
+        """Release a request's slot + pages (leave-between-steps)."""
+        assert self._slots[req.slot] is req
+        self._slots[req.slot] = None
+        self.block_tables[req.slot, :] = SCRATCH_PAGE
+        self.alloc.release(req.pages)
+        req.pages = None
+        req.state = state
+        req.finish_t = time.perf_counter()
+
+    def _free_slot(self):
+        for i, r in enumerate(self._slots):
+            if r is None:
+                return i
+        return None
+
+    # -- views -------------------------------------------------------------
+    @property
+    def running(self):
+        return [r for r in self._slots if r is not None]
+
+    @property
+    def queued(self):
+        return len(self._queue)
+
+    @property
+    def occupancy(self):
+        return sum(1 for r in self._slots if r is not None)
+
+    def slot_request(self, slot):
+        return self._slots[slot]
+
+    @property
+    def idle(self):
+        return not self._queue and self.occupancy == 0

@@ -1,0 +1,315 @@
+"""Serving-path probe: continuous batching vs the sequential predictor.
+
+Synthetic OPEN-LOOP load generator (Poisson arrivals — the generator
+never waits for the server, so queueing delay is measured, not hidden)
+over mixed prompt/output lengths, driven through two servers built on
+the SAME model with the SAME greedy workload:
+
+- **continuous** — ``mxnet_tpu.serving.ServingEngine``: fixed decode
+  slots, paged KV cache, ONE donated XLA program per decode step for
+  all resident sequences (the tentpole path);
+- **sequential** — the predictor discipline the serving stack replaces:
+  one request at a time, each new token a full fixed-shape forward over
+  the padded context (``Predictor.forward``'s compiled-program contract
+  — no KV cache, no cross-request batching), tokens via the same greedy
+  argmax.
+
+Reported per side: tokens/s, TTFT and TPOT p50/p99, queue wait, mean
+batch occupancy.  Hard contracts asserted by ``BENCH_MODE=serve``
+(bench.py):
+
+- exactly ONE decode dispatch per token step (all resident sequences
+  advance in it) and one dispatch per admitted request's prefill —
+  nothing else dispatches in the serving loop;
+- ZERO steady-state recompiles across request churn (slots joining /
+  leaving never change a program shape);
+- both sides emit IDENTICAL tokens (greedy determinism: the paged
+  engine is bit-equivalent to the dense forward);
+- warm replica spin-up (``measure_spinup``, restart_probe pattern: two
+  subprocesses sharing one AOT cache dir) reaches its first token with
+  ZERO foreground serving-program compiles.
+
+Usage: JAX_PLATFORMS=cpu python tools/perf_probe/serve_probe.py
+Prints one JSON object.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from restart_probe import _pct  # noqa: E402 — shared percentile helper
+
+
+def build_net(vocab=256, n_layer=2, d_model=128, n_head=4, max_len=64,
+              seed=0):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = gpt.GPTLM(vocab, n_layer, d_model, n_head, max_len=max_len)
+    net.initialize()
+    return net
+
+
+def make_workload(n_requests=24, mean_interarrival_s=0.004,
+                  prompt_lens=(4, 24), new_tokens=(8, 24), vocab=256,
+                  seed=7):
+    """[(arrival_offset_s, prompt int32[L], max_new)] — Poisson process
+    (exponential inter-arrival), uniform mixed lengths.  Seeded: both
+    servers replay the identical trace."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    out = []
+    for _ in range(n_requests):
+        t += float(rng.exponential(mean_interarrival_s))
+        lo, hi = prompt_lens
+        plen = int(rng.randint(lo, hi + 1))
+        nlo, nhi = new_tokens
+        out.append((t, rng.randint(0, vocab, plen).astype(np.int32),
+                    int(rng.randint(nlo, nhi + 1))))
+    return out
+
+
+def _req_stats(ttfts, tpots, waits):
+    ttfts, tpots, waits = sorted(ttfts), sorted(tpots), sorted(waits)
+    return {
+        "ttft_p50_ms": round(_pct(ttfts, 0.5) * 1e3, 3),
+        "ttft_p99_ms": round(_pct(ttfts, 0.99) * 1e3, 3),
+        "tpot_p50_ms": (round(_pct(tpots, 0.5) * 1e3, 3)
+                        if tpots else None),
+        "tpot_p99_ms": (round(_pct(tpots, 0.99) * 1e3, 3)
+                        if tpots else None),
+        "queue_wait_p50_ms": (round(_pct(waits, 0.5) * 1e3, 3)
+                              if waits else None),
+        "queue_wait_p99_ms": (round(_pct(waits, 0.99) * 1e3, 3)
+                              if waits else None),
+    }
+
+
+def run_continuous(net, workload, num_slots=8, page_size=16,
+                   max_prefill_len=32, max_seq_len=48, num_pages=None):
+    """Open-loop drive of the ServingEngine; returns throughput, latency
+    percentiles, occupancy, and the dispatch/compile accounting."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import ServingEngine
+    import numpy as np
+
+    eng = ServingEngine(net, num_slots=num_slots, page_size=page_size,
+                        max_prefill_len=max_prefill_len,
+                        max_seq_len=max_seq_len, num_pages=num_pages)
+    # warmup: both programs execute once (first-call overhead, twin
+    # hot-swap settle) before the timed workload
+    eng.generate([np.zeros(4, np.int32)], max_new=2)
+    profiler.reset_step_stats()
+    base = profiler.step_stats()
+    d0, c0 = base["dispatch_count"], base["compile_count"]
+    steps0, prefills0 = eng.decode_steps, eng.prefills
+
+    reqs = []
+    pending = list(workload)
+    t_start = time.perf_counter()
+    while pending or not eng.sched.idle:
+        now = time.perf_counter() - t_start
+        while pending and pending[0][0] <= now:
+            _, prompt, max_new = pending.pop(0)
+            reqs.append(eng.submit(prompt, max_new))
+        if eng.step() == 0 and pending:
+            # idle gap before the next arrival: wait it out off-device
+            time.sleep(min(1e-4, max(0.0, pending[0][0] - now)))
+    wall = time.perf_counter() - t_start
+
+    stats = profiler.step_stats()
+    decode_steps = eng.decode_steps - steps0
+    prefills = eng.prefills - prefills0
+    dispatches = stats["dispatch_count"] - d0
+    total_tokens = sum(len(r.tokens) for r in reqs)
+    decode_tokens = total_tokens - prefills  # 1 token/request from prefill
+    out = {
+        "requests": len(reqs),
+        "num_slots": num_slots,
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 4),
+        "tokens_per_sec": round(total_tokens / wall, 2),
+        "decode_steps": decode_steps,
+        "prefill_dispatches": prefills,
+        "total_dispatches": dispatches,
+        # the tentpole contract: every decode step is ONE program for
+        # ALL residents; the only other dispatches are one per prefill
+        "decode_dispatches_per_step": round(
+            (dispatches - prefills) / max(1, decode_steps), 4),
+        "steady_state_compiles": stats["compile_count"] - c0,
+        "mean_batch_occupancy": round(
+            decode_tokens / max(1, decode_steps), 3),
+        "tokens": [list(map(int, r.tokens)) for r in reqs],
+    }
+    out.update(_req_stats([r.ttft_s for r in reqs],
+                          [r.tpot_s for r in reqs
+                           if r.tpot_s is not None],
+                          [r.queue_wait_s for r in reqs]))
+    return out
+
+
+def run_sequential(net, workload, t_pad=48):
+    """The baseline the ISSUE names: sequential per-request
+    ``Predictor.forward`` — one fixed-shape compiled full forward per
+    generated token, requests strictly one at a time in arrival order.
+    Causal attention makes right-padding invisible to position
+    ``len-1``, so greedy tokens match the cached engine bit-for-bit."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from mxnet_tpu.gluon.block import functionalize
+
+    fn, params = functionalize(net, jnp.zeros((1, t_pad), jnp.int32))
+
+    @jax.jit
+    def fwd_next(params, toks, length):
+        (logits,), _ = fn(params, toks)
+        row = lax.dynamic_index_in_dim(logits[0], length - 1, 0,
+                                       keepdims=False)
+        return row.argmax(-1).astype(jnp.int32)
+
+    # warmup compile outside the timed region (parity with continuous)
+    np.asarray(fwd_next(params, jnp.zeros((1, t_pad), jnp.int32),
+                        jnp.int32(1)))
+
+    ttfts, tpots, waits, all_tokens = [], [], [], []
+    total = 0
+    t_start = time.perf_counter()
+    for arrival, prompt, max_new in workload:
+        now = time.perf_counter() - t_start
+        if now < arrival:
+            time.sleep(arrival - now)
+        service_start = time.perf_counter()
+        waits.append(max(0.0, service_start - t_start - arrival))
+        toks = np.zeros((1, t_pad), np.int32)
+        toks[0, :prompt.size] = prompt
+        length = prompt.size
+        produced = []
+        stamps = []
+        for _ in range(max_new):
+            nxt = int(fwd_next(params, toks, np.int32(length)))
+            stamps.append(time.perf_counter())
+            produced.append(nxt)
+            toks[0, length] = nxt
+            length += 1
+        total += len(produced)
+        all_tokens.append(produced)
+        ttfts.append(stamps[0] - (t_start + arrival))
+        if len(stamps) > 1:
+            tpots.append((stamps[-1] - stamps[0]) / (len(stamps) - 1))
+    wall = time.perf_counter() - t_start
+    out = {
+        "requests": len(workload),
+        "total_tokens": total,
+        "wall_s": round(wall, 4),
+        "tokens_per_sec": round(total / wall, 2),
+        "tokens": all_tokens,
+    }
+    out.update(_req_stats(ttfts, tpots, waits))
+    return out
+
+
+# -- AOT-warm replica spin-up (restart_probe pattern) ----------------------
+
+def _spinup_child():
+    """One fresh replica: backend-ready -> engine built -> first token.
+    Prints foreground serving-program compiles (profiler counters: the
+    engine's eager AOT-miss compiles + anything landing inside an
+    instrumented serve call) and the time to first token."""
+    import numpy as np
+    import jax
+    jax.devices()
+    from mxnet_tpu import aot_cache, profiler, telemetry
+    from mxnet_tpu.serving import ServingEngine
+
+    net = build_net()
+    profiler.reset_step_stats()
+    t0 = time.perf_counter()
+    eng = ServingEngine(net, num_slots=4, page_size=8,
+                        max_prefill_len=32, max_seq_len=48)
+    eng.generate([np.arange(6, dtype=np.int32)], max_new=2)
+    ttft = time.perf_counter() - t0
+    # background stores (twin serialization) must land before exit or
+    # the warm attempt finds an empty cache
+    aot_cache.drain(timeout=120)
+    c = telemetry.report()["counters"]
+    print(json.dumps({
+        "ttfb_s": round(ttft, 3),
+        "serve_compiles": profiler.step_stats()["compile_count"],
+        "aot_hits": c.get("aot.cache_hits", 0),
+        "aot_misses": c.get("aot.cache_misses", 0),
+    }), flush=True)
+
+
+def measure_spinup():
+    """Cold vs warm replica spin-up sharing one AOT cache dir — what two
+    launch.py restart attempts (or two replicas on one host) see."""
+    cache = tempfile.mkdtemp(prefix="serve-probe-aot-")
+    env = dict(os.environ)
+    env.update({
+        "MXTPU_AOT_CACHE_DIR": cache,
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(cache, "xla"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+        "JAX_PLATFORMS": "cpu",
+    })
+    out = {}
+    try:
+        for label in ("cold", "warm"):
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--spinup-child"],
+                env=env, capture_output=True, text=True, timeout=600)
+            if r.returncode != 0:
+                raise RuntimeError("spinup child (%s) failed rc=%d:\n%s"
+                                   % (label, r.returncode,
+                                      r.stderr[-2000:]))
+            out[label] = json.loads(r.stdout.strip().splitlines()[-1])
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+    return {
+        "cold_ttfb_s": out["cold"]["ttfb_s"],
+        "warm_ttfb_s": out["warm"]["ttfb_s"],
+        "cold_serve_compiles": out["cold"]["serve_compiles"],
+        "warm_serve_compiles": out["warm"]["serve_compiles"],
+        "warm_aot_hits": out["warm"]["aot_hits"],
+    }
+
+
+def run(spinup=True):
+    net = build_net()
+    workload = make_workload()
+    cont = run_continuous(net, workload)
+    seq = run_sequential(net, workload)
+    if cont.pop("tokens") != seq.pop("tokens"):
+        raise AssertionError(
+            "continuous and sequential servers emitted different greedy "
+            "tokens for the same workload — the paged engine diverged "
+            "from the dense forward")
+    result = {
+        "continuous": cont,
+        "sequential": seq,
+        "speedup_tokens_per_sec": round(
+            cont["tokens_per_sec"] / seq["tokens_per_sec"], 2),
+    }
+    if spinup:
+        result["spinup"] = measure_spinup()
+    return result
+
+
+if __name__ == "__main__":
+    if "--spinup-child" in sys.argv:
+        _spinup_child()
+    else:
+        print(json.dumps(run("--no-spinup" not in sys.argv)))
