@@ -732,6 +732,74 @@ def bench_serve():
     }))
 
 
+def bench_graph():
+    """BENCH_MODE=graph: the graph rewrite pipeline's contract
+    (PERF.md §15, tools/perf_probe/graph_probe.py).  Hard contracts:
+
+    - >= 15% fewer lowered-HLO instructions with the pipeline on vs off
+      on BOTH bench graphs (the ResNet conv→bn→relu tower and the
+      post-LN GPT stack) — the instruction-count contract is measured
+      on the pre-optimization module the graph stage hands XLA;
+    - pipeline-on outputs equivalent to pipeline-off (rtol 1e-6);
+    - steptrace invariants with the pipeline enabled: exactly 1.0
+      dispatch/step, 0 steady-state recompiles on a fused fit loop over
+      a fusable (conv→bn→relu) net.
+
+    The measured forward step-time ratio is reported alongside (the
+    headline unit string carries it)."""
+    import jax
+    _perf_probe_path()
+    import graph_probe
+
+    jax.devices()
+    _disarm_watchdog()
+    result = graph_probe.run()
+    contract = result["hlo_contract"]
+    for name in ("resnet", "gpt"):
+        side = result[name]
+        if side["lowered_reduction"] < contract:
+            raise AssertionError(
+                "%s bench graph: pipeline cut lowered-HLO instructions "
+                "by only %.1f%% (%d -> %d; contract >= %.0f%%)"
+                % (name, side["lowered_reduction"] * 100,
+                   side["lowered_instructions_off"],
+                   side["lowered_instructions_on"], contract * 100))
+        if side["max_rel_err"] > 1e-6:
+            raise AssertionError(
+                "%s bench graph: pipeline-on output diverged from "
+                "pipeline-off (max rel err %.3g > 1e-6)"
+                % (name, side["max_rel_err"]))
+    st = result["steptrace"]
+    if st["dispatches_per_step"] != 1.0:
+        raise AssertionError(
+            "fused fit loop with the pipeline enabled dispatched %.3f "
+            "programs/step (contract: exactly 1.0)"
+            % st["dispatches_per_step"])
+    if st["compile_count"] != 0:
+        raise AssertionError(
+            "fused fit loop with the pipeline enabled recompiled %d "
+            "time(s) in steady state (contract: 0)" % st["compile_count"])
+    worst = min(result["resnet"]["lowered_reduction"],
+                result["gpt"]["lowered_reduction"])
+    print(json.dumps({
+        "metric": "graph_pipeline_hlo_reduction",
+        "value": round(worst * 100, 2),
+        "unit": "%% fewer lowered-HLO instructions (worst graph; resnet "
+                "%.1f%% %d->%d fwd x%.2f, gpt %.1f%% %d->%d fwd "
+                "x%.2f; 1.0 dispatch/step, 0 recompiles)" % (
+                    result["resnet"]["lowered_reduction"] * 100,
+                    result["resnet"]["lowered_instructions_off"],
+                    result["resnet"]["lowered_instructions_on"],
+                    result["resnet"]["fwd_speedup"],
+                    result["gpt"]["lowered_reduction"] * 100,
+                    result["gpt"]["lowered_instructions_off"],
+                    result["gpt"]["lowered_instructions_on"],
+                    result["gpt"]["fwd_speedup"]),
+        "vs_baseline": round(worst / contract, 3),
+        "graph": result,
+    }))
+
+
 def bench_restart():
     """BENCH_MODE=restart: fault tolerance off the hot path.
 
@@ -783,6 +851,7 @@ def main():
         "telemetry": ("telemetry_overhead_pct", "%"),
         "restart": ("ckpt_stall_sync_over_async", "x"),
         "serve": ("serving_tokens_per_sec", "tok/s"),
+        "graph": ("graph_pipeline_hlo_reduction", "%"),
         "transformer": (_gpt_metric()[1] if mode == "transformer"
                         else "", "tok/s"),
         "generate": (_gpt_metric("generate")[1] if mode == "generate"
@@ -840,6 +909,9 @@ def _run_mode(mode, network):
         return
     if mode == "serve":
         bench_serve()
+        return
+    if mode == "graph":
+        bench_graph()
         return
     # bs 128 is the measured single-chip sweet spot on v5e (PERF.md:
     # 2379 img/s vs 2263 at bs 256, 2114 at bs 512)

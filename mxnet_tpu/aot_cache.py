@@ -163,6 +163,7 @@ def fingerprint():
     clobber above)."""
     import jax
     import jaxlib
+    from . import graph as _graph
     local = jax.local_devices()
     dev = local[0]
     return "|".join((_FORMAT, jax.__version__, jaxlib.__version__,
@@ -172,6 +173,13 @@ def fingerprint():
                                     jax.process_count()),
                      "dev%s/%d" % (",".join(str(d.id) for d in local),
                                    jax.device_count()),
+                     # the graph rewrite pipeline decides what program a
+                     # symbol lowers to: its version + enabled-pass set
+                     # are program identity, so a rewritten graph can
+                     # never replay a pre-rewrite executable (stale
+                     # entries miss, and unusable ones unlink on load —
+                     # the PR-5/7 staleness discipline)
+                     _graph.pipeline_fingerprint(),
                      os.environ.get("XLA_FLAGS", ""),
                      os.environ.get("LIBTPU_INIT_ARGS", "")))
 

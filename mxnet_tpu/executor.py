@@ -72,7 +72,31 @@ class Executor:
         self._lin_fns = None
         self._saved_vjp = None
         self._shardings = self._build_shardings() if mesh is not None else {}
-        self._plan = self._build_plan()
+        # graph rewrite pipeline (mxnet_tpu.graph, ROADMAP item 3): the
+        # compiler stage between bind and trace→jit.  Every jitted path
+        # (forward/backward/fused fit step) lowers the REWRITTEN graph;
+        # the original symbol keeps serving names/shapes/serialization
+        # and the monitor's per-op interpret mode.  ctx_group binds skip
+        # it (fused regions would erase per-node placement), and any
+        # pass failure falls back to the unrewritten graph — the
+        # pipeline may only ever make a bind faster, never break it.
+        self._opt_symbol = symbol
+        self._graph_report = None
+        if not group2ctx:
+            from . import graph as _graph
+            if _graph.enabled():
+                try:
+                    self._opt_symbol, self._graph_report = \
+                        _graph.optimize(symbol)
+                except Exception as e:
+                    import logging
+                    logging.warning(
+                        "mxnet_tpu.executor: graph rewrite pipeline "
+                        "failed (%s: %s); lowering the unrewritten "
+                        "graph", type(e).__name__, e)
+                    self._opt_symbol = symbol
+        self._interp_plan = None
+        self._plan = self._build_plan(self._opt_symbol)
 
     # -- SPMD placement ----------------------------------------------------
     def _build_shardings(self):
@@ -151,10 +175,11 @@ class Executor:
         return fresh_device_put(data, target)
 
     # -- graph compilation -------------------------------------------------
-    def _build_plan(self):
+    def _build_plan(self, symbol=None):
         """Assemble the pure graph function over (args, aux, rng, train)."""
-        nodes = self._symbol._topo_nodes()
-        sym_outputs = self._symbol._outputs
+        symbol = symbol if symbol is not None else self._opt_symbol
+        nodes = symbol._topo_nodes()
+        sym_outputs = symbol._outputs
 
         # ctx_group model parallelism (reference: nnvm PlaceDevice pass +
         # _CrossDeviceCopy, graph_executor.cc:309-395).  TPU-native: each
@@ -199,6 +224,11 @@ class Executor:
 
         staged = self._staged
 
+        # ONE per-node evaluation core shared with the gluon symbolic
+        # CachedOp (graph.make_eval_fn): _train threading, RNG fold-in
+        # by topo index, visible/aux-extra split, aux write-back pairing
+        from .graph.graph import apply_node, aux_writebacks
+
         def graph_fn(arg_vals, aux_vals, rng, train, tap=None):
             """tap(node, vis_outputs) is called per node when set — used by
             the monitor's eager interpret mode only (never under jit)."""
@@ -223,15 +253,7 @@ class Executor:
                     # jax.vjp) it records the transfer.
                     target = node_dev[id(node)]
                     inputs = [jax.device_put(x, target) for x in inputs]
-                params = dict(node.params)
-                if node.op.takes_train:
-                    params["_train"] = train
-                if node.op.needs_rng:
-                    inputs.append(jax.random.fold_in(rng, i))
-                out = node.op.fn(*inputs, **node.op.canon_params(params))
-                flat = list(out) if isinstance(out, (tuple, list)) else [out]
-                n_vis = node.op.num_outputs(node.params)
-                vis, extra = flat[:n_vis], flat[n_vis:]
+                vis, extra = apply_node(node, inputs, rng, i, train)
                 dev = placement.get(id(node))
                 if dev is not None and tap is None:
                     # placement constraints only under jit — eager
@@ -240,11 +262,7 @@ class Executor:
                     vis = [jax.device_put(v, dev) for v in vis]
                 vals[id(node)] = vis
                 if node.op.mutate_aux and extra and train:
-                    aux_inputs = [inp for inp, _ in node.inputs
-                                  if inp.is_aux_var]
-                    for aux_node, new_val in zip(aux_inputs[-len(extra):],
-                                                 extra):
-                        new_aux[aux_node.name] = new_val
+                    new_aux.update(aux_writebacks(node, extra))
                 if tap is not None:
                     tap(node, vis)
 
@@ -382,14 +400,22 @@ class Executor:
         """Eager (uncompiled) forward calling the monitor callback with
         every node output — the XLA-era analogue of the reference's
         per-op executor monitor (graph_executor.cc:1399-1419).  Slow;
-        used only when a Monitor installs with monitor_all."""
+        used only when a Monitor installs with monitor_all.  Runs the
+        ORIGINAL (unrewritten) graph so the monitor sees every per-op
+        intermediate the user wrote, not the fused regions the rewrite
+        pipeline lowered."""
+        if self._interp_plan is None:
+            self._interp_plan = self._plan \
+                if self._opt_symbol is self._symbol \
+                else self._build_plan(self._symbol)
+
         def tap(node, vis):
             for j, v in enumerate(vis):
                 suffix = "_output" if len(vis) == 1 else "_output%d" % j
                 self._monitor_callback(node.name + suffix,
                                        NDArray(v, self._ctx))
-        return self._plan(self._raw_args(), self._raw_aux(), rng, train,
-                          tap=tap)
+        return self._interp_plan(self._raw_args(), self._raw_aux(), rng,
+                                 train, tap=tap)
 
     def forward(self, is_train=False, **kwargs):
         from . import random as _random
@@ -884,6 +910,12 @@ class Executor:
                                       "participants": n}
             except Exception:
                 pass
+        if self._graph_report is not None:
+            # the rewrite pipeline's pass report rides the AOT entry
+            # metadata next to the cost/memory attribution, so a warm
+            # restart can still say what the stored program was built
+            # from (nodes before/after, rewrites by pattern, pass time)
+            doc["graph"] = self._graph_report
         return doc or None
 
     def _capture_cost_telemetry(self, compiled):

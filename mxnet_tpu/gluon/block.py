@@ -44,10 +44,11 @@ _TRACE_STATE = threading.local()
 
 
 class _TraceCtx:
-    def __init__(self, param_tracers, rng, train):
+    def __init__(self, param_tracers, rng, train, symbolic=False):
         self.param_tracers = param_tracers
         self.rng = rng
         self.train = train
+        self.symbolic = symbolic  # tracers are Symbols, F emits graph nodes
         self.counter = 0
         self.aux_updates = []  # (id(aux_tracer), new_value)
 
@@ -94,6 +95,20 @@ class _JnpF:
 
 
 _F_JNP = _JnpF()
+
+
+class _SymF:
+    """F for SYMBOLIC hybridize tracing: registry ops emitting Symbol
+    graph nodes, so a HybridBlock lowers through the graph rewrite
+    pipeline (mxnet_tpu.graph) exactly like a Module bind.  Aux states
+    ride as positional inputs (symbol._apply_op fills aux slots)."""
+
+    def __getattr__(self, name):
+        from ..symbol import symbol as _sym
+        return _sym.make_symbol_function(get_op(name), name)
+
+
+_F_SYM = _SymF()
 
 
 # ---------------------------------------------------------------------------
@@ -274,6 +289,7 @@ class HybridBlock(Block):
         self._active = False
         self._cached_op = None
         self._cached_param_list = None
+        self._cached_graph_report = None  # rewrite-pipeline pass report
 
     def hybridize(self, active=True):
         self._active = active
@@ -322,7 +338,70 @@ class HybridBlock(Block):
             if tracer is None:
                 raise MXNetError("parameter %s missing from trace" % p.name)
             params[k] = tracer
-        return self.hybrid_forward(_F_JNP, *args, **kwargs, **params)
+        F = _F_SYM if ctx is not None and ctx.symbolic else _F_JNP
+        return self.hybrid_forward(F, *args, **kwargs, **params)
+
+    def _build_symbolic_cached_op(self, nd_args, ordered, diff_params,
+                                  aux_params):
+        """Lower this block through the symbol graph + rewrite pipeline:
+        trace ``hybrid_forward`` with a Symbol-emitting F, run
+        ``graph.optimize`` over the result (conv→bn→act folding, dense
+        fusion, constant folding, CSE/DCE — same passes as a Module
+        bind), and evaluate the OPTIMIZED graph as the CachedOp body.
+        Returns the OpDef, or None when this block cannot trace
+        symbolically (shape introspection, raw-jnp math, kernels outside
+        the op registry — e.g. the GPT attention stack) — then the
+        jnp-tracing CachedOp below serves exactly as before."""
+        from .. import graph as _graph
+        from ..symbol import symbol as _sym
+        try:
+            in_syms = [_sym.Variable("in%d" % i)
+                       for i in range(len(nd_args))]
+            param_syms = {}
+            for p in ordered:
+                v = _sym.Variable(p.name)
+                if p.grad_req == "null":
+                    v._outputs[0][0].is_aux_var = True
+                param_syms[p.name] = v
+            prev = _trace_ctx()
+            _TRACE_STATE.ctx = _TraceCtx(param_syms, None, True,
+                                         symbolic=True)
+            try:
+                out = self._call_traced(*in_syms)
+            finally:
+                _TRACE_STATE.ctx = prev
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            if not outs or not all(isinstance(o, _sym.Symbol)
+                                   for o in outs):
+                return None
+            sym = _sym.Group(outs) if len(outs) > 1 else outs[0]
+            opt_sym, report = _graph.optimize(sym)
+            eval_fn = _graph.make_eval_fn(_graph.Graph.from_symbol(opt_sym))
+        except Exception:
+            return None
+        n_in = len(nd_args)
+        in_names = ["in%d" % i for i in range(n_in)]
+        diff_names = [p.name for p in diff_params]
+        aux_names = [p.name for p in aux_params]
+        n_out = len(sym._outputs)
+
+        def cached_fn(*flat, _train=False):
+            rng = flat[-1]
+            arg_vals = dict(zip(in_names, flat[:n_in]))
+            pvals = flat[n_in:-1]
+            arg_vals.update(zip(diff_names, pvals[:len(diff_names)]))
+            aux_vals = dict(zip(aux_names, pvals[len(diff_names):]))
+            outs_v, new_aux = eval_fn(arg_vals, aux_vals, rng, _train)
+            aux_out = [new_aux.get(n, aux_vals[n]) for n in aux_names]
+            return tuple(outs_v) + tuple(aux_out)
+
+        op = OpDef("_cachedop_%s" % self.name, cached_fn,
+                   arg_names=tuple(in_names) + tuple(diff_names),
+                   aux_names=tuple(aux_names),
+                   num_outputs=n_out, mutate_aux=True,
+                   needs_rng=True, takes_train=True)
+        self._cached_graph_report = report
+        return op
 
     def _build_cached_op(self, nd_args):
         plist = list(self.collect_params().values())
@@ -332,6 +411,15 @@ class HybridBlock(Block):
         n_in = len(nd_args)
         n_aux = len(aux_params)
         outer = self
+
+        from .. import graph as _graph
+        if _graph.enabled():
+            op = self._build_symbolic_cached_op(nd_args, ordered,
+                                                diff_params, aux_params)
+            if op is not None:
+                self._cached_op = op
+                self._cached_param_list = ordered
+                return op
 
         def cached_fn(*flat, _train=False):
             # flat = inputs, diff params, aux params, rng

@@ -1,0 +1,18 @@
+"""Graph-level rewrite pipeline over the symbol DAG (ROADMAP item 3).
+
+``optimize(symbol)`` runs the env-configured pass pipeline
+(``MXTPU_GRAPH_PASSES`` — default ``fuse,fold,cse,dce``; ``0``/``off``
+disables) between ``simple_bind`` and trace→jit and returns the
+rewritten symbol plus a structured pass report.  See
+:mod:`mxnet_tpu.graph.passes` for the pass catalogue and
+:mod:`mxnet_tpu.graph.graph` for the IR.
+"""
+from .graph import Graph, make_eval_fn, rebuild, topo_from_heads  # noqa
+from .passes import (  # noqa
+    PIPELINE_VERSION, enabled, last_report, list_passes, optimize,
+    pipeline_config, pipeline_fingerprint, register_pass, run_pass)
+
+__all__ = ["Graph", "make_eval_fn", "rebuild", "topo_from_heads",
+           "PIPELINE_VERSION", "enabled", "last_report", "list_passes",
+           "optimize", "pipeline_config", "pipeline_fingerprint",
+           "register_pass", "run_pass"]

@@ -1,0 +1,196 @@
+"""Graph IR for the pre-lowering rewrite pipeline.
+
+The NNVM-style graph the reference ran its optimization passes over
+(src/nnvm/, TVM arXiv 1802.04799 / Relay arXiv 1810.00952) — here a thin,
+explicit view of the ``_SymNode`` DAG a :class:`~mxnet_tpu.symbol.Symbol`
+denotes.  A :class:`Graph` is just ``(nodes, heads)``:
+
+- ``nodes`` — an ordered node list, topologically sorted.  Unlike
+  ``Symbol._topo_nodes()`` it MAY contain nodes that are no longer
+  reachable from the heads (pattern fusion and CSE orphan the interiors
+  they replace); the DCE pass is what drops them, so every pass's
+  before/after node counts in the report are honest Graph-level numbers.
+- ``heads`` — the output entries, ``[(node, out_index), ...]``.
+
+Passes are pure ``Graph -> Graph`` functions (mxnet_tpu.graph.passes):
+they never mutate the input graph's op nodes — :func:`rebuild` walks the
+topo order and clones exactly the nodes whose inputs changed (variables
+and untouched subgraphs are shared by identity, which is safe because
+nothing downstream writes through them).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..symbol.symbol import Symbol, _SymNode
+
+__all__ = ["Graph", "rebuild", "topo_from_heads", "make_eval_fn"]
+
+
+def topo_from_heads(heads):
+    """Topological order of every node reachable from ``heads``."""
+    seen = set()
+    order = []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp, _ in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for n, _ in heads:
+        visit(n)
+    return order
+
+
+class Graph:
+    """The rewrite pipeline's unit of work."""
+
+    __slots__ = ("nodes", "heads")
+
+    def __init__(self, nodes, heads):
+        self.nodes = list(nodes)
+        self.heads = list(heads)
+
+    @classmethod
+    def from_symbol(cls, symbol):
+        if not isinstance(symbol, Symbol):
+            raise MXNetError("graph passes run over a Symbol, got %r"
+                             % type(symbol).__name__)
+        heads = list(symbol._outputs)
+        return cls(topo_from_heads(heads), heads)
+
+    def to_symbol(self):
+        return Symbol(self.heads[0][0], list(self.heads))
+
+    def reachable(self):
+        """ids of nodes reachable from the heads."""
+        return {id(n) for n in topo_from_heads(self.heads)}
+
+    def consumers(self):
+        """id(node) -> list of (consumer_node, input_slot) over
+        ``nodes``; head entries appear with consumer ``None``."""
+        out = {id(n): [] for n in self.nodes}
+        for node in self.nodes:
+            if node.is_var:
+                continue
+            for slot, (inp, _idx) in enumerate(node.inputs):
+                out.setdefault(id(inp), []).append((node, slot))
+        for n, _i in self.heads:
+            out.setdefault(id(n), []).append((None, -1))
+        return out
+
+    def num_ops(self):
+        return sum(1 for n in self.nodes if not n.is_var)
+
+    def __len__(self):
+        return len(self.nodes)
+
+
+def _clone_node(node, new_inputs):
+    return _SymNode(node.op, node.name, dict(node.params), list(new_inputs),
+                    attrs=dict(node.attrs), is_var=node.is_var,
+                    is_aux_var=node.is_aux_var)
+
+
+def rebuild(graph, make=None):
+    """Walk ``graph.nodes`` in order, remapping each node's inputs onto
+    the rebuilt graph.  ``make(node, remap)`` — when given — may return a
+    replacement node for ``node`` (its inputs already expressed in the
+    NEW graph via ``remap((old_node, idx)) -> (new_node, idx)``); return
+    None to keep the node.  Kept nodes are shared when none of their
+    inputs changed and cloned otherwise, so the input graph is never
+    mutated.  Nodes orphaned by a replacement stay in ``nodes`` (DCE's
+    job), but the returned node list stays topologically sorted.
+    """
+    new_of = {}
+
+    def remap(entry):
+        old, idx = entry
+        return (new_of[id(old)], idx)
+
+    new_nodes = []
+    for node in graph.nodes:
+        if node.is_var:
+            new_of[id(node)] = node
+            new_nodes.append(node)
+            continue
+        replacement = make(node, remap) if make is not None else None
+        if replacement is not None:
+            new_of[id(node)] = replacement
+            new_nodes.append(replacement)
+            continue
+        new_inputs = [remap(e) for e in node.inputs]
+        if all(n is o[0] for n, o in zip((x for x, _ in new_inputs),
+                                         node.inputs)):
+            new_of[id(node)] = node
+            new_nodes.append(node)
+        else:
+            clone = _clone_node(node, new_inputs)
+            new_of[id(node)] = clone
+            new_nodes.append(clone)
+    heads = [(new_of[id(n)], i) for n, i in graph.heads]
+    return Graph(new_nodes, heads)
+
+
+def apply_node(node, inputs, rng, index, train):
+    """Evaluate ONE op node — the semantics both graph interpreters
+    (Executor._build_plan's plan and :func:`make_eval_fn`) must agree
+    on, kept in one place: ``_train`` threading for train-dependent
+    ops, the per-node RNG fold-in keyed by TOPO INDEX, and the
+    visible-outputs / trailing-aux-extras split.  Returns
+    ``(vis, extra)``."""
+    import jax
+
+    params = dict(node.params)
+    if node.op.takes_train:
+        params["_train"] = train
+    if node.op.needs_rng:
+        inputs = list(inputs) + [jax.random.fold_in(rng, index)]
+    out = node.op.fn(*inputs, **node.op.canon_params(params))
+    flat = list(out) if isinstance(out, (tuple, list)) else [out]
+    n_vis = node.op.num_outputs(node.params)
+    return flat[:n_vis], flat[n_vis:]
+
+
+def aux_writebacks(node, extra):
+    """``(aux_var_name, new_value)`` pairs for a ``mutate_aux`` node's
+    trailing extras — extras correspond 1:1, in order, to the node's
+    trailing auxiliary-variable inputs (``_apply_op`` guarantees aux
+    slots hold plain Variables)."""
+    aux_inputs = [inp for inp, _ in node.inputs if inp.is_aux_var]
+    return list(zip((n.name for n in aux_inputs[-len(extra):]), extra))
+
+
+def make_eval_fn(graph):
+    """A pure ``fn(arg_vals, aux_vals, rng, train) -> (outs, new_aux)``
+    evaluating the graph node by node — the same contract as the
+    executor's plan (Executor._build_plan), minus ctx_group placement
+    and monitor taps.  Used by the gluon HybridBlock symbolic lowering
+    to run an optimized graph as its CachedOp body.
+
+    RNG-consuming nodes fold the step key with their topo index;
+    ``mutate_aux`` extras are returned keyed by the aux variable's name
+    (train only), exactly like the executor (shared
+    :func:`apply_node` / :func:`aux_writebacks` core)."""
+    nodes = topo_from_heads(graph.heads)
+    heads = list(graph.heads)
+
+    def eval_fn(arg_vals, aux_vals, rng, train):
+        vals = {}
+        new_aux = {}
+        for i, node in enumerate(nodes):
+            if node.is_var:
+                vals[id(node)] = [aux_vals[node.name] if node.is_aux_var
+                                  else arg_vals[node.name]]
+                continue
+            inputs = [vals[id(inp)][idx] for inp, idx in node.inputs]
+            vis, extra = apply_node(node, inputs, rng, i, train)
+            vals[id(node)] = vis
+            if node.op.mutate_aux and extra and train:
+                new_aux.update(aux_writebacks(node, extra))
+        outs = [vals[id(n)][i] for n, i in heads]
+        return outs, new_aux
+
+    return eval_fn

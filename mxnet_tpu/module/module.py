@@ -141,6 +141,13 @@ class Module(BaseModule):
 
     # -- properties --------------------------------------------------------
     @property
+    def graph_report(self):
+        """The bind's graph rewrite-pipeline pass report (nodes
+        before/after, rewrites by pattern, per-pass wall time), or None
+        before bind / with the pipeline disabled."""
+        return self._exec._graph_report if self._exec is not None else None
+
+    @property
     def data_names(self):
         return self._data_names
 

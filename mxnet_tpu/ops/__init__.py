@@ -13,5 +13,6 @@ from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import rcnn  # noqa: F401
 from . import tail  # noqa: F401
+from . import fused  # noqa: F401  (graph-pass fused regions)
 
 __all__ = ["OpDef", "register_op", "get_op", "list_ops", "alias"]

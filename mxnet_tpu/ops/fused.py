@@ -1,0 +1,236 @@
+"""Fused-region operators emitted by the graph rewrite pipeline.
+
+These ops exist so a pattern the pipeline collapses
+(mxnet_tpu.graph.passes) stays ONE node in the rewritten graph — a
+fused region the reference's NNVM fusion would have handed TVM as a
+single generated kernel (arXiv 1802.04799).  Each op composes the
+member lowerings bit-exactly where the unfused graph does the same
+arithmetic, and applies the algebraic rewrite XLA's fuser cannot where
+it can't:
+
+- ``_fused_conv_bn_act`` — Convolution → BatchNorm (→ Activation).  In
+  training it IS the composition (same jnp calls, bit-identical, batch
+  statistics and moving-stat updates unchanged).  In eval the
+  normalization folds into the convolution weights — ``w' = w·γ/√(σ²+ε)``
+  per output channel, bias re-centered — an algebraic rewrite, not a
+  fusion: the per-feature-map normalize work disappears instead of
+  merely fusing into an epilogue.
+- ``_fused_dense_act`` — FullyConnected → Activation as one node; the
+  matmul contracts with ``dot_general`` directly instead of
+  ``matmul(data, w.T)``, so the weight transpose never exists.
+- ``_fused_layer_norm_residual`` — LayerNorm(x + r): the transformer
+  sublayer epilogue as one node; on TPU it lowers to a single Pallas
+  kernel (ops/pallas/layer_norm.py — one VMEM pass over the row does
+  add + statistics + normalize), elsewhere to the jnp composition.
+- ``_graph_constant`` — a literal produced by constant folding; holds
+  the folded value out-of-band (hash/eq by content digest so CSE and
+  jit caching stay sound).
+
+The registry coverage sweep (tests/test_operator_grad_sweep.py) points
+these at the equivalence-law suite (tests/test_graph_passes.py): every
+fused op is tested forward AND backward against its unfused composition.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from .nn import _activation, _batch_norm, _convolution
+
+__all__ = ["ConstPayload", "ACT_FUSABLE"]
+
+#: act_type values the fusion pass may fold into a fused region —
+#: everything Activation supports, plus "linear" for "no activation"
+ACT_FUSABLE = ("relu", "sigmoid", "tanh", "softrelu", "softsign", "gelu",
+               "gelu_erf")
+
+
+def _apply_act(out, act_type):
+    if act_type in (None, "linear"):
+        return out
+    return _activation(out, act_type=act_type)
+
+
+# ---------------------------------------------------------------------------
+# Convolution → BatchNorm (→ Activation)
+# ---------------------------------------------------------------------------
+
+def _conv_bn_args(p):
+    args = ["data", "weight"] if p.get("no_bias") else \
+        ["data", "weight", "bias"]
+    return args + ["gamma", "beta"]
+
+
+@register_op("_fused_conv_bn_act",
+             arg_names=_conv_bn_args,
+             aux_names=("moving_mean", "moving_var"),
+             mutate_aux=True, takes_train=True,
+             param_defaults={"kernel": (), "stride": (), "dilate": (),
+                             "pad": (), "num_filter": 0, "num_group": 1,
+                             "no_bias": False, "workspace": 1024,
+                             "cudnn_tune": None, "cudnn_off": False,
+                             "layout": None,
+                             "eps": 1e-3, "momentum": 0.9,
+                             "fix_gamma": True, "use_global_stats": False,
+                             "act_type": "linear"})
+def _fused_conv_bn_act(data, weight, *rest, kernel=(), stride=(), dilate=(),
+                       pad=(), num_filter=0, num_group=1, no_bias=False,
+                       workspace=1024, cudnn_tune=None, cudnn_off=False,
+                       layout=None, eps=1e-3, momentum=0.9, fix_gamma=True,
+                       use_global_stats=False, act_type="linear",
+                       _train=False):
+    """Returns (out, new_moving_mean, new_moving_var) like BatchNorm."""
+    if no_bias:
+        bias = None
+        gamma, beta, moving_mean, moving_var = rest
+    else:
+        bias, gamma, beta, moving_mean, moving_var = rest
+    if _train and not use_global_stats:
+        # training region: the literal composition — same jnp calls as
+        # the unfused graph, so outputs, gradients and the moving-stat
+        # updates are bit-identical
+        out = _convolution(data, weight, bias, kernel=kernel, stride=stride,
+                           dilate=dilate, pad=pad, num_filter=num_filter,
+                           num_group=num_group, no_bias=no_bias)
+        out, new_mm, new_mv = _batch_norm(
+            out, gamma, beta, moving_mean, moving_var, eps=eps,
+            momentum=momentum, fix_gamma=fix_gamma,
+            use_global_stats=use_global_stats, _train=True)
+        return _apply_act(out, act_type), new_mm, new_mv
+    # eval: fold the normalization into the convolution — the algebraic
+    # rewrite (scale lives on the O-sized weight axis, so the NCHW-sized
+    # normalize work is gone).  Statistics math in >= fp32, matching
+    # BatchNorm's stats dtype discipline.
+    sdt = jnp.promote_types(weight.dtype, jnp.float32)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    scale = g.astype(sdt) * lax.rsqrt(moving_var.astype(sdt) + eps)
+    ndim = len(kernel)
+    w = (weight.astype(sdt) *
+         scale.reshape((-1,) + (1,) * (ndim + 1))).astype(weight.dtype)
+    b = beta.astype(sdt) - moving_mean.astype(sdt) * scale
+    if bias is not None:
+        b = b + bias.astype(sdt) * scale
+    out = _convolution(data, w, b.astype(data.dtype), kernel=kernel,
+                       stride=stride, dilate=dilate, pad=pad,
+                       num_filter=num_filter, num_group=num_group,
+                       no_bias=False)
+    return _apply_act(out, act_type), moving_mean, moving_var
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected → Activation
+# ---------------------------------------------------------------------------
+
+@register_op("_fused_dense_act",
+             arg_names=lambda p: (["data", "weight"] if p.get("no_bias")
+                                  else ["data", "weight", "bias"]),
+             param_defaults={"num_hidden": 0, "no_bias": False,
+                             "flatten": True, "act_type": "linear"})
+def _fused_dense_act(data, weight, bias=None, num_hidden=0, no_bias=False,
+                     flatten=True, act_type="linear"):
+    if flatten and data.ndim > 2:
+        data = data.reshape((data.shape[0], -1))
+    # contract data's feature dim with weight's input dim directly: the
+    # (num_hidden, in_dim) weight never transposes
+    out = lax.dot_general(data, weight,
+                          (((data.ndim - 1,), (1,)), ((), ())))
+    if bias is not None:
+        out = out + bias
+    return _apply_act(out, act_type)
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm(x + r)
+# ---------------------------------------------------------------------------
+
+@register_op("_fused_layer_norm_residual",
+             arg_names=("lhs", "rhs", "gamma", "beta"),
+             param_defaults={"axis": -1, "eps": 1e-5})
+def _fused_layer_norm_residual(lhs, rhs, gamma, beta, axis=-1, eps=1e-5):
+    from ..ops.pallas import layer_norm as _ln
+    # the kernel adds lhs+rhs tile-by-tile: equal shapes only (the fuse
+    # matcher already restricts itself to equal-shape adds; this guard
+    # keeps a hand-built node safe too)
+    if lhs.shape == rhs.shape and _ln.use_pallas(lhs, axis):
+        return _ln.fused_layer_norm_residual(lhs, rhs, gamma, beta, eps=eps)
+    if axis not in (-1, lhs.ndim - 1):
+        # non-last-axis layouts keep the plain composition
+        from .nn import _layer_norm
+        return _layer_norm(lhs + rhs, gamma, beta, axis=axis, eps=eps)
+    # off-TPU last-axis path: the same region hand-lowered with the
+    # minimum of ops (single residual+cast add, reductions via lax, no
+    # reshape round-trips for gamma/beta) — numerically the LayerNorm
+    # recipe (fp32 statistics), within float-reassociation tolerance of
+    # the unfused chain
+    s = lhs.astype(jnp.float32) + rhs.astype(jnp.float32)
+    red = (s.ndim - 1,)
+    n = s.shape[-1]
+    mean = lax.expand_dims(
+        lax.reduce(s, jnp.float32(0), lax.add, red) / n, red)
+    d = s - mean
+    var = lax.expand_dims(
+        lax.reduce(d * d, jnp.float32(0), lax.add, red) / n, red)
+    y = d * lax.rsqrt(var + eps) * gamma.astype(jnp.float32) \
+        + beta.astype(jnp.float32)
+    return y.astype(lhs.dtype)
+
+
+# ---------------------------------------------------------------------------
+# transpose-free batched contraction
+# ---------------------------------------------------------------------------
+
+@register_op("_fused_batch_dot", arg_names=("lhs", "rhs"),
+             param_defaults={"transpose_a": False, "transpose_b": False})
+def _fused_batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """batch_dot with the transpose flags absorbed into the
+    ``dot_general`` dimension numbers — the materialized ``swapaxes``
+    never exists.  Same contraction order, bit-identical."""
+    c_l = lhs.ndim - (2 if transpose_a else 1)
+    c_r = rhs.ndim - (1 if transpose_b else 2)
+    batch = tuple(range(lhs.ndim - 2))
+    return lax.dot_general(lhs, rhs, ((
+        (c_l,), (c_r,)), (batch, batch)))
+
+
+# ---------------------------------------------------------------------------
+# Folded constants
+# ---------------------------------------------------------------------------
+
+class ConstPayload:
+    """Out-of-band value holder for ``_graph_constant`` params.  Hash/eq
+    by content digest so two folds of identical subgraphs CSE together
+    and per-param jit caches stay correct; repr stays compact so
+    ``Symbol.tojson``/``debug_str`` of a rewritten graph never inlines
+    megabytes of literal."""
+
+    __slots__ = ("value", "digest")
+
+    def __init__(self, value):
+        self.value = _np.asarray(value)
+        self.value.setflags(write=False)
+        self.digest = hashlib.sha256(
+            b"%s|%s|" % (str(self.value.dtype).encode(),
+                         str(self.value.shape).encode())
+            + self.value.tobytes()).hexdigest()
+
+    def __hash__(self):
+        return hash(self.digest)
+
+    def __eq__(self, other):
+        return isinstance(other, ConstPayload) and \
+            self.digest == other.digest
+
+    def __repr__(self):
+        return "<const %s%s sha256:%s>" % (
+            self.value.dtype, list(self.value.shape), self.digest[:12])
+
+
+@register_op("_graph_constant", arg_names=(),
+             param_defaults={"value": None})
+def _graph_constant(value=None):
+    return jnp.asarray(value.value)

@@ -85,6 +85,43 @@ _register("InstanceNorm", _channel_hint("gamma", "beta"))
 _register("LeakyReLU", _channel_hint("gamma"))
 
 
+def _layer_norm_hint(shapes, params):
+    data = shapes.get("data")
+    if data is None:
+        return {}
+    axis = int(params.get("axis", -1))
+    c = data[axis % len(data)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+_register("LayerNorm", _layer_norm_hint)
+
+
+def _fused_conv_bn_hint(shapes, params):
+    # conv weight/bias hint + the BN channel vector family on num_filter
+    out = _conv_hint(shapes, params)
+    nf = int(params.get("num_filter", 0))
+    for n in ("gamma", "beta", "moving_mean", "moving_var"):
+        out[n] = (nf,)
+    return out
+
+
+_register("_fused_conv_bn_act", _fused_conv_bn_hint)
+_register("_fused_dense_act", _fc_hint)
+
+
+def _fused_ln_res_hint(shapes, params):
+    data = shapes.get("lhs") or shapes.get("rhs")
+    if data is None:
+        return {}
+    axis = int(params.get("axis", -1))
+    c = data[axis % len(data)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+_register("_fused_layer_norm_residual", _fused_ln_res_hint)
+
+
 def _embedding_hint(shapes, params):
     return {"weight": (int(params.get("input_dim", 0)),
                        int(params.get("output_dim", 0)))}

@@ -447,6 +447,13 @@ class Symbol:
         raise NotImplementedError("use bind().backward()")
 
     # -- serialization -----------------------------------------------------
+    #: tojson schema version.  2 added the stamp itself (graph-pipeline
+    #: era): consumers hashing the JSON (Module._fused_setup's AOT
+    #: cache_extra) atomically orphan every pre-stamp cache entry, and
+    #: future schema changes bump it instead of silently reshaping the
+    #: document.  load_json accepts stamped and legacy documents alike.
+    JSON_SCHEMA_VERSION = 2
+
     def tojson(self):
         """nnvm-style JSON (reference format: nodes/arg_nodes/heads)."""
         nodes = self._topo_nodes()
@@ -476,7 +483,9 @@ class Symbol:
             "arg_nodes": arg_nodes,
             "node_row_ptr": list(range(len(nodes) + 1)),
             "heads": heads,
-            "attrs": {"mxnet_version": ["int", 1100]},
+            "attrs": {"mxnet_version": ["int", 1100],
+                      "mxtpu_json_schema": ["int",
+                                            self.JSON_SCHEMA_VERSION]},
         }, indent=2)
 
     def save(self, fname):
@@ -650,14 +659,22 @@ def _apply_op(op, name, sym_args, params, **sym_kwargs):
     aux_names = op.aux_names(params)
 
     inputs = [None] * len(arg_names)
-    # positional then keyword symbol inputs
+    aux_inputs = [None] * len(aux_names)
+    # positional then keyword symbol inputs; positionals beyond the
+    # learnable args fill the auxiliary-state slots, as the reference's
+    # generated wrappers allowed (sym.BatchNorm(x, g, b, mean, var))
     for i, s in enumerate(sym_args):
-        if i >= len(arg_names):
+        if i < len(arg_names):
+            inputs[i] = s
+        elif i < len(arg_names) + len(aux_names):
+            aux_inputs[i - len(arg_names)] = s
+        else:
             raise MXNetError("too many positional inputs for %s" % op.name)
-        inputs[i] = s
     for k, v in sym_kwargs.items():
         if k in arg_names:
             inputs[arg_names.index(k)] = v
+        elif k in aux_names:
+            aux_inputs[aux_names.index(k)] = v
         else:
             raise MXNetError("unknown input %s for %s" % (k, op.name))
     # auto-create variables for missing learnable inputs
@@ -666,10 +683,19 @@ def _apply_op(op, name, sym_args, params, **sym_kwargs):
         if s is None:
             s = Variable("%s_%s" % (name, argname))
         filled.append(s)
-    for auxname in aux_names:
-        v = Variable("%s_%s" % (name, auxname))
-        v._outputs[0][0].is_aux_var = True
-        filled.append(v)
+    for auxname, s in zip(aux_names, aux_inputs):
+        if s is None:
+            s = Variable("%s_%s" % (name, auxname))
+        outs = s._outputs
+        if len(outs) != 1 or not outs[0][0].is_var:
+            # aux states are mutable storage the executor writes back
+            # into by variable name; an op output in an aux slot would
+            # silently mispair the write-backs
+            raise MXNetError(
+                "auxiliary input %s of %s must be a Variable"
+                % (auxname, op.name))
+        outs[0][0].is_aux_var = True
+        filled.append(s)
 
     node_inputs = []
     for s in filled:

@@ -17,6 +17,16 @@ def _node_label(node):
     if op == "null":
         return node.name
     p = node.params or {}
+    fused = (node.attrs or {}).get("__fused_ops__")
+    if fused:
+        # fused-region node from the graph rewrite pipeline: a grouped
+        # label naming the constituent ops, so rewritten graphs render
+        # instead of falling through to an opaque internal op name
+        return "%s\n[%s]" % (op.lstrip("_"), fused)
+    if op == "_graph_constant":
+        v = p.get("value")
+        shape = list(getattr(getattr(v, "value", None), "shape", ()))
+        return "constant\n%s" % (shape,)
     if op == "Convolution":
         return "Convolution\n%s/%s, %s" % (
             "x".join(str(x) for x in p.get("kernel", ())),
@@ -138,7 +148,12 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
     fill = {"null": "#8dd3c7", "Convolution": "#fb8072",
             "FullyConnected": "#fb8072", "BatchNorm": "#bebada",
             "Activation": "#ffffb3", "Pooling": "#80b1d3",
-            "Concat": "#fdb462", "SoftmaxOutput": "#b3de69"}
+            "Concat": "#fdb462", "SoftmaxOutput": "#b3de69",
+            # graph-pipeline fused regions / folded literals
+            "_fused_conv_bn_act": "#fb8072",
+            "_fused_dense_act": "#fb8072",
+            "_fused_layer_norm_residual": "#bebada",
+            "_graph_constant": "#d9d9d9"}
     nodes = symbol._topo_nodes()
     param_suffixes = ("weight", "bias", "gamma", "beta", "parameters",
                       "moving_mean", "moving_var")

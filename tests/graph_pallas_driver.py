@@ -1,0 +1,59 @@
+"""Clean-subprocess driver for the fused LayerNorm+residual Pallas
+kernel (ops/pallas/layer_norm.py) — same discipline as
+flash_attention_driver.py: pallas' checkify import chain breaks inside
+the contaminated pytest process, so the kernel runs under the Pallas
+interpreter in a fresh interpreter and prints GRAPH_LN_OK on success.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import layer_norm as ln
+
+    r = np.random.RandomState(0)
+    for shape, dtype in [((2, 8, 64), jnp.float32),
+                         ((3, 130), jnp.float32),   # rows % block != 0
+                         ((2, 8, 64), jnp.bfloat16)]:
+        x = jnp.asarray(r.randn(*shape), dtype)
+        res = jnp.asarray(r.randn(*shape), dtype)
+        g = jnp.asarray(r.randn(shape[-1]), jnp.float32)
+        b = jnp.asarray(r.randn(shape[-1]), jnp.float32)
+
+        def oracle(x, res, g, b):
+            s = x.astype(jnp.float32) + res.astype(jnp.float32)
+            m = s.mean(-1, keepdims=True)
+            v = jnp.square(s - m).mean(-1, keepdims=True)
+            y = (s - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+            return y.astype(x.dtype)
+
+        out = ln.fused_layer_norm_residual(x, res, g, b, interpret=True)
+        tol = 1e-5 if dtype == jnp.float32 else 3e-2
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32),
+            np.asarray(oracle(x, res, g, b), np.float32),
+            rtol=tol, atol=tol)
+        if dtype != jnp.float32:
+            continue
+        got = jax.grad(lambda *a: ln.fused_layer_norm_residual(
+            *a, interpret=True).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2, 3))(x, res, g, b)
+        want = jax.grad(lambda *a: oracle(*a).astype(jnp.float32).sum(),
+                        argnums=(0, 1, 2, 3))(x, res, g, b)
+        for i, (a, w) in enumerate(zip(got, want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg="grad %d shape %s"
+                                       % (i, (shape,)))
+    print("GRAPH_LN_OK")
+
+
+if __name__ == "__main__":
+    main()

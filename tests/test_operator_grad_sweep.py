@@ -60,6 +60,36 @@ def _sample(domain, shape, r):
         d = np.arange(shape[-1])
         a[..., d, d] += 1.5
         return a
+    if domain.startswith("rois:"):
+        # WELL-FORMED roi rows [batch_idx, x1, y1, x2, y2]: batch index
+        # inside the (single-image) batch and ordered corners within
+        # [0, hi].  Free-random ints (the old "int:4") produced
+        # out-of-range batch indices — jax clamps them in the forward
+        # gather but DROPS them in the backward scatter-add, so the
+        # analytic gradient was legitimately 0 where finite differences
+        # (through the clamped forward) saw a dependence.  Out-of-range
+        # rois are undefined in the reference op too; the gradient
+        # contract only covers valid boxes.
+        hi = int(domain.split(":")[1])
+        rows = []
+        for _ in range(shape[0]):
+            x1, y1 = r.randint(0, hi + 1, 2)
+            x2 = r.randint(x1, hi + 1)
+            y2 = r.randint(y1, hi + 1)
+            rows.append([0, x1, y1, x2, y2])
+        return np.asarray(rows, dtype=np.float64)
+    if domain == "tiefree":
+        # max-pooling inputs for finite differences: every value is a
+        # distinct rung of a seeded, jittered ladder, so all pairwise
+        # gaps far exceed the central-difference step (2*eps) and the
+        # argmax can never flip under perturbation.  Plain continuous
+        # draws leave ~percent-level odds of two in-window values
+        # within 2e-4 of each other — the sp_ROIPooling tie failure.
+        n = int(np.prod(shape))
+        base = np.linspace(-1.0, 1.0, n)          # rung gap 2/(n-1)
+        jitter = r.uniform(-0.2, 0.2, n) * (2.0 / max(n - 1, 1))
+        vals = base + jitter                       # gaps stay >= 1.2/(n-1)
+        return r.permutation(vals).reshape(shape)
     if domain.startswith("int1:"):        # 1..hi (nonzero lengths)
         hi = int(domain.split(":")[1])
         return r.randint(1, hi + 1, shape).astype(np.float64)
@@ -118,6 +148,9 @@ C("bin_dot_t", "dot", [("lhs", (4, 3), "any"), ("rhs", (4, 5), "any")],
   params={"transpose_a": True})
 C("bin_batch_dot", "batch_dot",
   [("lhs", (2, 3, 4), "any"), ("rhs", (2, 4, 5), "any")])
+C("bin_fused_batch_dot_t", "_fused_batch_dot",
+  [("lhs", (2, 3, 4), "any"), ("rhs", (2, 5, 4), "any")],
+  params={"transpose_b": True})
 C("bin_where", "where",
   [("condition", (3, 4), "cell"), ("x", (3, 4), "any"),
    ("y", (3, 4), "any")], fixed=("condition",))
@@ -346,7 +379,7 @@ C("sp_Correlation", "Correlation",
   params={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
           "stride2": 1, "pad_size": 1}, rtol=2e-2)
 C("sp_ROIPooling", "ROIPooling",
-  [(D, (1, 2, 8, 8), "any"), ("rois", (2, 5), "int:4")],
+  [(D, (1, 2, 8, 8), "tiefree"), ("rois", (2, 5), "rois:7")],
   params={"pooled_size": (2, 2), "spatial_scale": 1.0}, fixed=("rois",))
 
 # -- outputs / losses (custom-grad semantics verified separately) -----------
@@ -809,6 +842,17 @@ SKIP_REASONS = {
     "_contrib_flash_attention": "kernel custom_vjp; gradients oracle-"
                                 "tested in flash_attention_driver.py and "
                                 "test_attention_op.py",
+    # graph rewrite-pipeline fused regions: forward AND backward are
+    # law-tested against their unfused compositions on randomized
+    # graphs in tests/test_graph_passes.py (rtol 1e-6, train-mode
+    # compositions bit-exact)
+    "_fused_conv_bn_act": "graph-pass fused region; equivalence laws in "
+                          "test_graph_passes.py",
+    "_fused_dense_act": "graph-pass fused region; equivalence laws in "
+                        "test_graph_passes.py",
+    "_fused_layer_norm_residual": "graph-pass fused region; equivalence "
+                                  "laws in test_graph_passes.py",
+    "_graph_constant": "no tensor inputs (folded literal)",
     "MultiBoxPrior": "anchor generation, input-independent",
     "MultiBoxTarget": "matching/assignment, non-differentiable",
     "MultiBoxDetection": "nms decode, non-differentiable",
