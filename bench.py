@@ -681,7 +681,12 @@ def bench_serve():
       the probe);
     - continuous batching >= 2x the sequential baseline's tokens/s;
     - an AOT-warm replica reaches its first token with 0 foreground
-      serving-program compiles (two subprocesses sharing a cache dir).
+      serving-program compiles (two subprocesses sharing a cache dir);
+    - **degraded mode** (ISSUE 11): with one of two router replicas
+      killed mid-probe (serve.replica.lost), every accepted request
+      still completes with BIT-identical tokens to the unfaulted run,
+      and the replacement replica spins up AOT-warm (0 foreground
+      compiles).
     """
     import jax
     _perf_probe_path()
@@ -713,6 +718,25 @@ def bench_serve():
             "continuous batching reached only %.2fx the sequential "
             "predictor baseline (contract: >= 2x tokens/s on the same "
             "mixed-length workload)" % speedup)
+    deg = result["degraded"]
+    if deg["dropped"] != 0:
+        raise AssertionError(
+            "degraded mode dropped %d accepted request(s) after a "
+            "replica kill (contract: the router completes every "
+            "accepted request exactly once)" % deg["dropped"])
+    if not deg["tokens_match_unfaulted"]:
+        raise AssertionError(
+            "degraded-mode tokens diverged from the unfaulted run "
+            "(contract: failover re-decode is bit-identical greedy)")
+    if deg["failovers"] < 1:
+        raise AssertionError(
+            "degraded mode observed no failover — the replica kill "
+            "never landed; the contract was not exercised")
+    if deg["replacement_foreground_compiles"] != 0:
+        raise AssertionError(
+            "replacement replica compiled %d serving program(s) in the "
+            "foreground (contract: AOT/memo-warm spin-up)"
+            % deg["replacement_foreground_compiles"])
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": cont["tokens_per_sec"],

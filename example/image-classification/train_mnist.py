@@ -94,6 +94,12 @@ def parse_args():
 
 if __name__ == "__main__":
     args = parse_args()
+    # the synthetic data is seeded but weight init was not: an unlucky
+    # entropy-seeded Xavier draw occasionally misses the test suite's
+    # 0.95 accuracy threshold on the 2-epoch run.  Seed both RNG planes
+    # so the example is run-to-run deterministic.
+    np.random.seed(0)
+    mx.random.seed(0)
     from importlib import import_module
     net = import_module("symbols." + args.network).get_symbol(
         num_classes=args.num_classes, num_layers=args.num_layers or 2,

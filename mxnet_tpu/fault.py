@@ -53,6 +53,25 @@ Sites wired in this package:
                           producer: input-starvation flavor of the
                           straggler (shows in ``data.prefetch_wait``,
                           not in the step phases).
+- ``serve.decode.stall``  wedge the serving engine right before the
+                          decode dispatch, renewing no lease — the
+                          ``serve_step`` watchdog lease expires and the
+                          replica dies 75 with a serving snapshot in
+                          its postmortem (ISSUE 11).
+- ``serve.prefill.error`` the admission prefill dispatch fails for ONE
+                          request: it exits with the typed
+                          ``prefill_error`` verdict, slot + reserved
+                          pages released deterministically (no requeue
+                          loop); the engine serves on.
+- ``serve.replica.lost``  a serving replica dies mid-decode
+                          (ReplicaLost from ServingReplica.step): the
+                          router fails its accepted requests over to a
+                          live replica at-most-once; standalone
+                          replicas die retryable.
+- ``serve.swap.torn``     poison a hot-swap's freshly loaded weight
+                          tree (NaN) — the finite-logits canary decode
+                          must catch it and roll the replica back to
+                          its prior weights.
 
 The ``*.slow`` DELAY sites are per-event and bounded (the run limps,
 correctly); the ``*.stall``/``kv.hang`` sites simulate HANGS — they

@@ -15,11 +15,31 @@ users" needs —
   (ops/pallas/paged_attention.py), AOT-warm-started from the executable
   cache, instrumented through telemetry.
 
-See SERVING.md for architecture, sizing, and the env contract.
+Survivability plane (ISSUE 11):
+
+- :class:`~mxnet_tpu.serving.slo.SLOController` — SLO-aware admission:
+  shed new intake (typed verdict, fail fast) when queue-wait p99
+  breaches the target, with hysteresis;
+- :class:`~mxnet_tpu.serving.replica.ServingReplica` — watchdog-derived
+  health, graceful drain (exit 80, classified clean by the launcher),
+  live weight hot-swap from CheckpointManager publications with
+  canary-verify + rollback;
+- :class:`~mxnet_tpu.serving.router.Router` — spread over replicas,
+  journaled request ids, retry-on-failover with at-most-once decode,
+  AOT-warm replacement spin-up.
+
+See SERVING.md for architecture, sizing, the env contract, and the
+"operating under failure" runbook.
 """
 from .kv_cache import PagedKVAllocator
 from .scheduler import ContinuousBatchingScheduler, Request
 from .engine import ServingEngine
+from .slo import SLOController
+from .replica import (ServingReplica, CheckpointSubscriber, ReplicaLost,
+                      EXIT_SERVE_DRAIN)
+from .router import Router, RouterRequest
 
 __all__ = ["PagedKVAllocator", "ContinuousBatchingScheduler",
-           "Request", "ServingEngine"]
+           "Request", "ServingEngine", "SLOController",
+           "ServingReplica", "CheckpointSubscriber", "ReplicaLost",
+           "EXIT_SERVE_DRAIN", "Router", "RouterRequest"]

@@ -38,21 +38,82 @@ Telemetry (OBSERVABILITY.md §9): ``serving.ttft`` / ``serving.tpot`` /
 ``serving.tokens`` / ``serving.prefills`` counters, and one flight-
 recorder record per decode step (``where="serve_step"``) so a crashed
 replica's postmortem carries its recent decode cadence.
+
+Survivability plane (ISSUE 11):
+
+- **deadlines** — per-request total budget (queue + decode,
+  ``submit(..., deadline_s=)`` / ``MXTPU_SERVE_DEADLINE_S``); expired
+  requests exit with typed verdicts (``expired_queue`` /
+  ``expired_decode``) before the next decode dispatch, releasing slot
+  and pages, never consuming another token's FLOPs;
+- **SLO shedding** — an :class:`~mxnet_tpu.serving.slo.SLOController`
+  refuses NEW intake (state ``shed``, fail-fast) when the queue-wait
+  p99 breaches its target, instead of queuing unboundedly;
+- **watchdog lease** — every completed step renews the ``serve_step``
+  progress lease and each prefill dispatch runs under a
+  ``serve.prefill`` scoped guard, so a wedged decode dispatch trips the
+  PR-4 stall watchdog (exit 75) and the postmortem carries this
+  engine's serving snapshot (:func:`live_snapshot`: resident slots,
+  free pages, queue depth) instead of dying silently;
+- **fault sites** — ``serve.decode.stall`` (lease-less wedge right
+  before the decode dispatch) and ``serve.prefill.error`` (admission
+  dispatch fails: the request exits ``prefill_error`` with its pages
+  released — deterministically, no requeue loop);
+- **live weight hot-swap** — :meth:`swap_params` installs a new decode
+  param tree between decode steps (same shapes: zero recompiles) after
+  a finite-logits canary prefill aimed entirely at the scratch page, so
+  the swap is invisible to resident sequences; a failed canary rolls
+  back to the prior weights (serving/replica.py drives this from
+  CheckpointManager publications).
 """
 from __future__ import annotations
 
+import itertools
+import os
 import time
+import weakref
 
 import numpy as _np
 
 from .. import aot_cache as _aot
+from .. import fault as _fault
 from .. import profiler as _profiler
 from .. import telemetry as _telemetry
+from .. import watchdog as _watchdog
 from ..base import MXNetError
-from .kv_cache import PagedKVAllocator
-from .scheduler import ContinuousBatchingScheduler, FINISHED
+from .kv_cache import PagedKVAllocator, SCRATCH_PAGE
+from .scheduler import (ContinuousBatchingScheduler, EXPIRED, FAILED,
+                        FINISHED, VERDICT_DRAINING, VERDICT_EXPIRED_DECODE,
+                        VERDICT_PREFILL_ERROR)
+from .slo import SLOController
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "live_snapshot"]
+
+# every live engine, weakly held: the crash postmortem
+# (telemetry.dump_postmortem) folds live_snapshot() in so a stalled or
+# dying replica's record says what it was serving, not just that it died
+_ENGINES = weakref.WeakSet()
+_engine_seq = itertools.count()
+
+
+def live_snapshot():
+    """Serving snapshots of every live engine in this process (the
+    postmortem's ``serving`` block); [] when none exist."""
+    out = []
+    for eng in list(_ENGINES):
+        try:
+            out.append(eng.snapshot())
+        except Exception:
+            pass  # a half-constructed engine must not break a postmortem
+    return out
+
+
+def _env_float(name):
+    try:
+        v = float(os.environ.get(name, "0"))
+    except ValueError:
+        return None
+    return v if v > 0 else None
 
 
 class ServingEngine:
@@ -71,7 +132,7 @@ class ServingEngine:
 
     def __init__(self, net, num_slots=4, page_size=16, num_pages=None,
                  max_prefill_len=32, max_seq_len=None, eos_id=None,
-                 record_logits=False):
+                 record_logits=False, slo=None, default_deadline_s=None):
         from ..gluon.model_zoo import gpt as _gpt
 
         self._gpt = _gpt
@@ -107,10 +168,27 @@ class ServingEngine:
             self.num_slots, self.alloc, self.max_pages_per_seq,
             max_seq_len=self.max_seq_len)
 
+        # survivability plane (ISSUE 11): SLO shed controller (explicit
+        # arg wins; env opt-in via MXTPU_SERVE_SLO_P99_S; None = the
+        # queue-forever behavior), default request deadline, drain flag
+        self._slo = slo if slo is not None else SLOController.from_env()
+        self.default_deadline_s = (default_deadline_s
+                                   if default_deadline_s is not None
+                                   else _env_float("MXTPU_SERVE_DEADLINE_S"))
+        self.draining = False
+        self.swaps = 0
+        # distinct watchdog lease key per engine in this process: one
+        # engine going idle (release) must not retire the lease another
+        # still-decoding engine depends on.  Production replicas hold
+        # one engine, whose lease is plain "serve_step".
+        seq = next(_engine_seq)
+        self._lease = "serve_step" if seq == 0 else "serve_step@%d" % seq
+
         self._kv = self._init_pages()
         self.decode_steps = 0
         self.prefills = 0
         self._build_programs()
+        _ENGINES.add(self)
         _telemetry.gauge("serving.kv_pages_free").set(
             self.alloc.free_pages)
         _telemetry.gauge("serving.batch_occupancy").set(0)
@@ -252,38 +330,102 @@ class ServingEngine:
                 _aot.donation_cache_guard(mk_jit()))
 
     # -- request intake ----------------------------------------------------
-    def submit(self, prompt, max_new):
+    def submit(self, prompt, max_new, deadline_s=None):
         """Enqueue one request (prompt: 1-d int token array).  Returns
-        the Request handle; tokens appear on it as the engine steps."""
+        the Request handle; tokens appear on it as the engine steps.
+
+        ``deadline_s``: total budget from now (queue wait + decode);
+        defaults to the engine's ``default_deadline_s`` (None = no
+        deadline).  The handle can come back ALREADY terminal with a
+        typed verdict — ``shed`` when the SLO controller is refusing
+        intake, ``draining`` while the replica drains — so callers fail
+        fast instead of waiting on a queue that will never serve them.
+        Infeasible requests (can never fit) still raise ValueError."""
         prompt = _np.asarray(prompt, _np.int32).reshape(-1)
         if prompt.size > self.max_prefill_len:
             raise ValueError(
                 "prompt length %d exceeds max_prefill_len %d"
                 % (prompt.size, self.max_prefill_len))
-        req = self.sched.submit(prompt, max_new)
+        # infeasibility is checked BEFORE the shed/drain branches: a
+        # request that can NEVER run must get the terminal ValueError,
+        # not a retryable-looking refusal a router would bounce forever
+        err = self.sched.feasibility_error(prompt.size, max_new)
+        if err is not None:
+            raise ValueError(err)
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        if self.draining:
+            _telemetry.counter("serving.drain_rejects").inc()
+            return self.sched.shed(
+                prompt, max_new, verdict=VERDICT_DRAINING,
+                error="replica is draining: finishing residents, "
+                      "admitting nothing new")
+        if self._slo is not None and self._slo.should_shed(
+                self.sched.oldest_queue_wait):
+            _telemetry.counter("serving.shed").inc()
+            return self.sched.shed(
+                prompt, max_new,
+                error="shed: queue-wait p99 %.3fs over SLO target %.3fs"
+                      % (self._slo.windowed_p99(),
+                         self._slo.target_p99_s))
+        req = self.sched.submit(prompt, max_new, deadline_s)
         if self._record_logits:
             req.logits_trace = []
         _telemetry.counter("serving.requests").inc()
         return req
 
     # -- the serving loop --------------------------------------------------
+    def _expire_deadlines(self):
+        """The per-step deadline sweep: queued requests past deadline
+        leave with ``expired_queue`` (no slot, no pages — pure
+        bookkeeping); residents past deadline are finished with
+        ``expired_decode`` BEFORE the decode dispatch, releasing slot +
+        pages, so an expired request never burns another token."""
+        for req in self.sched.expire_queued():
+            _telemetry.counter("serving.expired_queue").inc()
+        now = time.perf_counter()
+        for req in self.sched.expired_running(now):
+            self.sched.finish(
+                req, EXPIRED, verdict=VERDICT_EXPIRED_DECODE,
+                error="deadline %.3fs passed mid-decode after %d of %d "
+                      "tokens" % (req.deadline_s, len(req.tokens),
+                                  req.max_new))
+            _telemetry.counter("serving.expired_decode").inc()
+
     def _admit_and_prefill(self):
         """Join phase: place queued requests into free slots and run one
         prefill dispatch each (pages donated through; the request's
-        first generated token comes back with it)."""
-        placed = self.sched.admit()
-        for req in placed:
+        first generated token comes back with it).  Each dispatch runs
+        under a ``serve.prefill`` watchdog guard (a wedged prefill is a
+        diagnosable stall, not a silent hang); an injected
+        ``serve.prefill.error`` fails THAT request deterministically —
+        typed ``prefill_error`` verdict, slot + every reserved page
+        released, never requeued — and the loop moves on."""
+        placed = []
+        for req in self.sched.admit():
             _telemetry.histogram("serving.queue_wait").observe(
                 req.queue_wait_s)
+            if self._slo is not None:
+                self._slo.observe(req.queue_wait_s)
+            try:
+                _fault.check("serve.prefill.error",
+                             "prefill failed for request %d" % req.rid)
+            except _fault.FaultInjected as e:
+                self.sched.finish(req, FAILED,
+                                  verdict=VERDICT_PREFILL_ERROR,
+                                  error=str(e))
+                _telemetry.counter("serving.prefill_errors").inc()
+                continue
             toks = _np.zeros(self.max_prefill_len, _np.int32)
             toks[:req.prompt.size] = req.prompt
             t0 = time.perf_counter_ns()
-            logits, first, self._kv = self._prefill(
-                self._p, self._kv, toks,
-                _np.int32(req.prompt.size),
-                self.sched.block_tables[req.slot].copy())
-            t1 = time.perf_counter_ns()
-            first = int(first)          # device sync
+            with _watchdog.guard("serve.prefill"):
+                logits, first, self._kv = self._prefill(
+                    self._p, self._kv, toks,
+                    _np.int32(req.prompt.size),
+                    self.sched.block_tables[req.slot].copy())
+                t1 = time.perf_counter_ns()
+                first = int(first)          # device sync
             t2 = time.perf_counter_ns()
             _telemetry.note_train_step(t0, t1, t2,
                                        where="serve_prefill")
@@ -292,6 +434,7 @@ class ServingEngine:
             self._note_token(req, first,
                              _np.asarray(logits) if self._record_logits
                              else None)
+            placed.append(req)
         return placed
 
     def _note_token(self, req, token, logits_row=None):
@@ -312,16 +455,38 @@ class ServingEngine:
             self.sched.finish(req, FINISHED)
 
     def step(self):
-        """One serving iteration: admit+prefill joins, then ONE donated
-        decode dispatch advancing every resident slot.  Returns the
-        number of tokens produced (0 == idle)."""
+        """One serving iteration: deadline sweep, admit+prefill joins,
+        then ONE donated decode dispatch advancing every resident slot.
+        Returns the number of tokens produced (0 == idle).
+
+        Hang defense: a completed step renews the ``serve_step``
+        progress lease; going idle releases it (an idle replica is not
+        stalled).  The ``serve.decode.stall`` fault site wedges right
+        before the decode dispatch WITHOUT renewing — exactly the
+        production failure (a hung XLA dispatch / device lockup) the
+        watchdog's exit-75 path exists for."""
+        self._expire_deadlines()
         placed = self._admit_and_prefill()
         # every placed request produced exactly one token in its prefill
         produced = len(placed)
         running = self.sched.running
         if not running:
+            if produced:
+                _watchdog.renew(self._lease, step=self.decode_steps,
+                                phase="serve_step")
+            if self.sched.idle:
+                _watchdog.release(self._lease)
             self._publish_gauges()
             return produced
+        # arm the lease BEFORE the dispatch (auxiliary — it must not end
+        # the startup-grace window that covers a lazily-compiling first
+        # dispatch): a decode that wedges right here, including the very
+        # first one, ages this lease with no renewal coming — exactly
+        # what the watchdog exists to catch.  The post-decode renewal
+        # below is the primary "real progress" mark.
+        _watchdog.renew(self._lease, step=self.decode_steps,
+                        phase="serve_step", primary=False)
+        _fault.stall_if("serve.decode.stall")
 
         s = self.num_slots
         tokens = _np.zeros(s, _np.int32)
@@ -344,12 +509,16 @@ class ServingEngine:
         t2 = time.perf_counter_ns()
         _telemetry.note_train_step(t0, t1, t2, where="serve_step")
         self.decode_steps += 1
+        _watchdog.renew(self._lease, step=self.decode_steps,
+                        phase="serve_step")
         logits_np = _np.asarray(logits) if self._record_logits else None
         for req in list(running):
             self._note_token(
                 req, nxt[req.slot],
                 None if logits_np is None else logits_np[req.slot])
             produced += 1
+        if self.sched.idle:
+            _watchdog.release(self._lease)
         self._publish_gauges()
         return produced
 
@@ -368,6 +537,96 @@ class ServingEngine:
             self.step()
         raise MXNetError("serving loop did not drain in %d steps"
                          % max_steps)
+
+    # -- live weight hot-swap (ISSUE 11) -----------------------------------
+    def swap_params(self, params, verify=True):
+        """Install a new decode-param tree between decode steps — the
+        live weight hot-swap a serving replica runs when a training job
+        publishes a fresh checkpoint (serving/replica.py drives it from
+        CheckpointManager publications).
+
+        The tree must match the current one in structure, shapes, and
+        dtypes (the compiled programs take params as ORDINARY inputs, so
+        a same-shape swap costs ZERO recompiles; a mismatched one would
+        silently retrace, so it is rejected before touching anything).
+        With ``verify`` the new weights must pass a **canary decode**
+        first: one prefill dispatch whose block table points entirely at
+        the scratch page (page 0 — where every masked write already
+        goes), whose logits must come back finite.  Residents never see
+        the canary: no real page is read or written, and the swap lands
+        between decode steps by construction (the caller's loop).  A
+        failed canary rolls the engine back to the prior weights and
+        raises — the replica keeps serving what it was serving."""
+        import jax
+
+        old = self._p
+        flat_new, td_new = jax.tree_util.tree_flatten(params)
+        flat_old, td_old = jax.tree_util.tree_flatten(old)
+        if td_new != td_old or len(flat_new) != len(flat_old) or any(
+                tuple(n.shape) != tuple(o.shape) or n.dtype != o.dtype
+                for n, o in zip(flat_new, flat_old)):
+            raise MXNetError(
+                "hot-swap rejected: new param tree does not match the "
+                "serving tree in structure/shape/dtype — a mismatched "
+                "swap would retrace the decode program mid-flight")
+        self._p = params
+        if verify:
+            try:
+                self._canary_decode()
+            except BaseException:
+                self._p = old
+                _telemetry.counter("serving.swap_rollbacks").inc()
+                raise
+        self.swaps += 1
+        _telemetry.counter("serving.swaps").inc()
+
+    def _canary_decode(self):
+        """One prefill with an all-scratch block table (prompt_len=1):
+        exercises the full transformer stack under the NEW weights
+        without touching any resident's pages.  Non-finite logits mean
+        the published weights are torn/corrupt — raise so swap_params
+        rolls back."""
+        toks = _np.zeros(self.max_prefill_len, _np.int32)
+        bt = _np.full(self.max_pages_per_seq, SCRATCH_PAGE, _np.int32)
+        with _telemetry.span("serving.swap_canary", cat="serving"):
+            logits, _first, self._kv = self._prefill(
+                self._p, self._kv, toks, _np.int32(1), bt)
+            row = _np.asarray(logits)       # device sync
+        if not _np.isfinite(row).all():
+            raise MXNetError(
+                "hot-swap canary decode produced non-finite logits — "
+                "new weights are torn or corrupt, rolling back")
+
+    # -- drain / introspection ---------------------------------------------
+    def start_drain(self):
+        """Stop admitting: every subsequent submit comes back terminal
+        with verdict ``draining``.  Residents and the already-accepted
+        queue keep decoding — drive :meth:`step` (or
+        ``run_until_idle``) to let them finish; serving/replica.py's
+        ``drain()`` owns the full protocol including the exit code."""
+        self.draining = True
+
+    def snapshot(self):
+        """JSON-able serving state for postmortems and replica health:
+        resident slots, queue depth, page accounting, drain flag — the
+        "what was it serving" record a dead replica leaves behind."""
+        running = self.sched.running
+        return {
+            "decode_steps": self.decode_steps,
+            "prefills": self.prefills,
+            "swaps": self.swaps,
+            "occupancy": self.sched.occupancy,
+            "num_slots": self.num_slots,
+            "queued": self.sched.queued,
+            "resident_rids": [r.rid for r in running],
+            "resident_tokens": [len(r.tokens) for r in running],
+            "free_pages": self.alloc.free_pages,
+            "used_pages": self.alloc.used_pages,
+            "num_pages": self.alloc.num_pages,
+            "draining": self.draining,
+            "shedding": (self._slo.shedding if self._slo is not None
+                         else False),
+        }
 
     # -- convenience -------------------------------------------------------
     def generate(self, prompts, max_new):

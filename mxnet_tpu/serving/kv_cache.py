@@ -99,3 +99,29 @@ class PagedKVAllocator:
                     "free or scratch/foreign page)" % p)
             self._allocated.remove(p)
             self._free.append(p)
+
+    # -- invariants ----------------------------------------------------------
+    def assert_conservation(self):
+        """Page conservation: every usable page is in exactly ONE of
+        free-list / allocated-set, none twice, scratch in neither.
+        Raises MXNetError naming the violation.  Called by tests and by
+        the drain/mass-rejection paths — a request verdict that leaked
+        or duplicated a page would corrupt another sequence's history
+        long after the offending request is gone."""
+        free = list(self._free)
+        free_set = set(free)
+        if len(free_set) != len(free):
+            raise MXNetError("free-list holds duplicate pages: %r" % free)
+        if free_set & self._allocated:
+            raise MXNetError(
+                "pages both free and allocated: %r"
+                % sorted(free_set & self._allocated))
+        if SCRATCH_PAGE in free_set or SCRATCH_PAGE in self._allocated:
+            raise MXNetError("scratch page leaked into the pool")
+        usable = self.num_pages - 1
+        if len(free_set) + len(self._allocated) != usable:
+            raise MXNetError(
+                "page conservation violated: %d free + %d allocated != "
+                "%d usable" % (len(free_set), len(self._allocated),
+                               usable))
+        return True

@@ -1,0 +1,286 @@
+"""Replica lifecycle: health, graceful drain, live weight hot-swap.
+
+One :class:`ServingReplica` wraps one :class:`ServingEngine` with the
+process-level survivability protocol a fleet needs (ISSUE 11, ROADMAP
+item 1):
+
+- **health** — derived from the watchdog's ``serve_step`` progress lease
+  (the engine renews it per completed step): a replica whose lease age
+  runs away is wedged even though its process is alive.  A genuinely
+  wedged decode trips the PR-4 stall watchdog (exit 75) with this
+  engine's serving snapshot in the postmortem.
+- **graceful drain** — :meth:`drain`: stop admitting (new submits come
+  back terminal with verdict ``draining``), finish every resident AND
+  already-accepted queued request, verify all pages returned to the
+  pool, then hand back :data:`EXIT_SERVE_DRAIN` (80) for the process
+  wrapper to exit with.  ``tools/launch.py:classify_exit`` knows 80 as
+  *clean* — a drain is planned, never blamed toward elastic eviction,
+  and the membership journal records it as ``drain``/``replace``
+  transitions distinct from training failures.
+- **replica loss** — the ``serve.replica.lost`` fault site fires inside
+  :meth:`step` as :class:`ReplicaLost` (the router's failover signal);
+  a standalone replica process lets it propagate and dies with the
+  ordinary retryable machinery.
+- **live weight hot-swap** — a :class:`CheckpointSubscriber` watches a
+  ``CheckpointManager`` prefix a live trainer publishes to.  Between
+  decode steps the replica loads any NEW complete epoch (sha256
+  manifests verified by the manager's discovery — a torn or in-flight
+  publication is invisible), rebuilds the decode-param tree, and
+  installs it via ``ServingEngine.swap_params`` — which canary-decodes
+  the new weights against the scratch page and ROLLS BACK on anything
+  non-finite.  The ``serve.swap.torn`` fault site poisons a loaded
+  tree to drill exactly that rollback.
+
+The replica is transport-agnostic: tests and the in-process router
+drive it directly; a service wraps it in whatever RPC front-end it has.
+"""
+from __future__ import annotations
+
+import time
+
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+from .. import watchdog as _watchdog
+from ..base import MXNetError
+
+__all__ = ["ServingReplica", "CheckpointSubscriber", "ReplicaLost",
+           "EXIT_SERVE_DRAIN"]
+
+#: graceful-drain exit code (exit-code contract with tools/launch.py:
+#: classified *clean* — never blamed toward eviction; the membership
+#: journal records drain/replace transitions distinctly)
+EXIT_SERVE_DRAIN = 80
+
+
+class ReplicaLost(MXNetError):
+    """This replica died mid-flight (the ``serve.replica.lost`` site, or
+    a fatal dispatch error): the router fails its accepted requests over
+    to a live replica; a standalone process exits retryable."""
+
+
+class CheckpointSubscriber:
+    """Watch a CheckpointManager prefix for NEW complete checkpoints
+    from a live trainer and turn them into decode-param trees.
+
+    Discovery rides ``CheckpointManager.latest()`` — manifests are
+    written LAST and sha256-verified, so a torn, partial, or in-flight
+    publication simply does not exist yet.  Each epoch is attempted at
+    most once (``seen_epoch``): a publication that failed its canary
+    (torn swap) is not retried every step — the NEXT publication gets a
+    fresh chance.
+    """
+
+    def __init__(self, prefix, net, epoch=None):
+        from ..checkpoint import CheckpointManager
+        self._mgr = CheckpointManager(prefix)
+        self._net = net
+        self.applied_epoch = epoch   # newest epoch actually serving
+        self.seen_epoch = epoch      # newest epoch attempted
+
+    def poll(self):
+        """Newest complete epoch NEWER than anything attempted, else
+        None.  Never raises — a sick checkpoint store must not take the
+        serving loop down."""
+        try:
+            e = self._mgr.latest()
+        except Exception:
+            return None
+        if e is None or (self.seen_epoch is not None
+                         and e <= self.seen_epoch):
+            return None
+        return e
+
+    def snapshot_params(self):
+        """COPIES of the net's current param arrays, keyed by name —
+        taken before a swap so a failed canary can restore them.
+        Real copies, not handles: ``Parameter.set_data`` mutates the
+        param's NDArray in place, so a by-reference snapshot would be
+        overwritten by the very load it exists to undo."""
+        return {name: p.data().copy()
+                for name, p in self._net.collect_params().items()}
+
+    def restore_params(self, snapshot):
+        """Put a :meth:`snapshot_params` snapshot back — the net-side
+        half of a swap rollback.  Without it a torn checkpoint would
+        stay loaded in the net after the ENGINE rolled back, and any
+        later consumer of the net (a replacement engine built from it,
+        the next ``decode_params``) would serve the torn weights with
+        no canary in the way."""
+        params = self._net.collect_params()
+        for name, val in snapshot.items():
+            params[name].set_data(val)
+
+    def load_params(self, epoch):
+        """Load epoch's verified params into the replica's net and
+        return the fresh decode-param tree for
+        ``ServingEngine.swap_params``.  The manager's load path drains
+        async writers and re-validates the manifest, so a torn file can
+        never reach the tree build; the engine's canary is the last line
+        (bit-rot between verification and read, ``serve.swap.torn``)."""
+        from ..gluon.model_zoo import gpt as _gpt
+        _epoch, arg_params, _aux = self._mgr.load(epoch)
+        params = dict(self._net.collect_params().items())
+        missing = [n for n in params if n not in arg_params]
+        if missing:
+            raise MXNetError(
+                "checkpoint epoch %d is missing serving params %s — "
+                "published by a different model?" % (epoch, missing[:4]))
+        for name, val in arg_params.items():
+            if name in params:
+                params[name].set_data(val)
+        tree = _gpt.decode_params(self._net)
+        if _fault.trigger("serve.swap.torn"):
+            # bit-rot between manifest verification and the read — the
+            # canary (finite-logits decode) must catch it and roll back
+            tree = dict(tree)
+            tree["wte"] = tree["wte"] * float("nan")
+        return tree
+
+
+class ServingReplica:
+    """One engine + the lifecycle protocol (drain / loss / hot-swap).
+
+    ``subscriber``: optional :class:`CheckpointSubscriber` polled
+    between steps (every ``swap_poll_steps`` decode steps — discovery
+    stats a directory; don't do it per token).
+    """
+
+    def __init__(self, engine, replica_id=0, subscriber=None,
+                 swap_poll_steps=8):
+        self.engine = engine
+        self.replica_id = replica_id
+        self.subscriber = subscriber
+        self.swap_poll_steps = max(1, int(swap_poll_steps))
+        self.alive = True
+        self._steps = 0
+
+    # -- request plane -----------------------------------------------------
+    def submit(self, prompt, max_new, deadline_s=None):
+        if not self.alive:
+            raise ReplicaLost("replica %s is dead" % self.replica_id)
+        return self.engine.submit(prompt, max_new, deadline_s=deadline_s)
+
+    def step(self):
+        """One serving iteration, replica-flavored: the loss fault site,
+        then (between decode steps — exactly the hot-swap window) a
+        checkpoint poll, then the engine step."""
+        if not self.alive:
+            raise ReplicaLost("replica %s is dead" % self.replica_id)
+        if _fault.trigger("serve.replica.lost"):
+            self.abandon()
+            _telemetry.counter("serving.replica_lost").inc()
+            raise ReplicaLost(
+                "[fault injection] replica %s lost mid-decode"
+                % self.replica_id)
+        if self.subscriber is not None and \
+                self._steps % self.swap_poll_steps == 0:
+            self.maybe_swap()
+        self._steps += 1
+        return self.engine.step()
+
+    @property
+    def draining(self):
+        return self.engine.draining
+
+    @property
+    def load(self):
+        """Placement signal for the router: residents + queue depth."""
+        return self.engine.sched.occupancy + self.engine.sched.queued
+
+    @property
+    def idle(self):
+        return self.engine.sched.idle
+
+    # -- weight hot-swap ---------------------------------------------------
+    def maybe_swap(self):
+        """Poll for a newer published checkpoint and install it between
+        decode steps.  Returns the epoch applied, or None (nothing new /
+        load failed / canary rolled back — in the failure cases the
+        replica KEEPS SERVING its current weights and the epoch is
+        marked attempted so a torn publication is not retried every
+        step)."""
+        sub = self.subscriber
+        if sub is None:
+            return None
+        epoch = sub.poll()
+        if epoch is None:
+            return None
+        sub.seen_epoch = epoch
+        snap = sub.snapshot_params()
+        try:
+            with _telemetry.span("serving.swap", cat="serving"):
+                params = sub.load_params(epoch)
+                self.engine.swap_params(params)
+        except Exception as e:
+            # BOTH halves roll back: the engine restored its tree
+            # (swap_params), and the net's params — which load_params
+            # mutated in place — go back too, or the torn weights would
+            # resurface canary-free through the next decode_params /
+            # replacement engine built on this net
+            try:
+                sub.restore_params(snap)
+            except Exception:
+                pass  # partial restore still beats silently serving on
+            import logging
+            logging.warning(
+                "mxnet_tpu.serving: hot-swap to epoch %d failed (%s: "
+                "%s) — still serving epoch %s", epoch,
+                type(e).__name__, e, sub.applied_epoch)
+            return None
+        sub.applied_epoch = epoch
+        _telemetry.gauge("serving.swap_epoch").set(epoch)
+        return epoch
+
+    # -- lifecycle ---------------------------------------------------------
+    def abandon(self):
+        """Mark this replica dead and release its engine's watchdog
+        lease.  Called on replica loss (the fault path above; the
+        router calls it too on failover) — an abandoned engine is never
+        stepped again, so a lease left behind would age unrenewed and
+        an armed stall watchdog would kill the WHOLE healthy process
+        for it."""
+        self.alive = False
+        _watchdog.release(self.engine._lease)
+
+    def health(self):
+        """Lease-derived liveness + the engine snapshot: what a fleet
+        health endpoint returns."""
+        # this engine's OWN lease only — falling back to the shared
+        # name would report ANOTHER engine's liveness in multi-engine
+        # processes, keeping a wedged replica looking healthy
+        lease = _watchdog.snapshot()["leases"].get(self.engine._lease)
+        return {
+            "replica_id": self.replica_id,
+            "alive": self.alive,
+            "draining": self.draining,
+            "lease_age_s": None if lease is None else lease["age_s"],
+            "engine": self.engine.snapshot(),
+        }
+
+    def drain(self, max_steps=100000):
+        """Graceful drain: stop admitting, finish every resident and
+        already-accepted queued request, verify the page pool is whole,
+        release the progress lease, and return EXIT_SERVE_DRAIN for the
+        process wrapper to ``sys.exit`` with.  Zero accepted requests
+        are dropped — drain honors the queue; only NEW intake is
+        refused (typed verdict ``draining``)."""
+        self.engine.start_drain()
+        _telemetry.counter("serving.drains").inc()
+        for _ in range(max_steps):
+            if self.engine.sched.idle:
+                break
+            self.step()
+        else:
+            raise MXNetError(
+                "drain did not complete in %d steps (queue %d, "
+                "residents %d)" % (max_steps, self.engine.sched.queued,
+                                   self.engine.sched.occupancy))
+        if self.engine.alloc.used_pages:
+            raise MXNetError(
+                "drain finished with %d pages still allocated — a "
+                "request leaked its reservation"
+                % self.engine.alloc.used_pages)
+        self.engine.alloc.assert_conservation()
+        self.alive = False
+        _telemetry.gauge("serving.drained_at").set(time.time())
+        return EXIT_SERVE_DRAIN

@@ -1,0 +1,356 @@
+"""Multi-replica router: spread, retry-on-failover, at-most-once decode.
+
+The fleet front-door (ISSUE 11, ROADMAP item 1): requests enter HERE,
+are journaled under a router-scoped request id, and are placed on the
+least-loaded live replica.  The survivability contract:
+
+- **zero dropped accepted requests** — a replica dying mid-decode
+  (:class:`~mxnet_tpu.serving.replica.ReplicaLost`, e.g. the
+  ``serve.replica.lost`` drill) fails its incomplete requests over to a
+  live replica; greedy decode is deterministic, so the re-run produces
+  bit-identical tokens and the caller never observes the failover
+  beyond latency;
+- **at-most-once decode** — the journal is the authority: a request
+  recorded ``completed`` is NEVER re-executed, even when the replica it
+  ran on dies later; a mid-flight victim's partial tokens are discarded
+  and the request decodes exactly once more (bounded by
+  ``max_retries``, then verdict ``retries_exhausted`` — bounded-retry,
+  never a hang);
+- **typed refusals spread** — a replica that sheds (SLO) or is draining
+  refuses with a typed verdict; placement tries every live replica in
+  load order before giving up, so one overloaded replica doesn't turn
+  into a fleet-wide refusal;
+- **replacement spin-up** — an optional ``spawn`` callback builds a
+  fresh replica on failover (the PR-6 elastic replace move).  With a
+  shared AOT cache / in-process memo the replacement comes up warm: 0
+  foreground compiles before its first token (asserted by
+  ``BENCH_MODE=serve``'s degraded-mode contract).
+
+The journal can additionally be mirrored to a JSON-lines file
+(``journal_path``) — one line per transition (accept / complete /
+failover / retry / terminal verdict), the auditable "every accepted
+request completed exactly once" record the e2e drill greps.
+
+Replicas are duck-typed (``replica_id`` / ``alive`` / ``draining`` /
+``load`` / ``idle`` / ``submit`` / ``step``): the in-process
+:class:`~mxnet_tpu.serving.replica.ServingReplica` today, an RPC proxy
+tomorrow.  Telemetry: ``router.requests`` / ``router.failovers`` /
+``router.retries`` / ``router.replacements`` / ``router.refused``
+counters, ``router.live_replicas`` gauge.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from .replica import ReplicaLost
+from .scheduler import (EXPIRED, FAILED, FINISHED, REJECTED, SHED,
+                        VERDICT_REJECTED)
+
+__all__ = ["Router", "RouterRequest"]
+
+#: router-request terminal verdict when every retry is burned
+VERDICT_RETRIES_EXHAUSTED = "retries_exhausted"
+VERDICT_NO_REPLICAS = "no_live_replicas"
+
+#: engine states that are terminal-but-not-success (propagated verdicts)
+_TERMINAL_FAILURES = (REJECTED, EXPIRED, FAILED, SHED)
+
+
+class RouterRequest:
+    """The caller's handle: journaled id, terminal state + typed
+    verdict, and the completed token list.  ``tokens`` is only
+    populated at COMPLETION (a failover discards a victim's partial
+    tokens — the re-run regenerates them deterministically)."""
+
+    __slots__ = ("rid", "prompt", "max_new", "deadline_s", "deadline_t",
+                 "state", "verdict", "error", "tokens", "replica_id",
+                 "retries", "_live", "_home")
+
+    def __init__(self, rid, prompt, max_new, deadline_s):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline_s = deadline_s
+        # the deadline is ABSOLUTE from original submission: a failover
+        # re-placement passes the REMAINING budget, never a fresh one —
+        # retries must not multiply the caller's end-to-end bound
+        self.deadline_t = (None if deadline_s is None
+                           else time.perf_counter() + float(deadline_s))
+        self.state = "submitted"
+        self.verdict = None
+        self.error = None
+        self.tokens = None
+        self.replica_id = None  # journal/display only — never identity
+        self.retries = 0
+        self._live = None      # the engine Request currently decoding
+        self._home = None      # the replica OBJECT it decodes on (ids
+                               # are caller-supplied and may collide)
+
+    @property
+    def done(self):
+        return self.state not in ("submitted", "accepted")
+
+
+class Router:
+    def __init__(self, replicas, spawn=None, max_retries=1,
+                 journal_path=None, journal_retention=4096):
+        self._replicas = list(replicas)
+        self._spawn = spawn
+        self.max_retries = int(max_retries)
+        self._journal = {}           # rid -> RouterRequest
+        self._inflight = set()       # rids currently accepted somewhere
+        self._journal_path = journal_path
+        #: terminal entries kept in memory (None = unbounded).  The
+        #: in-memory journal only needs to cover in-flight work plus a
+        #: recent-history window; the JSONL file (journal_path) is the
+        #: durable all-time audit record — without a bound a long-lived
+        #: router pins every prompt + token list it ever served.
+        self.journal_retention = (None if journal_retention is None
+                                  else max(1, int(journal_retention)))
+        self._next_rid = 0
+        self.failovers = 0
+        self._gauge_live()
+
+    # -- journal -----------------------------------------------------------
+    def _log(self, event, rr, **extra):
+        if not self._journal_path:
+            return
+        line = {"t": time.time(), "event": event, "rid": rr.rid,
+                "replica": rr.replica_id, "state": rr.state,
+                "verdict": rr.verdict, "retries": rr.retries}
+        line.update(extra)
+        try:
+            with open(self._journal_path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+        except OSError:
+            pass  # the journal must never take the router down
+
+    def request(self, rid):
+        return self._journal.get(rid)
+
+    @property
+    def requests(self):
+        return list(self._journal.values())
+
+    # -- placement ---------------------------------------------------------
+    def _live(self):
+        return [r for r in self._replicas if r.alive]
+
+    def _gauge_live(self):
+        _telemetry.gauge("router.live_replicas").set(len(self._live()))
+
+    def submit(self, prompt, max_new, deadline_s=None):
+        """Journal a request and place it.  The handle is terminal
+        immediately when every live replica refused (typed verdict
+        propagated) or none exist — fail fast, never a silent hang."""
+        rr = RouterRequest(self._next_rid, prompt, max_new, deadline_s)
+        self._next_rid += 1
+        self._prune_journal()
+        self._journal[rr.rid] = rr
+        _telemetry.counter("router.requests").inc()
+        self._place(rr)
+        return rr
+
+    def _prune_journal(self):
+        """Evict the oldest TERMINAL entries once the in-memory journal
+        doubles its retention cap (amortized: one O(n log n) sweep per
+        ``journal_retention`` submissions).  In-flight entries — the
+        at-most-once authority — are never evicted; callers holding a
+        RouterRequest handle keep it alive regardless."""
+        cap = self.journal_retention
+        if cap is None or len(self._journal) < 2 * cap:
+            return
+        for rid in sorted(self._journal):
+            if len(self._journal) <= cap:
+                break
+            if rid in self._inflight:
+                continue
+            rr = self._journal[rid]
+            if rr.state in ("submitted", "accepted"):
+                continue
+            del self._journal[rid]
+
+    def _place(self, rr):
+        """Try every live, non-draining replica in load order until one
+        ACCEPTS (bounded spread — one pass, no retry loop).  A typed
+        refusal from every candidate propagates the LAST refusal's
+        verdict to the caller."""
+        self._inflight.discard(rr.rid)
+        candidates = sorted(
+            (r for r in self._live() if not r.draining),
+            key=lambda r: r.load)
+        # remaining budget relative to the ORIGINAL submission — an
+        # already-blown deadline goes through as ~0 so the engine's
+        # sweep expires it with the typed verdict, not a silent drop
+        remaining = (None if rr.deadline_t is None
+                     else rr.deadline_t - time.perf_counter())
+        refusal = None
+        for r in candidates:
+            try:
+                req = r.submit(rr.prompt, rr.max_new,
+                               deadline_s=remaining)
+            except ReplicaLost:
+                continue
+            except ValueError as e:
+                # infeasible everywhere by construction (engine-config
+                # bound): terminal immediately, with the same typed
+                # verdict an engine-level handle carries
+                rr.state, rr.verdict = "failed", VERDICT_REJECTED
+                rr.error = str(e)
+                self._log("reject", rr)
+                return
+            if req.state == SHED:
+                refusal = req
+                continue
+            rr._live = req
+            rr._home = r
+            rr.replica_id = r.replica_id
+            rr.state = "accepted"
+            self._inflight.add(rr.rid)
+            self._log("accept", rr)
+            return
+        rr.state = "refused"
+        rr.verdict = refusal.verdict if refusal is not None \
+            else VERDICT_NO_REPLICAS
+        rr.error = (refusal.error if refusal is not None
+                    else "no live replica to place on")
+        _telemetry.counter("router.refused").inc()
+        self._log("refuse", rr)
+
+    # -- the serving loop --------------------------------------------------
+    def step(self):
+        """Step every live replica, failing over on ReplicaLost, then
+        harvest finished requests into the journal.  Returns tokens
+        produced this iteration."""
+        produced = 0
+        for r in list(self._replicas):
+            if not r.alive:
+                continue
+            try:
+                produced += r.step()
+            except ReplicaLost:
+                self._failover(r)
+        self._harvest()
+        return produced
+
+    def _harvest(self):
+        """Move terminal engine states into the journal.  Completion is
+        recorded EXACTLY once per rid — the at-most-once authority the
+        failover path consults.  Scans only the in-flight set, not the
+        all-time journal: a long-lived router must not pay O(requests
+        ever served) per step."""
+        for rid in list(self._inflight):
+            rr = self._journal[rid]
+            live = rr._live
+            if rr.state != "accepted" or live is None:
+                self._inflight.discard(rid)
+                continue
+            if live.state == FINISHED:
+                rr.tokens = [int(t) for t in live.tokens]
+                rr.state = "completed"
+                rr.verdict = live.verdict or "completed"
+                self._inflight.discard(rid)
+                self._log("complete", rr, tokens=len(rr.tokens))
+            elif live.state in _TERMINAL_FAILURES:
+                rr.state = "failed"
+                rr.verdict = live.verdict or live.state
+                rr.error = live.error
+                self._inflight.discard(rid)
+                self._log("fail", rr)
+
+    def _failover(self, replica):
+        """A replica died: journal-driven failover.  Completed requests
+        are untouched (at-most-once); incomplete accepted ones are
+        re-placed on live replicas (partial tokens discarded — greedy
+        decode regenerates them bit-identically), bounded by
+        ``max_retries``.  A ``spawn`` callback, if any, brings up the
+        replacement FIRST so the victims have somewhere to land.  The
+        dead replica is then PRUNED: its watchdog lease is released
+        (an abandoned lease would age into a process-wide stall kill)
+        and it leaves ``_replicas``, dropping its engine — and with it
+        a full KV page pool per failover that would otherwise pin
+        memory for the router's lifetime."""
+        abandon = getattr(replica, "abandon", None)
+        if abandon is not None:
+            try:
+                abandon()
+            except Exception:
+                pass  # best-effort: the replica is already dead
+        replica.alive = False
+        self.failovers += 1
+        _telemetry.counter("router.failovers").inc()
+        self._harvest()   # completions from earlier steps stay completed
+        if self._spawn is not None:
+            try:
+                fresh = self._spawn()
+            except Exception as e:
+                import logging
+                logging.warning(
+                    "mxnet_tpu.serving.router: replacement spawn failed "
+                    "(%s: %s); continuing on survivors",
+                    type(e).__name__, e)
+            else:
+                self._replicas.append(fresh)
+                _telemetry.counter("router.replacements").inc()
+        # victims matched by replica IDENTITY (the object), never by
+        # replica_id — ids are caller-supplied and may collide, and an
+        # id match would "fail over" healthy requests still decoding
+        # fine on a live replica (double execution)
+        victims = [self._journal[rid] for rid in sorted(self._inflight)
+                   if self._journal[rid].state == "accepted"
+                   and self._journal[rid]._home is replica]
+        for rr in victims:
+            rr.retries += 1
+            rr._live = None
+            rr._home = None
+            if rr.retries > self.max_retries:
+                rr.state = "failed"
+                rr.verdict = VERDICT_RETRIES_EXHAUSTED
+                rr.error = ("replica %s lost; retry budget (%d) "
+                            "exhausted" % (replica.replica_id,
+                                           self.max_retries))
+                self._inflight.discard(rr.rid)
+                self._log("drop", rr)
+                continue
+            _telemetry.counter("router.retries").inc()
+            self._log("retry", rr, from_replica=replica.replica_id)
+            self._place(rr)
+        # prune: journal entries survive; the dead replica (and its
+        # engine's page pools) do not
+        self._replicas = [r for r in self._replicas if r is not replica]
+        self._gauge_live()
+
+    # -- drive -------------------------------------------------------------
+    @property
+    def idle(self):
+        """Nothing left to decode: every live replica is idle.  Every
+        accepted request lives on some replica's queue/slots (failover
+        re-places or terminally fails victims synchronously), so
+        replica idleness covers the journal too."""
+        return all(r.idle for r in self._live())
+
+    def run_until_idle(self, max_steps=100000):
+        for _ in range(max_steps):
+            if self.idle:
+                return
+            self.step()
+        raise MXNetError("router did not drain in %d steps" % max_steps)
+
+    def drain(self):
+        """Fleet drain: every live replica stops admitting, residents
+        finish, then each replica reports its drain exit code.
+        Returned as ``[(replica_id, rc)]`` pairs — ids are
+        caller-supplied and may collide, so a dict would silently drop
+        results."""
+        out = []
+        for r in self._live():
+            out.append((r.replica_id, r.drain()))
+        # the drains finished every accepted request on their engines;
+        # harvest moves those completions into the journal NOW — the
+        # replicas are dead after drain(), so no later step() would
+        self._harvest()
+        self._gauge_live()
+        return out

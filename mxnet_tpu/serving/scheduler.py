@@ -15,6 +15,13 @@ kv_cache.PagedKVAllocator.  Head-of-line blocking is deliberate: FIFO
 keeps per-request latency predictable and starvation impossible, the
 usual serving trade.
 
+Survivability additions (ISSUE 11): per-request deadlines (total
+budget, queue + decode) with expiry sweeps the engine runs each step,
+typed terminal verdicts on every non-success exit (fail fast — a
+handle is live or terminal, never hung), and :meth:`shed` for the
+SLO/drain refusals.  Every resident exit routes through
+:meth:`finish`, so pages can never leak on a failure path.
+
 Host-side control plane only; the engine owns every device object.
 """
 from __future__ import annotations
@@ -28,20 +35,38 @@ from .kv_cache import PagedKVAllocator, SCRATCH_PAGE
 
 __all__ = ["Request", "ContinuousBatchingScheduler"]
 
-#: request lifecycle states
-QUEUED, RUNNING, FINISHED, REJECTED = \
-    "queued", "running", "finished", "rejected"
+#: request lifecycle states.  FINISHED/REJECTED/EXPIRED/FAILED/SHED are
+#: terminal; every terminal request carries a typed ``verdict`` (and an
+#: ``error`` message for the failure classes) so a caller never has to
+#: poll a hung handle to learn its fate — fail fast is the contract
+#: (ISSUE 11).
+QUEUED, RUNNING, FINISHED, REJECTED, EXPIRED, FAILED, SHED = \
+    "queued", "running", "finished", "rejected", "expired", "failed", \
+    "shed"
+
+#: typed verdicts a terminal request can carry
+VERDICT_COMPLETED = "completed"                # every token produced
+VERDICT_EXPIRED_QUEUE = "expired_queue"        # deadline passed in queue
+VERDICT_EXPIRED_DECODE = "expired_decode"      # deadline passed resident
+VERDICT_SHED = "shed"                          # SLO shed at admission
+VERDICT_DRAINING = "draining"                  # replica refusing intake
+VERDICT_REJECTED = "rejected_infeasible"       # can never run here
+VERDICT_PREFILL_ERROR = "prefill_error"        # admission dispatch failed
 
 
 class Request:
-    """One inference request: a prompt plus a decode budget, and the
-    latency stamps the serving histograms are built from."""
+    """One inference request: a prompt plus a decode budget, an optional
+    deadline, and the latency stamps the serving histograms are built
+    from.  ``deadline_s`` is the TOTAL budget from submit — queue wait
+    plus decode — so an expired request fails with a typed verdict
+    instead of occupying a slot (or the queue) forever."""
 
     __slots__ = ("rid", "prompt", "max_new", "submit_t", "admit_t",
                  "first_token_t", "finish_t", "tokens", "state", "slot",
-                 "pages", "logits_trace", "token_times")
+                 "pages", "logits_trace", "token_times", "deadline_s",
+                 "deadline_t", "verdict", "error")
 
-    def __init__(self, rid, prompt, max_new):
+    def __init__(self, rid, prompt, max_new, deadline_s=None):
         self.rid = rid
         self.prompt = _np.asarray(prompt, _np.int32).reshape(-1)
         if self.prompt.size == 0:
@@ -59,6 +84,17 @@ class Request:
         self.slot = None
         self.pages = None
         self.logits_trace = None  # engine fills when record_logits=True
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.deadline_t = (None if deadline_s is None
+                           else self.submit_t + float(deadline_s))
+        self.verdict = None       # typed terminal verdict
+        self.error = None         # human-readable failure detail
+
+    @property
+    def done(self):
+        """Terminal: no further tokens will ever appear on this handle
+        (success or any typed failure) — the fail-fast polling target."""
+        return self.state not in (QUEUED, RUNNING)
 
     @property
     def ttft_s(self):
@@ -106,31 +142,109 @@ class ContinuousBatchingScheduler:
             _np.int32)
 
     # -- intake ------------------------------------------------------------
-    def submit(self, prompt, max_new):
+    def submit(self, prompt, max_new, deadline_s=None):
         """Enqueue a request (never blocks, never rejects for load — the
-        queue is the backpressure).  Rejects only requests that can
-        NEVER run: worst case beyond the per-sequence page budget."""
-        req = Request(self._next_rid, prompt, max_new)
+        queue is the backpressure; the ENGINE's SLO controller is what
+        sheds for load, via :meth:`shed`).  Rejects only requests that
+        can NEVER run: worst case beyond the per-sequence page budget.
+        Rejection is deterministic and terminal — the request carries a
+        typed verdict BEFORE the raise, reserves nothing, and is never
+        requeued (a never-fit request at the queue head would deadlock
+        FIFO admission forever)."""
+        req = Request(self._next_rid, prompt, max_new, deadline_s)
         self._next_rid += 1
-        worst = req.prompt.size + req.max_new
+        err = self.feasibility_error(req.prompt.size, req.max_new)
+        if err is not None:
+            self._reject(req, err)
+        self._queue.append(req)
+        return req
+
+    def feasibility_error(self, prompt_size, max_new):
+        """Why a (prompt_size, max_new) request can NEVER run here, or
+        None when it can.  The one home of the infeasibility rules —
+        the engine consults it BEFORE its shed/drain branches so an
+        impossible request always gets the terminal ValueError, never a
+        retryable-looking refusal."""
+        worst = int(prompt_size) + int(max_new)
         if worst > self.max_seq_len:
-            req.state = REJECTED
-            raise ValueError(
-                "request needs %d tokens (prompt %d + max_new %d) but "
-                "the engine serves at most %d per sequence"
-                % (worst, req.prompt.size, req.max_new,
-                   self.max_seq_len))
+            return ("request needs %d tokens (prompt %d + max_new %d) "
+                    "but the engine serves at most %d per sequence"
+                    % (worst, prompt_size, max_new, self.max_seq_len))
         need = self.alloc.pages_for(worst)
         if need > self.alloc.num_pages - 1:
             # admission could never reserve this many pages even with
             # the pool idle — queueing it would deadlock the queue head
-            req.state = REJECTED
-            raise ValueError(
-                "request needs %d KV pages but the pool only has %d "
-                "usable — enlarge num_pages or lower max_new"
-                % (need, self.alloc.num_pages - 1))
-        self._queue.append(req)
+            return ("request needs %d KV pages but the pool only has "
+                    "%d usable — enlarge num_pages or lower max_new"
+                    % (need, self.alloc.num_pages - 1))
+        return None
+
+    def _reject(self, req, msg):
+        """Terminal infeasible-rejection: typed verdict, no reservation,
+        no requeue — then the (compat-kept) ValueError."""
+        req.state = REJECTED
+        req.verdict = VERDICT_REJECTED
+        req.error = msg
+        req.finish_t = time.perf_counter()
+        raise ValueError(msg)
+
+    def shed(self, prompt, max_new, verdict=VERDICT_SHED, error=None):
+        """Refuse a request up front with a typed verdict (SLO shed /
+        draining replica): the handle comes back terminal — state SHED,
+        never queued, nothing reserved — so an overloaded replica fails
+        fast instead of queuing unboundedly."""
+        req = Request(self._next_rid, prompt, max_new)
+        self._next_rid += 1
+        req.state = SHED
+        req.verdict = verdict
+        req.error = error
+        req.finish_t = time.perf_counter()
         return req
+
+    # -- deadlines ---------------------------------------------------------
+    def expire_queued(self, now=None):
+        """Drop queued requests whose deadline has passed (verdict
+        ``expired_queue``) and return them.  They hold no slot and no
+        pages, so expiry is pure bookkeeping — FIFO order of the
+        survivors is preserved."""
+        if now is None:
+            now = time.perf_counter()
+        if not any(r.deadline_t is not None and now > r.deadline_t
+                   for r in self._queue):
+            return []
+        expired, keep = [], collections.deque()
+        for req in self._queue:
+            if req.deadline_t is not None and now > req.deadline_t:
+                req.state = EXPIRED
+                req.verdict = VERDICT_EXPIRED_QUEUE
+                req.error = ("deadline %.3fs passed after %.3fs in queue"
+                             % (req.deadline_s, now - req.submit_t))
+                req.finish_t = now
+                expired.append(req)
+            else:
+                keep.append(req)
+        self._queue = keep
+        return expired
+
+    def expired_running(self, now=None):
+        """Residents whose deadline has passed — the engine finishes
+        them (releasing slot + pages) before the next decode dispatch,
+        so an expired request never consumes another token's FLOPs."""
+        if now is None:
+            now = time.perf_counter()
+        return [r for r in self._slots
+                if r is not None and r.deadline_t is not None
+                and now > r.deadline_t]
+
+    @property
+    def oldest_queue_wait(self):
+        """Seconds the queue head has waited (None when empty) — the
+        SLO controller's forward-looking overload signal: the admission-
+        time p99 only updates when something IS admitted, but a wedged
+        queue head means new intake is already doomed to violate."""
+        if not self._queue:
+            return None
+        return time.perf_counter() - self._queue[0].submit_t
 
     # -- placement ---------------------------------------------------------
     def admit(self):
@@ -159,14 +273,22 @@ class ContinuousBatchingScheduler:
             placed.append(head)
         return placed
 
-    def finish(self, req, state=FINISHED):
-        """Release a request's slot + pages (leave-between-steps)."""
+    def finish(self, req, state=FINISHED, verdict=None, error=None):
+        """Release a request's slot + pages (leave-between-steps) and
+        stamp its typed verdict.  EVERY resident exit routes through
+        here — completion, deadline expiry, prefill failure — so pages
+        can never leak on a failure path (assert_conservation pins
+        it)."""
         assert self._slots[req.slot] is req
         self._slots[req.slot] = None
         self.block_tables[req.slot, :] = SCRATCH_PAGE
         self.alloc.release(req.pages)
         req.pages = None
         req.state = state
+        req.verdict = verdict or (VERDICT_COMPLETED if state == FINISHED
+                                  else state)
+        if error is not None:
+            req.error = error
         req.finish_t = time.perf_counter()
 
     def _free_slot(self):

@@ -1,0 +1,150 @@
+"""SLO-aware admission control: shed load instead of queuing unboundedly.
+
+An overloaded replica that keeps queuing converts overload into
+unbounded latency for EVERYONE — every queued request waits behind the
+backlog, the queue-wait p99 runs away, and by the time a request reaches
+a slot its caller has long timed out.  The serving fix is classic
+admission control: once the observed queue-wait p99 breaches the SLO
+target, REFUSE new intake with a typed verdict (state ``shed``) so
+callers fail fast and retry against another replica (the router) or
+back off — residents and the already-accepted queue are untouched.
+
+Mechanics (ISSUE 11):
+
+- the signal is the same queue-wait the ``serving.queue_wait`` histogram
+  records (the engine feeds both from one admission stamp), held here in
+  a bounded sliding WINDOW so the controller tracks current load, not
+  the run's whole history — a cumulative histogram's p99 would take
+  minutes to notice recovery;
+- a forward-looking term: the queue HEAD's current wait.  The
+  admission-time p99 only updates when something is admitted; a wedged
+  queue means new intake is already doomed, and that must engage the
+  shed even though nothing new has been admitted to observe;
+- **hysteresis**: engage at ``p99 > target``, release only when the
+  windowed p99 (and the head wait) fall below ``release_frac × target``
+  — a controller flapping at the threshold would shed and admit in
+  alternating bursts, the worst of both.
+
+Pure host-side control (numpy-free even); the engine owns the wiring:
+``ServingEngine(slo=SLOController(...))`` or the env knobs
+``MXTPU_SERVE_SLO_P99_S`` / ``MXTPU_SERVE_SLO_RELEASE`` /
+``MXTPU_SERVE_SLO_WINDOW_S`` (SERVING.md §8).  Telemetry:
+``serving.shed`` counter (engine-side), ``serving.shed_active`` /
+``serving.queue_wait_p99`` gauges (here).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import time
+
+from .. import telemetry as _telemetry
+
+__all__ = ["SLOController"]
+
+
+class SLOController:
+    """Hysteretic shed decision over a sliding window of queue waits.
+
+    ``target_p99_s``: the SLO — shed engages when the windowed
+    queue-wait p99 (or the current queue-head wait) exceeds it.
+    ``release_frac``: shed releases only when both signals drop below
+    ``release_frac * target_p99_s`` (default 0.5).
+    ``window_s``: how much admission history the p99 covers.
+    ``min_samples``: don't trust a p99 of fewer observations (the head-
+    wait term still engages on a genuinely wedged queue).
+    """
+
+    def __init__(self, target_p99_s, release_frac=0.5, window_s=10.0,
+                 min_samples=5):
+        self.target_p99_s = float(target_p99_s)
+        if self.target_p99_s <= 0:
+            raise ValueError("target_p99_s must be > 0")
+        self.release_frac = float(release_frac)
+        if not 0.0 < self.release_frac <= 1.0:
+            raise ValueError("release_frac must be in (0, 1]")
+        self.window_s = float(window_s)
+        self.min_samples = int(min_samples)
+        self._samples = collections.deque()   # (t, wait_s)
+        self._shedding = False
+        self.sheds = 0         # engagement transitions (not per-request)
+
+    @classmethod
+    def from_env(cls):
+        """Build from MXTPU_SERVE_SLO_P99_S (unset/<=0 → None: shedding
+        off — the pre-ISSUE-11 queue-forever behavior is the default)."""
+        try:
+            target = float(os.environ.get("MXTPU_SERVE_SLO_P99_S", "0"))
+        except ValueError:
+            target = 0.0
+        if target <= 0:
+            return None
+        kw = {}
+        try:
+            kw["release_frac"] = float(
+                os.environ.get("MXTPU_SERVE_SLO_RELEASE", "0.5"))
+        except ValueError:
+            pass
+        try:
+            kw["window_s"] = float(
+                os.environ.get("MXTPU_SERVE_SLO_WINDOW_S", "10"))
+        except ValueError:
+            pass
+        return cls(target, **kw)
+
+    # -- signal intake -------------------------------------------------------
+    def observe(self, wait_s, now=None):
+        """One admission's queue wait (the engine calls this exactly
+        where it feeds the ``serving.queue_wait`` histogram)."""
+        if wait_s is None:
+            return
+        if now is None:
+            now = time.perf_counter()
+        self._samples.append((now, float(wait_s)))
+        self._evict(now)
+
+    def _evict(self, now):
+        cutoff = now - self.window_s
+        q = self._samples
+        while q and q[0][0] < cutoff:
+            q.popleft()
+
+    def windowed_p99(self, now=None):
+        """p99 of the queue waits observed inside the window (0.0 when
+        empty — an idle replica is trivially inside its SLO)."""
+        if now is None:
+            now = time.perf_counter()
+        self._evict(now)
+        if not self._samples:
+            return 0.0
+        waits = sorted(w for _, w in self._samples)
+        return waits[min(len(waits) - 1, int(0.99 * (len(waits) - 1) + 0.999999))]
+
+    # -- the decision --------------------------------------------------------
+    def should_shed(self, oldest_wait_s=None, now=None):
+        """Shed new intake right now?  Hysteretic (see class doc); the
+        transition into shedding bumps ``serving.shed_active`` and is
+        counted on ``self.sheds``."""
+        if now is None:
+            now = time.perf_counter()
+        p99 = self.windowed_p99(now)
+        head = oldest_wait_s or 0.0
+        enough = len(self._samples) >= self.min_samples
+        if not self._shedding:
+            if (enough and p99 > self.target_p99_s) or \
+                    head > self.target_p99_s:
+                self._shedding = True
+                self.sheds += 1
+        else:
+            release = self.release_frac * self.target_p99_s
+            if p99 <= release and head <= release:
+                self._shedding = False
+        _telemetry.gauge("serving.shed_active").set(
+            1 if self._shedding else 0)
+        _telemetry.gauge("serving.queue_wait_p99").set(p99)
+        return self._shedding
+
+    @property
+    def shedding(self):
+        """Current state without re-evaluating (telemetry/health)."""
+        return self._shedding

@@ -724,6 +724,19 @@ def dump_postmortem(reason, path=None):
         doc["membership"] = _elastic.snapshot()
     except Exception:
         pass  # interpreter teardown
+    try:
+        # serving context (ISSUE 11): a dying/stalled REPLICA's record
+        # must say what it was serving — resident slots, queue depth,
+        # page accounting.  sys.modules-gated: a training process that
+        # never imported the serving stack must not start importing the
+        # jax-adjacent engine module mid-crash.
+        eng_mod = sys.modules.get("mxnet_tpu.serving.engine")
+        if eng_mod is not None:
+            snaps = eng_mod.live_snapshot()
+            if snaps:
+                doc["serving"] = snaps
+    except Exception:
+        pass  # the postmortem must never fail on a half-dead engine
     # the plain writer: a ckpt.write.* fault armed for the checkpoint
     # layer must not fire here and tear the record of the crash itself
     from .checkpoint import _plain_atomic_write

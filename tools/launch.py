@@ -66,13 +66,16 @@ import sys
 import tempfile
 import time
 
-# exit-code contract with mxnet_tpu/watchdog.py and mxnet_tpu/fault.py
-# (kept literal here: the launcher must work without the package
-# importable on this host)
+# exit-code contract with mxnet_tpu/watchdog.py, mxnet_tpu/fault.py and
+# mxnet_tpu/serving/replica.py (kept literal here: the launcher must
+# work without the package importable on this host)
 STALL_EXIT = 75         # EX_TEMPFAIL: watchdog stall — retryable
 PORT_IN_USE_EXIT = 76   # coordinator port bind failure — retryable
 WORKER_LOST_EXIT = 77   # worker.lost fault site: simulated permanent
                         # rank death — retryable; elastic mode evicts
+SERVE_DRAIN_EXIT = 80   # graceful serving-replica drain — CLEAN: never
+                        # blamed toward eviction; the restart spins an
+                        # AOT-warm replacement (journaled drain/replace)
 
 
 class _Membership:
@@ -431,8 +434,8 @@ def _run_local_once(args, cmd, attempt, mem, prev_world=None):
 
 
 def classify_exit(rc):
-    """Classify a failed worker's exit code → ('retryable'|'permanent',
-    reason).
+    """Classify a failed worker's exit code →
+    ('retryable'|'permanent'|'clean', reason).
 
     Restart attempts are a scarce budget; burning one on a failure that
     will repeat identically (CLI misuse exit 2, unresolvable/unrunnable
@@ -448,7 +451,13 @@ def classify_exit(rc):
     (mxnet_tpu/watchdog.py): 75 (EX_TEMPFAIL) is a diagnosed stall —
     the worker's watchdog dumped stacks + postmortem and self-terminated,
     or this launcher declared heartbeat silence; 76 is a coordinator
-    port bind failure — a restart with ``--port 0`` picks a fresh port."""
+    port bind failure — a restart with ``--port 0`` picks a fresh port.
+
+    One CLEAN class: 80 is a graceful serving-replica drain
+    (mxnet_tpu/serving/replica.py EXIT_SERVE_DRAIN) — planned, never
+    blamed toward elastic eviction; the restart loop journals it as
+    drain/replace transitions and spins the replacement without
+    backoff."""
     if rc < 0:
         return "retryable", "killed by signal %d" % (-rc)
     if rc == STALL_EXIT:
@@ -462,6 +471,11 @@ def classify_exit(rc):
         return "retryable", ("exit code 77: worker lost (fault site "
                              "worker.lost — simulated permanent rank "
                              "death; --elastic evicts repeat offenders)")
+    if rc == SERVE_DRAIN_EXIT:
+        return "clean", ("exit code 80: graceful serving drain — the "
+                         "replica finished its residents and released "
+                         "its pages; never blamed toward eviction, the "
+                         "restart spins an AOT-warm replacement")
     if rc == 2:
         return "permanent", ("exit code 2: usage/import-time error — "
                              "would fail identically on every attempt")
@@ -499,6 +513,31 @@ def _restart_loop(args, run_once, cmd):
             mem.record(attempt, "interrupted")
             return rc or 1
         kind, reason = classify_exit(rc)
+        if kind == "clean":
+            # graceful serving drain (exit 80): planned, never blamed —
+            # no failure note, no streak, no eviction, no backoff.  The
+            # journal records drain/replace DISTINCTLY from training
+            # failures; the next attempt is the replacement spin-up
+            # (AOT-warm via the shared --aot-cache-dir).
+            slot = mem.slot_of(failed_rank)
+            mem.record(attempt, "drain", slot=slot, rank=failed_rank,
+                       rc=rc, reason=reason)
+            print("launch.py: attempt %d (world size %d): worker rank "
+                  "%d (slot %d) drained gracefully (%s)"
+                  % (attempt, world, failed_rank, slot, reason),
+                  file=sys.stderr, flush=True)
+            if attempt == args.max_restarts:
+                # out of restart budget: the drain itself is a success
+                mem.record(attempt, "complete", rc=rc)
+                return 0
+            mem.record(attempt, "replace", slot=slot)
+            print("launch.py: spinning replacement for drained slot %d "
+                  "(attempt %d/%d; no backoff — a drain is planned, "
+                  "not a crash)" % (slot, attempt + 1,
+                                    args.max_restarts),
+                  file=sys.stderr, flush=True)
+            prev_world = world
+            continue
         slot = mem.note_failure(attempt, failed_rank, rc, kind, reason)
         print("launch.py: attempt %d (world size %d): worker rank %d "
               "(slot %d) failure classified %s (%s)"

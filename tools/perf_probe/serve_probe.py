@@ -27,7 +27,12 @@ batch occupancy.  Hard contracts asserted by ``BENCH_MODE=serve``
   engine is bit-equivalent to the dense forward);
 - warm replica spin-up (``measure_spinup``, restart_probe pattern: two
   subprocesses sharing one AOT cache dir) reaches its first token with
-  ZERO foreground serving-program compiles.
+  ZERO foreground serving-program compiles;
+- **degraded mode** (``run_degraded``, ISSUE 11): the same workload
+  through a 2-replica Router with one replica killed mid-probe
+  (``serve.replica.lost``) — zero dropped accepted requests, tokens
+  bit-identical to the unfaulted run, and the replacement replica
+  spawns AOT-warm (0 foreground compiles).
 
 Usage: JAX_PLATFORMS=cpu python tools/perf_probe/serve_probe.py
 Prints one JSON object.
@@ -221,6 +226,74 @@ def run_sequential(net, workload, t_pad=48):
     return out
 
 
+# -- degraded mode: kill a replica mid-probe (ISSUE 11) --------------------
+
+def run_degraded(net, workload, reference_tokens, num_slots=8,
+                 page_size=16, max_prefill_len=32, max_seq_len=48,
+                 kill_after_steps=3):
+    """The survivability contract under replica loss: a 2-replica
+    router serving the SAME workload, one replica killed mid-probe
+    (``serve.replica.lost``).  Hard contracts asserted by
+    ``BENCH_MODE=serve``:
+
+    - ZERO dropped accepted requests — every one completes exactly once;
+    - tokens bit-identical to the unfaulted continuous run (greedy
+      determinism survives the failover re-decode);
+    - the replacement replica spins up AOT-warm: 0 foreground compiles
+      (in-process memo / shared AOT cache tier).
+    """
+    from mxnet_tpu import fault, profiler
+    from mxnet_tpu.serving import Router, ServingEngine, ServingReplica
+
+    kw = dict(num_slots=num_slots, page_size=page_size,
+              max_prefill_len=max_prefill_len, max_seq_len=max_seq_len)
+    spawn_compiles = []
+
+    def spawn():
+        c0 = profiler.step_stats()["compile_count"]
+        rep = ServingReplica(ServingEngine(net, **kw),
+                             replica_id="replacement")
+        spawn_compiles.append(
+            profiler.step_stats()["compile_count"] - c0)
+        return rep
+
+    rt = Router([ServingReplica(ServingEngine(net, **kw),
+                                replica_id="a"),
+                 ServingReplica(ServingEngine(net, **kw),
+                                replica_id="b")],
+                spawn=spawn, max_retries=2)
+    t_start = time.perf_counter()
+    rrs = []
+    pending = list(workload)
+    steps = 0
+    killed = False
+    while pending or not rt.idle:
+        now = time.perf_counter() - t_start
+        while pending and pending[0][0] <= now:
+            _, prompt, max_new = pending.pop(0)
+            rrs.append(rt.submit(prompt, max_new))
+        if steps == kill_after_steps and not killed:
+            fault.configure("serve.replica.lost:1")
+            killed = True
+        if rt.step() == 0 and pending:
+            time.sleep(min(1e-4, max(0.0, pending[0][0] - now)))
+        steps += 1
+    fault.reset()
+    wall = time.perf_counter() - t_start
+    completed = [rr for rr in rrs if rr.state == "completed"]
+    tokens = [rr.tokens for rr in completed]
+    return {
+        "requests": len(rrs),
+        "completed": len(completed),
+        "dropped": len(rrs) - len(completed),
+        "failovers": rt.failovers,
+        "replacement_spawns": len(spawn_compiles),
+        "replacement_foreground_compiles": sum(spawn_compiles),
+        "tokens_match_unfaulted": tokens == reference_tokens,
+        "wall_s": round(wall, 4),
+    }
+
+
 # -- AOT-warm replica spin-up (restart_probe pattern) ----------------------
 
 def _spinup_child():
@@ -287,12 +360,13 @@ def measure_spinup():
     }
 
 
-def run(spinup=True):
+def run(spinup=True, degraded=True):
     net = build_net()
     workload = make_workload()
     cont = run_continuous(net, workload)
     seq = run_sequential(net, workload)
-    if cont.pop("tokens") != seq.pop("tokens"):
+    cont_tokens = cont.pop("tokens")
+    if cont_tokens != seq.pop("tokens"):
         raise AssertionError(
             "continuous and sequential servers emitted different greedy "
             "tokens for the same workload — the paged engine diverged "
@@ -303,6 +377,8 @@ def run(spinup=True):
         "speedup_tokens_per_sec": round(
             cont["tokens_per_sec"] / seq["tokens_per_sec"], 2),
     }
+    if degraded:
+        result["degraded"] = run_degraded(net, workload, cont_tokens)
     if spinup:
         result["spinup"] = measure_spinup()
     return result
