@@ -55,6 +55,7 @@ from . import module
 from . import module as mod
 from .module import Module
 from . import recordio
+from . import stream
 from . import image
 from . import rnn
 from . import profiler

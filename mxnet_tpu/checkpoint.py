@@ -544,7 +544,8 @@ class CheckpointManager:
 
     # -- saving ------------------------------------------------------------
     def save(self, epoch, arg_params, aux_params, symbol=None,
-             optimizer_states=None, mode=None, sharding=None):
+             optimizer_states=None, mode=None, sharding=None,
+             stream_cursor=None):
         """Write one complete checkpoint; the manifest is committed last,
         so a crash anywhere earlier leaves the previous checkpoint as the
         newest *complete* one.
@@ -560,7 +561,15 @@ class CheckpointManager:
         resume knows the layout that produced the checkpoint; the
         PAYLOAD is always written gathered/full-size (ZeRO-1 state is
         all-gathered by the host fetch), which is what lets an elastic
-        restart reshard it onto ANY world size at load."""
+        restart reshard it onto ANY world size at load.
+
+        ``stream_cursor``: optional JSON-able stamp of THIS RANK's
+        streaming-input position (``StreamLoader.cursor()``) at the
+        moment of the snapshot — recorded in the manifest so a resumed
+        job knows where its data stream stood when these weights were
+        taken (world-agnostic on load like the membership stamp; the
+        multi-rank consistent snapshot lives in
+        ``stream.CursorStore``, DATA.md "Cursors")."""
         if mode is None:
             mode = "async" if async_enabled() else "sync"
         with _telemetry.span("ckpt.save", cat="checkpoint"):
@@ -571,26 +580,29 @@ class CheckpointManager:
                 # latest() a reordered history
                 flush_async()
                 return self._save(epoch, arg_params, aux_params, symbol,
-                                  optimizer_states, sharding)
+                                  optimizer_states, sharding,
+                                  stream_cursor)
             _telemetry.counter("ckpt.async_saves").inc()
             snap = self._snapshot(epoch, arg_params, aux_params, symbol,
                                   optimizer_states, own=True,
-                                  sharding=sharding)
+                                  sharding=sharding,
+                                  stream_cursor=stream_cursor)
             _async_submit(
                 "ckpt save %s epoch %d" % (self.prefix, int(epoch)),
                 functools.partial(self._write_snapshot, *snap))
             return None
 
     def _save(self, epoch, arg_params, aux_params, symbol,
-              optimizer_states, sharding=None):
+              optimizer_states, sharding=None, stream_cursor=None):
         """The one-call sync body (save() routes sync mode through here,
         so a subclass hook still sees every inline write)."""
         return self._write_snapshot(*self._snapshot(
             epoch, arg_params, aux_params, symbol, optimizer_states,
-            sharding=sharding))
+            sharding=sharding, stream_cursor=stream_cursor))
 
     def _snapshot(self, epoch, arg_params, aux_params, symbol,
-                  optimizer_states, own=False, sharding=None):
+                  optimizer_states, own=False, sharding=None,
+                  stream_cursor=None):
         """Host-side materialization of one checkpoint: everything the
         write phase needs, detached from the device.  With ``own`` the
         arrays are forced to own their memory — the async queue outlives
@@ -619,10 +631,10 @@ class CheckpointManager:
                 arrays = [_own_host_record(a) for a in arrays]
             sym_json = symbol.tojson() if symbol is not None else None
         return (epoch, arrays, names, optimizer_states, sym_json,
-                sharding)
+                sharding, stream_cursor)
 
     def _write_snapshot(self, epoch, arrays, names, optimizer_states,
-                        sym_json, sharding=None):
+                        sym_json, sharding=None, stream_cursor=None):
         """The write phase: serialization + atomic publishes + manifest
         commit (+ retention).  Runs on the caller (sync) or the writer
         thread (async) — same code, same fault sites, same telemetry."""
@@ -672,6 +684,11 @@ class CheckpointManager:
             # payloads are gathered on disk, so this is metadata for the
             # resume path's reshard decision, never a load precondition
             manifest["sharding"] = sharding
+        if stream_cursor is not None:
+            # this rank's streaming-input position at snapshot time
+            # (StreamLoader.cursor()); optional metadata like the keys
+            # above — readers must tolerate its absence
+            manifest["stream_cursor"] = stream_cursor
         atomic_write(self.manifest_path(epoch),
                      json.dumps(manifest, indent=1).encode("utf-8"),
                      retries=self._retries, backoff=self._backoff)

@@ -72,6 +72,19 @@ Sites wired in this package:
                           tree (NaN) — the finite-logits canary decode
                           must catch it and roll the replica back to
                           its prior weights.
+- ``io.shard.torn``       one stream decode task reads as a torn shard
+                          tail (crashed-writer truncation stand-in):
+                          the StreamLoader skips-and-counts it
+                          (``io.torn_records``) and serves on.
+- ``io.decode.error``     raise inside a stream decode worker
+                          (exercises the worker-traceback-preserving
+                          re-raise at the consumption point).
+- ``io.decode.slow``      bounded per-task delay in the decode worker
+                          (``MXTPU_FAULT_DELAY_SECS``): the INPUT
+                          flavor of the straggler — shows in
+                          ``io.queue_wait``/``data.prefetch_wait``,
+                          never in the step phases, and job_report's
+                          input-stall blame must name it.
 
 The ``*.slow`` DELAY sites are per-event and bounded (the run limps,
 correctly); the ``*.stall``/``kv.hang`` sites simulate HANGS — they

@@ -21,6 +21,8 @@ from collections import namedtuple
 
 import numpy as np
 
+from .base import MXNetError
+
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
 
@@ -29,12 +31,29 @@ _LEN_MASK = (1 << 29) - 1
 
 
 class MXRecordIO:
-    """Sequential .rec reader/writer (reference recordio.py:MXRecordIO)."""
+    """Sequential .rec reader/writer (reference recordio.py:MXRecordIO).
+
+    Reads validate the frame on every record: a bad magic or a record
+    that ends mid-header/mid-payload — the torn tail a crashed writer
+    leaves — raises :class:`MXNetError` naming the path and byte offset
+    instead of returning garbage (the stream layer's skip-and-count
+    policy sits on top of exactly this error,
+    mxnet_tpu/stream/loader.py).  Only a clean EOF at a record boundary
+    returns ``None``.
+
+    Teardown is defensive: ``close`` is idempotent and safe on a
+    half-constructed instance (``open`` raised) and at interpreter
+    shutdown.  Readers pickle (decode worker processes ship them; the
+    reopened copy seeks back to the pickled position); pickling an OPEN
+    WRITER refuses loudly — ``__setstate__``'s reopen would truncate
+    the file it is mid-writing.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self.is_open = False
         self.open()
 
     def open(self):
@@ -49,9 +68,14 @@ class MXRecordIO:
         self.is_open = True
 
     def close(self):
-        if self.is_open:
-            self.handle.close()
+        """Idempotent; never assumes construction finished (``__del__``
+        runs even when ``open()`` raised, and interpreter shutdown may
+        have torn half the module away)."""
+        if getattr(self, "is_open", False):
             self.is_open = False
+            handle = getattr(self, "handle", None)
+            if handle is not None:
+                handle.close()
 
     def __del__(self):
         try:
@@ -60,6 +84,15 @@ class MXRecordIO:
             pass
 
     def __getstate__(self):
+        if getattr(self, "writable", False):
+            # open OR closed: __setstate__ reopens with the original
+            # flag, and mode "w" TRUNCATES — unpickling a closed
+            # writer would zero the completed shard it just wrote
+            raise MXNetError(
+                "refusing to pickle the WRITER MXRecordIO(%s): "
+                "__setstate__ reopens with mode 'w', truncating the "
+                "file — ship the path and reopen for read instead"
+                % self.uri)
         d = dict(self.__dict__)
         d["handle"] = None
         d["_pos"] = self.handle.tell() if self.is_open else 0
@@ -67,6 +100,11 @@ class MXRecordIO:
         return d
 
     def __setstate__(self, d):
+        if d.get("writable"):
+            raise MXNetError(
+                "refusing to unpickle a WRITER MXRecordIO(%s): "
+                "reopening with mode 'w' would truncate the file"
+                % d.get("uri"))
         pos = d.pop("_pos", 0)
         self.__dict__.update(d)
         self.open()
@@ -87,17 +125,33 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        offset = self.handle.tell()
         head = self.handle.read(8)
+        if not head:
+            return None  # clean EOF at a record boundary
         if len(head) < 8:
-            return None
+            raise MXNetError(
+                "truncated record header in %s at offset %d (%d of 8 "
+                "bytes) — torn tail from a crashed writer?"
+                % (self.uri, offset, len(head)))
         magic, lrec = struct.unpack("<II", head)
         if magic != _MAGIC:
-            raise IOError("Invalid magic number in record file %s"
-                          % self.uri)
+            raise MXNetError(
+                "invalid record magic 0x%08x in %s at offset %d "
+                "(corrupt file or mid-record seek)"
+                % (magic, self.uri, offset))
         length = lrec & _LEN_MASK
         buf = self.handle.read(length)
+        if len(buf) < length:
+            raise MXNetError(
+                "truncated record payload in %s at offset %d (%d of %d "
+                "bytes) — torn tail from a crashed writer?"
+                % (self.uri, offset, len(buf), length))
         pad = (-length) % 4
         if pad:
+            # a missing pad means the writer died AFTER the payload:
+            # the record itself is whole, so return it — the next read
+            # hits the truncated frame and raises there
             self.handle.read(pad)
         return buf
 
@@ -132,10 +186,20 @@ class MXIndexedRecordIO(MXRecordIO):
         self.fidx = open(self.idx_path, "w") if self.writable else None
 
     def close(self):
-        if self.is_open and self.fidx is not None:
-            self.fidx.close()
+        # getattr-guarded like the base close: __del__ may run on a
+        # half-constructed instance, double-close must be a no-op
+        fidx = getattr(self, "fidx", None)
+        if getattr(self, "is_open", False) and fidx is not None:
+            fidx.close()
             self.fidx = None
         super().close()
+
+    def __getstate__(self):
+        # the .idx sidecar handle never pickles: readers reload the idx
+        # in __setstate__→open(); writers already refuse in the base
+        d = super().__getstate__()
+        d["fidx"] = None
+        return d
 
     def seek(self, idx):
         assert not self.writable

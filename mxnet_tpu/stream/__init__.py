@@ -1,0 +1,29 @@
+"""Streaming data plane: sharded ingest for real, growing, on-disk data.
+
+Three layers (DATA.md is the user contract):
+
+- :mod:`manifest` — the shard-set manifest format: an append-aware,
+  atomically-published list of RecordIO/JSONL shards with committed
+  record counts and content digests (``ShardSetWriter`` publishes,
+  ``load_shard_set``/``discover`` read, ``seal()`` ends a stream).
+- :mod:`assignment` — the exact-once (shard, offset)-range laws
+  extending ``elastic.shard_for_epoch`` to disk streams: epoch-mode
+  contiguous position cuts, follow-mode per-shard partitions, and the
+  world-agnostic cursor-resume algebra (``CursorStore`` persists one
+  consistent cursor snapshot per checkpoint generation).
+- :mod:`loader` — ``StreamLoader``: a background decode worker pool
+  feeding the PR-1 ``DataLoader`` prefetcher unchanged, with io.*
+  telemetry, torn-tail skip-and-count, and the ``io.shard.torn`` /
+  ``io.decode.error`` / ``io.decode.slow`` fault sites.
+"""
+from . import assignment
+from . import manifest
+from .assignment import (CursorStore, follow_resume, ranges_for_epoch,
+                         resume_spans, span_for_rank)
+from .manifest import ShardSet, ShardSetWriter, discover, load_shard_set
+from .loader import StreamLoader
+
+__all__ = ["assignment", "manifest", "CursorStore", "follow_resume",
+           "ranges_for_epoch", "resume_spans", "span_for_rank",
+           "ShardSet", "ShardSetWriter", "discover", "load_shard_set",
+           "StreamLoader"]

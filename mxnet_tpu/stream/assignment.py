@@ -1,0 +1,331 @@
+"""Elastic-aware shard assignment: the exact-once laws on (shard, offset).
+
+``elastic.shard_for_epoch`` (PR 6) states the resume law at sample
+granularity: one epoch permutation seeded by ``(seed, epoch)`` ONLY —
+never by the world size — cut contiguously by rank, so the union over
+ranks is every sample exactly once at ANY world size.  Streaming from
+disk needs the same law expressed over **(shard, offset) ranges** so a
+rank reads contiguous runs of records (sequential I/O) instead of a
+scattered index set:
+
+- **Position space.**  An epoch over shards of sizes ``[n_0..n_k]``
+  orders the shards by the epoch permutation (same RNG law as
+  ``shard_for_epoch``, applied to shard indices) and concatenates them:
+  global position ``p`` ∈ [0, N) maps to one (shard, offset).  Records
+  stay sequential *within* a shard — the permutation shuffles at shard
+  granularity, which is what keeps reads contiguous.
+- **The cut.**  Rank ``r`` of ``world`` owns the contiguous position
+  span given by the same base/extra law ``shard_for_epoch`` uses.
+  Degenerate case: when every shard holds ONE record, position space
+  *is* the PR-6 sample permutation and the ranges reduce to exactly
+  ``shard_for_epoch``'s indices (test-pinned).
+- **Cursors.**  A rank's progress is "consumed ``k`` records of my span
+  concatenation" plus the spans themselves (so cursor-derived
+  assignments compose through repeated reshards).  Resuming at ANY new
+  world size: every old rank consumed a *prefix* of its spans, so the
+  remaining work is a union of position spans; sort them, cut the
+  remainder contiguously for the new world — still exactly once.
+
+All functions are pure (no env, no I/O) except for the ``seed`` default
+(``MXTPU_DATA_SEED``, matching ``shard_for_epoch``); ``CursorStore`` is
+the small persistence layer the continual-training loop stamps next to
+its checkpoints (DATA.md "Cursors").
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["shard_order", "span_for_rank", "spans_to_ranges",
+           "ranges_for_epoch", "slice_spans", "resume_spans",
+           "follow_spans", "follow_resume", "CursorStore"]
+
+CURSOR_SCHEMA = "mxtpu-stream-cursor-1"
+
+
+def _default_seed(seed):
+    if seed is not None:
+        return int(seed)
+    try:
+        return int(os.environ.get("MXTPU_DATA_SEED", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def shard_order(num_shards, epoch, seed=None):
+    """The epoch's shard permutation — the exact RNG law of
+    ``elastic.shard_for_epoch`` applied to shard indices, so the
+    one-record-per-shard degenerate case reproduces PR 6 bit-for-bit."""
+    seed = _default_seed(seed)
+    return _np.random.RandomState(
+        (seed * 1_000_003 + int(epoch)) % (2 ** 32)).permutation(
+            int(num_shards))
+
+
+def span_for_rank(total, rank, world_size):
+    """Rank ``rank``'s contiguous position span ``(lo, hi)`` of a
+    ``total``-record space under the base/extra remainder law (lowest
+    ranks absorb the remainder, uneven by at most one)."""
+    world_size = int(world_size)
+    rank = int(rank)
+    if world_size < 1:
+        raise ValueError("world_size must be >= 1, got %d" % world_size)
+    if not 0 <= rank < world_size:
+        raise ValueError("rank %d outside world of %d"
+                         % (rank, world_size))
+    base, extra = divmod(int(total), world_size)
+    lo = rank * base + min(rank, extra)
+    return lo, lo + base + (1 if rank < extra else 0)
+
+
+def spans_to_ranges(sizes, epoch, spans, seed=None):
+    """Map position spans into ``(shard, start, stop)`` read ranges via
+    the epoch's shard order.  Ranges come back in position order (the
+    deterministic delivery order every rank agrees on)."""
+    order = shard_order(len(sizes), epoch, seed)
+    bounds = [0]
+    for s in order:
+        bounds.append(bounds[-1] + int(sizes[int(s)]))
+    out = []
+    for lo, hi in spans:
+        lo, hi = int(lo), int(hi)
+        if hi > bounds[-1]:
+            raise MXNetError(
+                "span (%d, %d) exceeds the epoch's %d records"
+                % (lo, hi, bounds[-1]))
+        for k, shard in enumerate(order):
+            beg, end = bounds[k], bounds[k + 1]
+            if end <= lo:
+                continue
+            if beg >= hi:
+                break
+            out.append((int(shard), max(lo, beg) - beg,
+                        min(hi, end) - beg))
+    return out
+
+
+def ranges_for_epoch(sizes, epoch, rank=None, world_size=None, seed=None):
+    """One rank's read ranges for a fresh epoch: the (shard, offset)
+    form of ``elastic.shard_for_epoch``.  ``rank``/``world_size``
+    default to the current elastic membership."""
+    if rank is None or world_size is None:
+        from .. import elastic as _elastic
+        mem = _elastic.membership()
+        rank = mem["rank"] if rank is None else rank
+        world_size = mem["world_size"] if world_size is None \
+            else world_size
+    lo, hi = span_for_rank(sum(int(n) for n in sizes), rank, world_size)
+    return spans_to_ranges(sizes, epoch, [(lo, hi)], seed)
+
+
+def slice_spans(spans, lo, hi):
+    """The [lo, hi) slice of a span list's *concatenation*, as spans.
+    (Cutting a remainder set for a new rank.)"""
+    out = []
+    pos = 0
+    for a, b in spans:
+        n = b - a
+        s, e = max(lo, pos), min(hi, pos + n)
+        if s < e:
+            out.append((a + (s - pos), a + (e - pos)))
+        pos += n
+    return out
+
+
+def _remaining(cursor):
+    """The un-consumed suffix of one cursor's span concatenation."""
+    spans = [(int(a), int(b)) for a, b in cursor["spans"]]
+    total = sum(b - a for a, b in spans)
+    consumed = int(cursor["consumed"])
+    if not 0 <= consumed <= total:
+        raise MXNetError(
+            "cursor consumed %d outside its %d-record assignment"
+            % (consumed, total))
+    return slice_spans(spans, consumed, total)
+
+
+def _check_cursor_set(cursors):
+    if not cursors:
+        raise MXNetError("empty cursor set")
+    worlds = {int(c["world_size"]) for c in cursors}
+    if len(worlds) != 1:
+        raise MXNetError(
+            "cursor set spans multiple world sizes %s — not one "
+            "consistent snapshot" % sorted(worlds))
+    w = worlds.pop()
+    ranks = sorted(int(c["rank"]) for c in cursors)
+    if ranks != list(range(w)):
+        raise MXNetError(
+            "cursor set is incomplete: have ranks %s of world %d"
+            % (ranks, w))
+
+
+def resume_spans(cursors, rank, world_size):
+    """Epoch-mode reshard: given ONE consistent cursor per old rank
+    (each a prefix-consumed span assignment), the new ``rank``'s spans
+    over the remaining records at the new ``world_size``.  The union
+    over new ranks is exactly the un-consumed set — exact-once coverage
+    survives the world change."""
+    _check_cursor_set(cursors)
+    rem = []
+    for c in sorted(cursors, key=lambda c: int(c["rank"])):
+        rem.extend(_remaining(c))
+    rem.sort()
+    total = sum(b - a for a, b in rem)
+    lo, hi = span_for_rank(total, rank, world_size)
+    return slice_spans(rem, lo, hi)
+
+
+# -- follow mode (continual streams) ----------------------------------------
+#
+# A continual stream has no epoch: shards are consumed once, in
+# publication order, each partitioned across the current world by
+# span_for_rank over its own records (identity order within the shard —
+# there is nothing to shuffle in a stream you see once).  A cursor is
+# (shard index, consumed-within-shard) plus an ``assigned`` override map
+# for shards whose spans came from an earlier reshard rather than the
+# fresh law — which is what makes reshards compose.
+
+def follow_spans(n_records, rank, world_size):
+    """Fresh-law spans of one stream shard for ``rank``: the contiguous
+    cut, identity order."""
+    lo, hi = span_for_rank(n_records, rank, world_size)
+    return [(lo, hi)] if hi > lo else []
+
+
+def _old_spans(cursor, shard_idx, sizes):
+    """The spans OLD rank ``cursor`` owned in ``shard_idx``: its
+    override when one exists, else the fresh law at its world."""
+    assigned = cursor.get("assigned") or {}
+    key = str(int(shard_idx))
+    if key in assigned:
+        return [(int(a), int(b)) for a, b in assigned[key]]
+    return follow_spans(int(sizes[shard_idx]), int(cursor["rank"]),
+                        int(cursor["world_size"]))
+
+
+def follow_resume(cursors, sizes, rank, world_size):
+    """Follow-mode reshard: from one consistent cursor per old rank,
+    compute the new ``rank``'s ``(start_shard, assigned)`` where
+    ``assigned`` maps shard index → position spans for every shard any
+    old rank had started but not finished (later shards follow the
+    fresh law at the new world).  The union over new ranks of
+    (assigned ∪ fresh-law tail) is exactly every un-consumed record
+    once."""
+    _check_cursor_set(cursors)
+    n_shards = len(sizes)
+    starts = [min(int(c["shard"]), n_shards) for c in cursors]
+    lo_shard = min(starts)
+    hi_shard = max(starts)  # exclusive of fully-fresh shards beyond
+    assigned = {}
+    for s in range(lo_shard, min(hi_shard + 1, n_shards)):
+        rem = []
+        for c in cursors:
+            cs = int(c["shard"])
+            if cs > s:
+                continue  # old rank already finished its slice of s
+            if cs == s:
+                rem.extend(_remaining(c))
+            else:  # cs < s: started nothing of s — its whole slice remains
+                rem.extend(_old_spans(c, s, sizes))
+        rem.sort()
+        total = sum(b - a for a, b in rem)
+        lo, hi = span_for_rank(total, rank, world_size)
+        assigned[str(s)] = [list(p) for p in slice_spans(rem, lo, hi)]
+    return lo_shard, assigned
+
+
+# -- cursor persistence ------------------------------------------------------
+
+class CursorStore:
+    """Per-rank stream cursors, one atomic JSON per (generation, rank),
+    published next to the checkpoints they pair with.
+
+    The exact-once resume law needs ONE CONSISTENT SNAPSHOT of every
+    rank's position — so cursors are written in *generations* (the
+    training loop writes generation ``g`` on the same cadence/barrier
+    as checkpoint epoch ``g``), and ``load_latest()`` returns only the
+    newest generation for which EVERY rank of that generation's world
+    wrote its file.  A rank that died mid-generation leaves it
+    incomplete; resume falls back to the previous complete one, and the
+    records consumed after it are simply replayed — correct, because
+    the parameter state resumes from the paired checkpoint, discarding
+    those records' updates too.  World-agnostic on load, like the PR-6
+    v2 checkpoint manifests: the files record the world that wrote
+    them; any new world re-partitions from them.
+    """
+
+    _NAME = re.compile(r"^stream-cursor-g(\d+)-r(\d+)\.json$")
+
+    def __init__(self, directory):
+        self.dir = os.fspath(directory)
+
+    def path(self, generation, rank):
+        return os.path.join(self.dir, "stream-cursor-g%06d-r%03d.json"
+                            % (int(generation), int(rank)))
+
+    def save(self, generation, cursor):
+        """Atomically publish one rank's cursor for ``generation``.
+        ``cursor`` must carry ``rank``/``world_size`` (the loader's
+        ``cursor()`` does) — completeness of a generation is judged
+        against the world stamped inside it."""
+        from ..checkpoint import _plain_atomic_write
+        os.makedirs(self.dir, exist_ok=True)
+        doc = dict(cursor)
+        doc["schema"] = CURSOR_SCHEMA
+        doc["generation"] = int(generation)
+        doc["time"] = time.time()
+        _plain_atomic_write(
+            self.path(generation, cursor["rank"]),
+            json.dumps(doc, indent=1).encode("utf-8"))
+
+    def _scan(self):
+        out = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            m = self._NAME.match(name)
+            if m:
+                out.setdefault(int(m.group(1)), {})[int(m.group(2))] = \
+                    os.path.join(self.dir, name)
+        return out
+
+    def generations(self):
+        return sorted(self._scan())
+
+    def load(self, generation):
+        """Every cursor of one generation (rank-sorted), or None when
+        any file is missing/unreadable — half a snapshot is no
+        snapshot."""
+        by_rank = self._scan().get(int(generation), {})
+        cursors = []
+        for rank in sorted(by_rank):
+            try:
+                with open(by_rank[rank], "rb") as f:
+                    cursors.append(json.loads(f.read().decode("utf-8")))
+            except (OSError, ValueError):
+                return None
+        if not cursors:
+            return None
+        world = {int(c["world_size"]) for c in cursors}
+        if len(world) != 1 or sorted(int(c["rank"]) for c in cursors) \
+                != list(range(world.pop())):
+            return None  # incomplete or mixed-world generation
+        return cursors
+
+    def load_latest(self):
+        """``(generation, [cursors])`` of the newest COMPLETE
+        generation, or ``(None, None)``."""
+        for g in reversed(self.generations()):
+            cursors = self.load(g)
+            if cursors is not None:
+                return g, cursors
+        return None, None

@@ -1,0 +1,367 @@
+"""Shard-set manifests: the on-disk contract of the streaming data plane.
+
+A *shard set* is an ordered list of record shards (RecordIO ``.rec`` or
+JSONL) published under one JSON manifest (``shardset.json``, schema
+``mxtpu-shardset-1``).  The manifest — not the directory listing — is
+the unit of trust, exactly like the checkpoint layer's per-epoch
+manifests (ROBUSTNESS.md §1): a shard exists for readers only once its
+entry (record count, byte size, sha256) is committed, and the manifest
+itself is published atomically, so a torn or in-flight shard write is
+simply invisible.
+
+The manifest is **append-aware**: a live writer keeps publishing new
+shards mid-job (each publish bumps ``version`` and re-commits the whole
+document atomically), readers ``refresh()`` and see strictly more
+shards — existing entries are immutable by contract, enforced on
+reload.  ``seal()`` marks the stream finished (``closed: true``) so a
+follow-mode consumer knows "no new shards" is the end, not a lull.
+
+DATA.md documents the schema, sizing guidance, and the exact-once
+assignment laws layered on top (mxnet_tpu/stream/assignment.py).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import hashlib
+import json
+import os
+
+from ..base import MXNetError
+
+__all__ = ["SCHEMA", "ShardSet", "ShardSetWriter", "load_shard_set",
+           "discover", "count_records"]
+
+SCHEMA = "mxtpu-shardset-1"
+
+_FORMATS = ("recordio", "jsonl")
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def count_records(path, fmt):
+    """Walk one shard file and count complete records (the discovery
+    path for manifest-less shard files).  A torn tail stops the count at
+    the last complete record — discovery never claims records a reader
+    could not deliver."""
+    if fmt == "jsonl":
+        n = 0
+        with open(path, "rb") as f:
+            data = f.read()
+        for ln in data.split(b"\n"):
+            if ln.strip():
+                n += 1
+        if data and not data.endswith(b"\n"):
+            n -= 1  # unterminated final line: a torn tail, not a record
+        return max(0, n)
+    from .. import recordio as _recordio
+    reader = _recordio.MXRecordIO(path, "r")
+    n = 0
+    try:
+        while True:
+            try:
+                if reader.read() is None:
+                    break
+            except MXNetError:
+                break  # torn tail: count stops at the last whole record
+            n += 1
+    finally:
+        reader.close()
+    return n
+
+
+def _infer_format(path):
+    ext = os.path.splitext(path)[1].lower()
+    if ext in (".jsonl", ".json", ".txt"):
+        return "jsonl"
+    return "recordio"
+
+
+class ShardSet:
+    """Read-side view of one shard-set manifest (or of a globbed,
+    manifest-less set — see :func:`discover`).
+
+    - ``shards``: list of dicts ``{path (absolute), format,
+      num_records, bytes, sha256}`` in publication order.
+    - ``refresh()``: re-read the manifest; returns True when new shards
+      appeared.  Existing entries must be an unchanged prefix (the
+      append-only contract) — anything else raises, because a reader
+      holding (shard, offset) cursors into a *rewritten* history would
+      silently read the wrong records.
+    - ``closed``: the writer sealed the stream.
+    """
+
+    def __init__(self, manifest_path=None, shards=None, version=0,
+                 closed=False):
+        self.manifest_path = manifest_path
+        self.shards = list(shards or [])
+        self.version = version
+        self.closed = closed
+        self._stat = None
+        if manifest_path is not None:
+            self._load(initial=True)
+
+    @property
+    def sizes(self):
+        return [s["num_records"] for s in self.shards]
+
+    @property
+    def total_records(self):
+        return sum(self.sizes)
+
+    def _load(self, initial=False):
+        path = self.manifest_path
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+                # fstat the handle actually READ: a path-stat after the
+                # read can land past a concurrent os.replace and pin
+                # the NEW file's signature against the OLD content —
+                # refresh() would then no-op forever
+                st = os.fstat(f.fileno())
+            doc = json.loads(data.decode("utf-8"))
+        except OSError as e:
+            if initial:
+                raise MXNetError(
+                    "cannot read shard-set manifest %s: %s" % (path, e))
+            return False  # mid-publish race: keep the current view
+        except ValueError as e:
+            raise MXNetError(
+                "shard-set manifest %s is not valid JSON: %s" % (path, e))
+        if not str(doc.get("schema", "")).startswith("mxtpu-shardset-"):
+            raise MXNetError(
+                "%s is not a shard-set manifest (schema %r)"
+                % (path, doc.get("schema")))
+        root = os.path.dirname(os.path.abspath(path))
+        shards = []
+        for ent in doc.get("shards", []):
+            ent = dict(ent)
+            if not os.path.isabs(ent["path"]):
+                ent["path"] = os.path.join(root, ent["path"])
+            shards.append(ent)
+        if not initial:
+            # append-only contract: the committed history never mutates
+            old = [(s["path"], s["num_records"], s.get("sha256"))
+                   for s in self.shards]
+            new = [(s["path"], s["num_records"], s.get("sha256"))
+                   for s in shards[:len(old)]]
+            if new != old:
+                raise MXNetError(
+                    "shard-set manifest %s rewrote committed shard "
+                    "entries (append-only contract): cursors into the "
+                    "old history are meaningless" % path)
+        grew = len(shards) > len(self.shards)
+        self.shards = shards
+        self.version = int(doc.get("version", 0))
+        self.closed = bool(doc.get("closed", False))
+        self._stat = (st.st_size, st.st_mtime_ns, st.st_ino)
+        return grew
+
+    def refresh(self):
+        """Re-read the manifest if it changed on disk; True when new
+        shards were appended (the follow-mode wakeup signal)."""
+        if self.manifest_path is None:
+            return False
+        try:
+            st = os.stat(self.manifest_path)
+            sig = (st.st_size, st.st_mtime_ns, st.st_ino)
+        except OSError:
+            return False
+        if sig == self._stat:
+            return False
+        return self._load()
+
+    def validate(self, shard_index=None):
+        """Full sha256 verification of one shard (or all).  Not on the
+        read hot path — openers check byte size only; this is the audit
+        tool (and the test hook)."""
+        idx = range(len(self.shards)) if shard_index is None \
+            else [shard_index]
+        for i in idx:
+            ent = self.shards[i]
+            try:
+                if os.path.getsize(ent["path"]) != ent.get("bytes"):
+                    return False
+            except OSError:
+                return False
+            digest = ent.get("sha256")
+            if digest and _sha256_file(ent["path"]) != digest:
+                return False
+        return True
+
+
+def load_shard_set(path):
+    """Open a shard-set manifest (a file path, or a directory holding
+    ``shardset.json``)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "shardset.json")
+    return ShardSet(manifest_path=path)
+
+
+def discover(pattern, fmt=None):
+    """Build an in-memory ShardSet from a glob over manifest-less shard
+    files, sorted by name (record counts come from walking each file —
+    a torn tail counts up to the last whole record).  For one-off reads
+    of legacy .rec directories; real streams should publish a manifest
+    (the writer below) so counts/digests are committed, not re-derived."""
+    paths = sorted(_glob.glob(pattern))
+    if not paths:
+        raise MXNetError("shard glob %r matched no files" % pattern)
+    shards = []
+    for p in paths:
+        f = fmt or _infer_format(p)
+        shards.append({
+            "path": os.path.abspath(p), "format": f,
+            "num_records": count_records(p, f),
+            "bytes": os.path.getsize(p), "sha256": None,
+        })
+    return ShardSet(shards=shards, version=len(shards), closed=True)
+
+
+class ShardSetWriter:
+    """Publish shards into a shard set, append-aware.
+
+    Each ``write_*_shard`` writes the shard file, then re-commits the
+    manifest atomically (via the checkpoint layer's plain atomic writer:
+    tmp + fsync + ``os.replace`` — without the ``ckpt.write.*`` fault
+    sites or ckpt telemetry, which belong to checkpoints, not data).
+    A writer crash mid-shard leaves an unreferenced partial file that no
+    reader ever sees; a crash mid-publish leaves the previous manifest.
+
+    Re-opening an existing manifest resumes appending after its last
+    committed shard.
+    """
+
+    def __init__(self, root, name="shardset.json"):
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.manifest_path = os.path.join(self.root, name)
+        if os.path.exists(self.manifest_path):
+            ss = ShardSet(manifest_path=self.manifest_path)
+            if ss.closed:
+                raise MXNetError(
+                    "shard set %s is sealed (closed: true) — appending "
+                    "to a closed stream would violate readers that "
+                    "already saw the end" % self.manifest_path)
+            self._shards = ss.shards
+            self._version = ss.version
+        else:
+            self._shards = []
+            self._version = 0
+        self._closed = False
+
+    @property
+    def num_shards(self):
+        return len(self._shards)
+
+    def _publish(self):
+        from ..checkpoint import _plain_atomic_write
+        self._version += 1
+        doc = {
+            "schema": SCHEMA, "version": self._version,
+            "closed": self._closed,
+            "shards": [dict(s, path=os.path.relpath(s["path"], self.root))
+                       for s in self._shards],
+        }
+        _plain_atomic_write(self.manifest_path,
+                            json.dumps(doc, indent=1).encode("utf-8"))
+
+    @staticmethod
+    def _fsync_path(path):
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _commit(self, path, fmt, num_records):
+        # shard DATA reaches the platter before the manifest commits:
+        # the manifest is fsync-published (atomic_write), so without
+        # this a power loss could leave a committed manifest vouching
+        # for records still in the page cache — exactly the torn state
+        # the manifest exists to make invisible
+        self._fsync_path(path)
+        self._shards.append({
+            "path": os.path.abspath(path), "format": fmt,
+            "num_records": int(num_records),
+            "bytes": os.path.getsize(path),
+            "sha256": _sha256_file(path),
+        })
+        self._publish()
+        return self._shards[-1]
+
+    def _next_name(self, ext):
+        return os.path.join(self.root,
+                            "shard-%06d%s" % (len(self._shards), ext))
+
+    def write_recordio_shard(self, records, name=None):
+        """Write ``records`` (an iterable of bytes payloads) as one
+        indexed RecordIO shard (+ ``.idx`` sidecar, so readers seek to a
+        record in O(1)) and commit it to the manifest."""
+        from .. import recordio as _recordio
+        path = name or self._next_name(".rec")
+        idx_path = os.path.splitext(path)[0] + ".idx"
+        w = _recordio.MXIndexedRecordIO(idx_path, path, "w")
+        n = 0
+        try:
+            for rec in records:
+                w.write_idx(n, rec)
+                n += 1
+        finally:
+            w.close()
+        # the .idx sidecar is a performance hint (readers fall back to
+        # a sequential walk when it is short), but a torn one should
+        # still be rare — fsync it alongside the data _commit fsyncs
+        self._fsync_path(idx_path)
+        return self._commit(path, "recordio", n)
+
+    def write_jsonl_shard(self, records, name=None):
+        """Write ``records`` (dicts/lists/strings; non-strings are JSON-
+        encoded) as one JSONL shard and commit it to the manifest.
+        String records must be exactly one non-empty line: an embedded
+        newline or a blank string would break the one-line-one-record
+        bijection the committed ``num_records`` (and every reader
+        range) is defined over — rejected here, never mis-counted."""
+        path = name or self._next_name(".jsonl")
+        n = 0
+        with open(path, "w", encoding="utf-8") as f:
+            for rec in records:
+                line = rec if isinstance(rec, str) else json.dumps(rec)
+                line = line.rstrip("\n")
+                if "\n" in line or not line.strip():
+                    raise MXNetError(
+                        "jsonl record %d is %s — one record must be "
+                        "exactly one non-empty line (JSON-encode "
+                        "payloads with newlines)"
+                        % (n, "empty" if not line.strip()
+                           else "multi-line"))
+                f.write(line + "\n")
+                n += 1
+            f.flush()
+            os.fsync(f.fileno())
+        return self._commit(path, "jsonl", n)
+
+    def append_existing(self, path, fmt=None, num_records=None):
+        """Commit an already-written shard file (counted by walking it
+        when ``num_records`` is not given)."""
+        fmt = fmt or _infer_format(path)
+        if fmt not in _FORMATS:
+            raise MXNetError("unknown shard format %r" % fmt)
+        if num_records is None:
+            num_records = count_records(path, fmt)
+        return self._commit(path, fmt, num_records)
+
+    def seal(self):
+        """Mark the stream finished: ``closed: true`` in the manifest.
+        A follow-mode reader that has consumed everything stops instead
+        of polling forever."""
+        self._closed = True
+        self._publish()

@@ -73,11 +73,11 @@ import time
 import numpy as _np
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
-           "histogram", "span", "report", "reset", "note_train_step",
-           "note_fault", "mark_last_step_verdict", "flight_records",
-           "flight_capacity", "dump_postmortem", "start_emitter",
-           "stop_emitter", "set_enabled", "enabled", "identity",
-           "clock_anchor", "suppress_compile_accounting"]
+           "histogram", "span", "observe_phase", "report", "reset",
+           "note_train_step", "note_fault", "mark_last_step_verdict",
+           "flight_records", "flight_capacity", "dump_postmortem",
+           "start_emitter", "stop_emitter", "set_enabled", "enabled",
+           "identity", "clock_anchor", "suppress_compile_accounting"]
 
 SCHEMA_REPORT = "mxtpu-telemetry-2"
 SCHEMA_POSTMORTEM = "mxtpu-postmortem-2"
@@ -324,6 +324,17 @@ class span(object):
                               dur_ns // 1000, cat=self.cat,
                               args={"depth": self._depth})
         return False
+
+
+def observe_phase(name, seconds):
+    """Feed one duration into the span histogram ``name`` without timing
+    a block here — for phases measured somewhere this registry can't
+    reach: a stream decode worker may be a separate PROCESS whose
+    registry dies with it, so the measured duration rides the result
+    back and the consumer folds it into THIS process's phase table
+    (rendered exactly like a span of the same name)."""
+    if not _DISABLED:
+        _span_hist(name).observe(seconds)
 
 
 # -- XLA compile attribution (jax.monitoring bridge) -----------------------

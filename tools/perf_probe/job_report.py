@@ -13,9 +13,14 @@ answers the job-level questions none of them can alone:
   guard skips, recompiles) per attempt segment, with **straggler blame**:
   a rank whose ``fit_step.dispatch + fit_step.sync`` p50 exceeds the job
   median by ``--straggler-factor`` (default 2.0) is named, with the
-  ratio.  The ``step.slow`` / ``data.slow`` fault sites
-  (``MXTPU_FAULT_SLOTS`` scopes them to one victim rank) make the
-  detector drillable end-to-end.
+  ratio.  **Input-stall blame** is detected and rendered DISTINCTLY
+  (``INPUT-STALL`` vs ``STRAGGLER``): a rank data-starved on its
+  prefetch/decode queues (``data.prefetch_wait + io.queue_wait`` p50,
+  same leave-one-out law) is an input-pipeline problem, not a compute
+  one, and ranks that streamed get an io.* table (records/bytes/torn,
+  decode + queue-wait p50s).  The ``step.slow`` / ``data.slow`` /
+  ``io.decode.slow`` fault sites (``MXTPU_FAULT_SLOTS`` scopes them to
+  one victim rank) make both detectors drillable end-to-end.
 - **one merged trace** — every rank's recent per-step spans (the flight
   ring each rank leaves in its stream's final line, or in its postmortem
   when it crashed) rendered into a single Perfetto/chrome-tracing file
@@ -133,28 +138,42 @@ def rank_rows(ranks):
     """Per-rank summary rows for one attempt segment, from each rank's
     LAST line (cumulative within the attempt's process lifetime).
     Returns ``[{rank, slot, world, steps, ema_s, dispatch_p50, sync_p50,
-    data_wait_p50, skipped, compiles, score}]`` sorted by rank; ``score``
-    is the straggler-blame metric (dispatch+sync p50)."""
+    data_wait_p50, io_wait_p50, io_records, io_torn, skipped, compiles,
+    score, input_score}]`` sorted by rank; ``score`` is the compute
+    straggler-blame metric (dispatch+sync p50), ``input_score`` the
+    input-stall one (prefetch starvation + decode-queue starvation)."""
     rows = []
     for rank in sorted(ranks):
         last = ranks[rank][-1]
         ident = last["_ident"]
         ss = last.get("step_stats") or {}
+        c = last.get("counters") or {}
         dispatch = _phase_p50(last, "fit_step.dispatch")
         sync = _phase_p50(last, "fit_step.sync")
         score = None
         if dispatch is not None:
             score = dispatch + (sync or 0.0)
+        data_wait = _phase_p50(last, "data.prefetch_wait")
+        io_wait = _phase_p50(last, "io.queue_wait")
+        input_score = None
+        if data_wait is not None or io_wait is not None:
+            input_score = (data_wait or 0.0) + (io_wait or 0.0)
         rows.append({
             "rank": rank, "slot": ident.get("slot"),
             "world": ident.get("world_size"),
             "steps": ss.get("steps"),
             "ema_s": ss.get("step_time_ema_s"),
             "dispatch_p50": dispatch, "sync_p50": sync,
-            "data_wait_p50": _phase_p50(last, "data.prefetch_wait"),
+            "data_wait_p50": data_wait,
+            "io_wait_p50": io_wait,
+            "io_decode_p50": _phase_p50(last, "io.decode"),
+            "io_records": c.get("io.records"),
+            "io_bytes": c.get("io.bytes"),
+            "io_torn": c.get("io.torn_records"),
             "skipped": ss.get("skipped_steps"),
             "compiles": ss.get("compile_count"),
             "score": score,
+            "input_score": input_score,
         })
     return rows
 
@@ -177,6 +196,24 @@ def find_stragglers(rows, factor):
         baseline = median(o["score"] for o in scored if o is not r)
         if baseline > 0 and r["score"] > factor * baseline:
             out.append((r, r["score"] / baseline))
+    return sorted(out, key=lambda p: -p[1])
+
+
+def find_input_stalls(rows, factor):
+    """Input-plane skew detection, same leave-one-out law as
+    :func:`find_stragglers` but over the time a rank spends STARVED for
+    data (``data.prefetch_wait`` + ``io.queue_wait`` p50s).  A rank can
+    be blamed here and NOT in the compute detector — a stalled input
+    pipeline hides inside dispatch gaps, not inside the dispatch span —
+    which is exactly why the two blames render distinctly."""
+    scored = [r for r in rows if r["input_score"]]
+    if len(scored) < 2:
+        return []
+    out = []
+    for r in scored:
+        baseline = median(o["input_score"] for o in scored if o is not r)
+        if baseline > 0 and r["input_score"] > factor * baseline:
+            out.append((r, r["input_score"] / baseline))
     return sorted(out, key=lambda p: -p[1])
 
 
@@ -323,17 +360,49 @@ def render(job, out, factor=2.0):
         _tr._table(("rank", "slot", "steps", "step_ema", "disp_p50",
                     "sync_p50", "data_wait", "skipped", "compiles"),
                    table, out)
+        if any(r["io_records"] or r["io_torn"] for r in rows):
+            # the streaming input plane, one row per rank (any rank
+            # that streamed — INCLUDING one whose records were all
+            # torn: hiding the torn counter would be exactly the
+            # silent cap it exists to prevent)
+            io_table = [(r["rank"], r["slot"], _fmt(r["io_records"]),
+                         _fmt(r["io_bytes"]), _fmt(r["io_torn"] or 0),
+                         _tr._fmt_s(r["io_decode_p50"]),
+                         _tr._fmt_s(r["io_wait_p50"]),
+                         _tr._fmt_s(r["data_wait_p50"]))
+                        for r in rows
+                        if r["io_records"] or r["io_torn"]]
+            out.write("  stream input plane (io.*):\n")
+            _tr._table(("rank", "slot", "records", "bytes", "torn",
+                        "decode_p50", "ioq_wait", "data_wait"),
+                       io_table, out)
         stragglers = find_stragglers(rows, factor)
+        input_stalls = find_input_stalls(rows, factor)
+        stalled_ranks = {row["rank"] for row, _ in input_stalls}
         for row, ratio in stragglers:
+            note = ""
+            if row["rank"] in stalled_ranks:
+                note = " [also input-stalled — see INPUT-STALL below]"
             out.write("  STRAGGLER: rank %s (slot %s) — "
                       "dispatch+sync p50 %s is %.1fx the other ranks' "
-                      "median (threshold %.1fx)\n"
+                      "median (threshold %.1fx)%s\n"
                       % (row["rank"], row["slot"],
-                         _tr._fmt_s(row["score"]), ratio, factor))
+                         _tr._fmt_s(row["score"]), ratio, factor, note))
             all_stragglers.append((attempt, row, ratio))
-        if len(rows) >= 2 and not stragglers:
+        for row, ratio in input_stalls:
+            # input stalls are blamed DISTINCTLY from compute
+            # stragglers: the victim's steps are starved, not slow
+            out.write("  INPUT-STALL: rank %s (slot %s) — data-starved "
+                      "%s per batch (prefetch+decode-queue wait p50), "
+                      "%.1fx the other ranks' median — input pipeline, "
+                      "not compute\n"
+                      % (row["rank"], row["slot"],
+                         _tr._fmt_s(row["input_score"]), ratio))
+            all_stragglers.append((attempt, row, ratio))
+        if len(rows) >= 2 and not stragglers and not input_stalls:
             out.write("  no straggler: every rank within %.1fx of the "
-                      "other ranks' median dispatch+sync p50\n" % factor)
+                      "other ranks' median dispatch+sync p50 and "
+                      "data-wait p50\n" % factor)
     for doc in job["postmortems"]:
         ident = doc["_ident"]
         out.write("\n  postmortem: rank %s slot %s attempt %s — %s\n"

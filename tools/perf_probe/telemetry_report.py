@@ -126,6 +126,7 @@ def render_report(doc, out, context=""):
         out.write("  gauges: " + "  ".join(
             "%s=%s" % kv for kv in sorted(gauges.items())) + "\n")
     _render_ckpt_pipeline(doc, out)
+    _render_io_pipeline(doc, out)
 
 
 # phases the step loop actually blocks on under async checkpointing vs
@@ -164,6 +165,44 @@ def _render_ckpt_pipeline(doc, out):
                          _fmt_s(h["sum"] / h["count"]), _fmt_s(h["p50"]),
                          _fmt_s(h["p99"]), _fmt_s(h["max"])))
     _table(("span", "where", "count", "mean", "p50", "p99", "max"),
+           rows, out)
+
+
+# the streaming input plane's phase taxonomy (mxnet_tpu/stream/,
+# OBSERVABILITY.md §11): worker-side decode/open phases folded consumer-
+# side, plus the two starvation signals a training rank actually blocks
+# on — io.queue_wait (consumer starved on the decode result queue) and
+# data.prefetch_wait (consumer starved on the device prefetcher)
+_IO_PHASES = ("io.queue_wait", "io.decode", "io.shard_open",
+              "data.prefetch_wait")
+
+
+def _render_io_pipeline(doc, out):
+    """Streaming-input digest: record/byte/torn counters, open-shard
+    gauge, and the io.* phase table — so "is the input plane keeping
+    up, and what is it costing" reads off one report the way the
+    checkpoint pipeline does."""
+    c = doc.get("counters") or {}
+    phases = doc.get("phases") or {}
+    records = c.get("io.records", 0)
+    if not records and not any(
+            (phases.get(k) or {}).get("count") for k in _IO_PHASES[:3]):
+        return
+    g = doc.get("gauges") or {}
+    out.write("\n  stream input plane: records=%d bytes=%d torn=%d "
+              "batches=%d shards_open=%s\n"
+              % (records, c.get("io.bytes", 0),
+                 c.get("io.torn_records", 0), c.get("data.batches", 0),
+                 g.get("io.shards_open", "-")))
+    rows = []
+    for name in _IO_PHASES:
+        h = phases.get(name)
+        if not h or not h["count"]:
+            continue
+        rows.append((name, h["count"], _fmt_s(h["sum"] / h["count"]),
+                     _fmt_s(h["p50"]), _fmt_s(h["p99"]),
+                     _fmt_s(h["max"]), _fmt_s(h["sum"])))
+    _table(("span", "count", "mean", "p50", "p99", "max", "total"),
            rows, out)
 
 
