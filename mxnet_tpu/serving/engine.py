@@ -65,6 +65,21 @@ Survivability plane (ISSUE 11):
   the swap is invisible to resident sequences; a failed canary rolls
   back to the prior weights (serving/replica.py drives this from
   CheckpointManager publications).
+
+Request-scope tracing (ISSUE 13, OBSERVABILITY.md §12): every request
+carries a trace id (minted here, or passed through from the Router so a
+failover re-decode stays ONE trace) and leaves a lifecycle event at each
+transition — ``submit``/``place``, ``admit`` (slot + queue wait),
+``prefill`` (dispatch/sync wall), one ``token`` event per prefill first
+token, ONE batched ``tokens`` event per decode step naming every
+advanced trace (hot-path: a single tuple append, same discipline as the
+flight recorder), a ``swap`` pause event naming the resident traces it
+interrupted, and exactly one terminal ``verdict`` event (``final`` when
+this engine owns the trace).  ``serving.goodput`` counts tokens on
+requests that COMPLETED within deadline (vs raw ``serving.tokens``),
+and the compiled decode/prefill programs' ``cost_analysis`` is
+published as ``serving.cost.{decode,prefill}.*`` gauges — joined by
+``tools/perf_probe/serve_report.py`` into flops-and-bytes-per-token.
 """
 from __future__ import annotations
 
@@ -83,8 +98,9 @@ from .. import watchdog as _watchdog
 from ..base import MXNetError
 from .kv_cache import PagedKVAllocator, SCRATCH_PAGE
 from .scheduler import (ContinuousBatchingScheduler, EXPIRED, FAILED,
-                        FINISHED, VERDICT_DRAINING, VERDICT_EXPIRED_DECODE,
-                        VERDICT_PREFILL_ERROR)
+                        FINISHED, VERDICT_COMPLETED, VERDICT_DRAINING,
+                        VERDICT_EXPIRED_DECODE, VERDICT_PREFILL_ERROR,
+                        VERDICT_REJECTED)
 from .slo import SLOController
 
 __all__ = ["ServingEngine", "live_snapshot"]
@@ -183,6 +199,16 @@ class ServingEngine:
         # one engine, whose lease is plain "serve_step".
         seq = next(_engine_seq)
         self._lease = "serve_step" if seq == 0 else "serve_step@%d" % seq
+        # request-scope tracing identity: serve_report attributes every
+        # event to this tag (a ServingReplica overwrites it with its
+        # replica_id, so fleet views name replicas, not engine ordinals)
+        self.trace_tag = "engine%d" % seq
+        #: checkpoint epoch currently serving (set by swap_params; the
+        #: periodic serving status line carries it)
+        self.weights_epoch = None
+        #: per-program compile-time cost attribution (flops / bytes per
+        #: execution), best-effort from the backend's cost_analysis
+        self.cost = {}
 
         self._kv = self._init_pages()
         self.decode_steps = 0
@@ -287,6 +313,7 @@ class ServingEngine:
             key = _aot.cache_key("serve_" + name, examples, extra=extra)
             memo = _aot.memo_get(key)
             if memo is not None:
+                self._capture_cost(name, memo)
                 return _profiler.instrument(memo,
                                             first_call_compiles=False)
             if _aot.enabled():
@@ -295,6 +322,7 @@ class ServingEngine:
                     compiled, var, _meta = loaded
                     from .. import watchdog as _watchdog
                     _watchdog.note_warm_start()
+                    self._capture_cost(name, compiled)
                     if var == _aot.VARIANT_DONATED:
                         _aot.memo_put(key, compiled)
                         return _profiler.instrument(
@@ -310,6 +338,7 @@ class ServingEngine:
             with _telemetry.span("serving.compile", cat="serving"):
                 with _aot.bypass_persistent_cache():
                     compiled = mk_jit().lower(*examples).compile()
+            self._capture_cost(name, compiled)
             _aot.memo_put(key, compiled)
             if _aot.enabled():
                 _aot.spawn_variant_store(mk_jit, examples, key,
@@ -329,8 +358,39 @@ class ServingEngine:
             return _profiler.instrument(
                 _aot.donation_cache_guard(mk_jit()))
 
+    def _capture_cost(self, name, compiled):
+        """Best-effort compile-time cost attribution of one serving
+        program (the executor._analyze_compiled move, serving flavor):
+        flops / bytes-accessed PER EXECUTION from the backend's own
+        accounting, published as ``serving.cost.<prog>.*`` gauges and
+        kept on ``self.cost`` — serve_report joins these with the
+        measured token counters into flops-and-bytes-per-token, the
+        objective the ROADMAP-item-2 autotuner optimizes.  A backend or
+        cache tier that reports nothing yields nothing, never an
+        error."""
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if not ca:
+                return
+            doc = {}
+            for key, field in (("flops", "flops"),
+                               ("bytes accessed", "bytes_accessed"),
+                               ("transcendentals", "transcendentals")):
+                v = ca.get(key)
+                if v is not None:
+                    doc[field] = float(v)
+                    _telemetry.gauge(
+                        "serving.cost.%s.%s" % (name, field)).set(
+                        float(v))
+            if doc:
+                self.cost[name] = doc
+        except Exception:
+            pass
+
     # -- request intake ----------------------------------------------------
-    def submit(self, prompt, max_new, deadline_s=None):
+    def submit(self, prompt, max_new, deadline_s=None, trace=None):
         """Enqueue one request (prompt: 1-d int token array).  Returns
         the Request handle; tokens appear on it as the engine steps.
 
@@ -340,9 +400,33 @@ class ServingEngine:
         typed verdict — ``shed`` when the SLO controller is refusing
         intake, ``draining`` while the replica drains — so callers fail
         fast instead of waiting on a queue that will never serve them.
-        Infeasible requests (can never fit) still raise ValueError."""
+        Infeasible requests (can never fit) still raise ValueError.
+
+        ``trace``: request-scope trace id.  None (direct callers) mints
+        one here and this engine's terminal verdict event is FINAL; the
+        Router passes its own id through so a failover re-decode on a
+        survivor replica continues the same trace, and fleet-level
+        terminality stays the Router's to stamp."""
         prompt = _np.asarray(prompt, _np.int32).reshape(-1)
+        # malformed-argument raises (the scheduler's Request rules)
+        # happen BEFORE any trace event: they produce no handle, so
+        # they must open no trace a verdict would then never close
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if int(max_new) < 1:
+            raise ValueError("max_new must be >= 1")
+        owned = trace is None
+        if owned:
+            trace = _telemetry.mint_trace()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        _telemetry.note_request_event(
+            trace, "submit" if owned else "place",
+            args={"replica": self.trace_tag,
+                  "prompt_len": int(prompt.size),
+                  "max_new": int(max_new), "deadline_s": deadline_s})
         if prompt.size > self.max_prefill_len:
+            self._close_unplaced(trace, owned, VERDICT_REJECTED)
             raise ValueError(
                 "prompt length %d exceeds max_prefill_len %d"
                 % (prompt.size, self.max_prefill_len))
@@ -351,28 +435,82 @@ class ServingEngine:
         # not a retryable-looking refusal a router would bounce forever
         err = self.sched.feasibility_error(prompt.size, max_new)
         if err is not None:
+            self._close_unplaced(trace, owned, VERDICT_REJECTED,
+                                 error=err)
             raise ValueError(err)
-        if deadline_s is None:
-            deadline_s = self.default_deadline_s
         if self.draining:
             _telemetry.counter("serving.drain_rejects").inc()
-            return self.sched.shed(
+            req = self.sched.shed(
                 prompt, max_new, verdict=VERDICT_DRAINING,
                 error="replica is draining: finishing residents, "
                       "admitting nothing new")
+            return self._trace_refusal(req, trace, owned)
         if self._slo is not None and self._slo.should_shed(
                 self.sched.oldest_queue_wait):
             _telemetry.counter("serving.shed").inc()
-            return self.sched.shed(
+            req = self.sched.shed(
                 prompt, max_new,
                 error="shed: queue-wait p99 %.3fs over SLO target %.3fs"
                       % (self._slo.windowed_p99(),
                          self._slo.target_p99_s))
+            return self._trace_refusal(req, trace, owned)
         req = self.sched.submit(prompt, max_new, deadline_s)
+        req.trace = trace
+        req.trace_owned = owned
         if self._record_logits:
             req.logits_trace = []
         _telemetry.counter("serving.requests").inc()
         return req
+
+    # -- request-scope trace plumbing --------------------------------------
+    def _close_unplaced(self, trace, owned, verdict, error=None):
+        """Terminal verdict event for a request that never produced a
+        scheduler handle (infeasible submit): the trace still closes."""
+        args = {"verdict": verdict, "final": bool(owned),
+                "replica": self.trace_tag, "tokens": 0}
+        if error:
+            args["error"] = str(error)[:200]
+        _telemetry.note_request_event(trace, "verdict", args=args)
+
+    def _trace_refusal(self, req, trace, owned):
+        """Stamp trace identity on a shed/draining refusal handle and
+        close (or, router-owned, annotate) its trace — a refused request
+        still reaches a verdict span (no trace is ever left open)."""
+        req.trace = trace
+        req.trace_owned = owned
+        self._close_trace(req)
+        return req
+
+    def _close_trace(self, req):
+        """The terminal verdict event: verdict + the latency stamps the
+        fleet percentiles split on.  ``final`` is False for router-owned
+        traces (an engine-level shed may be just one hop of a spread;
+        the Router emits the one FINAL verdict per trace)."""
+        if req.trace is None:
+            return
+        args = {"verdict": req.verdict, "final": bool(req.trace_owned),
+                "replica": self.trace_tag, "rid": req.rid,
+                "tokens": len(req.tokens)}
+        if req.ttft_s is not None:
+            args["ttft_s"] = round(req.ttft_s, 6)
+        if req.queue_wait_s is not None:
+            args["queue_wait_s"] = round(req.queue_wait_s, 6)
+        if req.tpot_s is not None:
+            args["tpot_s"] = round(req.tpot_s, 6)
+        if req.error:
+            args["error"] = str(req.error)[:200]
+        _telemetry.note_request_event(req.trace, "verdict", args=args)
+
+    def _finish(self, req, state=FINISHED, verdict=None, error=None):
+        """Every resident exit routes through here: the scheduler's
+        finish (slot + pages released) plus the trace close and the
+        goodput accounting — ``serving.goodput`` counts only tokens on
+        requests that COMPLETED (reached every token within deadline),
+        the numerator of the goodput-vs-raw-tokens split."""
+        self.sched.finish(req, state, verdict=verdict, error=error)
+        if req.verdict == VERDICT_COMPLETED:
+            _telemetry.counter("serving.goodput").inc(len(req.tokens))
+        self._close_trace(req)
 
     # -- the serving loop --------------------------------------------------
     def _expire_deadlines(self):
@@ -383,9 +521,10 @@ class ServingEngine:
         pages, so an expired request never burns another token."""
         for req in self.sched.expire_queued():
             _telemetry.counter("serving.expired_queue").inc()
+            self._close_trace(req)
         now = time.perf_counter()
         for req in self.sched.expired_running(now):
-            self.sched.finish(
+            self._finish(
                 req, EXPIRED, verdict=VERDICT_EXPIRED_DECODE,
                 error="deadline %.3fs passed mid-decode after %d of %d "
                       "tokens" % (req.deadline_s, len(req.tokens),
@@ -405,15 +544,21 @@ class ServingEngine:
         for req in self.sched.admit():
             _telemetry.histogram("serving.queue_wait").observe(
                 req.queue_wait_s)
+            _telemetry.note_request_event(
+                req.trace, "admit",
+                args={"replica": self.trace_tag, "slot": req.slot,
+                      "rid": req.rid,
+                      "queue_wait_s": round(req.queue_wait_s, 6),
+                      "pages": len(req.pages)})
             if self._slo is not None:
                 self._slo.observe(req.queue_wait_s)
             try:
                 _fault.check("serve.prefill.error",
                              "prefill failed for request %d" % req.rid)
             except _fault.FaultInjected as e:
-                self.sched.finish(req, FAILED,
-                                  verdict=VERDICT_PREFILL_ERROR,
-                                  error=str(e))
+                self._finish(req, FAILED,
+                             verdict=VERDICT_PREFILL_ERROR,
+                             error=str(e))
                 _telemetry.counter("serving.prefill_errors").inc()
                 continue
             toks = _np.zeros(self.max_prefill_len, _np.int32)
@@ -429,6 +574,14 @@ class ServingEngine:
             t2 = time.perf_counter_ns()
             _telemetry.note_train_step(t0, t1, t2,
                                        where="serve_prefill")
+            _telemetry.note_request_event(
+                req.trace, "prefill", t_ns=t0,
+                args={"dispatch_s": round((t1 - t0) * 1e-9, 9),
+                      "sync_s": round((t2 - t1) * 1e-9, 9)})
+            # the prefill's first token: one ``token`` event, stamped
+            # BEFORE _note_token so a finish-on-first-token (max_new=1)
+            # orders token -> verdict in the trace
+            _telemetry.note_request_event(req.trace, "token", t_ns=t2)
             self.prefills += 1
             _telemetry.counter("serving.prefills").inc()
             self._note_token(req, first,
@@ -452,7 +605,7 @@ class ServingEngine:
             req.logits_trace.append(_np.array(logits_row, _np.float32))
         if len(req.tokens) >= req.max_new or \
                 (self.eos_id is not None and int(token) == self.eos_id):
-            self.sched.finish(req, FINISHED)
+            self._finish(req, FINISHED)
 
     def step(self):
         """One serving iteration: deadline sweep, admit+prefill joins,
@@ -508,6 +661,15 @@ class ServingEngine:
         nxt = _np.asarray(nxt)           # device sync barrier
         t2 = time.perf_counter_ns()
         _telemetry.note_train_step(t0, t1, t2, where="serve_step")
+        # ONE batched ``tokens`` event per decode step naming every
+        # advanced trace (all residents share the step's sync stamp
+        # anyway) — per-token tracing at flight-recorder cost; the
+        # per-trace token count is len-weighted at read time and must
+        # equal the serving.tokens delta bit-exactly (test-pinned)
+        _telemetry.note_request_event(
+            "", "tokens", t_ns=t2,
+            args={"replica": self.trace_tag, "step": self.decode_steps,
+                  "traces": [r.trace for r in running]})
         self.decode_steps += 1
         _watchdog.renew(self._lease, step=self.decode_steps,
                         phase="serve_step")
@@ -539,7 +701,7 @@ class ServingEngine:
                          % max_steps)
 
     # -- live weight hot-swap (ISSUE 11) -----------------------------------
-    def swap_params(self, params, verify=True):
+    def swap_params(self, params, verify=True, epoch=None):
         """Install a new decode-param tree between decode steps — the
         live weight hot-swap a serving replica runs when a training job
         publishes a fresh checkpoint (serving/replica.py drives it from
@@ -569,6 +731,14 @@ class ServingEngine:
                 "hot-swap rejected: new param tree does not match the "
                 "serving tree in structure/shape/dtype — a mismatched "
                 "swap would retrace the decode program mid-flight")
+        # the swap is a decode-cadence PAUSE for every resident (the
+        # canary prefill runs in the step gap): record it as one
+        # engine-scope event naming the resident traces, so serve_report
+        # can charge the pause to exactly the requests that felt it —
+        # the "swap pause" term of the SLO breach blame decomposition
+        t0 = time.perf_counter_ns()
+        resident = [r.trace for r in self.sched.running
+                    if r.trace is not None]
         self._p = params
         if verify:
             try:
@@ -576,9 +746,23 @@ class ServingEngine:
             except BaseException:
                 self._p = old
                 _telemetry.counter("serving.swap_rollbacks").inc()
+                _telemetry.note_request_event(
+                    "", "swap", t_ns=t0,
+                    args={"replica": self.trace_tag, "ok": False,
+                          "epoch": epoch, "traces": resident,
+                          "dur_s": round((time.perf_counter_ns() - t0)
+                                         * 1e-9, 9)})
                 raise
         self.swaps += 1
+        if epoch is not None:
+            self.weights_epoch = epoch
         _telemetry.counter("serving.swaps").inc()
+        _telemetry.note_request_event(
+            "", "swap", t_ns=t0,
+            args={"replica": self.trace_tag, "ok": True, "epoch": epoch,
+                  "traces": resident,
+                  "dur_s": round((time.perf_counter_ns() - t0) * 1e-9,
+                                 9)})
 
     def _canary_decode(self):
         """One prefill with an all-scratch block table (prompt_len=1):
@@ -607,11 +791,14 @@ class ServingEngine:
         self.draining = True
 
     def snapshot(self):
-        """JSON-able serving state for postmortems and replica health:
-        resident slots, queue depth, page accounting, drain flag — the
-        "what was it serving" record a dead replica leaves behind."""
+        """JSON-able serving state for postmortems, replica health, and
+        the PERIODIC serving status line (every telemetry ``report()``
+        from a process with live engines carries this block): resident
+        slots, queue depth, page accounting, drain flag, SLO controller
+        state, and the checkpoint epoch currently serving."""
         running = self.sched.running
         return {
+            "replica": self.trace_tag,
             "decode_steps": self.decode_steps,
             "prefills": self.prefills,
             "swaps": self.swaps,
@@ -624,8 +811,12 @@ class ServingEngine:
             "used_pages": self.alloc.used_pages,
             "num_pages": self.alloc.num_pages,
             "draining": self.draining,
+            "weights_epoch": self.weights_epoch,
             "shedding": (self._slo.shedding if self._slo is not None
                          else False),
+            "slo": (self._slo.state() if self._slo is not None
+                    else None),
+            "cost": self.cost or None,
         }
 
     # -- convenience -------------------------------------------------------

@@ -149,16 +149,21 @@ class ServingReplica:
                  swap_poll_steps=8):
         self.engine = engine
         self.replica_id = replica_id
+        # request-scope tracing: events from this engine are attributed
+        # to the REPLICA id (serve_report's fleet views name replicas;
+        # the engine ordinal means nothing outside this process)
+        engine.trace_tag = str(replica_id)
         self.subscriber = subscriber
         self.swap_poll_steps = max(1, int(swap_poll_steps))
         self.alive = True
         self._steps = 0
 
     # -- request plane -----------------------------------------------------
-    def submit(self, prompt, max_new, deadline_s=None):
+    def submit(self, prompt, max_new, deadline_s=None, trace=None):
         if not self.alive:
             raise ReplicaLost("replica %s is dead" % self.replica_id)
-        return self.engine.submit(prompt, max_new, deadline_s=deadline_s)
+        return self.engine.submit(prompt, max_new, deadline_s=deadline_s,
+                                  trace=trace)
 
     def step(self):
         """One serving iteration, replica-flavored: the loss fault site,
@@ -210,7 +215,7 @@ class ServingReplica:
         try:
             with _telemetry.span("serving.swap", cat="serving"):
                 params = sub.load_params(epoch)
-                self.engine.swap_params(params)
+                self.engine.swap_params(params, epoch=epoch)
         except Exception as e:
             # BOTH halves roll back: the engine restored its tree
             # (swap_params), and the net's params — which load_params

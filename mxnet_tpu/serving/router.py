@@ -27,9 +27,22 @@ least-loaded live replica.  The survivability contract:
   ``BENCH_MODE=serve``'s degraded-mode contract).
 
 The journal can additionally be mirrored to a JSON-lines file
-(``journal_path``) — one line per transition (accept / complete /
-failover / retry / terminal verdict), the auditable "every accepted
-request completed exactly once" record the e2e drill greps.
+(``journal_path``; defaults to ``$MXTPU_SERVE_JOURNAL`` — the
+tools/launch.py run-dir layout puts it next to the replica telemetry
+streams) — one line per transition (accept / complete / failover /
+retry / terminal verdict), the auditable "every accepted request
+completed exactly once" record the e2e drill greps.  Each line is ONE
+``os.write`` on an O_APPEND fd (the PR-8 emitter discipline): a crash
+mid-write can truncate the FILE at a line boundary, never tear a line
+into two readers' worth of garbage — ``serve_report`` still
+skips-and-counts anything unparseable (no silent caps).
+
+Request-scope tracing (ISSUE 13): ``submit`` mints the trace id and
+passes it through every placement, so a failover re-decode on a
+survivor replica continues the SAME trace (linked ``retry`` event);
+journal lines carry the trace id, and the Router stamps the one FINAL
+verdict event per trace (engine-level refusals on a spread are hops,
+not terminals).
 
 Replicas are duck-typed (``replica_id`` / ``alive`` / ``draining`` /
 ``load`` / ``idle`` / ``submit`` / ``step``): the in-process
@@ -41,6 +54,7 @@ counters, ``router.live_replicas`` gauge.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 from .. import telemetry as _telemetry
@@ -59,6 +73,13 @@ VERDICT_NO_REPLICAS = "no_live_replicas"
 _TERMINAL_FAILURES = (REJECTED, EXPIRED, FAILED, SHED)
 
 
+def _np_size(prompt):
+    """Prompt length without importing numpy here (prompts are arrays
+    or plain sequences — the router never touches their contents)."""
+    size = getattr(prompt, "size", None)
+    return len(prompt) if size is None else size
+
+
 class RouterRequest:
     """The caller's handle: journaled id, terminal state + typed
     verdict, and the completed token list.  ``tokens`` is only
@@ -67,7 +88,7 @@ class RouterRequest:
 
     __slots__ = ("rid", "prompt", "max_new", "deadline_s", "deadline_t",
                  "state", "verdict", "error", "tokens", "replica_id",
-                 "retries", "_live", "_home")
+                 "retries", "trace", "_live", "_home")
 
     def __init__(self, rid, prompt, max_new, deadline_s):
         self.rid = rid
@@ -85,6 +106,7 @@ class RouterRequest:
         self.tokens = None
         self.replica_id = None  # journal/display only — never identity
         self.retries = 0
+        self.trace = None       # request-scope trace id (router-minted)
         self._live = None      # the engine Request currently decoding
         self._home = None      # the replica OBJECT it decodes on (ids
                                # are caller-supplied and may collide)
@@ -102,7 +124,11 @@ class Router:
         self.max_retries = int(max_retries)
         self._journal = {}           # rid -> RouterRequest
         self._inflight = set()       # rids currently accepted somewhere
-        self._journal_path = journal_path
+        # run-dir layout default (tools/launch.py exports it next to
+        # the replica telemetry streams — serve_report's input contract)
+        self._journal_path = (journal_path if journal_path is not None
+                              else os.environ.get("MXTPU_SERVE_JOURNAL")
+                              or None)
         #: terminal entries kept in memory (None = unbounded).  The
         #: in-memory journal only needs to cover in-flight work plus a
         #: recent-history window; the JSONL file (journal_path) is the
@@ -116,15 +142,32 @@ class Router:
 
     # -- journal -----------------------------------------------------------
     def _log(self, event, rr, **extra):
+        """One audit line, written as a SINGLE ``os.write`` on an
+        O_APPEND fd (the PR-8 emitter discipline): a buffered writer
+        flushes in stdio-chunk units, and a crash between chunks used to
+        leave a torn line that poisoned the whole file for naive
+        readers — a single append either lands whole or not at all, so
+        a crash can truncate the journal, never tear it mid-line
+        (serve_report still skips-and-counts the unparseable, because
+        other writers make no such promise).  Open-per-line like the
+        emitter: journal lines are per request TRANSITION, not per
+        token, and a cached fd would leak one descriptor per journaled
+        Router for the life of the process."""
         if not self._journal_path:
             return
         line = {"t": time.time(), "event": event, "rid": rr.rid,
-                "replica": rr.replica_id, "state": rr.state,
-                "verdict": rr.verdict, "retries": rr.retries}
+                "trace": rr.trace, "replica": rr.replica_id,
+                "state": rr.state, "verdict": rr.verdict,
+                "retries": rr.retries}
         line.update(extra)
         try:
-            with open(self._journal_path, "a") as f:
-                f.write(json.dumps(line) + "\n")
+            fd = os.open(self._journal_path,
+                         os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+            try:
+                os.write(fd,
+                         (json.dumps(line) + "\n").encode("utf-8"))
+            finally:
+                os.close(fd)
         except OSError:
             pass  # the journal must never take the router down
 
@@ -145,14 +188,49 @@ class Router:
     def submit(self, prompt, max_new, deadline_s=None):
         """Journal a request and place it.  The handle is terminal
         immediately when every live replica refused (typed verdict
-        propagated) or none exist — fail fast, never a silent hang."""
+        propagated) or none exist — fail fast, never a silent hang.
+
+        The request-scope trace id is minted HERE (the fleet
+        front-door): every engine it touches — the first placement, a
+        spread after a shed refusal, a failover re-decode — records its
+        lifecycle events under this one id."""
         rr = RouterRequest(self._next_rid, prompt, max_new, deadline_s)
+        rr.trace = _telemetry.mint_trace()
         self._next_rid += 1
         self._prune_journal()
         self._journal[rr.rid] = rr
         _telemetry.counter("router.requests").inc()
+        _telemetry.note_request_event(
+            rr.trace, "submit",
+            args={"router": True, "rid": rr.rid,
+                  "prompt_len": int(_np_size(prompt)),
+                  "max_new": int(max_new), "deadline_s": deadline_s})
         self._place(rr)
         return rr
+
+    def _close_trace(self, rr, live=None):
+        """The one FINAL verdict event per trace — the Router owns
+        fleet-level terminality (engine-level verdicts under a
+        router-minted trace are hops: a shed refusal mid-spread, a
+        victim's abandoned decode).  ``live`` (the engine Request at
+        completion) contributes the latency stamps."""
+        if rr.trace is None:
+            return
+        args = {"verdict": rr.verdict, "final": True, "router": True,
+                "rid": rr.rid, "retries": rr.retries,
+                "tokens": 0 if rr.tokens is None else len(rr.tokens)}
+        if rr.replica_id is not None:
+            args["replica"] = str(rr.replica_id)
+        if live is not None:
+            # duck-typed replicas (RPC proxies, test stubs) may not
+            # carry the latency stamps — include what exists
+            for key in ("ttft_s", "queue_wait_s", "tpot_s"):
+                v = getattr(live, key, None)
+                if v is not None:
+                    args[key] = round(v, 6)
+        if rr.error:
+            args["error"] = str(rr.error)[:200]
+        _telemetry.note_request_event(rr.trace, "verdict", args=args)
 
     def _prune_journal(self):
         """Evict the oldest TERMINAL entries once the in-memory journal
@@ -191,7 +269,7 @@ class Router:
         for r in candidates:
             try:
                 req = r.submit(rr.prompt, rr.max_new,
-                               deadline_s=remaining)
+                               deadline_s=remaining, trace=rr.trace)
             except ReplicaLost:
                 continue
             except ValueError as e:
@@ -201,6 +279,7 @@ class Router:
                 rr.state, rr.verdict = "failed", VERDICT_REJECTED
                 rr.error = str(e)
                 self._log("reject", rr)
+                self._close_trace(rr)
                 return
             if req.state == SHED:
                 refusal = req
@@ -219,6 +298,7 @@ class Router:
                     else "no live replica to place on")
         _telemetry.counter("router.refused").inc()
         self._log("refuse", rr)
+        self._close_trace(rr)
 
     # -- the serving loop --------------------------------------------------
     def step(self):
@@ -254,12 +334,14 @@ class Router:
                 rr.verdict = live.verdict or "completed"
                 self._inflight.discard(rid)
                 self._log("complete", rr, tokens=len(rr.tokens))
+                self._close_trace(rr, live=live)
             elif live.state in _TERMINAL_FAILURES:
                 rr.state = "failed"
                 rr.verdict = live.verdict or live.state
                 rr.error = live.error
                 self._inflight.discard(rid)
                 self._log("fail", rr)
+                self._close_trace(rr, live=live)
 
     def _failover(self, replica):
         """A replica died: journal-driven failover.  Completed requests
@@ -314,9 +396,17 @@ class Router:
                                            self.max_retries))
                 self._inflight.discard(rr.rid)
                 self._log("drop", rr)
+                self._close_trace(rr)
                 continue
             _telemetry.counter("router.retries").inc()
             self._log("retry", rr, from_replica=replica.replica_id)
+            # the failover arc: same trace, victim named — the
+            # survivor's `place`/`admit` events continue it, and
+            # serve_report charges the re-decode window to this replica
+            _telemetry.note_request_event(
+                rr.trace, "retry",
+                args={"from": str(replica.replica_id),
+                      "retries": rr.retries, "rid": rr.rid})
             self._place(rr)
         # prune: journal entries survive; the dead replica (and its
         # engine's page pools) do not

@@ -64,7 +64,8 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new", "submit_t", "admit_t",
                  "first_token_t", "finish_t", "tokens", "state", "slot",
                  "pages", "logits_trace", "token_times", "deadline_s",
-                 "deadline_t", "verdict", "error")
+                 "deadline_t", "verdict", "error", "trace",
+                 "trace_owned")
 
     def __init__(self, rid, prompt, max_new, deadline_s=None):
         self.rid = rid
@@ -89,6 +90,14 @@ class Request:
                            else self.submit_t + float(deadline_s))
         self.verdict = None       # typed terminal verdict
         self.error = None         # human-readable failure detail
+        # request-scope tracing (ISSUE 13): the lifecycle trace id this
+        # request's events are recorded under (the engine mints one, or
+        # the Router passes its own through so a failover re-decode on
+        # another replica stays ONE trace).  ``trace_owned`` says who
+        # closes it: True — the engine's terminal verdict event is
+        # final; False — the Router owns fleet-level terminality.
+        self.trace = None
+        self.trace_owned = True
 
     @property
     def done(self):

@@ -148,3 +148,17 @@ class SLOController:
     def shedding(self):
         """Current state without re-evaluating (telemetry/health)."""
         return self._shedding
+
+    def state(self):
+        """JSON-able controller state for the periodic serving status
+        line (engine ``snapshot()``, ISSUE 13): the decision inputs an
+        operator needs to read a shed engagement off one line — target,
+        windowed p99, hysteresis release point, sample depth."""
+        return {
+            "shedding": self._shedding,
+            "target_p99_s": self.target_p99_s,
+            "release_p99_s": self.release_frac * self.target_p99_s,
+            "windowed_p99_s": self.windowed_p99(),
+            "window_samples": len(self._samples),
+            "sheds": self.sheds,
+        }

@@ -48,6 +48,25 @@ emitter's final line additionally carries the flight ring
 (``last_steps``) so a cleanly-exited rank leaves its recent per-step
 spans behind the way a crashed rank leaves them in its postmortem.
 
+**Request scope** (ISSUE 13, OBSERVABILITY.md §12): the serving twin of
+the per-step flight recorder.  :func:`mint_trace` issues a process-unique
+trace id at ``Router.submit`` / ``ServingEngine.submit``;
+:func:`note_request_event` records one lifecycle event (submit, place,
+admit, prefill, token batches, retry, swap, terminal verdict) with the
+SAME hot-path discipline as ``note_train_step`` — one tuple append, all
+folding deferred to a batched drain into a bounded event ring.  The
+periodic emitter ships each line's NEWLY-drained events
+(``req_events``, a cursor over the monotonic per-process ``seq``) so the
+stream accumulates the full lifecycle record while each line stays
+bounded; ring evictions of never-emitted events are counted
+(``serving.trace_dropped`` / per-line ``req_dropped`` — no silent caps).
+Crash postmortems carry the whole ring (``request_trace``), and every
+``report()`` from a process with live serving engines carries a
+``serving`` status block (occupancy, free pages, SLO controller state,
+current weights epoch) — the periodic serving status line.
+``tools/perf_probe/serve_report.py`` merges router journal + replica
+streams into the fleet view.
+
 ``tools/perf_probe/telemetry_report.py`` renders the per-rank artifacts
 (JSON-lines timeline and postmortem) for humans;
 ``tools/perf_probe/job_report.py`` aggregates a whole run dir;
@@ -55,6 +74,7 @@ OBSERVABILITY.md is the metric-name / span-taxonomy / schema contract.
 
 Env vars: ``MXTPU_TELEMETRY``, ``MXTPU_POSTMORTEM_DIR``,
 ``MXTPU_FLIGHT_RECORDER_STEPS`` (ring size, default 64),
+``MXTPU_REQUEST_TRACE_EVENTS`` (request-event ring size, default 8192),
 ``MXTPU_TELEMETRY_OFF=1`` (disable hot-path recording; the A/B side of
 ``BENCH_MODE=telemetry``'s overhead measurement).
 """
@@ -63,6 +83,7 @@ from __future__ import annotations
 import atexit
 import collections
 import contextlib
+import itertools
 import json
 import math
 import os
@@ -77,7 +98,9 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "note_train_step", "note_fault", "mark_last_step_verdict",
            "flight_records", "flight_capacity", "dump_postmortem",
            "start_emitter", "stop_emitter", "set_enabled", "enabled",
-           "identity", "clock_anchor", "suppress_compile_accounting"]
+           "identity", "clock_anchor", "suppress_compile_accounting",
+           "mint_trace", "note_request_event", "request_events",
+           "consume_request_events", "count_token_events"]
 
 SCHEMA_REPORT = "mxtpu-telemetry-2"
 SCHEMA_POSTMORTEM = "mxtpu-postmortem-2"
@@ -397,7 +420,7 @@ def xla_compile_events():
 # -- flight recorder -------------------------------------------------------
 _FLIGHT_FIELDS = ("step", "t_unix", "dispatch_s", "sync_s",
                   "dispatch_delta", "compile_delta", "skipped", "loss",
-                  "faults")
+                  "faults", "where")
 _flight = collections.deque(
     maxlen=max(1, _env_int("MXTPU_FLIGHT_RECORDER_STEPS", 64)))
 _step_seq = 0
@@ -516,7 +539,8 @@ def _drain_steps():
         for (where, t0, t1, t2, skipped, loss, d, c, faults) in batch:
             sync_s = (t2 - t1) * 1e-9 if t2 is not None else None
             append([seq, t_off + t0 * 1e-9, (t1 - t0) * 1e-9, sync_s,
-                    d - last_d, c - last_c, skipped, loss, faults])
+                    d - last_d, c - last_c, skipped, loss, faults,
+                    where])
             seq += 1
             last_d, last_c = d, c
             if running and t0 // 1000 >= trace_t0_us:
@@ -593,6 +617,139 @@ def flight_capacity():
     return _flight.maxlen
 
 
+# -- request-scope tracing (the serving plane, OBSERVABILITY.md §12) -------
+# One bounded ring of per-request lifecycle events, the serving twin of
+# the per-step flight ring: the hot path (a decode step's token batch)
+# is ONE tuple append; the batched drain assigns a monotonic per-process
+# ``seq`` and folds into the ring.  The periodic emitter ships each
+# line's newly-drained events (a cursor over ``seq``), so a replica's
+# stream accumulates the complete lifecycle record while every line
+# stays bounded; evicting a never-emitted event is counted, never
+# silent.  ``tools/perf_probe/serve_report.py`` reconstructs per-request
+# lifecycles (and the fleet view) from these events.
+_REQ_RING_CAP = max(64, _env_int("MXTPU_REQUEST_TRACE_EVENTS", 8192))
+_req_ring = collections.deque(maxlen=_REQ_RING_CAP)
+_req_seq = 0            # next event sequence number (monotonic)
+_req_emit_seq = 0       # first seq NOT yet shipped by the emitter
+_req_dropped = 0        # never-emitted events evicted since last consume
+_pending_req = []
+_REQ_PENDING_MAX = 256
+_trace_seq = itertools.count()
+# process-unique trace-id base: pid alone repeats across restart
+# attempts, and a survivor's stream must never collide trace ids with
+# its predecessor's (serve_report merges both)
+_TRACE_BASE = "%x.%x" % (os.getpid(),
+                         int(_unix_base * 1e3) & 0xffffffff)
+
+
+def mint_trace():
+    """A new process-unique request trace id (``Router.submit`` /
+    ``ServingEngine.submit`` mint one per request; everything the
+    request experiences — admission, prefill, every decode token, a
+    failover re-decode on another replica — is recorded under it)."""
+    return "%s-%x" % (_TRACE_BASE, next(_trace_seq))
+
+
+def note_request_event(trace, event, t_ns=None, args=None):
+    """Record one request-lifecycle event.  Hot-path discipline matches
+    :func:`note_train_step`: one tuple append, everything else deferred
+    to the batched drain (``BENCH_MODE=serve`` asserts the per-decode-
+    step budget).  ``trace=""`` marks an engine-scope event (a hot-swap
+    pause naming the resident traces in ``args``); ``t_ns`` is a
+    ``perf_counter_ns`` stamp (defaults to now — pass the step's
+    existing stamp on hot paths to skip the clock read)."""
+    if _DISABLED:
+        return
+    p = _pending_req
+    p.append((trace, event,
+              t_ns if t_ns is not None else time.perf_counter_ns(),
+              args))
+    if len(p) >= _REQ_PENDING_MAX:
+        _drain_req_events()
+
+
+def _drain_req_events():
+    global _req_seq, _req_dropped
+    with _drain_lock:
+        batch = list(_pending_req)
+        if not batch:
+            return
+        del _pending_req[:len(batch)]
+        ring = _req_ring
+        seq = _req_seq
+        dropped = 0
+        t_off = _unix_base - _perf_base * 1e-9
+        for (trace, event, t, args) in batch:
+            if len(ring) == ring.maxlen and ring[0][0] >= _req_emit_seq:
+                dropped += 1    # evicting an event nothing ever shipped
+            ring.append((seq, t_off + t * 1e-9, trace, event, args))
+            seq += 1
+        _req_seq = seq
+        if dropped:
+            _req_dropped += dropped
+            counter("serving.trace_dropped").inc(dropped)
+
+
+def _req_dicts(recs):
+    return [{"seq": s, "t": t, "trace": tr, "event": ev,
+             "args": args or {}} for (s, t, tr, ev, args) in recs]
+
+
+def request_events():
+    """The whole request-event ring as dicts, oldest first (postmortems
+    and tests; does not advance the emitter cursor)."""
+    _drain_req_events()
+    with _drain_lock:
+        return _req_dicts(list(_req_ring))
+
+
+def consume_request_events():
+    """``(new_events, dropped)`` since the last consume — the emitter's
+    per-line payload.  Advances the cursor, so each event ships exactly
+    once across the stream's lines; ``dropped`` counts events evicted
+    from the ring before any line could carry them (burst faster than
+    the emitter interval — the reader must know the record has a gap)."""
+    global _req_emit_seq, _req_dropped
+    _drain_req_events()
+    with _drain_lock:
+        evs = [r for r in _req_ring if r[0] >= _req_emit_seq]
+        dropped, _req_dropped = _req_dropped, 0
+        _req_emit_seq = _req_seq
+        return _req_dicts(evs), dropped
+
+
+def count_token_events(events):
+    """Traced token total over request-event dicts: singular ``token``
+    events (prefill first tokens) plus len-weighted batched ``tokens``
+    events (decode steps).  THE token-accounting law's left-hand side —
+    one definition, shared by the bench probe and the law tests, equal
+    to the ``serving.tokens`` counter delta bit-exactly."""
+    n = 0
+    for e in events:
+        ev = e.get("event")
+        if ev == "token":
+            n += 1
+        elif ev == "tokens":
+            n += len((e.get("args") or {}).get("traces") or ())
+    return n
+
+
+def _unconsume_request_events(evs, dropped):
+    """Roll a failed emit's consume back: the events never reached the
+    stream, so the cursor returns to the first unshipped seq and the
+    drop count is restored — the next successful line carries them.
+    (Events the ring evicts while the cursor is transiently advanced
+    escape the drop accounting — a write failing in the same instant
+    the ring overflows — which is as far as best-effort telemetry
+    reaches.)"""
+    global _req_emit_seq, _req_dropped
+    with _drain_lock:
+        if evs:
+            _req_emit_seq = min(_req_emit_seq, evs[0]["seq"])
+        if dropped:
+            _req_dropped += dropped
+
+
 # -- reporting -------------------------------------------------------------
 def identity():
     """Who this stream belongs to inside the job: the elastic launch
@@ -637,7 +794,7 @@ def report():
         gauges = {n: g.value for n, g in _gauges.items()}
         hists = dict(_histograms)
         spans = set(_span_names)
-    return {
+    doc = {
         "schema": SCHEMA_REPORT,
         "time_unix": time.time(),
         "pid": os.getpid(),
@@ -652,6 +809,20 @@ def report():
         "step_stats": _profiler().step_stats(),
         "flight": {"len": len(_flight), "maxlen": _flight.maxlen},
     }
+    try:
+        # the periodic serving status line (ISSUE 13): every report from
+        # a process with live engines says what they are serving right
+        # now — occupancy, free pages, SLO state, current weights epoch.
+        # sys.modules-gated exactly like the postmortem block: a
+        # training process must not import the serving stack for this.
+        eng_mod = sys.modules.get("mxnet_tpu.serving.engine")
+        if eng_mod is not None:
+            snaps = eng_mod.live_snapshot()
+            if snaps:
+                doc["serving"] = snaps
+    except Exception:
+        pass  # a half-dead engine must never take a report down
+    return doc
 
 
 def reset():
@@ -663,9 +834,13 @@ def reset():
     # zeroed histograms nor re-append them into the just-cleared ring.
     # Lock order _drain_lock -> _reg_lock matches _drain_steps (via
     # _span_hist); nothing takes them in the reverse order.
+    global _req_seq, _req_emit_seq, _req_dropped
     with _drain_lock:
         del _pending_steps[:]
+        del _pending_req[:]
         _pending_faults.clear()
+        _req_ring.clear()
+        _req_seq = _req_emit_seq = _req_dropped = 0
         with _reg_lock:
             # zero IN PLACE: hot callers hold metric objects (counter()'s
             # documented contract), and clearing the dicts would orphan
@@ -720,6 +895,13 @@ def dump_postmortem(reason, path=None):
     from . import fault as _fault
     doc["fault_fires"] = _fault.fire_counts()
     doc["last_steps"] = flight_records()
+    recs = request_events()
+    if recs:
+        # the request-scope ring (ISSUE 13): a dying replica's record
+        # carries the recent per-request lifecycle events the same way
+        # it carries its per-step ring — serve_report dedups against
+        # already-emitted stream lines by (pid, seq)
+        doc["request_trace"] = recs
     try:
         # hang-defense context: lease ages/timeouts at the moment of
         # death — for a watchdog stall this names the wedged phase
@@ -838,17 +1020,33 @@ def _emit_line(path, final=False, lock_timeout=None):
         if final:
             doc["final"] = True
             doc["last_steps"] = flight_records()
-        data = (json.dumps(doc) + "\n").encode("utf-8")
         if not _emit_lock.acquire(
                 timeout=-1 if lock_timeout is None else lock_timeout):
             return
+        evs = dropped = None
         try:
+            # request-scope events recorded since the previous line:
+            # the stream accumulates the full lifecycle record one
+            # bounded payload at a time (each event ships exactly once;
+            # evictions that outran the emitter are declared, never
+            # silent).  Consumed only once the lock is HELD — and
+            # rolled back if the write fails below — so a skipped or
+            # failed line never silently swallows the cursor advance.
+            evs, dropped = consume_request_events()
+            if evs:
+                doc["req_events"] = evs
+            if dropped:
+                doc["req_dropped"] = dropped
+            data = (json.dumps(doc) + "\n").encode("utf-8")
             fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
                          0o644)
             try:
                 os.write(fd, data)
             finally:
                 os.close(fd)
+        except Exception:
+            _unconsume_request_events(evs, dropped)
+            raise
         finally:
             _emit_lock.release()
     except Exception:

@@ -337,6 +337,162 @@ def section_swap(net=None):
     print("SERVING_SWAP_OK")
 
 
+# -- request-scope tracing laws (ISSUE 13) ---------------------------------
+
+def _token_event_count(evs):
+    """The token-accounting law's left-hand side — the one shared
+    definition (telemetry owns the event schema)."""
+    return telemetry.count_token_events(evs)
+
+
+def _finals(evs, trace):
+    return [e for e in evs
+            if e["event"] == "verdict" and e["trace"] == trace
+            and e["args"].get("final")]
+
+
+def section_trace():
+    """The lifecycle laws, against real engines (test-pinned contract
+    of OBSERVABILITY.md §12):
+
+    - every submitted request reaches EXACTLY ONE terminal verdict
+      span, whatever its fate (completed / shed / expired in queue /
+      expired mid-decode / prefill error / infeasible);
+    - shed and expired requests still close their trace;
+    - the trace id survives router failover: same id on both replicas,
+      a ``retry`` span linking victim -> survivor;
+    - traced token count == the serving.tokens counter delta,
+      bit-exactly;
+    - serve_report reconstructs all of it from a REAL artifact tree
+      (stream + router journal) including the blame section and a
+      loadable merged chrome trace.
+    """
+    import serve_report   # tools/perf_probe (path set in __main__)
+    from mxnet_tpu.serving import Router, ServingReplica, SLOController
+
+    net = _net()
+    rng = np.random.RandomState(7)
+    tree = tempfile.mkdtemp(prefix="surv-trace-")
+    tdir = os.path.join(tree, "telemetry")
+    os.makedirs(tdir)
+    telemetry.reset()
+    telemetry.start_emitter(os.path.join(tdir, "stream-slot0.jsonl"),
+                            interval=0.2)
+
+    # --- engine-level verdict variety (direct submits own their trace)
+    eng = _engine(net)
+    tok0 = telemetry.counter("serving.tokens").value
+    expired_q = eng.submit(rng.randint(0, VOCAB, (4,)).astype(np.int32),
+                           3, deadline_s=1e-5)
+    time.sleep(0.002)
+    fault.configure("serve.prefill.error:1")
+    try:
+        # FIFO: the doomed request expires in the sweep, then `pe` is
+        # the queue head and eats the armed prefill fault
+        pe = eng.submit(rng.randint(0, VOCAB, (4,)).astype(np.int32), 3)
+        eng.step()
+    finally:
+        fault.reset()
+    assert expired_q.verdict == "expired_queue"
+    assert pe.verdict == "prefill_error"
+    ok = eng.submit(rng.randint(0, VOCAB, (5,)).astype(np.int32), 4)
+    mid = eng.submit(rng.randint(0, VOCAB, (5,)).astype(np.int32), 10,
+                     deadline_s=60.0)
+    eng.step()
+    mid.deadline_t = time.perf_counter() - 1.0
+    eng.run_until_idle()
+    assert mid.verdict == "expired_decode"
+    assert ok.verdict == "completed"
+    try:
+        eng.submit(np.zeros(16, np.int32), 32)
+        raise AssertionError("infeasible request accepted")
+    except ValueError:
+        pass
+    slo = SLOController(target_p99_s=0.01, min_samples=2)
+    eng_slo = _engine(net, slo=slo)
+    for _ in range(3):
+        slo.observe(1.0)
+    shed = eng_slo.submit(rng.randint(0, VOCAB, (4,)).astype(np.int32),
+                          3)
+    assert shed.verdict == "shed"
+
+    evs = telemetry.request_events()
+    # law: exactly one FINAL verdict per trace, and it is the last
+    # per-trace event — for EVERY fate above (the infeasible submit
+    # minted a trace too, closed before the raise)
+    traces = {e["trace"] for e in evs if e["trace"]}
+    for tr in traces:
+        finals = _finals(evs, tr)
+        assert len(finals) == 1, (tr, finals)
+        per_trace = [e for e in evs if e["trace"] == tr]
+        assert per_trace[-1]["event"] == "verdict", per_trace[-1]
+    closed = {_finals(evs, e["trace"])[0]["args"]["verdict"]
+              for e in evs if e["trace"]}
+    for v in ("completed", "expired_queue", "expired_decode",
+              "prefill_error", "rejected_infeasible", "shed"):
+        assert v in closed, (v, closed)
+    # law: traced tokens == serving.tokens delta, bit-exactly
+    assert _token_event_count(evs) == \
+        telemetry.counter("serving.tokens").value - tok0
+
+    # --- failover: trace id survives onto the survivor ---------------
+    tok1 = telemetry.counter("serving.tokens").value
+    seen1 = len(telemetry.request_events())
+    reps = [ServingReplica(_engine(net), replica_id="a"),
+            ServingReplica(_engine(net), replica_id="b")]
+    rt = Router(reps, spawn=lambda: ServingReplica(
+        _engine(net), replica_id="c"), max_retries=2,
+        journal_path=os.path.join(tdir, "router-journal-slot0.jsonl"))
+    rrs = [rt.submit(p, 5) for p in _prompts(rng, 6)]
+    rt.step()
+    fault.configure("serve.replica.lost:1")
+    try:
+        rt.run_until_idle()
+    finally:
+        fault.reset()
+    assert rt.failovers == 1
+    assert all(rr.state == "completed" for rr in rrs)
+    evs = telemetry.request_events()[seen1:]
+    retried = [e for e in evs if e["event"] == "retry"]
+    assert retried, "the failover traced no retry span"
+    victim = retried[0]["args"]["from"]
+    for e in retried:
+        tr = e["trace"]
+        # same id on BOTH replicas: victim placement before the retry,
+        # survivor placement after, one final verdict at the end
+        hops = [x["args"]["replica"] for x in evs
+                if x["trace"] == tr and x["event"] in ("place", "admit")]
+        assert victim in hops, (tr, hops)
+        assert hops[-1] != victim, (tr, hops)
+        assert len(_finals(evs, tr)) == 1
+        assert _finals(evs, tr)[0]["args"]["verdict"] == "completed"
+    # router-minted traces: engine-level verdicts along the way are
+    # non-final hops; exactly one FINAL per trace overall
+    for rr in rrs:
+        assert len(_finals(evs, rr.trace)) == 1, rr.trace
+    assert _token_event_count(evs) == \
+        telemetry.counter("serving.tokens").value - tok1
+
+    # --- the fleet report reconstructs it from the real artifacts ----
+    telemetry.stop_emitter()
+    rep = serve_report.analyze(tree)
+    assert rep["lifecycle"]["ok"], rep["lifecycle"]
+    assert rep["linked_arcs"] == len(retried) == len(rep["arcs"])
+    assert any(b["replica"] == victim for b in rep["blame"]), \
+        rep["blame"]
+    assert rep["accounting"]["tokens_match"], rep["accounting"]
+    assert rep["accounting"]["goodput_fraction"] is not None
+    doc, _t0 = serve_report.merged_trace(rep["data"], rep["requests"])
+    path = os.path.join(tree, "trace.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    loaded = json.load(open(path))
+    assert loaded["traceEvents"], "merged trace empty"
+    assert any(e["ph"] == "s" for e in loaded["traceEvents"]), \
+        "no failover flow arrows in the merged trace"
+    print("SERVING_TRACE_OK")
+
+
 # -- stall: the watchdog owns this process's death --------------------------
 
 def section_stall():
@@ -487,6 +643,8 @@ def main(section):
         section_router(net)
     if section in ("swap", "fast"):
         section_swap(net)
+    if section == "trace":
+        section_trace()
     if section == "stall":
         section_stall()
     if section == "e2e":
@@ -494,4 +652,7 @@ def main(section):
 
 
 if __name__ == "__main__":
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "tools",
+        "perf_probe"))
     main(sys.argv[1] if len(sys.argv) > 1 else "fast")

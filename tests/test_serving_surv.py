@@ -158,6 +158,7 @@ class _StubReplica:
         self.reqs = []
         self.die_next = False
         self.last_deadline = None
+        self.last_trace = None
 
     @property
     def load(self):
@@ -167,8 +168,9 @@ class _StubReplica:
     def idle(self):
         return all(r.state == FINISHED for r in self.reqs)
 
-    def submit(self, prompt, max_new, deadline_s=None):
+    def submit(self, prompt, max_new, deadline_s=None, trace=None):
         self.last_deadline = deadline_s
+        self.last_trace = trace
         r = _StubReq(shed=self.shed_mode)
         if not self.shed_mode:
             self.reqs.append(r)

@@ -222,6 +222,13 @@ def _telemetry_env(args, slot):
         "MXTPU_TELEMETRY": spec,
         "MXTPU_POSTMORTEM_DIR":
             os.environ.get("MXTPU_POSTMORTEM_DIR") or d,
+        # serving-scope layout (ISSUE 13): a Router in this slot
+        # journals next to the replica streams (append-only per slot,
+        # like the streams), so tools/perf_probe/serve_report.py finds
+        # journal + streams + postmortems in ONE tree
+        "MXTPU_SERVE_JOURNAL":
+            os.environ.get("MXTPU_SERVE_JOURNAL") or
+            os.path.join(d, "router-journal-slot%d.jsonl" % slot),
     }
 
 

@@ -283,11 +283,15 @@ def merged_trace(job):
                 args["loss"] = rec["loss"]
             if rec.get("faults"):
                 args["faults"] = list(rec["faults"])
-            events.append({"name": "fit_step.dispatch", "cat": "step",
+            # flight records carry their origin since schema grew the
+            # `where` field (serve_step / serve_prefill / fit_step);
+            # older artifacts default to the training name
+            where = rec.get("where") or "fit_step"
+            events.append({"name": where + ".dispatch", "cat": "step",
                            "ph": "X", "pid": slot, "tid": attempt,
                            "ts": ts, "dur": dur, "args": args})
             if rec.get("sync_s") is not None:
-                events.append({"name": "fit_step.sync", "cat": "step",
+                events.append({"name": where + ".sync", "cat": "step",
                                "ph": "X", "pid": slot, "tid": attempt,
                                "ts": ts + dur,
                                "dur": rec["sync_s"] * 1e6,

@@ -32,7 +32,20 @@ batch occupancy.  Hard contracts asserted by ``BENCH_MODE=serve``
   through a 2-replica Router with one replica killed mid-probe
   (``serve.replica.lost``) — zero dropped accepted requests, tokens
   bit-identical to the unfaulted run, and the replacement replica
-  spawns AOT-warm (0 foreground compiles).
+  spawns AOT-warm (0 foreground compiles).  Per-verdict accounting is
+  pinned too: 0 ``failed``, and exactly the killed replica's in-flight
+  count ``retried`` — the degraded contract covers verdicts, not just
+  totals;
+- **request-scope observability** (ISSUE 13): the degraded drill runs
+  against a REAL artifact tree (telemetry stream + router journal in
+  the run-dir layout) and ``serve_report.py`` must reconstruct every
+  accepted request's lifecycle with exactly one terminal verdict, link
+  each failed-over request across both replicas by trace id, name the
+  killed replica in the blame section, emit a merged chrome trace that
+  loads as one file, and reconcile traced tokens with the
+  ``serving.tokens``/``serving.goodput`` counters bit-exactly;
+  ``measure_trace_overhead`` microbenches the per-decode-step tracing
+  cost in isolation (``MXTPU_SERVE_TRACE_BUDGET_US``, default 2).
 
 Usage: JAX_PLATFORMS=cpu python tools/perf_probe/serve_probe.py
 Prints one JSON object.
@@ -104,8 +117,12 @@ def _req_stats(ttfts, tpots, waits):
 def run_continuous(net, workload, num_slots=8, page_size=16,
                    max_prefill_len=32, max_seq_len=48, num_pages=None):
     """Open-loop drive of the ServingEngine; returns throughput, latency
-    percentiles, occupancy, and the dispatch/compile accounting."""
-    from mxnet_tpu import profiler
+    percentiles, occupancy, and the dispatch/compile accounting —
+    WITH request-scope tracing live (it is always on: the 1.0
+    dispatch/step and recompile contracts below therefore hold with the
+    tracing plane enabled, and goodput must equal raw tokens on this
+    unfaulted run)."""
+    from mxnet_tpu import profiler, telemetry
     from mxnet_tpu.serving import ServingEngine
     import numpy as np
 
@@ -116,6 +133,7 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
     # hot-swap settle) before the timed workload
     eng.generate([np.zeros(4, np.int32)], max_new=2)
     profiler.reset_step_stats()
+    telemetry.reset()   # clean counter/trace baseline for the deltas
     base = profiler.step_stats()
     d0, c0 = base["dispatch_count"], base["compile_count"]
     steps0, prefills0 = eng.decode_steps, eng.prefills
@@ -139,7 +157,13 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
     dispatches = stats["dispatch_count"] - d0
     total_tokens = sum(len(r.tokens) for r in reqs)
     decode_tokens = total_tokens - prefills  # 1 token/request from prefill
+    # request-scope accounting on the unfaulted run: traced token
+    # events and goodput must BOTH equal the raw token counter
+    traced = telemetry.count_token_events(telemetry.request_events())
     out = {
+        "tokens_counter": telemetry.counter("serving.tokens").value,
+        "goodput_counter": telemetry.counter("serving.goodput").value,
+        "traced_tokens": traced,
         "requests": len(reqs),
         "num_slots": num_slots,
         "total_tokens": total_tokens,
@@ -226,7 +250,7 @@ def run_sequential(net, workload, t_pad=48):
     return out
 
 
-# -- degraded mode: kill a replica mid-probe (ISSUE 11) --------------------
+# -- degraded mode: kill a replica mid-probe (ISSUE 11 + 13) ---------------
 
 def run_degraded(net, workload, reference_tokens, num_slots=8,
                  page_size=16, max_prefill_len=32, max_seq_len=48,
@@ -240,10 +264,20 @@ def run_degraded(net, workload, reference_tokens, num_slots=8,
     - tokens bit-identical to the unfaulted continuous run (greedy
       determinism survives the failover re-decode);
     - the replacement replica spins up AOT-warm: 0 foreground compiles
-      (in-process memo / shared AOT cache tier).
+      (in-process memo / shared AOT cache tier);
+    - per-VERDICT deltas, not just totals: 0 ``failed``, and exactly
+      the killed replica's in-flight count ``retried``;
+    - the whole drill runs against a REAL artifact tree (telemetry
+      stream + router journal, the launch.py run-dir layout) and
+      ``serve_report`` must reconstruct it: every accepted request one
+      terminal verdict, failed-over requests linked across both
+      replicas by trace id, the killed replica named in the blame
+      section, the merged chrome trace one loadable file, traced
+      tokens == serving.tokens bit-exactly.
     """
-    from mxnet_tpu import fault, profiler
+    from mxnet_tpu import fault, profiler, telemetry
     from mxnet_tpu.serving import Router, ServingEngine, ServingReplica
+    import serve_report
 
     kw = dict(num_slots=num_slots, page_size=page_size,
               max_prefill_len=max_prefill_len, max_seq_len=max_seq_len)
@@ -257,31 +291,91 @@ def run_degraded(net, workload, reference_tokens, num_slots=8,
             profiler.step_stats()["compile_count"] - c0)
         return rep
 
-    rt = Router([ServingReplica(ServingEngine(net, **kw),
-                                replica_id="a"),
-                 ServingReplica(ServingEngine(net, **kw),
-                                replica_id="b")],
-                spawn=spawn, max_retries=2)
+    # the run-dir artifact layout (tools/launch.py contract): stream +
+    # router journal under <run-dir>/telemetry/
+    tree = tempfile.mkdtemp(prefix="serve-degraded-")
+    tdir = os.path.join(tree, "telemetry")
+    os.makedirs(tdir)
+    telemetry.reset()   # the earlier probe phases' events are not ours
+    telemetry.start_emitter(os.path.join(tdir, "stream-slot0.jsonl"),
+                            interval=0.25)
+    replicas = [ServingReplica(ServingEngine(net, **kw),
+                               replica_id="a"),
+                ServingReplica(ServingEngine(net, **kw),
+                               replica_id="b")]
+    rt = Router(replicas, spawn=spawn, max_retries=2,
+                journal_path=os.path.join(
+                    tdir, "router-journal-slot0.jsonl"))
     t_start = time.perf_counter()
     rrs = []
     pending = list(workload)
     steps = 0
     killed = False
+    victim_inflight = None
     while pending or not rt.idle:
         now = time.perf_counter() - t_start
         while pending and pending[0][0] <= now:
             _, prompt, max_new = pending.pop(0)
             rrs.append(rt.submit(prompt, max_new))
         if steps == kill_after_steps and not killed:
+            # snapshot each replica's accepted in-flight count BEFORE
+            # the killing step: the victim's count is exactly what the
+            # router must retry (the per-verdict contract)
+            inflight = {id(r): sum(1 for rr in rrs
+                                   if rr.state == "accepted"
+                                   and rr._home is r)
+                        for r in replicas}
             fault.configure("serve.replica.lost:1")
             killed = True
         if rt.step() == 0 and pending:
             time.sleep(min(1e-4, max(0.0, pending[0][0] - now)))
+        if killed and victim_inflight is None:
+            dead = [r for r in replicas if not r.alive]
+            if dead:
+                victim_inflight = inflight[id(dead[0])]
+                victim_id = dead[0].replica_id
         steps += 1
     fault.reset()
     wall = time.perf_counter() - t_start
+    telemetry.stop_emitter()   # final line flushes remaining events
     completed = [rr for rr in rrs if rr.state == "completed"]
     tokens = [rr.tokens for rr in completed]
+
+    # fleet reconstruction from the REAL artifacts
+    rep = serve_report.analyze(tree)
+    trace_path = os.path.join(tree, "serve-trace.json")
+    doc, _t0 = serve_report.merged_trace(rep["data"], rep["requests"])
+    with open(trace_path, "w") as f:
+        json.dump(doc, f)
+    try:
+        trace_events = len(json.load(open(trace_path))["traceEvents"])
+    except Exception:
+        trace_events = 0
+    acc = rep["accounting"]
+    blamed = {b["replica"] for b in rep["blame"]}
+    report = {
+        "lifecycle_ok": rep["lifecycle"]["ok"],
+        "violations": rep["lifecycle"]["violations"][:5],
+        "open_traces": len(rep["lifecycle"]["open_traces"]),
+        "arcs": len(rep["arcs"]),
+        "linked_arcs": rep["linked_arcs"],
+        "killed_replica": victim_id if victim_inflight is not None
+        else None,
+        "killed_replica_blamed": (victim_id in blamed
+                                  if victim_inflight is not None
+                                  else False),
+        "trace_file_events": trace_events,
+        "tokens_counter": acc["tokens"],
+        "traced_tokens": acc["traced_tokens"],
+        "goodput_counter": acc["goodput"],
+        "token_accounting_exact": acc["tokens_match"],
+    }
+    shutil.rmtree(tree, ignore_errors=True)
+
+    verdicts = {}
+    for rr in rrs:
+        verdicts[rr.verdict or rr.state] = \
+            verdicts.get(rr.verdict or rr.state, 0) + 1
     return {
         "requests": len(rrs),
         "completed": len(completed),
@@ -291,7 +385,40 @@ def run_degraded(net, workload, reference_tokens, num_slots=8,
         "replacement_foreground_compiles": sum(spawn_compiles),
         "tokens_match_unfaulted": tokens == reference_tokens,
         "wall_s": round(wall, 4),
+        # per-verdict accounting (the degraded contract pins verdicts,
+        # not just totals): nothing failed, and the retried count is
+        # exactly the victim's in-flight count at the kill
+        "verdicts": verdicts,
+        "failed": sum(1 for rr in rrs if rr.state == "failed"),
+        "retried": sum(1 for rr in rrs if rr.retries > 0),
+        "expected_retried": victim_inflight,
+        "report": report,
     }
+
+
+def measure_trace_overhead(slots=8, iters=2000, passes=5):
+    """Isolated microbench of the per-decode-step tracing cost: one
+    batched ``tokens`` event naming every resident trace (exactly what
+    ``ServingEngine.step`` adds per decode step), timed hot, median of
+    ``passes``.  ``BENCH_MODE=serve`` asserts it under
+    ``MXTPU_SERVE_TRACE_BUDGET_US`` (default 2 µs/decode-step)."""
+    from mxnet_tpu import telemetry
+
+    telemetry.reset()
+    traces = [telemetry.mint_trace() for _ in range(slots)]
+    note = telemetry.note_request_event
+    results = []
+    for _ in range(passes):
+        t0 = time.perf_counter_ns()
+        for i in range(iters):
+            # list built per step like the engine's comprehension over
+            # its residents — the microbench pays what the hot path pays
+            note("", "tokens", t_ns=t0,
+                 args={"replica": "a", "step": i,
+                       "traces": list(traces)})
+        results.append((time.perf_counter_ns() - t0) / 1e3 / iters)
+        telemetry.reset()
+    return round(sorted(results)[len(results) // 2], 3)
 
 
 # -- AOT-warm replica spin-up (restart_probe pattern) ----------------------
@@ -376,6 +503,7 @@ def run(spinup=True, degraded=True):
         "sequential": seq,
         "speedup_tokens_per_sec": round(
             cont["tokens_per_sec"] / seq["tokens_per_sec"], 2),
+        "trace_overhead_us": measure_trace_overhead(),
     }
     if degraded:
         result["degraded"] = run_degraded(net, workload, cont_tokens)

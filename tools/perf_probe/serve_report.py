@@ -1,0 +1,764 @@
+"""Fleet serving report: request lifecycles, failover arcs, SLO blame.
+
+The serving twin of ``job_report.py`` (ISSUE 13, OBSERVABILITY.md §12).
+A serving fleet leaves three artifact kinds behind in one run-dir tree
+(``tools/launch.py --run-dir`` / ``MXTPU_SERVE_JOURNAL`` layout): the
+Router's audit journal (``router-journal*.jsonl``), each replica
+process's telemetry stream (``stream-slot*.jsonl`` — every line carries
+the request-trace events recorded since the previous line, plus the
+periodic serving status block), and crash postmortems (which carry the
+request-event ring).  ``telemetry_report.py`` renders each artifact
+faithfully; THIS tool answers the fleet-level questions none can alone:
+
+- **what did each request experience** — per-trace lifecycle
+  reconstruction (submit → admit → prefill → every decode token → one
+  terminal verdict), across replicas: a failed-over request's victim
+  and survivor segments are ONE trace linked by the Router's ``retry``
+  event, so the arc reads as a single story;
+- **who served what, and how well** — a per-replica request matrix
+  (admits, tokens, verdicts, retries-out) and TTFT / TPOT / queue-wait
+  percentiles SPLIT BY VERDICT CLASS (a p99 that mixes completed and
+  shed requests describes nothing);
+- **SLO breach blame** — every deadline-missed / shed / failed-over
+  (and, with ``--slo-ttft``, p99-breaching) request decomposed into its
+  phase budget: queue wait, prefill, decode, hot-swap pauses, failover
+  re-decode — with the dominant phase and the responsible replica
+  named.  "Replica a died and its victims spent 60% of their budget
+  re-decoding on b" is a sentence this tool prints, not a forensic
+  project;
+- **goodput and cost-per-token** — ``serving.goodput`` (tokens on
+  requests that completed within deadline) vs raw ``serving.tokens``,
+  joined with the compile-time ``serving.cost.*`` attribution of the
+  decode/prefill executables into measured flops-and-bytes-per-token —
+  the objective function the ROADMAP-item-2 autotuner optimizes;
+- **one merged chrome trace** (``--trace-out``) — pid = replica,
+  tid = decode slot, one span per residency segment, token instants,
+  flow arrows linking failover arcs across replicas, hot-swap pauses,
+  and each process's recent decode-step spans — loadable as ONE file
+  in Perfetto.
+
+Torn artifact lines (a process killed mid-append) are skipped and
+counted, and request events evicted before any stream line could carry
+them are declared per line (``req_dropped``) — no silent caps anywhere.
+
+Usage:
+    python tools/perf_probe/serve_report.py RUN_DIR \
+        [--trace-out serve-trace.json] [--slo-ttft SECONDS]
+
+``discover_run_dir`` / ``parse_artifact`` are shared with
+``telemetry_report.py`` (one input contract, not two copies).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import telemetry_report as _tr  # noqa: E402 (sibling module)
+from restart_probe import _pct  # noqa: E402 — shared percentile helper
+
+#: verdicts that are refusals (the request never held a slot here)
+REFUSAL_VERDICTS = ("shed", "draining", "no_live_replicas")
+#: trace pid for per-process decode-step tracks (real replica pids are
+#: small ordinals; keep the synthetic ones far away)
+PROC_TRACK_BASE = 900
+SWAP_TID = 9990
+
+
+# -- loading ---------------------------------------------------------------
+
+def load_serve(run_dir):
+    """Parse the run dir into the fleet structure: request events (from
+    every stream line's ``req_events`` and every postmortem's
+    ``request_trace``, deduplicated by (process, seq) — a crashed
+    replica leaves the SAME ring twice), the router journal, the last
+    serving status block and counter snapshot per process, and each
+    process's flight records (decode-step spans)."""
+    found = _tr.discover_run_dir(run_dir)
+    notes = []
+    events = {}          # (proc key, seq) -> event dict (+"_pid")
+    counters = {}        # proc key -> last counters dict
+    status = {}          # (proc key, engine tag) -> engine snapshot
+    flights = []         # (proc key, [flight recs]) from finals / pms
+    req_dropped = 0
+    journal = []
+
+    def _proc_key(doc):
+        """One key per fleet PROCESS: identity slot + attempt + pid.
+        The dedup's job is to match a process's stream lines against
+        its own postmortem ring — but pid ALONE collides across
+        containerized replicas (every container's service can be pid
+        7) and across restart attempts that recycle a pid, and a
+        collision would silently discard a whole replica's lifecycle
+        record.  Slot/attempt (the elastic identity the transport
+        stamps on every line) disambiguate both."""
+        ident = doc.get("identity") or {}
+        return (ident.get("slot"), ident.get("attempt"),
+                ident.get("pid") or doc.get("pid"))
+
+    def _fold(doc, recs):
+        pkey = _proc_key(doc)
+        pid = pkey[-1]
+        for e in recs:
+            events.setdefault((pkey, e.get("seq")), dict(e, _pid=pid))
+        return pkey
+
+    for path in found["streams"]:
+        for doc in _tr.parse_artifact(path, notes):
+            pkey = _fold(doc, doc.get("req_events") or [])
+            req_dropped += doc.get("req_dropped", 0)
+            if doc.get("counters"):
+                counters[pkey] = doc["counters"]
+            for snap in doc.get("serving") or []:
+                status[(pkey, snap.get("replica"))] = snap
+            if doc.get("last_steps"):
+                flights.append((pkey, doc["last_steps"]))
+    for path in found["postmortems"]:
+        docs = _tr.parse_artifact(path, notes)
+        if docs:
+            doc = docs[-1]
+            pkey = _fold(doc, doc.get("request_trace") or [])
+            # a postmortem is the AT-DEATH view — newer than the last
+            # periodic stream line by up to one emitter interval.
+            # Counters are monotonic, so max-merge keeps whichever
+            # artifact saw more (a stale stream line must not produce
+            # a spurious traced-vs-counter mismatch for a crash, the
+            # exact scenario this tool serves)
+            pm = doc.get("counters") or {}
+            cur = counters.setdefault(pkey, pm)
+            if cur is not pm:
+                for k, v in pm.items():
+                    old = cur.get(k)
+                    if isinstance(v, (int, float)) and \
+                            isinstance(old, (int, float)):
+                        cur[k] = max(old, v)
+                    elif k not in cur:
+                        cur[k] = v
+            for snap in doc.get("serving") or []:
+                key = (pkey, snap.get("replica"))
+                old = status.get(key)
+                if old is None or (snap.get("decode_steps") or 0) >= \
+                        (old.get("decode_steps") or 0):
+                    status[key] = snap
+    for path in found["router_journals"]:
+        for doc in _tr.parse_artifact(path, notes):
+            if "rid" in doc and "event" in doc:
+                journal.append(doc)
+    evs = sorted(events.values(),
+                 key=lambda e: (e.get("t", 0), e.get("seq", 0)))
+    return {"run_dir": run_dir, "events": evs, "journal": journal,
+            "counters": counters, "status": status, "flights": flights,
+            "req_dropped": req_dropped, "notes": notes}
+
+
+# -- lifecycle reconstruction ----------------------------------------------
+
+def build_requests(events):
+    """Per-trace lifecycle records from the merged event list.
+
+    The batched ``tokens`` events (one per decode step, naming every
+    advanced trace) are len-expanded here: each named trace gets one
+    token at the step's stamp.  Engine-scope ``swap`` events are charged
+    to the traces they name.  Returns ``{trace: record}`` where a record
+    holds the ordered events, per-segment residency (a new segment per
+    ``admit`` — a failover arc has one per replica), token timestamps,
+    retries, swap pauses, and the final verdict.
+
+    Ordering uses the merged-list POSITION (the (t, seq) sort of
+    ``load_serve``), never raw ``seq``: seq counters are per-process,
+    and a trace spanning a router process and a remote replica process
+    would compare apples to oranges."""
+    reqs = {}
+
+    def rec(trace):
+        r = reqs.get(trace)
+        if r is None:
+            r = reqs[trace] = {
+                "trace": trace, "events": [], "segments": [],
+                "token_ts": [], "retries": [], "swap_s": 0.0,
+                "swap_count": 0, "verdicts": [], "final": None,
+                "submit_t": None, "rid": None, "router": False,
+                "prompt_len": None, "max_new": None,
+                "deadline_s": None, "last_pos": -1,
+            }
+        return r
+
+    for pos, e in enumerate(events):
+        ev, tr = e.get("event"), e.get("trace")
+        args = e.get("args") or {}
+        if ev == "tokens":
+            for t in args.get("traces") or []:
+                r = rec(t)
+                r["token_ts"].append(e.get("t"))
+                if r["segments"]:
+                    r["segments"][-1]["tokens"] += 1
+                r["last_pos"] = pos
+            continue
+        if ev == "swap":
+            for t in args.get("traces") or []:
+                r = rec(t)
+                r["swap_s"] += args.get("dur_s") or 0.0
+                r["swap_count"] += 1
+            continue
+        if not tr:
+            continue
+        r = rec(tr)
+        r["events"].append(e)
+        r["last_pos"] = pos
+        if ev == "submit":
+            if r["submit_t"] is None:
+                r["submit_t"] = e.get("t")
+            r["router"] = r["router"] or bool(args.get("router"))
+            for k in ("prompt_len", "max_new", "deadline_s"):
+                if r[k] is None:
+                    r[k] = args.get(k)
+            if args.get("rid") is not None and r["router"]:
+                r["rid"] = args.get("rid")
+        elif ev == "admit":
+            r["segments"].append({
+                "replica": args.get("replica"), "t": e.get("t"),
+                "slot": args.get("slot"),
+                "queue_wait_s": args.get("queue_wait_s") or 0.0,
+                "prefill_s": 0.0, "tokens": 0, "end": None,
+            })
+        elif ev == "prefill":
+            if r["segments"]:
+                r["segments"][-1]["prefill_s"] += (
+                    (args.get("dispatch_s") or 0.0)
+                    + (args.get("sync_s") or 0.0))
+        elif ev == "token":
+            r["token_ts"].append(e.get("t"))
+            if r["segments"]:
+                r["segments"][-1]["tokens"] += 1
+        elif ev == "retry":
+            r["retries"].append({"t": e.get("t"),
+                                 "from": args.get("from")})
+            if r["segments"]:
+                r["segments"][-1]["end"] = e.get("t")
+        elif ev == "verdict":
+            r["verdicts"].append(dict(e, _pos=pos))
+            if args.get("final"):
+                r["final"] = r["verdicts"][-1]
+            if r["segments"] and r["segments"][-1]["end"] is None:
+                r["segments"][-1]["end"] = e.get("t")
+            if r["rid"] is None and args.get("rid") is not None:
+                r["rid"] = args.get("rid")
+    for r in reqs.values():
+        _phase_budget(r)
+    return reqs
+
+
+def _phase_budget(r):
+    """Decompose one request's wall time into its phase budget (the
+    blame decomposition).  ``failover_s`` is the window from each
+    ``retry`` until the survivor REGAINED the victim's progress (the
+    k tokens produced before the loss exist again at overall token
+    2k — greedy re-decode reproduces them bit-identically), so the
+    re-decode is charged to the failover, not to useful decode.  The
+    phases partition total wall time exactly: ``decode_s`` is the
+    remainder, never double-counted."""
+    final = r["final"] or (r["verdicts"][-1] if r["verdicts"] else None)
+    t0 = r["submit_t"]
+    t1 = final["t"] if final is not None else (
+        r["token_ts"][-1] if r["token_ts"] else t0)
+    if t0 is None or t1 is None:
+        r["phases"] = None
+        return
+    total = max(0.0, t1 - t0)
+    # a request that never reached a slot (expired in queue, shed,
+    # refused) spent its WHOLE budget waiting — that is queue time,
+    # not decode time
+    queue = (sum(s["queue_wait_s"] for s in r["segments"])
+             if r["segments"] else total)
+    prefill = sum(s["prefill_s"] for s in r["segments"])
+    swap = r["swap_s"]
+    failover = 0.0
+    ts = r["token_ts"]
+    dup = 0   # tokens already re-produced by earlier failovers
+    for ret in sorted(r["retries"], key=lambda x: x["t"] or 0):
+        k = sum(1 for t in ts if t <= ret["t"])
+        unique = k - dup      # the victim's NET progress to re-produce
+        if unique <= 0:
+            # killed while queued / pre-first-token: nothing to regain,
+            # and the survivor's queue wait is already in queue_s —
+            # charging a window here would double-count it
+            continue
+        target = k + unique   # overall token count at regained progress
+        regained = ts[target - 1] if len(ts) >= target else t1
+        failover += max(0.0, regained - ret["t"])
+        dup = k
+    used = queue + prefill + swap + failover
+    decode = max(0.0, total - used)
+    r["phases"] = {"total_s": total, "queue_s": queue,
+                   "prefill_s": prefill, "decode_s": decode,
+                   "swap_s": swap, "failover_s": failover}
+    r["dominant"] = max(
+        ("queue_s", "prefill_s", "decode_s", "swap_s", "failover_s"),
+        key=lambda k: r["phases"][k])[:-2]
+
+
+def lifecycle_check(reqs):
+    """The trace laws (test-pinned, asserted by ``BENCH_MODE=serve``):
+    every trace closes with EXACTLY ONE final verdict event, and that
+    verdict is the trace's last event.  Returns the violation list
+    (empty == lawful) and the set of open traces."""
+    violations, open_traces = [], []
+    for tr, r in sorted(reqs.items()):
+        finals = [v for v in r["verdicts"]
+                  if (v.get("args") or {}).get("final")]
+        if not finals:
+            open_traces.append(tr)
+            continue
+        if len(finals) > 1:
+            violations.append(
+                "trace %s has %d final verdicts (law: exactly one)"
+                % (tr, len(finals)))
+        if finals[-1]["_pos"] < r["last_pos"]:
+            violations.append(
+                "trace %s has events after its final verdict" % tr)
+    return violations, open_traces
+
+
+# -- fleet views -----------------------------------------------------------
+
+def replica_matrix(reqs):
+    """{replica: {admits, tokens, retries_out, verdict counts}} — the
+    per-replica request matrix."""
+    m = {}
+
+    def row(tag):
+        return m.setdefault(tag, {"admits": 0, "tokens": 0,
+                                  "retries_out": 0, "verdicts": {}})
+
+    for r in reqs.values():
+        for seg in r["segments"]:
+            rr = row(seg["replica"])
+            rr["admits"] += 1
+            rr["tokens"] += seg["tokens"]
+        for ret in r["retries"]:
+            row(ret["from"])["retries_out"] += 1
+        final = r["final"]
+        if final is not None:
+            tag = ((final.get("args") or {}).get("replica")
+                   or (r["segments"][-1]["replica"] if r["segments"]
+                       else "-"))
+            v = (final.get("args") or {}).get("verdict")
+            vr = row(tag)["verdicts"]
+            vr[v] = vr.get(v, 0) + 1
+    return m
+
+
+def verdict_latency_split(reqs):
+    """{verdict: {n, ttft p50/p99, tpot p50/p99, queue p50/p99}} from
+    the final verdict events' latency stamps."""
+    groups = {}
+    for r in reqs.values():
+        if r["final"] is None:
+            continue
+        args = r["final"].get("args") or {}
+        g = groups.setdefault(args.get("verdict"),
+                              {"n": 0, "ttft": [], "tpot": [],
+                               "queue": []})
+        g["n"] += 1
+        for key, field in (("ttft", "ttft_s"), ("tpot", "tpot_s"),
+                           ("queue", "queue_wait_s")):
+            if args.get(field) is not None:
+                g[key].append(args[field])
+    out = {}
+    for v, g in groups.items():
+        row = {"n": g["n"]}
+        for key in ("ttft", "tpot", "queue"):
+            vals = sorted(g[key])
+            row[key + "_p50"] = _pct(vals, 0.5)
+            row[key + "_p99"] = _pct(vals, 0.99)
+        out[v] = row
+    return out
+
+
+def failover_arcs(reqs):
+    """Failed-over requests as linked arcs: one per retried trace —
+    victim replica, survivor replica, tokens lost/regained, and whether
+    the arc completed."""
+    arcs = []
+    for tr, r in sorted(reqs.items()):
+        if not r["retries"]:
+            continue
+        hops = [s["replica"] for s in r["segments"]]
+        arcs.append({
+            "trace": tr, "rid": r["rid"],
+            "victims": [ret["from"] for ret in r["retries"]],
+            "path": hops,
+            "survivor": hops[-1] if hops else None,
+            "verdict": ((r["final"] or {}).get("args") or {})
+            .get("verdict"),
+            "failover_s": (r["phases"] or {}).get("failover_s"),
+        })
+    return arcs
+
+
+def blame(reqs, slo_ttft=None):
+    """The SLO breach blame list: every request whose terminal verdict
+    is not ``completed``, every failed-over request, and (with
+    ``slo_ttft``) every completed request whose TTFT breached it —
+    each decomposed into its phase budget with the dominant phase and
+    the responsible replica named."""
+    out = []
+    for tr, r in sorted(reqs.items()):
+        final = r["final"]
+        if final is None:
+            continue
+        args = final.get("args") or {}
+        verdict = args.get("verdict")
+        breach = None
+        if verdict != "completed":
+            breach = verdict
+        elif r["retries"]:
+            breach = "failed_over"
+        elif slo_ttft is not None and \
+                (args.get("ttft_s") or 0.0) > slo_ttft:
+            breach = "ttft_over_slo"
+        if breach is None:
+            continue
+        phases = r["phases"] or {}
+        dominant = r.get("dominant")
+        if r["retries"]:
+            blamed = r["retries"][-1]["from"]
+            why = "replica %s lost mid-decode" % blamed
+        elif verdict in REFUSAL_VERDICTS:
+            blamed = ((r["verdicts"][0].get("args") or {})
+                      .get("replica") if r["verdicts"] else None)
+            why = "intake refused (%s)" % verdict
+        elif dominant == "queue":
+            blamed = (r["segments"][0]["replica"] if r["segments"]
+                      else args.get("replica"))
+            why = "queue wait dominated"
+        else:
+            blamed = (r["segments"][-1]["replica"] if r["segments"]
+                      else args.get("replica"))
+            why = "%s phase dominated" % (dominant or "?")
+        out.append({"trace": tr, "rid": r["rid"], "breach": breach,
+                    "verdict": verdict, "phases": phases,
+                    "dominant": dominant, "replica": blamed,
+                    "why": why})
+    out.sort(key=lambda b: -(b["phases"].get("total_s") or 0.0)
+             if b["phases"] else 0.0)
+    return out
+
+
+def accounting(data, reqs):
+    """Goodput vs raw tokens, traced-vs-counter reconciliation, and
+    flops/bytes-per-token from the compile-time cost attribution joined
+    with the measured execution counts."""
+    tokens = goodput = requests = dropped = 0
+    for c in data["counters"].values():
+        tokens += c.get("serving.tokens", 0)
+        goodput += c.get("serving.goodput", 0)
+        requests += c.get("serving.requests", 0)
+        dropped += c.get("serving.trace_dropped", 0)
+    traced = sum(len(r["token_ts"]) for r in reqs.values())
+    flops = bytes_ = 0.0
+    have_cost = False
+    for snap in data["status"].values():
+        cost = snap.get("cost") or {}
+        dec, pre = cost.get("decode") or {}, cost.get("prefill") or {}
+        if dec.get("flops") is not None:
+            have_cost = True
+            flops += (dec.get("flops", 0.0)
+                      * (snap.get("decode_steps") or 0)
+                      + pre.get("flops", 0.0)
+                      * (snap.get("prefills") or 0))
+            bytes_ += (dec.get("bytes_accessed", 0.0)
+                       * (snap.get("decode_steps") or 0)
+                       + pre.get("bytes_accessed", 0.0)
+                       * (snap.get("prefills") or 0))
+    return {
+        "tokens": tokens, "goodput": goodput, "requests": requests,
+        "traced_tokens": traced,
+        "tokens_match": traced == tokens and not dropped
+        and not data["req_dropped"],
+        "trace_dropped": dropped + data["req_dropped"],
+        "goodput_fraction": (goodput / tokens) if tokens else None,
+        "flops_per_token": (flops / tokens) if have_cost and tokens
+        else None,
+        "bytes_per_token": (bytes_ / tokens) if have_cost and tokens
+        else None,
+    }
+
+
+# -- merged chrome trace ---------------------------------------------------
+
+def merged_trace(data, reqs):
+    """One chrome-tracing document for the fleet: pid = replica (tid =
+    decode slot; residency segments as spans, tokens as thread-scoped
+    instants, failover arcs as flow arrows crossing replica tracks,
+    hot-swap pauses on a dedicated row), plus each process's recent
+    decode-step spans (the flight ring) on per-process tracks.  Returns
+    ``(doc, t0_unix)``."""
+    tags = sorted({s["replica"] for r in reqs.values()
+                   for s in r["segments"] if s["replica"] is not None})
+    pid_of = {tag: i + 1 for i, tag in enumerate(tags)}
+    stamps = [r["submit_t"] for r in reqs.values()
+              if r["submit_t"] is not None]
+    stamps += [rec["t_unix"] for _, recs in data["flights"]
+               for rec in recs if rec.get("t_unix")]
+    t0 = min(stamps) if stamps else 0.0
+
+    def us(t):
+        return (t - t0) * 1e6
+
+    events = []
+    for tag, pid in pid_of.items():
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "args": {"name": "replica %s" % tag}})
+    flow_id = 0
+    for tr, r in sorted(reqs.items()):
+        label = "req %s" % (r["rid"] if r["rid"] is not None else tr)
+        final_args = (r["final"] or {}).get("args") or {}
+        prev_end = None
+        for i, seg in enumerate(r["segments"]):
+            pid = pid_of.get(seg["replica"], 0)
+            tid = seg["slot"] if seg["slot"] is not None else 0
+            end = seg["end"]
+            if end is None:
+                seg_ts = [t for t in r["token_ts"] if t >= seg["t"]]
+                end = seg_ts[-1] if seg_ts else seg["t"]
+            events.append({
+                "name": label, "cat": "request", "ph": "X",
+                "pid": pid, "tid": tid, "ts": us(seg["t"]),
+                "dur": max(1.0, (end - seg["t"]) * 1e6),
+                "args": {"trace": tr, "segment": i,
+                         "tokens": seg["tokens"],
+                         "verdict": final_args.get("verdict")}})
+            if prev_end is not None:
+                # the failover arc: an arrow from the victim segment's
+                # end to the survivor's admit
+                flow_id += 1
+                events.append({"name": "failover", "cat": "request",
+                               "ph": "s", "id": flow_id, "pid":
+                               prev_end[0], "tid": prev_end[1],
+                               "ts": us(prev_end[2])})
+                events.append({"name": "failover", "cat": "request",
+                               "ph": "f", "bp": "e", "id": flow_id,
+                               "pid": pid, "tid": tid,
+                               "ts": us(seg["t"])})
+            prev_end = (pid, tid, end)
+        for t in r["token_ts"]:
+            seg = next((s for s in reversed(r["segments"])
+                        if s["t"] <= t), None)
+            if seg is None:
+                continue
+            events.append({"name": "token", "cat": "token", "ph": "i",
+                           "s": "t",
+                           "pid": pid_of.get(seg["replica"], 0),
+                           "tid": seg["slot"] or 0, "ts": us(t),
+                           "args": {"trace": tr}})
+    for e in (e for e in data["events"] if e.get("event") == "swap"):
+        args = e.get("args") or {}
+        pid = pid_of.get(args.get("replica"), 0)
+        events.append({"name": "swap epoch %s%s"
+                       % (args.get("epoch"),
+                          "" if args.get("ok") else " (ROLLBACK)"),
+                       "cat": "swap", "ph": "X", "pid": pid,
+                       "tid": SWAP_TID, "ts": us(e.get("t", t0)),
+                       "dur": max(1.0, (args.get("dur_s") or 0.0)
+                                  * 1e6),
+                       "args": {"traces": args.get("traces")}})
+    for i, (proc, recs) in enumerate(data["flights"]):
+        pid = PROC_TRACK_BASE + i
+        slot, attempt, ppid = proc
+        label = "pid %s" % ppid if slot is None else \
+            "slot %s attempt %s pid %s" % (slot, attempt, ppid)
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "args": {"name": "process %s (decode steps)"
+                                % label}})
+        for rec in recs:
+            where = rec.get("where") or "step"
+            ts = us(rec.get("t_unix", t0))
+            dur = (rec.get("dispatch_s") or 0.0) * 1e6
+            events.append({"name": where + ".dispatch", "cat": "step",
+                           "ph": "X", "pid": pid, "tid": 0, "ts": ts,
+                           "dur": dur,
+                           "args": {"step": rec.get("step")}})
+            if rec.get("sync_s") is not None:
+                events.append({"name": where + ".sync", "cat": "step",
+                               "ph": "X", "pid": pid, "tid": 0,
+                               "ts": ts + dur,
+                               "dur": rec["sync_s"] * 1e6,
+                               "args": {"step": rec.get("step")}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}, t0
+
+
+# -- the report ------------------------------------------------------------
+
+def analyze(run_dir, slo_ttft=None):
+    """Load + reconstruct + judge: the structured fleet report
+    (``render`` prints it; ``BENCH_MODE=serve`` asserts on it)."""
+    data = load_serve(run_dir)
+    reqs = build_requests(data["events"])
+    violations, open_traces = lifecycle_check(reqs)
+    arcs = failover_arcs(reqs)
+    journal_retries = [d for d in data["journal"]
+                       if d.get("event") == "retry"]
+    # an arc is LINKED when the same trace names both a victim and a
+    # different survivor — a victim killed while still queued (no
+    # residency segment on the dead replica) links exactly the same way
+    linked_arcs = sum(
+        1 for a in arcs
+        if a["victims"] and a["survivor"] is not None
+        and a["survivor"] not in a["victims"])
+    return {
+        "data": data, "requests": reqs,
+        "lifecycle": {"violations": violations,
+                      "open_traces": open_traces,
+                      "ok": not violations and not open_traces},
+        "matrix": replica_matrix(reqs),
+        "latency": verdict_latency_split(reqs),
+        "arcs": arcs, "linked_arcs": linked_arcs,
+        "journal_retries": journal_retries,
+        "blame": blame(reqs, slo_ttft),
+        "accounting": accounting(data, reqs),
+    }
+
+
+def render(rep, out=sys.stdout):
+    data = rep["data"]
+    reqs = rep["requests"]
+    out.write("== SERVE REPORT %s ==\n" % data["run_dir"])
+    out.write("  %d trace(s), %d journal line(s), %d replica stream "
+              "process(es)\n"
+              % (len(reqs), len(data["journal"]),
+                 len(data["counters"])))
+    for note in data["notes"]:
+        out.write("  %s\n" % note)
+    if data["req_dropped"]:
+        out.write("  WARNING: %d request event(s) evicted before any "
+                  "stream line carried them — lifecycles may have "
+                  "gaps\n" % data["req_dropped"])
+    lc = rep["lifecycle"]
+    if lc["ok"]:
+        out.write("  lifecycle laws: every trace closed with exactly "
+                  "one final verdict\n")
+    else:
+        for v in lc["violations"]:
+            out.write("  LIFECYCLE VIOLATION: %s\n" % v)
+        for tr in lc["open_traces"]:
+            out.write("  OPEN TRACE (no final verdict): %s\n" % tr)
+
+    out.write("\n-- per-replica request matrix --\n")
+    rows = []
+    for tag in sorted(rep["matrix"]):
+        m = rep["matrix"][tag]
+        rows.append((tag, m["admits"], m["tokens"], m["retries_out"],
+                     "  ".join("%s=%d" % kv
+                               for kv in sorted(m["verdicts"].items()))
+                     or "-"))
+    _tr._table(("replica", "admits", "tokens", "lost", "verdicts"),
+               rows, out)
+
+    out.write("\n-- latency by verdict class --\n")
+    rows = []
+    for v in sorted(rep["latency"]):
+        g = rep["latency"][v]
+        rows.append((v, g["n"], _tr._fmt_s(g["ttft_p50"]),
+                     _tr._fmt_s(g["ttft_p99"]),
+                     _tr._fmt_s(g["tpot_p50"]),
+                     _tr._fmt_s(g["queue_p50"]),
+                     _tr._fmt_s(g["queue_p99"])))
+    _tr._table(("verdict", "n", "ttft_p50", "ttft_p99", "tpot_p50",
+                "queue_p50", "queue_p99"), rows, out)
+
+    if rep["arcs"]:
+        out.write("\n-- failover arcs (linked by trace id) --\n")
+        for a in rep["arcs"]:
+            out.write("  req %s [%s]: %s -> %s (%s, failover cost %s)"
+                      "\n"
+                      % (a["rid"] if a["rid"] is not None
+                         else a["trace"],
+                         a["trace"], " + ".join(a["victims"]),
+                         a["survivor"], a["verdict"],
+                         _tr._fmt_s(a["failover_s"])))
+
+    if rep["blame"]:
+        out.write("\n-- SLO breach blame --\n")
+        for b in rep["blame"]:
+            p = b["phases"] or {}
+            out.write("  req %s (%s): %s — dominant %s; %s\n"
+                      % (b["rid"] if b["rid"] is not None
+                         else b["trace"], b["breach"],
+                         "  ".join("%s %s" % (k[:-2],
+                                              _tr._fmt_s(p.get(k)))
+                                   for k in ("queue_s", "prefill_s",
+                                             "decode_s", "swap_s",
+                                             "failover_s")
+                                   if p.get(k)),
+                         b["dominant"], b["why"]))
+        blamed = {}
+        for b in rep["blame"]:
+            if b["replica"] is not None:
+                blamed[b["replica"]] = blamed.get(b["replica"], 0) + 1
+        if blamed:
+            out.write("  blame by replica: " + "  ".join(
+                "%s=%d" % kv for kv in sorted(blamed.items())) + "\n")
+    else:
+        out.write("\n  no SLO breaches: every request completed "
+                  "without failover\n")
+
+    acc = rep["accounting"]
+    out.write("\n-- goodput / cost --\n")
+    out.write("  tokens=%d goodput=%d (%.1f%%)  traced=%d (%s)\n"
+              % (acc["tokens"], acc["goodput"],
+                 100.0 * (acc["goodput_fraction"] or 0.0),
+                 acc["traced_tokens"],
+                 "bit-exact" if acc["tokens_match"]
+                 else "MISMATCH vs serving.tokens"))
+    if acc["flops_per_token"] is not None:
+        out.write("  cost per token: %.3g flops, %.3g bytes accessed "
+                  "(compile-time attribution x measured executions)\n"
+                  % (acc["flops_per_token"], acc["bytes_per_token"]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge a serving fleet's artifacts (router journal "
+        "+ replica streams + postmortems) into one report: request "
+        "lifecycles, failover arcs, SLO breach blame, goodput/cost, "
+        "merged chrome trace")
+    ap.add_argument("run_dir", help="run dir holding the telemetry "
+                    "tree (stream-slot*.jsonl, router-journal*.jsonl, "
+                    "postmortems)")
+    ap.add_argument("--slo-ttft", type=float, default=None,
+                    help="also blame COMPLETED requests whose TTFT "
+                    "exceeded this many seconds")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the merged fleet chrome trace "
+                    "(Perfetto-loadable) to this path")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        sys.stderr.write("serve_report.py: %s is not a run dir\n"
+                         % args.run_dir)
+        return 2
+    rep = analyze(args.run_dir, slo_ttft=args.slo_ttft)
+    if not rep["requests"]:
+        sys.stderr.write("serve_report.py: no request traces under %s "
+                         "(serve with telemetry enabled? "
+                         "MXTPU_TELEMETRY / --telemetry-dir)\n"
+                         % args.run_dir)
+        return 1
+    render(rep)
+    if args.trace_out:
+        doc, t0 = merged_trace(rep["data"], rep["requests"])
+        with open(args.trace_out, "w") as f:
+            json.dump(doc, f)
+        spans = sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
+        sys.stdout.write("\n  merged trace: %s (%d span(s) across %d "
+                         "replica track(s), t0=%.3f)\n"
+                         % (args.trace_out, spans, sum(
+                             1 for e in doc["traceEvents"]
+                             if e["ph"] == "M"
+                             and str(e["args"].get("name", ""))
+                             .startswith("replica")), t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

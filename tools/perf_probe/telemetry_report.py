@@ -15,7 +15,12 @@ sniffed per artifact type:
 - an elastic membership journal (schema ``mxtpu-membership-1``) written
   by ``tools/launch.py`` into ``<run-dir>/membership.json`` — rendered
   as the world-size transition timeline (attempt starts, failures with
-  blamed slot/exit, evictions, re-admissions).
+  blamed slot/exit, evictions, re-admissions), or
+- a Router audit journal (``router-journal*.jsonl``, schema-less JSON
+  lines keyed by request id) — rendered as event/verdict counts and
+  failover arcs.  Serving replicas' streams additionally render a
+  "serving plane" digest (periodic status line: occupancy, pages, SLO
+  state, weights epoch); ``serve_report.py`` merges the fleet.
 
 A **run directory** (``tools/launch.py --run-dir``) renders everything
 it holds together — the membership journal, every rank's stream, every
@@ -127,6 +132,7 @@ def render_report(doc, out, context=""):
             "%s=%s" % kv for kv in sorted(gauges.items())) + "\n")
     _render_ckpt_pipeline(doc, out)
     _render_io_pipeline(doc, out)
+    _render_serving_plane(doc, out)
 
 
 # phases the step loop actually blocks on under async checkpointing vs
@@ -204,6 +210,79 @@ def _render_io_pipeline(doc, out):
                      _fmt_s(h["max"]), _fmt_s(h["sum"])))
     _table(("span", "count", "mean", "p50", "p99", "max", "total"),
            rows, out)
+
+
+def _render_serving_plane(doc, out):
+    """Serving-scope digest (OBSERVABILITY.md §12): the request/token/
+    goodput counters and — when the line carries the periodic serving
+    status block — one row per live engine (occupancy, pages, SLO
+    controller state, weights epoch).  ``serve_report.py`` (same
+    directory) merges the whole fleet; this renders one process's
+    view faithfully."""
+    c = doc.get("counters") or {}
+    serving = doc.get("serving") or []
+    requests = c.get("serving.requests", 0)
+    if not requests and not serving and not c.get("router.requests"):
+        return
+    tokens = c.get("serving.tokens", 0)
+    goodput = c.get("serving.goodput", 0)
+    out.write("\n  serving plane: requests=%d tokens=%d goodput=%d "
+              "(%.1f%%) shed=%d expired=%d+%d swaps=%d rollbacks=%d "
+              "trace_dropped=%d\n"
+              % (requests, tokens, goodput,
+                 100.0 * goodput / tokens if tokens else 100.0,
+                 c.get("serving.shed", 0),
+                 c.get("serving.expired_queue", 0),
+                 c.get("serving.expired_decode", 0),
+                 c.get("serving.swaps", 0),
+                 c.get("serving.swap_rollbacks", 0),
+                 c.get("serving.trace_dropped", 0)))
+    rows = []
+    for s in serving:
+        slo = s.get("slo") or {}
+        rows.append((s.get("replica"), "%s/%s" % (s.get("occupancy"),
+                                                  s.get("num_slots")),
+                     s.get("queued"),
+                     "%s/%s" % (s.get("free_pages"), s.get("num_pages")),
+                     s.get("decode_steps"),
+                     "drain" if s.get("draining") else
+                     ("shed" if s.get("shedding") else "ok"),
+                     ("-" if slo.get("windowed_p99_s") is None
+                      else _fmt_s(slo.get("windowed_p99_s"))),
+                     s.get("weights_epoch")
+                     if s.get("weights_epoch") is not None else "-"))
+    if rows:
+        _table(("engine", "occ", "queued", "pages_free", "steps",
+                "state", "slo_p99", "epoch"), rows, out)
+
+
+def render_router_journal(docs, out, path=""):
+    """Summarize a Router audit journal (one JSON line per lifecycle
+    transition): event counts, failover arcs, terminal verdicts — the
+    faithful single-artifact view; ``serve_report.py`` joins it with
+    the replica streams for blame."""
+    events = {}
+    verdicts = {}
+    retries = [d for d in docs if d.get("event") == "retry"]
+    for d in docs:
+        events[d.get("event", "?")] = events.get(d.get("event", "?"),
+                                                 0) + 1
+        if d.get("event") in ("complete", "fail", "refuse", "drop",
+                              "reject") and d.get("verdict"):
+            verdicts[d["verdict"]] = verdicts.get(d["verdict"], 0) + 1
+    out.write("== ROUTER JOURNAL%s: %d line(s), %d request(s) ==\n"
+              % ((" " + path) if path else "", len(docs),
+                 len({d.get("rid") for d in docs})))
+    out.write("  events: " + "  ".join(
+        "%s=%d" % kv for kv in sorted(events.items())) + "\n")
+    if verdicts:
+        out.write("  terminal verdicts: " + "  ".join(
+            "%s=%d" % kv for kv in sorted(verdicts.items())) + "\n")
+    for d in retries:
+        out.write("  failover: rid %s trace %s off replica %s "
+                  "(retry %s)\n"
+                  % (d.get("rid"), d.get("trace"), d.get("from_replica"),
+                     d.get("retries")))
 
 
 def render_membership(doc, out):
@@ -337,6 +416,11 @@ def render_file(path, out=sys.stdout):
     if schema.startswith("mxtpu-membership-"):
         render_membership(last, out)
         return
+    if not schema and "rid" in last and "event" in last:
+        # a Router audit journal: schema-less JSON lines keyed by
+        # request id + lifecycle event
+        render_router_journal(docs, out)
+        return
     ctx = ""
     if len(docs) > 1:
         span = last.get("time_unix", 0) - docs[0].get("time_unix", 0)
@@ -347,14 +431,17 @@ def render_file(path, out=sys.stdout):
 
 def discover_run_dir(run_dir):
     """Inventory a launch.py run dir: the membership journal, every
-    per-slot stream, every postmortem, every stall-stacks dump — looking
-    both at the top level and under ``telemetry/`` (the launcher's
-    default tree).  Returns ``{"membership": path|None, "streams": [...],
-    "postmortems": [...], "stall_stacks": [...]}`` with sorted lists.
-    Shared with job_report.py (its input contract)."""
+    per-slot stream, every router journal (the serving fleet's audit
+    record — ``router-journal*.jsonl``, the ``MXTPU_SERVE_JOURNAL``
+    layout), every postmortem, every stall-stacks dump — looking both at
+    the top level and under ``telemetry/`` (the launcher's default
+    tree).  Returns ``{"membership": path|None, "streams": [...],
+    "router_journals": [...], "postmortems": [...],
+    "stall_stacks": [...]}`` with sorted lists.  Shared with
+    job_report.py and serve_report.py (their input contract)."""
     roots = [run_dir, os.path.join(run_dir, "telemetry")]
-    found = {"membership": None, "streams": [], "postmortems": [],
-             "stall_stacks": []}
+    found = {"membership": None, "streams": [], "router_journals": [],
+             "postmortems": [], "stall_stacks": []}
     for root in roots:
         try:
             names = sorted(os.listdir(root))
@@ -366,6 +453,9 @@ def discover_run_dir(run_dir):
                 continue
             if name == "membership.json":
                 found["membership"] = found["membership"] or path
+            elif name.startswith("router-journal") and \
+                    name.endswith(".jsonl"):
+                found["router_journals"].append(path)
             elif name.endswith(".jsonl"):
                 found["streams"].append(path)
             elif name.startswith("postmortem-") and \
@@ -382,14 +472,15 @@ def render_run_dir(run_dir, out=sys.stdout):
     postmortem, with a stall-stacks inventory line at the end."""
     found = discover_run_dir(run_dir)
     if not (found["membership"] or found["streams"]
-            or found["postmortems"]):
+            or found["router_journals"] or found["postmortems"]):
         out.write("%s: no telemetry artifacts (membership.json, "
                   "*.jsonl, postmortem-*.json)\n" % run_dir)
         return
     out.write("== RUN DIR %s ==\n" % run_dir)
     first = True
     for path in ([found["membership"]] if found["membership"] else []) \
-            + found["streams"] + found["postmortems"]:
+            + found["streams"] + found["router_journals"] \
+            + found["postmortems"]:
         if not first:
             out.write("\n")
         first = False
@@ -398,6 +489,11 @@ def render_run_dir(run_dir, out=sys.stdout):
     if found["stall_stacks"]:
         out.write("\n  stall-stacks dumps: %s\n" % ", ".join(
             os.path.relpath(p, run_dir) for p in found["stall_stacks"]))
+    if found["router_journals"]:
+        out.write("\n  serving artifacts present: serve_report.py "
+                  "(same directory) merges the router journal with the "
+                  "replica streams into the fleet view (request "
+                  "lifecycles, failover arcs, SLO breach blame)\n")
 
 
 def _render_watchdog_timeline(docs, out):
