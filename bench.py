@@ -802,6 +802,52 @@ def bench_serve():
             "traced token events (%s) did not reconcile bit-exactly "
             "with the serving.tokens counter (%s) on the degraded "
             "drill" % (rep["traced_tokens"], rep["tokens_counter"]))
+    fleet = result["fleet"]
+    if fleet["dropped"] != 0:
+        raise AssertionError(
+            "fleet drill dropped %d accepted request(s) after the "
+            "replica-process SIGKILL (contract: the router completes "
+            "every accepted request exactly once across real process "
+            "death)" % fleet["dropped"])
+    if not fleet["tokens_match_unfaulted"]:
+        raise AssertionError(
+            "fleet-drill tokens diverged from the unfaulted run "
+            "(contract: the out-of-process failover re-decode is "
+            "bit-identical greedy)")
+    if fleet["failovers"] < 1:
+        raise AssertionError(
+            "fleet drill observed no failover — the "
+            "serve.replica.sigkill never landed; the contract was "
+            "not exercised")
+    if fleet["replacement_spawns"] < 1:
+        raise AssertionError(
+            "the fleet drill never spawned a replacement process — "
+            "the AOT-warm-replacement contract was not exercised "
+            "(Router tolerates spawn failures on survivors; the DRILL "
+            "must not)")
+    if fleet["replacement_foreground_compiles"] != 0:
+        raise AssertionError(
+            "the replacement replica PROCESS compiled %d serving "
+            "program(s) in the foreground (contract: 0 — it "
+            "deserializes the fleet's shared AOT cache)"
+            % fleet["replacement_foreground_compiles"])
+    br = fleet["breaker"]
+    if br["trips"] < 1 or not br["recovered"] or \
+            br["final_state"] != "closed":
+        raise AssertionError(
+            "circuit breaker did not trip and recover under rpc.drop "
+            "(trips=%s, final=%s; contract: consecutive timeouts trip "
+            "it open, the half-open probe closes it once the replica "
+            "heals)" % (br["trips"], br["final_state"]))
+    if br["completed"] != br["requests"]:
+        raise AssertionError(
+            "breaker drill completed %d of %d requests (contract: a "
+            "tripped breaker re-routes intake, it never strands a "
+            "request)" % (br["completed"], br["requests"]))
+    if br["served_by_b_after_recovery"] < 1:
+        raise AssertionError(
+            "no post-recovery request was served by the healed "
+            "replica (contract: a closed breaker restores placement)")
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": cont["tokens_per_sec"],

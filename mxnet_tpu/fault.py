@@ -79,6 +79,28 @@ Sites wired in this package:
 - ``io.decode.error``     raise inside a stream decode worker
                           (exercises the worker-traceback-preserving
                           re-raise at the consumption point).
+- ``serve.replica.sigkill``  REAL process death: hard
+                          ``os.kill(os.getpid(), SIGKILL)`` from
+                          ``ServingReplica.step`` — no cleanup, no
+                          telemetry flush, no exception path; the
+                          out-of-process fleet drill
+                          (``tools/serve_worker.py``) the in-process
+                          ``serve.replica.lost`` cannot fake.  The
+                          launcher reaps rc -9 (retryable) and respawns
+                          the slot; the router's proxy confirms the
+                          death and fails accepted requests over.
+- ``rpc.drop``            a serving RPC reply is blackholed: the server
+                          processes the request (an accepted submit IS
+                          journaled — the client retry dedups) but
+                          never replies; the client's per-call deadline
+                          is the only way out (serving/rpc.py).
+- ``rpc.delay``           bounded server-side delay before an RPC reply
+                          (``MXTPU_FAULT_DELAY_SECS``): the slow-wire
+                          flavor — latency, not loss.
+- ``rpc.conn.refused``    a serving RPC connection attempt fails
+                          client-side (worker not up yet / already
+                          gone): exercises the bounded retry + backoff
+                          + jitter path deterministically.
 - ``io.decode.slow``      bounded per-task delay in the decode worker
                           (``MXTPU_FAULT_DELAY_SECS``): the INPUT
                           flavor of the straggler — shows in
@@ -97,6 +119,10 @@ failure mode they stand in for.
 env-provided ``MXTPU_FAULT`` spec to the worker slots listed (the
 launcher exports one environment per job, but a straggler/loss drill
 wants exactly one victim; slots are elastic-stable where ranks re-pack).
+``MXTPU_FAULT_ATTEMPTS="0"`` additionally restricts it to specific
+restart attempts (``MXTPU_RESTART_ATTEMPT``): a supervised RESPAWN
+inherits its predecessor's environment, so a kill drill without attempt
+scoping would re-arm in every replacement and crash-loop the slot.
 Explicit ``configure(spec)`` calls are never scoped — a worker script
 that arms its own rule means it.
 
@@ -182,6 +208,22 @@ def _scoped_out_by_slot():
     return mine not in {s.strip() for s in slots.split(",") if s.strip()}
 
 
+def _scoped_out_by_attempt():
+    """True when MXTPU_FAULT_ATTEMPTS names specific restart attempts
+    and this process's MXTPU_RESTART_ATTEMPT is not one of them.  The
+    supervised-respawn drills need this: a launcher-spawned REPLACEMENT
+    inherits the same environment as its predecessor, so an unscoped
+    ``serve.replica.sigkill:1`` would re-arm in every respawn and
+    kill-loop the slot forever — ``MXTPU_FAULT_ATTEMPTS=0`` arms the
+    drill in the original incarnation only."""
+    attempts = os.environ.get("MXTPU_FAULT_ATTEMPTS", "").strip()
+    if not attempts:
+        return False
+    mine = os.environ.get("MXTPU_RESTART_ATTEMPT", "0").strip() or "0"
+    return mine not in {a.strip() for a in attempts.split(",")
+                        if a.strip()}
+
+
 def configure(spec=None):
     """Install fault rules from ``spec`` (or the MXTPU_FAULT env when
     None).  Replaces any previous configuration; fire counters reset.
@@ -190,7 +232,8 @@ def configure(spec=None):
     global _rules, _fired, _loaded_env
     if spec is None:
         spec = os.environ.get("MXTPU_FAULT", "")
-        if spec and _scoped_out_by_slot():
+        if spec and (_scoped_out_by_slot() or
+                     _scoped_out_by_attempt()):
             spec = ""
     with _lock:
         _rules = _parse(spec)

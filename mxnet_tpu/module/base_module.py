@@ -171,10 +171,22 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """The training loop (reference base_module.py:376-487)."""
+        """The training loop (reference base_module.py:376-487).
+
+        ``train_data`` may be a DataIter OR an epoch-mode
+        :class:`~mxnet_tpu.stream.loader.StreamLoader` (the streaming
+        data plane, DATA.md): a bare loader is wrapped in
+        :class:`~mxnet_tpu.stream.fit.StreamTrainIter` — shapes peeked
+        from its first batch, ``reset()`` advancing ``set_epoch``, and
+        the loader's exact-once CURSOR stamped onto this module at
+        every epoch boundary so a checkpoint epoch callback
+        (``callback.module_checkpoint``) pairs each checkpoint with
+        the records consumed when it was cut."""
         assert num_epoch is not None, "please specify number of epochs"
         from .. import watchdog as _watchdog
         from ..initializer import Uniform
+        from ..stream.fit import maybe_wrap as _maybe_wrap_stream
+        train_data = _maybe_wrap_stream(train_data)
         if initializer is None:
             initializer = Uniform(0.01)
         # hang defense is scoped to the run: armed here (no-op unless
@@ -266,6 +278,16 @@ class BaseModule:
 
             arg_params_, aux_params_ = self.get_params()
             self.set_params(arg_params_, aux_params_)
+            # streaming sugar: stamp the loader's exact-once cursor on
+            # the module BEFORE the epoch-end callbacks run, so a
+            # checkpoint callback saving now pairs this epoch with
+            # exactly the records consumed when it was cut.  Always
+            # assigned: a later fit() over a PLAIN iter on the same
+            # module must clear the stamp, or its checkpoints would
+            # carry a stale cursor from an unrelated stream run
+            cursor_fn = getattr(train_data, "stream_cursor", None)
+            self._stream_cursor = None if cursor_fn is None \
+                else cursor_fn()
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)

@@ -116,7 +116,12 @@ class Module(BaseModule):
         CheckpointManager(prefix, keep_last=keep_last).save(
             epoch, arg_params, aux_params, symbol=self._symbol,
             optimizer_states=states, mode=mode,
-            sharding=self._sharding_stamp())
+            sharding=self._sharding_stamp(),
+            # the streaming-fit sugar: BaseModule.fit stamps the
+            # StreamLoader's exact-once cursor here at each epoch
+            # boundary, so a plain module_checkpoint callback writes
+            # manifests StreamLoader(resume=...) can replay
+            stream_cursor=getattr(self, "_stream_cursor", None))
 
     def _sharding_stamp(self):
         """Manifest stamp for the run's in-memory layout (SCALING.md):

@@ -28,8 +28,20 @@ Survivability plane (ISSUE 11):
   journaled request ids, retry-on-failover with at-most-once decode,
   AOT-warm replacement spin-up.
 
+Out-of-process fleet (ISSUE 14):
+
+- :mod:`~mxnet_tpu.serving.rpc` — the length-framed JSON-over-socket
+  plane that turns each replica into its own OS process
+  (``tools/serve_worker.py``): :class:`~mxnet_tpu.serving.rpc.RpcServer`
+  in the worker, :class:`~mxnet_tpu.serving.rpc.RpcReplicaProxy` (the
+  Router's replica duck-type) on the front-end, with per-call deadlines
+  from the request's remaining budget, bounded retries with
+  backoff+jitter, idempotent submit keys (a retry after a lost ACK
+  never double-decodes) and a per-replica
+  :class:`~mxnet_tpu.serving.rpc.CircuitBreaker`.
+
 See SERVING.md for architecture, sizing, the env contract, and the
-"operating under failure" runbook.
+"operating under failure" + §9 fleet runbooks.
 """
 from .kv_cache import PagedKVAllocator
 from .scheduler import ContinuousBatchingScheduler, Request
@@ -38,8 +50,12 @@ from .slo import SLOController
 from .replica import (ServingReplica, CheckpointSubscriber, ReplicaLost,
                       EXIT_SERVE_DRAIN)
 from .router import Router, RouterRequest
+from .rpc import (RpcServer, RpcReplicaProxy, CircuitBreaker, RpcError,
+                  fleet_proxies)
 
 __all__ = ["PagedKVAllocator", "ContinuousBatchingScheduler",
            "Request", "ServingEngine", "SLOController",
            "ServingReplica", "CheckpointSubscriber", "ReplicaLost",
-           "EXIT_SERVE_DRAIN", "Router", "RouterRequest"]
+           "EXIT_SERVE_DRAIN", "Router", "RouterRequest",
+           "RpcServer", "RpcReplicaProxy", "CircuitBreaker",
+           "RpcError", "fleet_proxies"]

@@ -36,6 +36,7 @@ drive it directly; a service wraps it in whatever RPC front-end it has.
 """
 from __future__ import annotations
 
+import os
 import time
 
 from .. import fault as _fault
@@ -171,6 +172,19 @@ class ServingReplica:
         checkpoint poll, then the engine step."""
         if not self.alive:
             raise ReplicaLost("replica %s is dead" % self.replica_id)
+        if _fault.trigger("serve.replica.sigkill"):
+            # REAL process death, not an exception: SIGKILL runs no
+            # cleanup, flushes no telemetry, unwinds no stack — exactly
+            # what the in-process ``serve.replica.lost`` cannot fake.
+            # Only meaningful in a worker PROCESS (tools/serve_worker);
+            # arming it in-process kills the armer, which is the point.
+            import signal
+            import sys
+            print("mxnet_tpu.serving: [fault injection] "
+                  "serve.replica.sigkill fired — SIGKILLing replica "
+                  "process %d" % os.getpid(), file=sys.stderr,
+                  flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
         if _fault.trigger("serve.replica.lost"):
             self.abandon()
             _telemetry.counter("serving.replica_lost").inc()

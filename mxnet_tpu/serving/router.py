@@ -171,6 +171,63 @@ class Router:
         except OSError:
             pass  # the journal must never take the router down
 
+    def replay_journal(self, path=None):
+        """Rebuild the at-most-once authority from the journal file a
+        previous router incarnation left behind (router restart).
+
+        A crash can TRUNCATE the file mid-line — the single-``os.write``
+        O_APPEND discipline means it never tears an EARLIER line — so a
+        partial tail is skipped and counted, never allowed to poison
+        the replay (the torn-tail contract ``serve_report`` applies to
+        every artifact, applied to the authority itself).  Every
+        complete entry replays: terminal requests land in the in-memory
+        journal in their terminal state — a rid recorded ``complete``
+        is never re-executed — and ``_next_rid`` advances past every
+        replayed rid so new submissions cannot collide with history.
+        Entries last seen ``accept``-ed (their replica may still be
+        decoding them, or died with them) replay as journal records
+        only: a restarted router has no engine handle to harvest, and
+        re-submitting is the CALLER's decision, not a silent replay.
+
+        Returns ``{"entries", "requests", "torn"}``."""
+        path = path or self._journal_path
+        torn = applied = 0
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return {"entries": 0, "requests": 0, "torn": 0}
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line.decode("utf-8"))
+                rid = int(doc["rid"])
+            except (ValueError, TypeError, KeyError,
+                    UnicodeDecodeError):
+                torn += 1
+                continue
+            applied += 1
+            rr = self._journal.get(rid)
+            if rr is None:
+                rr = RouterRequest(rid, None, 0, None)
+                self._journal[rid] = rr
+            # later lines win: the journal is append-ordered, so the
+            # last complete line per rid IS its newest known state
+            rr.trace = doc.get("trace") or rr.trace
+            if doc.get("replica") is not None:
+                rr.replica_id = doc["replica"]
+            if doc.get("state"):
+                rr.state = doc["state"]
+            if doc.get("verdict"):
+                rr.verdict = doc["verdict"]
+            if doc.get("retries"):
+                rr.retries = int(doc["retries"])
+            if rid >= self._next_rid:
+                self._next_rid = rid + 1
+        return {"entries": applied, "requests": len(self._journal),
+                "torn": torn}
+
     def request(self, rid):
         return self._journal.get(rid)
 
@@ -247,7 +304,13 @@ class Router:
             if rid in self._inflight:
                 continue
             rr = self._journal[rid]
-            if rr.state in ("submitted", "accepted"):
+            # live handles are never evicted; an "accepted" entry with
+            # NO engine handle is a replay_journal record of a request
+            # a previous incarnation lost mid-flight — history, not
+            # live state, and it must age out like any terminal entry
+            # (or crash/replay cycles grow the journal without bound)
+            if rr.state in ("submitted", "accepted") and \
+                    (rr._live is not None or rr._home is not None):
                 continue
             del self._journal[rid]
 

@@ -1,0 +1,1059 @@
+"""Out-of-process serving RPC plane: framed JSON transport, deadlines,
+retries, circuit breaking (ISSUE 14).
+
+PRs 9/11/13 built the serving fleet — engine, replica lifecycle,
+router, request-scope observability — but every replica lived inside
+the router's process: one SIGSEGV (e.g. the donated-deserialize
+toolchain hazard, ROBUSTNESS.md §8) took down the router, every other
+replica, and the journal writer with it.  This module is the wire that
+lets each :class:`~mxnet_tpu.serving.replica.ServingReplica` become its
+OWN OS process (``tools/serve_worker.py``) while the
+:class:`~mxnet_tpu.serving.router.Router` keeps its exact duck-typed
+replica contract (``replica_id`` / ``alive`` / ``draining`` / ``load``
+/ ``idle`` / ``submit`` / ``step`` / ``drain`` / ``abandon``):
+
+- **transport** — length-framed JSON over a TCP socket (4-byte
+  big-endian length + UTF-8 JSON payload).  One connection per call:
+  a timed-out call abandons its socket, so a late reply can never
+  desynchronize the stream the way a persistent connection would.
+- **deadlines** — every call's socket deadline is derived from the
+  REQUEST's remaining deadline (capped by ``MXTPU_RPC_TIMEOUT_S``): a
+  replica that blackholes every RPC (the ``rpc.drop`` drill) costs a
+  request at most its remaining budget, never an unbounded hang — the
+  proxy sweeps unreachable-and-expired requests into the typed
+  ``expired_rpc`` verdict.
+- **retries** — bounded, with exponential backoff + jitter
+  (``MXTPU_RPC_RETRIES`` / ``MXTPU_RPC_BACKOFF_S``), total time capped
+  by the call deadline.  Retries are safe because every submit carries
+  a client-minted **idempotence key**: the worker journals accepted
+  requests by key, and a retry after a lost ACK gets the ORIGINAL
+  handle back — it never double-decodes (refusals are deliberately
+  NOT journaled: a shed is not a decode, and a later failover
+  re-placement must get a fresh admission attempt).
+- **circuit breaker** — per-replica consecutive-failure trip →
+  ``open`` (placement skips the replica, no sockets burned) →
+  after a cooldown ``half_open`` admits exactly ONE probe call →
+  close on success, re-trip on probe failure.  Laws are unit-pinned
+  with an injected clock (tests/test_serving_rpc.py).
+- **health fusion** — the proxy fuses the RPC-level view with the PR-4
+  launcher heartbeat files and the port-file incarnation stamp
+  (pid + attempt): a breaker that is merely open keeps the replica
+  ALIVE (it may just be slow — the breaker recovers), while a changed
+  incarnation, a dead pid, or a stale heartbeat past
+  ``MXTPU_RPC_DEAD_AFTER_S`` confirms process death and raises
+  :class:`~mxnet_tpu.serving.replica.ReplicaLost` so the Router runs
+  its journaled at-most-once failover.
+
+Fault sites drilled here (ROBUSTNESS.md §4): ``rpc.drop`` (the server
+reads a request and never replies — the client's per-call deadline is
+the only way out), ``rpc.delay`` (bounded server-side reply delay),
+``rpc.conn.refused`` (client-side connection failure — exercises the
+retry/backoff path deterministically).  ``serve.replica.sigkill``
+(serving/replica.py) is the process-death twin of
+``serve.replica.lost``: a hard ``os.kill(SIGKILL)`` no in-process
+exception path can fake.
+
+Telemetry (OBSERVABILITY.md §13): ``rpc.calls`` / ``rpc.retries`` /
+``rpc.timeouts`` / ``rpc.conn_errors`` / ``rpc.dedup_hits`` /
+``rpc.dropped_replies`` / ``rpc.expired_unreachable`` /
+``rpc.breaker_trips`` / ``rpc.breaker_recoveries`` counters, an
+``rpc.call`` phase histogram, and one ``rpc.breaker.<replica>`` gauge
+per proxy (0 closed / 1 half-open / 2 open).
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import select
+import socket
+import struct
+import time
+import zlib
+
+import numpy as _np
+
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from .replica import EXIT_SERVE_DRAIN, ReplicaLost
+from .scheduler import EXPIRED, SHED
+
+__all__ = ["RpcError", "CircuitBreaker", "RpcServer", "RpcReplicaProxy",
+           "rpc_call", "send_frame", "recv_frame", "read_port_file",
+           "write_port_file", "wait_port_file", "fleet_proxies",
+           "VERDICT_EXPIRED_RPC",
+           "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN"]
+
+#: sanity cap on one frame (a garbage length prefix must fail fast,
+#: not allocate gigabytes)
+MAX_FRAME_BYTES = 64 << 20
+
+#: typed verdict for a request whose replica became unreachable and
+#: whose deadline passed with no status obtainable — the bounded-cost
+#: guarantee under a blackholing replica (``rpc.drop``)
+VERDICT_EXPIRED_RPC = "expired_rpc"
+
+BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = \
+    "closed", "open", "half_open"
+_BREAKER_GAUGE_VAL = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1,
+                      BREAKER_OPEN: 2}
+
+
+class RpcError(MXNetError):
+    """A serving RPC call failed after its bounded retries (transport
+    level — the replica may be slow, partitioned, or dead; the breaker
+    and the health fusion decide which)."""
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# -- framing ---------------------------------------------------------------
+
+def send_frame(sock, obj):
+    """One length-framed JSON message: 4-byte big-endian length + UTF-8
+    payload, sent with a single ``sendall`` (the kernel may still
+    fragment, but a reader never sees a length without its payload
+    following on the same connection)."""
+    payload = json.dumps(obj).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise RpcError("rpc frame of %d bytes exceeds the %d cap"
+                       % (len(payload), MAX_FRAME_BYTES))
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock, n, deadline_t):
+    buf = bytearray()
+    while len(buf) < n:
+        if deadline_t is not None:
+            rem = deadline_t - time.monotonic()
+            if rem <= 0:
+                raise socket.timeout("rpc call deadline passed "
+                                     "mid-frame")
+            sock.settimeout(rem)
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RpcError("connection closed mid-frame (%d of %d "
+                           "bytes)" % (len(buf), n))
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock, deadline_t=None):
+    """Read one framed message; ``deadline_t`` (monotonic) bounds the
+    WHOLE read — header and payload together."""
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4, deadline_t))
+    if n > MAX_FRAME_BYTES:
+        raise RpcError("rpc frame header claims %d bytes (cap %d) — "
+                       "corrupt stream" % (n, MAX_FRAME_BYTES))
+    try:
+        return json.loads(_recv_exact(sock, n, deadline_t)
+                          .decode("utf-8"))
+    except ValueError as e:
+        raise RpcError("undecodable rpc frame: %s" % e)
+
+
+# -- the client call (bounded retries + backoff + jitter) ------------------
+
+def rpc_call(addr, msg, timeout_s, retries=None, backoff_s=None,
+             backoff_max_s=None, deadline_t=None, rng=None):
+    """One logical RPC: connect → send → receive → close, retried up to
+    ``retries`` times with exponential backoff + jitter on transport
+    failures.  Safe ONLY for idempotent methods — which every method
+    here is, by the worker-side idempotence journal.
+
+    ``timeout_s`` bounds each attempt; ``deadline_t`` (monotonic)
+    bounds the whole call including backoff sleeps — derived by callers
+    from the REQUEST's remaining deadline, so a blackholed replica
+    costs a request at most its budget.  The ``rpc.conn.refused`` fault
+    site fires per connection attempt (a worker that is not up yet /
+    already gone), exercising exactly this retry path."""
+    retries = _env_int("MXTPU_RPC_RETRIES", 2) if retries is None \
+        else int(retries)
+    backoff_s = _env_float("MXTPU_RPC_BACKOFF_S", 0.05) \
+        if backoff_s is None else float(backoff_s)
+    backoff_max_s = _env_float("MXTPU_RPC_BACKOFF_MAX_S", 1.0) \
+        if backoff_max_s is None else float(backoff_max_s)
+    rng = rng or random
+    last = None
+    for attempt in range(retries + 1):
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            break
+        t0 = time.perf_counter()
+        try:
+            if _fault.trigger("rpc.conn.refused"):
+                raise ConnectionRefusedError(
+                    "[fault injection] rpc.conn.refused")
+            att_timeout = timeout_s
+            if deadline_t is not None:
+                att_timeout = min(att_timeout,
+                                  max(0.01,
+                                      deadline_t - time.monotonic()))
+            call_deadline = time.monotonic() + att_timeout
+            with socket.create_connection(addr,
+                                          timeout=att_timeout) as s:
+                send_frame(s, msg)
+                reply = recv_frame(s, call_deadline)
+            _telemetry.counter("rpc.calls").inc()
+            _telemetry.observe_phase("rpc.call",
+                                     time.perf_counter() - t0)
+            return reply
+        except socket.timeout as e:
+            _telemetry.counter("rpc.timeouts").inc()
+            last = e
+        except (ConnectionError, OSError, RpcError) as e:
+            _telemetry.counter("rpc.conn_errors").inc()
+            last = e
+        if attempt < retries:
+            delay = min(backoff_s * (2 ** attempt), backoff_max_s)
+            delay *= 0.5 + rng.random()  # jitter: decorrelate retries
+            if deadline_t is not None:
+                delay = min(delay,
+                            max(0.0, deadline_t - time.monotonic()))
+            _telemetry.counter("rpc.retries").inc()
+            if delay > 0:
+                time.sleep(delay)
+    raise RpcError("rpc %r to %s failed after %d attempt(s): %s: %s"
+                   % (msg.get("method"), (addr,), retries + 1,
+                      type(last).__name__ if last is not None
+                      else "deadline", last))
+
+
+# -- circuit breaker -------------------------------------------------------
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with an injectable clock.
+
+    Laws (unit-pinned in tests/test_serving_rpc.py):
+
+    - ``closed``: every call allowed; ``threshold`` CONSECUTIVE
+      failures trip it ``open`` (one success resets the count);
+    - ``open``: nothing allowed until ``cooldown_s`` elapses, then the
+      breaker turns ``half_open``;
+    - ``half_open``: exactly ONE probe call is admitted; its success
+      closes the breaker, its failure re-trips a fresh cooldown.
+
+    The breaker protects the CALLER (no sockets burned on a replica
+    that is clearly sick) and the replica (no thundering herd the
+    instant it limps back); the router's placement skips open-breaker
+    replicas without marking them dead — a tripped breaker RECOVERS,
+    unlike a failover."""
+
+    def __init__(self, threshold=None, cooldown_s=None,
+                 clock=time.monotonic, name=None):
+        self.threshold = _env_int("MXTPU_RPC_BREAKER_THRESHOLD", 3) \
+            if threshold is None else int(threshold)
+        self.cooldown_s = _env_float("MXTPU_RPC_BREAKER_COOLDOWN_S",
+                                     1.0) \
+            if cooldown_s is None else float(cooldown_s)
+        self._clock = clock
+        self.name = name
+        self.state = BREAKER_CLOSED
+        self.failures = 0
+        self.trips = 0
+        self._opened_at = None
+        self._probe_inflight = False
+        self._publish()
+
+    def _publish(self):
+        if self.name:
+            _telemetry.gauge("rpc.breaker.%s" % self.name).set(
+                _BREAKER_GAUGE_VAL[self.state])
+
+    def _set(self, state):
+        self.state = state
+        self._publish()
+
+    def allow(self):
+        """May the caller place a call now?  In ``half_open`` exactly
+        one True is handed out until the probe reports back."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.state == BREAKER_OPEN:
+            if self._clock() - self._opened_at < self.cooldown_s:
+                return False
+            self._set(BREAKER_HALF_OPEN)
+            self._probe_inflight = False
+        if self._probe_inflight:
+            return False
+        self._probe_inflight = True
+        return True
+
+    def record_success(self):
+        if self.state != BREAKER_CLOSED:
+            _telemetry.counter("rpc.breaker_recoveries").inc()
+        self._set(BREAKER_CLOSED)
+        self.failures = 0
+        self._probe_inflight = False
+
+    def record_failure(self):
+        if self.state == BREAKER_HALF_OPEN:
+            self._trip()
+            return
+        if self.state == BREAKER_OPEN:
+            return  # already open; failures while open don't re-stamp
+        self.failures += 1
+        if self.failures >= self.threshold:
+            self._trip()
+
+    def _trip(self):
+        self.trips += 1
+        self.failures = 0
+        self._probe_inflight = False
+        self._opened_at = self._clock()
+        self._set(BREAKER_OPEN)
+        _telemetry.counter("rpc.breaker_trips").inc()
+
+
+# -- port-file discovery ---------------------------------------------------
+
+def write_port_file(path, port, host="127.0.0.1", attempt=0):
+    """Atomically publish where this worker incarnation listens.  The
+    (pid, attempt) pair is the incarnation stamp proxies pin: a
+    replacement rewrites the file, and the old incarnation's proxy
+    sees the change as confirmed death, never as a silent redirect."""
+    doc = {"host": host, "port": int(port), "pid": os.getpid(),
+           "attempt": int(attempt), "t": time.time()}
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return doc
+
+
+def read_port_file(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def wait_port_file(path, timeout=30.0, min_attempt=None,
+                   poll_s=0.05):
+    """Block until ``path`` exists (and, with ``min_attempt``, carries
+    ``attempt >= min_attempt`` — how a spawn callback waits for the
+    REPLACEMENT incarnation, not the corpse's stale file)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            doc = read_port_file(path)
+            if min_attempt is None or \
+                    int(doc.get("attempt", 0)) >= min_attempt:
+                return doc
+        except (OSError, ValueError):
+            pass
+        time.sleep(poll_s)
+    raise RpcError("no serve worker published %s within %.1fs%s"
+                   % (path, timeout,
+                      "" if min_attempt is None
+                      else " at attempt >= %d" % min_attempt))
+
+
+# -- server ----------------------------------------------------------------
+
+def _req_doc(req):
+    """Serialize one engine Request's caller-visible state for the
+    wire (the mirror's update payload)."""
+    doc = {"rid": req.rid, "state": req.state, "verdict": req.verdict,
+           "error": req.error, "tokens": [int(t) for t in req.tokens]}
+    for key in ("ttft_s", "queue_wait_s", "tpot_s"):
+        v = getattr(req, key, None)
+        if v is not None:
+            doc[key] = round(v, 6)
+    return doc
+
+
+class RpcServer:
+    """Serve one :class:`ServingReplica` over the framed transport.
+
+    Single-threaded by design: the worker's main loop interleaves
+    ``poll()`` (accept + answer pending calls) with ``replica.step()``
+    — the engine is never touched from two threads.  One connection
+    per call (the client contract), so a handler reads exactly one
+    frame and writes exactly one reply.
+
+    **Idempotence journal**: accepted requests are recorded by the
+    client-minted key; a duplicate submit (retry after a lost ACK)
+    returns the ORIGINAL handle's state — at-most-once decode across
+    the wire.  Refusals (shed / draining) are NOT journaled: they are
+    terminal verdicts, not decodes, and a later re-placement of the
+    same trace must get a fresh admission attempt.
+
+    Fault sites: ``rpc.delay`` sleeps before the reply (bounded);
+    ``rpc.drop`` parks the connection unreplied — the client's
+    per-call deadline is the only way out, exactly a blackholed
+    service."""
+
+    #: terminal journal entries kept (the in-flight set plus a recent
+    #: window; the engine's own scheduler is the durable state)
+    JOURNAL_RETENTION = 4096
+    #: how long a ``rpc.drop``-parked connection is held open before
+    #: the server closes it (long enough that any sane client timeout
+    #: fires first — a closed socket would be a fast error, not the
+    #: blackhole the site simulates)
+    PARK_SECS = 30.0
+    #: how long a connection may take to dribble its whole request
+    #: frame in before the server drops it (slow-loris defense — the
+    #: read path never BLOCKS the decode loop regardless; this just
+    #: bounds the bookkeeping)
+    RECV_GRACE_S = 2.0
+    #: reply-send timeout: replies are small and a live client is
+    #: already blocked in recv, so the kernel buffer normally absorbs
+    #: the whole send without waiting
+    SEND_TIMEOUT_S = 0.5
+
+    def __init__(self, replica, host="127.0.0.1", port=0):
+        self.replica = replica
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR,
+                               1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(64)
+        self._lsock.setblocking(False)
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._journal = {}       # idempotence key -> engine Request
+        self._parked = []        # [(conn, close_at)] rpc.drop victims
+        self._pending = {}       # conn -> {"buf", "t0"} mid-frame reads
+        self.drain_requested = False
+        self.calls = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        for conn, _t in self._parked:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._parked = []
+        for conn in list(self._pending):
+            self._drop_pending(conn)
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+    # -- the poll loop -----------------------------------------------------
+    def poll(self, timeout=0.0, max_calls=64):
+        """Accept connections and answer complete requests — at most
+        ``max_calls`` per poll so a request flood cannot starve the
+        decode loop, and NEVER blocking on a read: frames are
+        assembled non-blocking across polls, so a connection that
+        sends nothing (a load balancer's connect-and-hold probe, a
+        half-open socket, a port scan) costs the decode loop NOTHING
+        — it just ages out after ``RECV_GRACE_S``.  Returns the number
+        of requests answered."""
+        self._sweep_parked()
+        self._sweep_pending()
+        try:
+            r, _, _ = select.select(
+                [self._lsock] + list(self._pending), [], [], timeout)
+        except OSError:
+            return 0
+        handled = 0
+        for sock in r:
+            if sock is self._lsock:
+                while True:
+                    try:
+                        conn, _addr = self._lsock.accept()
+                    except OSError:
+                        break
+                    conn.setblocking(False)
+                    self._pending[conn] = {"buf": bytearray(),
+                                           "t0": time.monotonic()}
+            else:
+                handled += self._feed(sock)
+                if handled >= max_calls:
+                    break
+        return handled
+
+    def _sweep_parked(self):
+        if not self._parked:
+            return
+        now = time.monotonic()
+        keep = []
+        for conn, close_at in self._parked:
+            if now >= close_at:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            else:
+                keep.append((conn, close_at))
+        self._parked = keep
+
+    def _sweep_pending(self):
+        if not self._pending:
+            return
+        now = time.monotonic()
+        for conn in list(self._pending):
+            if now - self._pending[conn]["t0"] > self.RECV_GRACE_S:
+                self._drop_pending(conn)
+
+    def _drop_pending(self, conn):
+        self._pending.pop(conn, None)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _feed(self, conn):
+        """Non-blocking read of whatever ``conn`` has; when the frame
+        completes, dispatch and reply.  Returns requests answered (0
+        or 1)."""
+        st = self._pending.get(conn)
+        if st is None:
+            return 0
+        try:
+            chunk = conn.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return 0
+        except OSError:
+            self._drop_pending(conn)
+            return 0
+        if not chunk:
+            self._drop_pending(conn)
+            return 0
+        buf = st["buf"]
+        buf.extend(chunk)
+        if len(buf) < 4:
+            return 0
+        (n,) = struct.unpack(">I", bytes(buf[:4]))
+        if n > MAX_FRAME_BYTES:
+            self._drop_pending(conn)   # corrupt length: fail fast
+            return 0
+        if len(buf) < 4 + n:
+            return 0
+        del self._pending[conn]
+        try:
+            msg = json.loads(bytes(buf[4:4 + n]).decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return 0
+        self.calls += 1
+        reply = self._dispatch(msg)
+        _fault.delay_if("rpc.delay")
+        if _fault.trigger("rpc.drop"):
+            # blackhole: the request WAS processed (an accepted submit
+            # is journaled — the retry dedups), but the ACK never
+            # leaves.  Exactly the lost-ACK case the idempotence key
+            # exists for.
+            _telemetry.counter("rpc.dropped_replies").inc()
+            self._parked.append(
+                (conn, time.monotonic() + self.PARK_SECS))
+            return 1
+        try:
+            conn.setblocking(True)
+            conn.settimeout(self.SEND_TIMEOUT_S)
+            send_frame(conn, reply)
+        except (OSError, RpcError, socket.timeout):
+            pass  # a sick client must not take the worker down
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        return 1
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, msg):
+        method = msg.get("method")
+        try:
+            if method == "submit":
+                return self._do_submit(msg)
+            if method == "status":
+                return self._do_status(msg)
+            if method == "health":
+                return self._do_health()
+            if method == "drain":
+                self.drain_requested = True
+                return {"ok": True, "draining": True}
+            return {"ok": False, "error_type": "RpcError",
+                    "error": "unknown rpc method %r" % (method,)}
+        except Exception as e:  # never let a handler kill the worker
+            return {"ok": False, "error_type": type(e).__name__,
+                    "error": str(e)}
+
+    def _prune_journal(self):
+        if len(self._journal) < 2 * self.JOURNAL_RETENTION:
+            return
+        for key in list(self._journal):
+            if len(self._journal) <= self.JOURNAL_RETENTION:
+                break
+            req = self._journal[key]
+            if req.done:  # never evict in-flight: it IS the dedup
+                del self._journal[key]
+
+    def _do_submit(self, msg):
+        key = msg.get("key")
+        if key is not None and key in self._journal:
+            _telemetry.counter("rpc.dedup_hits").inc()
+            return {"ok": True, "dedup": True,
+                    "request": _req_doc(self._journal[key])}
+        try:
+            req = self.replica.submit(
+                _np.asarray(msg["prompt"], _np.int32),
+                int(msg["max_new"]),
+                deadline_s=msg.get("deadline_s"),
+                trace=msg.get("trace"))
+        except ValueError as e:
+            return {"ok": False, "error_type": "ValueError",
+                    "error": str(e)}
+        except ReplicaLost as e:
+            return {"ok": False, "error_type": "ReplicaLost",
+                    "error": str(e)}
+        if key is not None and req.state != SHED:
+            self._prune_journal()
+            self._journal[key] = req
+        return {"ok": True, "request": _req_doc(req)}
+
+    def _do_status(self, msg):
+        out = {}
+        for key in msg.get("keys") or []:
+            req = self._journal.get(key)
+            out[key] = _req_doc(req) if req is not None \
+                else {"state": "unknown"}
+        rep = self.replica
+        return {"ok": True, "requests": out,
+                "replica": {"alive": bool(rep.alive),
+                            "draining": bool(rep.draining),
+                            "load": int(rep.load),
+                            "idle": bool(rep.idle)}}
+
+    def _do_health(self):
+        from .. import profiler as _profiler
+        doc = {"ok": True, "pid": os.getpid(),
+               "serve_compiles":
+                   _profiler.step_stats().get("compile_count", 0)}
+        try:
+            doc["health"] = self.replica.health()
+        except Exception as e:
+            doc["health_error"] = str(e)
+        return doc
+
+
+# -- the router-facing proxy -----------------------------------------------
+
+class _MirrorRequest:
+    """The proxy-side mirror of one request decoding in a worker
+    process: duck-types the engine Request fields the Router reads
+    (``state`` / ``verdict`` / ``error`` / ``tokens`` + the latency
+    stamps).  Updated by status polls; stays valid after the proxy
+    stops polling it (the Router holds it as ``rr._live``)."""
+
+    __slots__ = ("key", "trace", "rid", "state", "verdict", "error",
+                 "tokens", "ttft_s", "queue_wait_s", "tpot_s",
+                 "deadline_t")
+
+    def __init__(self, key, trace, deadline_t):
+        self.key = key
+        self.trace = trace
+        self.rid = None
+        self.state = "queued"
+        self.verdict = None
+        self.error = None
+        self.tokens = []
+        self.ttft_s = None
+        self.queue_wait_s = None
+        self.tpot_s = None
+        self.deadline_t = deadline_t  # monotonic, proxy clock
+
+    def _update(self, doc):
+        self.rid = doc.get("rid", self.rid)
+        self.state = doc.get("state", self.state)
+        self.verdict = doc.get("verdict")
+        self.error = doc.get("error")
+        self.tokens = doc.get("tokens") or []
+        for k in ("ttft_s", "queue_wait_s", "tpot_s"):
+            if doc.get(k) is not None:
+                setattr(self, k, doc[k])
+
+    @property
+    def done(self):
+        return self.state not in ("queued", "running")
+
+
+class RpcReplicaProxy:
+    """The Router's replica duck-type over the wire.
+
+    Address resolution goes through the worker's port file each
+    connect, PINNED to the first (pid, attempt) incarnation seen: a
+    replacement that rewrites the file is a DIFFERENT replica — the
+    old proxy reports :class:`ReplicaLost` (confirmed death), and
+    :meth:`successor` builds the fresh proxy the Router's ``spawn``
+    callback hands back.
+
+    ``step()`` polls the worker for the in-flight mirrors' status (the
+    worker decodes autonomously — the poll is observation, not
+    drive).  Transport failures feed the breaker; the replica is
+    declared DEAD (→ failover) only when the health fusion confirms
+    it: incarnation changed, pid gone, or heartbeat stale past
+    ``dead_after_s``.  A merely-unreachable replica (tripped breaker)
+    keeps its requests until their own deadlines expire them with the
+    typed ``expired_rpc`` verdict — bounded cost, no failover churn,
+    and full recovery when the breaker's probe succeeds."""
+
+    def __init__(self, replica_id, addr=None, port_file=None,
+                 heartbeat_path=None, timeout_s=None, retries=None,
+                 breaker=None, dead_after_s=None, clock=time.monotonic,
+                 rng=None):
+        if addr is None and port_file is None:
+            raise ValueError("RpcReplicaProxy needs addr or port_file")
+        self.replica_id = replica_id
+        self.alive = True
+        self._addr = tuple(addr) if addr is not None else None
+        self._port_file = port_file
+        self._heartbeat_path = heartbeat_path
+        self._pin = None           # (pid, attempt) incarnation stamp
+        self._clock = clock
+        self.breaker = breaker if breaker is not None else \
+            CircuitBreaker(name=str(replica_id), clock=clock)
+        self._timeout_s = _env_float("MXTPU_RPC_TIMEOUT_S", 2.0) \
+            if timeout_s is None else float(timeout_s)
+        self._retries = _env_int("MXTPU_RPC_RETRIES", 2) \
+            if retries is None else int(retries)
+        self._dead_after_s = _env_float("MXTPU_RPC_DEAD_AFTER_S", 10.0) \
+            if dead_after_s is None else float(dead_after_s)
+        # deterministic jitter stream per proxy (decorrelated across
+        # replicas, reproducible within one)
+        self._rng = rng or random.Random(
+            zlib.crc32(str(replica_id).encode("utf-8")))
+        self._mirrors = {}         # key -> _MirrorRequest (in flight)
+        self._status = {"alive": True, "draining": False, "idle": True,
+                        "load": 0}
+        self._last_ok_t = None
+
+    # -- address / incarnation ---------------------------------------------
+    def _resolve(self):
+        if self._port_file is None:
+            return self._addr
+        try:
+            doc = read_port_file(self._port_file)
+        except (OSError, ValueError) as e:
+            raise RpcError("cannot read port file %s: %s"
+                           % (self._port_file, e))
+        stamp = (doc.get("pid"), doc.get("attempt"))
+        if self._pin is None:
+            self._pin = stamp
+        elif self._pin != stamp:
+            # a replacement took the slot: this incarnation is gone
+            raise ReplicaLost(
+                "replica %s incarnation changed (pid/attempt %s -> "
+                "%s): a replacement took its slot"
+                % (self.replica_id, self._pin, stamp))
+        return (doc.get("host", "127.0.0.1"), int(doc["port"]))
+
+    @property
+    def incarnation(self):
+        """The (pid, attempt) stamp this proxy is pinned to (None
+        until the first successful resolve)."""
+        return self._pin
+
+    def successor(self, replica_id=None, timeout=60.0):
+        """Wait for a REPLACEMENT incarnation at this slot's port file
+        and return a fresh proxy for it — the Router ``spawn``
+        callback for launcher-supervised fleets (the launcher respawns
+        the slot; this is how the router picks the newcomer up)."""
+        if self._port_file is None:
+            raise RpcError("successor() needs a port_file-addressed "
+                           "proxy")
+        min_attempt = None
+        if self._pin is not None and self._pin[1] is not None:
+            min_attempt = int(self._pin[1]) + 1
+        doc = wait_port_file(self._port_file, timeout=timeout,
+                             min_attempt=min_attempt)
+        rid = replica_id if replica_id is not None else \
+            "%s+%s" % (self.replica_id, doc.get("attempt"))
+        return RpcReplicaProxy(
+            rid, port_file=self._port_file,
+            heartbeat_path=self._heartbeat_path,
+            timeout_s=self._timeout_s, retries=self._retries,
+            dead_after_s=self._dead_after_s, clock=self._clock)
+
+    # -- health fusion ------------------------------------------------------
+    def _confirmed_dead(self):
+        """Fuse the non-RPC evidence: only a changed incarnation, a
+        vanished pid, or a stale PR-4 heartbeat file turns transport
+        failure into declared process death (→ Router failover).  A
+        replica that is merely slow or partitioned stays alive — its
+        breaker recovers; a failover would double-execute its work."""
+        if self._port_file is not None:
+            try:
+                doc = read_port_file(self._port_file)
+                stamp = (doc.get("pid"), doc.get("attempt"))
+                if self._pin is not None and stamp != self._pin:
+                    return True
+                pid = doc.get("pid")
+            except (OSError, ValueError):
+                pid = self._pin[0] if self._pin else None
+            if pid:
+                try:
+                    os.kill(int(pid), 0)
+                except ProcessLookupError:
+                    return True
+                except (OSError, PermissionError):
+                    pass  # not ours to probe (remote/other-user pid)
+        hb = self._heartbeat_path
+        if hb:
+            try:
+                age = time.time() - os.stat(hb).st_mtime
+                if age > self._dead_after_s:
+                    return True
+            except OSError:
+                pass  # no heartbeat written (yet): not evidence
+        return False
+
+    # -- the replica duck-type ---------------------------------------------
+    @property
+    def draining(self):
+        return bool(self._status.get("draining", False))
+
+    @property
+    def load(self):
+        return max(int(self._status.get("load", 0)),
+                   len(self._mirrors))
+
+    @property
+    def idle(self):
+        """Nothing the router is waiting on here.  When the worker is
+        unreachable, local mirrors (until their deadlines sweep them)
+        are the only wait-state — remote idleness is unknowable and
+        must not wedge ``run_until_idle``."""
+        if self._mirrors:
+            return False
+        if self._last_ok_t is None:
+            return True
+        return bool(self._status.get("idle", True))
+
+    def submit(self, prompt, max_new, deadline_s=None, trace=None):
+        if not self.alive:
+            raise ReplicaLost("replica %s is dead" % self.replica_id)
+        # argument conversion BEFORE the breaker check: a malformed
+        # prompt raising after allow() would leak the one half-open
+        # probe slot (nothing would ever record_*), wedging the
+        # breaker open against a healthy replica forever
+        prompt = _np.asarray(prompt, _np.int32).reshape(-1)
+        if not self.breaker.allow():
+            # placement-level skip: the router tries the next
+            # candidate; the breaker's cooldown owns recovery
+            raise ReplicaLost(
+                "replica %s circuit breaker is %s"
+                % (self.replica_id, self.breaker.state))
+        key = trace if trace is not None else \
+            "anon-%s" % _telemetry.mint_trace()
+        now = self._clock()
+        deadline_t = None if deadline_s is None \
+            else now + max(0.0, float(deadline_s))
+        call_deadline = None if deadline_t is None \
+            else time.monotonic() + max(0.05, float(deadline_s))
+        msg = {"method": "submit", "key": key, "trace": trace,
+               "prompt": [int(t) for t in prompt],
+               "max_new": int(max_new), "deadline_s": deadline_s}
+        try:
+            addr = self._resolve()
+            reply = rpc_call(addr, msg, self._timeout_s,
+                             retries=self._retries,
+                             deadline_t=call_deadline, rng=self._rng)
+        except ReplicaLost:
+            self.breaker.record_failure()
+            raise
+        except (RpcError, OSError) as e:
+            self.breaker.record_failure()
+            raise ReplicaLost(
+                "submit to replica %s failed: %s"
+                % (self.replica_id, e))
+        self.breaker.record_success()
+        self._last_ok_t = self._clock()
+        if not reply.get("ok"):
+            if reply.get("error_type") == "ValueError":
+                raise ValueError(reply.get("error"))
+            raise ReplicaLost("replica %s refused submit: %s"
+                              % (self.replica_id, reply.get("error")))
+        m = _MirrorRequest(key, trace, deadline_t)
+        m._update(reply["request"])
+        if not m.done:
+            self._mirrors[key] = m
+        return m
+
+    def step(self):
+        """One observation round: sweep locally-expired mirrors, then
+        (breaker permitting) poll the worker and fold the updates in.
+        Returns tokens newly observed.  Raises ReplicaLost only on
+        CONFIRMED process death — the Router's failover trigger."""
+        if not self.alive:
+            raise ReplicaLost("replica %s is dead" % self.replica_id)
+        self._sweep_expired()
+        produced = 0
+        if not self.breaker.allow():
+            if self._confirmed_dead():
+                raise ReplicaLost(
+                    "replica %s confirmed dead (breaker %s)"
+                    % (self.replica_id, self.breaker.state))
+            return produced
+        # the status call's socket deadline: never more than the
+        # per-call cap, never more than the tightest in-flight
+        # remaining deadline (floored so a just-expiring request
+        # cannot zero out the poll that would report its verdict)
+        timeout = self._timeout_s
+        rem = [m.deadline_t - self._clock()
+               for m in self._mirrors.values()
+               if m.deadline_t is not None]
+        if rem:
+            timeout = max(0.05, min([timeout] + rem))
+        msg = {"method": "status", "keys": sorted(self._mirrors)}
+        try:
+            addr = self._resolve()
+            reply = rpc_call(addr, msg, timeout, retries=0,
+                             rng=self._rng)
+        except ReplicaLost:
+            raise
+        except (RpcError, OSError):
+            self.breaker.record_failure()
+            if self._confirmed_dead():
+                raise ReplicaLost(
+                    "replica %s unreachable and confirmed dead"
+                    % self.replica_id)
+            return produced
+        self.breaker.record_success()
+        self._last_ok_t = self._clock()
+        if not reply.get("ok"):
+            return produced
+        for key, doc in (reply.get("requests") or {}).items():
+            m = self._mirrors.get(key)
+            if m is None:
+                continue
+            if doc.get("state") == "unknown":
+                # the worker no longer knows an accepted request: its
+                # journal did not survive (process replaced between
+                # polls) — that incarnation is gone
+                raise ReplicaLost(
+                    "replica %s lost accepted request %s (journal "
+                    "reset — process replaced?)"
+                    % (self.replica_id, key))
+            before = len(m.tokens)
+            m._update(doc)
+            produced += max(0, len(m.tokens) - before)
+            if m.done:
+                del self._mirrors[key]
+        self._status = reply.get("replica") or self._status
+        return produced
+
+    def _sweep_expired(self):
+        """Expire mirrors whose deadline (+ one call timeout of grace
+        — a HEALTHY engine reports its own typed expiry within one
+        poll) passed with the worker unreachable: the bounded-cost
+        guarantee under ``rpc.drop``.  The verdict is the typed
+        ``expired_rpc``, and the handle stays terminal even if the
+        worker later completes the decode (at-most-once to the caller:
+        the router never reads an expired handle twice)."""
+        now = self._clock()
+        for key in list(self._mirrors):
+            m = self._mirrors[key]
+            if m.deadline_t is None:
+                continue
+            if now > m.deadline_t + self._timeout_s:
+                m.state = EXPIRED
+                m.verdict = VERDICT_EXPIRED_RPC
+                m.error = ("deadline passed with replica %s "
+                           "unreachable over rpc" % self.replica_id)
+                del self._mirrors[key]
+                _telemetry.counter("rpc.expired_unreachable").inc()
+
+    def drain(self, timeout=60.0):
+        """Ask the worker to drain, then POLL until every in-flight
+        mirror reached a terminal state — ``Router.drain`` harvests
+        exactly once after the drains return, on the in-process
+        contract that drain() completes the accepted requests first;
+        returning on the bare ack would strand them ``running`` forever
+        (the worker exits 80 after its post-drain linger).  Returns
+        EXIT_SERVE_DRAIN."""
+        addr = self._resolve()
+        reply = rpc_call(addr, {"method": "drain"}, self._timeout_s,
+                         retries=self._retries, rng=self._rng)
+        if not reply.get("ok"):
+            raise RpcError("drain of replica %s refused: %s"
+                           % (self.replica_id, reply.get("error")))
+        self._status["draining"] = True
+        deadline = time.monotonic() + timeout
+        while self._mirrors and time.monotonic() < deadline:
+            try:
+                self.step()
+            except ReplicaLost:
+                break  # worker already gone; expiry sweeps the rest
+            if self._mirrors:
+                time.sleep(0.02)
+        if self._mirrors:
+            raise RpcError(
+                "replica %s drain left %d request(s) unresolved after "
+                "%.0fs — their completions were never observable"
+                % (self.replica_id, len(self._mirrors), timeout))
+        # the worker exits 80 once its linger elapses: this replica is
+        # finished, not failed
+        self.alive = False
+        return EXIT_SERVE_DRAIN
+
+    def abandon(self):
+        """Router failover hook: mark dead.  The engine, its pages and
+        its watchdog lease live in the worker process — nothing to
+        release here; the launcher reaps the corpse."""
+        self.alive = False
+
+    def health(self):
+        """The fused health view: local breaker/heartbeat evidence
+        plus (reachable) the worker's own ``health()`` snapshot and
+        foreground-compile count."""
+        doc = {"replica_id": self.replica_id, "alive": self.alive,
+               "breaker": self.breaker.state,
+               "incarnation": self._pin}
+        hb = self._heartbeat_path
+        if hb:
+            try:
+                doc["heartbeat_age_s"] = round(
+                    time.time() - os.stat(hb).st_mtime, 3)
+            except OSError:
+                doc["heartbeat_age_s"] = None
+        try:
+            addr = self._resolve()
+            reply = rpc_call(addr, {"method": "health"},
+                             self._timeout_s, retries=0,
+                             rng=self._rng)
+            doc["reachable"] = bool(reply.get("ok"))
+            doc["remote"] = reply
+        except (RpcError, ReplicaLost, OSError) as e:
+            doc["reachable"] = False
+            doc["error"] = str(e)
+        return doc
+
+
+# -- fleet discovery (tools/launch.py --serve layout) ----------------------
+
+def port_file_path(run_dir, slot):
+    return os.path.join(run_dir, "serve-port-slot%d.json" % int(slot))
+
+
+def fleet_proxies(run_dir, slots, timeout=60.0, **kw):
+    """Proxies for a ``tools/launch.py --serve`` fleet: one per slot,
+    each pinned to the incarnation its port file currently publishes
+    (waits for workers still spinning up).  Heartbeat fusion uses the
+    launcher's run-dir heartbeat tree."""
+    out = []
+    for slot in slots:
+        pf = port_file_path(run_dir, slot)
+        wait_port_file(pf, timeout=timeout)
+        hb = os.path.join(run_dir, "hb", "hb-%d.json" % int(slot))
+        out.append(RpcReplicaProxy(
+            "slot%d" % int(slot), port_file=pf, heartbeat_path=hb,
+            **kw))
+    return out

@@ -22,8 +22,9 @@ from .assignment import (CursorStore, follow_resume, ranges_for_epoch,
                          resume_spans, span_for_rank)
 from .manifest import ShardSet, ShardSetWriter, discover, load_shard_set
 from .loader import StreamLoader
+from .fit import StreamTrainIter
 
 __all__ = ["assignment", "manifest", "CursorStore", "follow_resume",
            "ranges_for_epoch", "resume_spans", "span_for_rank",
            "ShardSet", "ShardSetWriter", "discover", "load_shard_set",
-           "StreamLoader"]
+           "StreamLoader", "StreamTrainIter"]

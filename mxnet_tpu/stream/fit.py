@@ -1,0 +1,144 @@
+"""``Module.fit(train_data=StreamLoader)`` sugar (ROADMAP item 5
+follow-up, ISSUE 14 satellite).
+
+:class:`StreamTrainIter` adapts an epoch-mode
+:class:`~mxnet_tpu.stream.loader.StreamLoader` to the ``DataIter``
+contract the training loop speaks (``provide_data`` /
+``provide_label`` / ``reset`` / iteration yielding ``DataBatch``), so
+
+    mod.fit(train_data=stream_loader, num_epoch=3, ...)
+
+just works — ``BaseModule.fit`` wraps a bare StreamLoader in this
+adapter automatically.  The pieces:
+
+- **shape discovery** — ``provide_data`` peeks ONE batch (kept, and
+  yielded first in epoch 0 — the cursor advanced for it, so it must
+  reach the trainer exactly once, never be re-read);
+- **epoch advance** — ``reset()`` (the fit loop calls it at each epoch
+  end) re-pins the loader via ``set_epoch(epoch + 1)``: an appended
+  manifest enters coverage at the next epoch, per the exact-once laws;
+- **cursor → checkpoint wiring** — the fit loop stamps
+  ``loader.cursor()`` onto the module at every epoch boundary
+  (``Module._stream_cursor``) BEFORE the epoch-end callbacks run, so
+  a plain ``callback.module_checkpoint(mod, prefix)`` callback writes
+  manifests whose ``stream_cursor`` pairs the checkpoint epoch with
+  exactly the records consumed when it was cut — the
+  world-agnostic resume stamp ``StreamLoader(resume=...)`` replays.
+
+The loader must use ``last_batch="discard"``: ``Module.bind`` compiles
+one static batch shape, and a ragged tail batch would retrace it
+(coverage is still exact — the discarded tail's records are folded
+into the cursor by the loader's attribution markers).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc
+
+__all__ = ["StreamTrainIter"]
+
+
+class StreamTrainIter:
+    """DataIter facade over an epoch-mode StreamLoader.
+
+    ``decode_fn`` samples must batchify into ``(data, label)`` pairs
+    (the default batchify does this for tuple samples) or into a bare
+    data array (label-less fitting); already-built ``DataBatch``
+    objects pass through untouched."""
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label"):
+        if getattr(loader, "_mode", None) != "epoch":
+            raise MXNetError(
+                "Module.fit needs an epoch-mode StreamLoader (follow "
+                "mode has no epoch boundary for the fit loop to pace)")
+        if getattr(loader, "_last_batch", None) != "discard":
+            raise MXNetError(
+                "Module.fit over a StreamLoader requires "
+                "last_batch='discard': bind compiles ONE static batch "
+                "shape, and a ragged tail batch would retrace it "
+                "(tail records still reach the cursor — coverage "
+                "stays exact-once)")
+        self._loader = loader
+        self._data_name = data_name
+        self._label_name = label_name
+        self._peek = None
+        self._inner = None
+        self.batch_size = loader._batch_size
+
+    # -- shape discovery ---------------------------------------------------
+    def _peek_batch(self):
+        if self._peek is None:
+            if self._inner is None:
+                self._inner = iter(self._loader)
+            try:
+                self._peek = self._to_batch(next(self._inner))
+            except StopIteration:
+                raise MXNetError(
+                    "the stream has no complete batch for this rank — "
+                    "cannot derive provide_data (grow the shard set "
+                    "or shrink batch_size/world)")
+        return self._peek
+
+    @property
+    def provide_data(self):
+        b = self._peek_batch()
+        return [DataDesc(self._data_name, tuple(a.shape),
+                         dtype=a.dtype) for a in b.data]
+
+    @property
+    def provide_label(self):
+        b = self._peek_batch()
+        return [DataDesc(self._label_name, tuple(a.shape),
+                         dtype=a.dtype) for a in b.label]
+
+    # -- cursor ------------------------------------------------------------
+    def stream_cursor(self):
+        """The loader's world-agnostic resume stamp — what the fit
+        loop hands the checkpoint manifest at each epoch boundary."""
+        return self._loader.cursor()
+
+    # -- DataIter protocol -------------------------------------------------
+    def _to_batch(self, batch):
+        if isinstance(batch, DataBatch):
+            return batch
+        if isinstance(batch, (tuple, list)):
+            if len(batch) == 2:
+                return DataBatch(data=[batch[0]], label=[batch[1]],
+                                 pad=0)
+            return DataBatch(data=list(batch), label=[], pad=0)
+        return DataBatch(data=[batch], label=[], pad=0)
+
+    def __iter__(self):
+        # one live iteration per loader: adopt the peek's iteration
+        # instead of superseding it (the peeked batch advanced the
+        # cursor — it must reach the trainer exactly once)
+        inner = self._inner if self._inner is not None \
+            else iter(self._loader)
+        self._inner = None
+
+        def gen():
+            if self._peek is not None:
+                first, self._peek = self._peek, None
+                yield first
+            for b in inner:
+                yield self._to_batch(b)
+        return gen()
+
+    def reset(self):
+        """Epoch boundary (the fit loop calls this after each epoch):
+        abandon any leftover iteration state and re-pin the next
+        epoch's assignment."""
+        self._peek = None
+        self._inner = None
+        self._loader.set_epoch(self._loader._epoch + 1)
+
+
+def maybe_wrap(train_data):
+    """``BaseModule.fit``'s sugar hook: a bare StreamLoader becomes a
+    StreamTrainIter; anything else (including an already-wrapped
+    adapter) passes through."""
+    from .loader import StreamLoader
+    if isinstance(train_data, StreamLoader):
+        return StreamTrainIter(train_data)
+    return train_data

@@ -382,13 +382,23 @@ class _DecodePool:
     def begin(self):
         """Start a new iteration: bump the generation and drop tasks an
         abandoned iteration left queued (results already in flight are
-        discarded by the generation filter in :meth:`get`)."""
+        discarded by the generation filter in :meth:`get`).  Tasks
+        already tagged with the NEW generation survive the drain — the
+        epoch prefetch-ahead path submits the next epoch's first
+        chunks under ``gen + 1`` before the iteration that will
+        consume them begins, and dropping them would strand their
+        sequence numbers forever."""
         self.gen += 1
+        keep = []
         while True:
             try:
-                self._tasks.get_nowait()
+                item = self._tasks.get_nowait()
             except _queue.Empty:
                 break
+            if item[0] >= self.gen:
+                keep.append(item)
+        for item in keep:
+            self._tasks.put(item)
         return self.gen
 
     def submit(self, gen, task_tail):
@@ -448,13 +458,22 @@ class _DecodePool:
                     continue
             item_gen = self._item_gen(item)
             if item_gen > gen:
-                # a newer iteration's item landed in a SUPERSEDED
-                # consumer: hand it back and retire this consumer
+                # a newer-generation item in this consumer's hands:
+                # hand it back either way — but it only means THIS
+                # consumer is superseded when a newer iteration
+                # actually began (pool.gen moved past ours).  The
+                # other source of ahead-of-generation items is the
+                # epoch prefetch-ahead (next epoch's chunks decoded
+                # under gen+1 while this iteration drains its tail):
+                # those belong to the NEXT consumer, not to anyone
+                # superseding us.
                 self._push_return(item)
-                raise MXNetError(
-                    "stream iteration superseded: a newer iteration of "
-                    "this StreamLoader was started (one live iteration "
-                    "per loader)")
+                if self.gen > gen:
+                    raise MXNetError(
+                        "stream iteration superseded: a newer "
+                        "iteration of this StreamLoader was started "
+                        "(one live iteration per loader)")
+                continue
             if isinstance(item, tuple) and item and item[0] == "__err__":
                 _, err_gen, exc, tb_text, summary = item
                 self._degraded = True  # its worker exits after this item
@@ -584,6 +603,15 @@ class StreamLoader:
         self._dl = _dl
         self._torn_warned = set()
         self._open_by_worker = {}
+        # epoch-boundary prefetch-ahead (ISSUE 14 satellite): once this
+        # rank's epoch-N spans are exhausted, the otherwise-idle decode
+        # pool starts on epoch N+1's first chunks under the NEXT
+        # iteration generation; set_epoch's re-pin is validated against
+        # the speculation before the results are consumed (generation
+        # tagging makes a wrong guess safe — it is simply discarded)
+        self._epoch_prefetch = _env_int("MXTPU_STREAM_EPOCH_PREFETCH",
+                                        1) > 0
+        self._spec = None
         if mode == "epoch":
             self.set_epoch(epoch, resume=resume)
         else:
@@ -770,15 +798,97 @@ class StreamLoader:
         self.close()
         return False
 
-    def _results(self, pool, gen):
+    # -- epoch-boundary prefetch-ahead ---------------------------------------
+    def _spec_matches(self, spec, pool):
+        """Does a recorded speculation describe EXACTLY the iteration
+        about to run?  Inputs fully determine the task stream
+        (``spans_to_ranges`` is pure), so matching inputs means the
+        pre-submitted chunks are the iteration's true prefix."""
+        return (spec is not None and self._mode == "epoch"
+                and spec["pool"] == id(pool)
+                and spec["epoch"] == self._epoch
+                and spec["sizes"] == self._sizes
+                and spec["spans"] == self._spans
+                and spec["rank"] == self._rank
+                and spec["world"] == self._world
+                and self._consumed == 0)
+
+    def _speculate(self, pool, gen):
+        """This rank's epoch-N spans are exhausted and the pool is
+        about to idle through ``set_epoch``: submit epoch N+1's first
+        assigned chunks (the fresh law — a resume or a grown manifest
+        invalidates the guess at the next iteration) under ``gen+1``,
+        the generation the NEXT iteration's ``begin()`` will mint."""
+        if not (self._epoch_prefetch and self._mode == "epoch"
+                and pool.full_strength()):
+            return
+        next_epoch = self._epoch + 1
+        sizes = self._sizes
+        lo, hi = _assign.span_for_rank(sum(sizes), self._rank,
+                                       self._world)
+        spans = [(lo, hi)] if hi > lo else []
+        if not spans:
+            return
+        ranges = _assign.spans_to_ranges(sizes, next_epoch, spans,
+                                         self._seed)
+        tasks, keys = [], []
+        for task in self._chunks(ranges):
+            if len(tasks) >= pool.window:
+                break
+            tasks.append(task)
+            keys.append((task[1], task[2], task[3]))
+        if not tasks:
+            return
+        for seq, task in enumerate(tasks):
+            pool.submit(gen + 1, (seq,) + task)
+        _telemetry.counter("io.epoch_prefetch").inc(len(tasks))
+        self._spec = {"pool": id(pool), "gen": gen + 1,
+                      "epoch": next_epoch, "sizes": list(sizes),
+                      "spans": [(lo, hi)], "rank": self._rank,
+                      "world": self._world, "keys": keys}
+
+    def _adopt_speculation(self, pool, gen):
+        """Called at iteration start (after ``begin()``): if the
+        recorded speculation IS this iteration's prefix, return its
+        chunk keys (the first ``len(keys)`` tasks are already in the
+        pool under this generation); otherwise discard it — one more
+        ``begin()`` makes the stale results unconsumable."""
+        spec, self._spec = self._spec, None
+        if spec is None:
+            return gen, []
+        if spec["gen"] == gen and self._spec_matches(spec, pool):
+            _telemetry.counter("io.epoch_prefetch_hits").inc(
+                len(spec["keys"]))
+            return gen, spec["keys"]
+        return pool.begin(), []
+
+    def _results(self, pool, gen, preloaded=()):
         """Submit tasks into the pool (bounded window) and yield result
         items strictly in sequence order — byte-deterministic delivery
-        no matter how workers interleave."""
+        no matter how workers interleave.  ``preloaded`` chunk keys
+        were already submitted under this generation by the previous
+        iteration's epoch prefetch-ahead: the iterator's first tasks
+        are verified against them and NOT re-submitted."""
         tasks = self._task_iter()
         reorder = {}
-        next_seq = submitted = 0
+        next_seq = 0
+        submitted = len(preloaded)
         exhausted = False
         first_wait = True
+        speculated = False
+        for key in preloaded:
+            t = next(tasks, None)
+            actual = None if t is None or t[0] == "__skip__" \
+                else (t[1], t[2], t[3])
+            if actual != key:
+                # inputs matched, so the pure task derivation cannot
+                # diverge — reaching here is an internal bug, and
+                # serving a mis-attributed chunk would silently break
+                # exact-once; fail loudly instead
+                raise MXNetError(
+                    "epoch prefetch-ahead speculation diverged from "
+                    "the live task stream (%r vs %r) — internal "
+                    "invariant broken" % (key, actual))
         while True:
             while not exhausted and submitted - next_seq < pool.window:
                 try:
@@ -799,6 +909,13 @@ class StreamLoader:
                     continue
                 pool.submit(gen, (submitted,) + t)
                 submitted += 1
+            if exhausted and not speculated:
+                # the pool would idle through set_epoch: start on the
+                # next epoch's first chunks while this iteration's
+                # tail drains (their results are tagged gen+1 — the
+                # next iteration consumes or discards them)
+                speculated = True
+                self._speculate(pool, gen)
             if next_seq == submitted:
                 if exhausted:
                     return
@@ -871,6 +988,7 @@ class StreamLoader:
         when the caller receives the batch."""
         pool = self._ensure_pool()
         gen = pool.begin()
+        gen, preloaded = self._adopt_speculation(pool, gen)
         batches = _telemetry.counter("data.batches")
         B = self._batch_size
         try:
@@ -880,7 +998,7 @@ class StreamLoader:
             # boundary can be cut at B SAMPLES while the cursor folds
             # RECORDS (torn records advance it without data)
             buf, attrib = [], []
-            for samples, meta in self._results(pool, gen):
+            for samples, meta in self._results(pool, gen, preloaded):
                 shard = meta["shard"]
                 if samples:
                     buf.extend(samples)

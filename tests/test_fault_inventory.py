@@ -1,0 +1,111 @@
+"""Fault-site inventory lint (ISSUE 14 satellite): the no-silent-caps
+contract applied to the fault grammar itself.
+
+The fault-injection layer is only trustworthy if every site is
+(a) DOCUMENTED — an operator reading ROBUSTNESS.md §4 must see the
+complete drill surface, and (b) DRILLED — a site nothing exercises is
+a recovery path nothing proves.  This lint enumerates every site
+string passed to ``fault.trigger`` / ``check`` / ``stall_if`` /
+``delay_if`` / ``exit_if`` / ``is_active`` across the runtime
+(``mxnet_tpu/``, ``tools/``, ``bench.py``) and asserts:
+
+- every site in code has a row in the ROBUSTNESS.md §4 table;
+- every row in the table corresponds to a site in code (no stale
+  docs describing drills that no longer exist);
+- every site is referenced by at least one file under ``tests/``
+  (the drill exists — a fault path with no test is undrilled).
+
+Adding a fault site therefore REQUIRES a §4 row and a test in the
+same change, mechanically.
+"""
+import os
+import re
+
+import pytest
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: a fault-site check: fault.trigger("site") / _fault.stall_if('site')…
+_CALL_RE = re.compile(
+    r"(?:\b|_)fault\.(?:trigger|check|stall_if|delay_if|exit_if|"
+    r"is_active)\(\s*['\"]([a-z0-9_.]+)['\"]")
+#: a §4 table row: | `site` | effect |
+_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|")
+
+
+def _py_files(*roots):
+    for root in roots:
+        root = os.path.join(REPO, root)
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__"]
+            for name in filenames:
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def sites_in_code():
+    sites = {}
+    for path in _py_files("mxnet_tpu", "tools", "bench.py"):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for m in _CALL_RE.finditer(src):
+            sites.setdefault(m.group(1), []).append(
+                os.path.relpath(path, REPO))
+    return sites
+
+
+def sites_in_doc():
+    """Rows of the ROBUSTNESS.md §4 site table (between the §4 and §5
+    headings)."""
+    with open(os.path.join(REPO, "ROBUSTNESS.md"),
+              encoding="utf-8") as f:
+        text = f.read()
+    start = text.index("## 4. Fault injection")
+    end = text.index("## 5.", start)
+    rows = set()
+    for line in text[start:end].splitlines():
+        m = _ROW_RE.match(line.strip())
+        if m and m.group(1) != "site":
+            rows.add(m.group(1))
+    return rows
+
+
+def test_every_code_site_documented_and_every_doc_row_live():
+    code = sites_in_code()
+    assert code, "the site scan found nothing — the regex rotted"
+    doc = sites_in_doc()
+    undocumented = sorted(set(code) - doc)
+    assert not undocumented, (
+        "fault sites checked in code but MISSING from the "
+        "ROBUSTNESS.md §4 table: %s (sites live at %s)"
+        % (undocumented,
+           {s: code[s] for s in undocumented}))
+    stale = sorted(doc - set(code))
+    assert not stale, (
+        "ROBUSTNESS.md §4 documents fault sites no code checks "
+        "anymore: %s — drop the rows or restore the drills" % stale)
+
+
+def test_every_site_exercised_by_a_test():
+    code = sites_in_code()
+    tests_dir = os.path.join(REPO, "tests")
+    corpus = {}
+    for path in _py_files("tests"):
+        with open(path, encoding="utf-8") as f:
+            corpus[os.path.relpath(path, tests_dir)] = f.read()
+    # this lint enumerates sites from source, so its own strings never
+    # count as "a drill exists"
+    corpus.pop(os.path.basename(__file__), None)
+    undrilled = sorted(s for s in code
+                       if not any(s in text
+                                  for text in corpus.values()))
+    assert not undrilled, (
+        "fault sites no test exercises: %s — every recovery path "
+        "must be drilled, not just written (checked at %s)"
+        % (undrilled, {s: code[s] for s in undrilled}))

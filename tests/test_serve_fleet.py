@@ -1,0 +1,128 @@
+"""Out-of-process serving fleet e2e (ISSUE 14, slow).
+
+``tools/launch.py --serve`` brings up a router-facing fleet of THREE
+serving-replica processes (tools/serve_worker.py); slot 1 is armed
+with ``serve.replica.sigkill:1`` (scoped by slot AND attempt — the
+respawned replacement must not re-arm the drill) so it dies a REAL
+SIGKILL mid-load.  The driver (clean subprocess,
+serve_fleet_driver.py) asserts the survivability contract; this test
+then audits the artifacts the fleet left behind:
+
+- the membership journal recorded the slot-1 failure AND the replace;
+- ``serve_report`` on the multi-process run dir links the failover
+  arc(s) by trace id across the victim and survivor processes and
+  names the killed replica in the SLO blame section.
+
+Every spawned process is wrapped in ``timeout -k`` (the hang-marker
+discipline): a supervision regression surfaces as a failed assertion,
+never a wedged suite.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+WORKER = os.path.join(REPO, "tools", "serve_worker.py")
+DRIVER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "serve_fleet_driver.py")
+
+pytestmark = [pytest.mark.rpcfleet, pytest.mark.hang]
+
+
+@pytest.mark.slow
+def test_fleet_sigkill_failover_e2e(tmp_path):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # the drill: slot 1's ORIGINAL incarnation sigkills on its
+        # first decode step; the replacement (attempt 1) is unscoped
+        "MXTPU_FAULT": "serve.replica.sigkill:1",
+        "MXTPU_FAULT_SLOTS": "1",
+        "MXTPU_FAULT_ATTEMPTS": "0",
+    })
+    launcher = subprocess.Popen(
+        ["timeout", "-k", "10", "420", sys.executable, LAUNCH,
+         "--serve", "-n", "3", "--run-dir", run_dir,
+         "--max-restarts", "4", "--restart-backoff", "0.2",
+         "--telemetry-interval", "0.25", "--cpu-fake-devices", "--",
+         sys.executable, WORKER, "--max-seconds", "360"],
+        env=env)
+    try:
+        drv_env = dict(os.environ, JAX_PLATFORMS="cpu")
+        drv_env.pop("MXTPU_FAULT", None)  # the driver is not a victim
+        driver = subprocess.run(
+            ["timeout", "-k", "10", "380", sys.executable, DRIVER,
+             run_dir],
+            env=drv_env, capture_output=True, text=True, timeout=400)
+        assert driver.returncode == 0, (
+            "fleet driver failed rc=%d\nstdout:\n%s\nstderr:\n%s"
+            % (driver.returncode, driver.stdout[-4000:],
+               driver.stderr[-4000:]))
+        assert "SERVE_FLEET_OK" in driver.stdout
+    finally:
+        # stop the fleet via the operator handle; escalate if needed
+        with open(os.path.join(run_dir, "serve-stop"), "w") as f:
+            f.write("stop\n")
+        try:
+            rc = launcher.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            launcher.send_signal(signal.SIGINT)
+            rc = launcher.wait(timeout=30)
+    assert rc == 0, "launch.py --serve exited %d" % rc
+
+    # membership journal: slot 1 failed (SIGKILL) and was REPLACED
+    with open(os.path.join(run_dir, "membership.json")) as f:
+        transitions = json.load(f)["transitions"]
+    failures = [t for t in transitions
+                if t["event"] == "failure" and t.get("slot") == 1]
+    replaces = [t for t in transitions
+                if t["event"] == "replace" and t.get("slot") == 1]
+    spawns1 = [t for t in transitions
+               if t["event"] == "spawn" and t.get("slot") == 1]
+    assert failures, transitions
+    assert failures[0]["rc"] == -9 and failures[0]["kind"] == \
+        "retryable", failures[0]
+    assert replaces, "no replace transition journaled for slot 1"
+    assert len(spawns1) >= 2, "slot 1 was never respawned"
+    # no OTHER slot was blamed: the fleet survived on its survivors
+    assert not [t for t in transitions if t["event"] == "failure"
+                and t.get("slot") in (0, 2)]
+
+    # serve_report over the REAL multi-process artifact tree
+    sys.path.insert(0, os.path.join(REPO, "tools", "perf_probe"))
+    try:
+        import serve_report
+        rep = serve_report.analyze(run_dir)
+    finally:
+        sys.path.pop(0)
+    assert rep["linked_arcs"] >= 1, rep["arcs"]
+    for arc in rep["arcs"]:
+        assert arc["victims"] == ["slot1"], arc
+        assert arc["survivor"] is not None and \
+            arc["survivor"] != "slot1", arc
+        assert arc["verdict"] == "completed", arc
+    blamed = {b["replica"] for b in rep["blame"]}
+    assert "slot1" in blamed, rep["blame"]
+    kill_blames = [b for b in rep["blame"]
+                   if b["replica"] == "slot1"
+                   and b["breach"] == "failed_over"]
+    assert kill_blames and "lost mid-decode" in kill_blames[0]["why"]
+    # every driver trace closed with exactly one final verdict
+    assert rep["lifecycle"]["ok"], rep["lifecycle"]
+
+
+def test_serve_mode_rejects_non_local_launcher():
+    rc = subprocess.run(
+        [sys.executable, LAUNCH, "--serve", "--launcher", "ssh",
+         "-n", "1", "--", "true"],
+        capture_output=True, text=True, timeout=60)
+    assert rc.returncode == 2
+    assert "local-launcher" in rc.stderr

@@ -1,0 +1,566 @@
+"""RPC-plane laws for the out-of-process serving fleet (ISSUE 14).
+
+Everything here runs against STUB replicas behind a real
+``RpcServer``/``RpcReplicaProxy`` pair over loopback sockets — the
+transport, deadline, retry, idempotence and circuit-breaker laws are
+socket-level properties and must not pay an XLA compile to be pinned.
+Real-engine integration rides tests/test_serve_fleet.py (slow e2e via
+``tools/launch.py --serve``) and ``BENCH_MODE=serve``'s fleet drill.
+
+Pinned laws:
+
+- framing round-trips; oversized/corrupt frames fail fast;
+- circuit breaker (INJECTED clock): trip at the consecutive-failure
+  threshold, open blocks, cooldown → half-open admits exactly ONE
+  probe, probe success closes, probe failure re-trips;
+- ``rpc.conn.refused`` exercises bounded retry + backoff (the call
+  succeeds once the site disarms, counters prove the retries);
+- idempotent submit keys: a retry after a lost ACK (``rpc.drop``
+  eating the reply) dedups into the ORIGINAL handle — the worker
+  decodes the request exactly once;
+- a replica that blackholes every RPC costs a request at most its
+  remaining deadline (typed ``expired_rpc`` verdict), never an
+  unbounded hang — and the breaker RECOVERS once the replica does;
+- Router over proxies: completion harvest, refusal spread, and
+  incarnation-change failover (a replacement rewriting the port file
+  reads as confirmed death; victims re-decode on the successor);
+- Router journal torn-tail replay: a journal truncated mid-line
+  replays every complete entry, skips-and-counts the partial one, and
+  preserves at-most-once for every completed rid.
+"""
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu  # noqa: F401 — package init (telemetry registry)
+from mxnet_tpu import fault, telemetry
+from mxnet_tpu.serving import (CircuitBreaker, ReplicaLost, Router,
+                               RpcError, RpcReplicaProxy, RpcServer)
+from mxnet_tpu.serving.replica import EXIT_SERVE_DRAIN
+from mxnet_tpu.serving.rpc import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                   BREAKER_OPEN, VERDICT_EXPIRED_RPC,
+                                   recv_frame, rpc_call, send_frame,
+                                   write_port_file)
+from mxnet_tpu.serving.scheduler import FINISHED, SHED
+
+pytestmark = pytest.mark.rpcfleet
+
+
+# -- stub replica (the serving_surv stub, server-side flavored) ------------
+
+class _StubReq:
+    def __init__(self, rid, max_new, shed=False):
+        self.rid = rid
+        self.max_new = max_new
+        self.state = SHED if shed else "running"
+        self.verdict = "shed" if shed else None
+        self.error = "stub shed" if shed else None
+        self.tokens = []
+        self.ttft_s = None
+        self.queue_wait_s = 0.0
+        self.tpot_s = None
+
+    @property
+    def done(self):
+        return self.state not in ("queued", "running")
+
+
+class _StubReplica:
+    """Server-side replica duck-type: one deterministic token (rid*10
+    + position) per step per request — completions are checkable
+    without a model."""
+
+    def __init__(self, rid="stub", shed=False, step_sleep=0.0):
+        self.replica_id = rid
+        self.alive = True
+        self.draining = False
+        self.shed_mode = shed
+        self.step_sleep = step_sleep
+        self.reqs = []
+        self.submits = 0
+        self._next = 0
+
+    @property
+    def load(self):
+        return sum(1 for r in self.reqs if not r.done)
+
+    @property
+    def idle(self):
+        return all(r.done for r in self.reqs)
+
+    def submit(self, prompt, max_new, deadline_s=None, trace=None):
+        self.submits += 1
+        r = _StubReq(self._next, int(max_new), shed=self.shed_mode)
+        self._next += 1
+        if not self.shed_mode:
+            self.reqs.append(r)
+        return r
+
+    def step(self):
+        if self.step_sleep and any(not r.done for r in self.reqs):
+            time.sleep(self.step_sleep)
+        n = 0
+        for r in self.reqs:
+            if not r.done:
+                r.tokens.append(r.rid * 10 + len(r.tokens))
+                if r.ttft_s is None:
+                    r.ttft_s = 0.001
+                if len(r.tokens) >= r.max_new:
+                    r.state = FINISHED
+                    r.verdict = "completed"
+                n += 1
+        return n
+
+    def drain(self):
+        while not self.idle:
+            self.step()
+        self.draining = True
+        self.alive = False
+        return EXIT_SERVE_DRAIN
+
+    def health(self):
+        return {"replica_id": self.replica_id, "alive": self.alive}
+
+
+class _WorkerLoop:
+    """The serve_worker main loop, in a thread: poll RPCs, step the
+    stub — so proxy calls in the test thread get answered."""
+
+    def __init__(self, replica=None):
+        self.replica = replica or _StubReplica()
+        self.server = RpcServer(self.replica)
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    @property
+    def addr(self):
+        return (self.server.host, self.server.port)
+
+    def _run(self):
+        drained = False
+        while not self._stop.is_set():
+            self.server.poll(timeout=0.01)
+            if self.server.drain_requested and not drained:
+                drained = True
+                self.replica.drain()   # then linger answering status
+            elif not self.replica.idle and self.replica.alive:
+                self.replica.step()
+
+    def close(self):
+        self._stop.set()
+        self._t.join(timeout=5.0)
+        self.server.close()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+# -- framing ---------------------------------------------------------------
+
+def test_frame_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        doc = {"method": "x", "payload": list(range(100)),
+               "s": "héllo"}
+        send_frame(a, doc)
+        assert recv_frame(b) == doc
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_corrupt_length_fails_fast():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\xff\xff\xff\xff")  # claims ~4 GiB
+        with pytest.raises(RpcError):
+            recv_frame(b, deadline_t=time.monotonic() + 1.0)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_truncated_payload_times_out():
+    a, b = socket.socketpair()
+    try:
+        import struct
+        a.sendall(struct.pack(">I", 100) + b"{")  # 99 bytes missing
+        with pytest.raises((socket.timeout, RpcError)):
+            recv_frame(b, deadline_t=time.monotonic() + 0.2)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- circuit breaker laws (injected clock) ---------------------------------
+
+def test_breaker_trips_at_threshold_and_resets_on_success():
+    clk = [0.0]
+    br = CircuitBreaker(threshold=3, cooldown_s=10.0,
+                        clock=lambda: clk[0])
+    assert br.state == BREAKER_CLOSED
+    br.record_failure()
+    br.record_failure()
+    br.record_success()          # success resets the CONSECUTIVE count
+    br.record_failure()
+    br.record_failure()
+    assert br.state == BREAKER_CLOSED
+    br.record_failure()
+    assert br.state == BREAKER_OPEN and br.trips == 1
+    assert not br.allow()
+
+
+def test_breaker_half_open_single_probe_then_close():
+    clk = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown_s=5.0,
+                        clock=lambda: clk[0])
+    br.record_failure()
+    assert br.state == BREAKER_OPEN
+    clk[0] = 4.9
+    assert not br.allow()
+    clk[0] = 5.1
+    assert br.allow()            # the ONE half-open probe
+    assert br.state == BREAKER_HALF_OPEN
+    assert not br.allow()        # second caller blocked while probing
+    br.record_success()
+    assert br.state == BREAKER_CLOSED
+    assert br.allow()
+
+
+def test_breaker_probe_failure_retrips_fresh_cooldown():
+    clk = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown_s=5.0,
+                        clock=lambda: clk[0])
+    br.record_failure()
+    clk[0] = 6.0
+    assert br.allow()
+    br.record_failure()          # probe failed
+    assert br.state == BREAKER_OPEN and br.trips == 2
+    clk[0] = 10.0                # 4s into the FRESH cooldown
+    assert not br.allow()
+    clk[0] = 11.1
+    assert br.allow()
+
+
+# -- retry / backoff -------------------------------------------------------
+
+def test_conn_refused_retries_then_succeeds():
+    w = _WorkerLoop()
+    try:
+        telemetry.reset()
+        fault.configure("rpc.conn.refused:2")
+        t0 = time.perf_counter()
+        reply = rpc_call(w.addr, {"method": "health"}, 1.0, retries=3,
+                         backoff_s=0.01, backoff_max_s=0.05)
+        wall = time.perf_counter() - t0
+        assert reply["ok"]
+        assert telemetry.counter("rpc.retries").value == 2
+        assert telemetry.counter("rpc.conn_errors").value == 2
+        assert wall < 2.0        # bounded: two small backoffs, no hang
+    finally:
+        w.close()
+
+
+def test_retries_exhausted_raises_rpc_error():
+    fault.configure("rpc.conn.refused:10")
+    with pytest.raises(RpcError):
+        rpc_call(("127.0.0.1", 1), {"method": "health"}, 0.2,
+                 retries=1, backoff_s=0.01)
+    assert fault.fire_count("rpc.conn.refused") == 2  # 1 + 1 retry
+
+
+def test_rpc_delay_is_bounded_not_fatal():
+    w = _WorkerLoop()
+    try:
+        os.environ["MXTPU_FAULT_DELAY_SECS"] = "0.1"
+        try:
+            fault.configure("rpc.delay:1")
+            t0 = time.perf_counter()
+            reply = rpc_call(w.addr, {"method": "health"}, 2.0,
+                             retries=0)
+            wall = time.perf_counter() - t0
+        finally:
+            del os.environ["MXTPU_FAULT_DELAY_SECS"]
+        assert reply["ok"] and wall >= 0.1
+    finally:
+        w.close()
+
+
+# -- idempotent submit keys (the lost-ACK law) -----------------------------
+
+def test_lost_ack_retry_dedups_never_double_decodes():
+    w = _WorkerLoop()
+    try:
+        # first reply eaten by rpc.drop: the submit WAS processed and
+        # journaled; the client retry must get the ORIGINAL handle
+        fault.configure("rpc.drop:1")
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=0.3,
+                                retries=2)
+        m = proxy.submit(np.ones(3, np.int32), 2, trace="tr-1")
+        assert w.replica.submits == 1          # exactly one decode
+        for _ in range(50):
+            proxy.step()
+            if m.done:
+                break
+            time.sleep(0.01)
+        assert m.state == FINISHED and len(m.tokens) == 2
+    finally:
+        w.close()
+
+
+def test_duplicate_submit_key_returns_same_rid():
+    w = _WorkerLoop()
+    try:
+        msg = {"method": "submit", "key": "K", "trace": "K",
+               "prompt": [1, 2], "max_new": 1, "deadline_s": None}
+        r1 = rpc_call(w.addr, msg, 1.0)
+        r2 = rpc_call(w.addr, dict(msg), 1.0)
+        assert r1["ok"] and r2["ok"]
+        assert r2.get("dedup") is True
+        assert r1["request"]["rid"] == r2["request"]["rid"]
+        assert w.replica.submits == 1
+    finally:
+        w.close()
+
+
+def test_shed_refusal_not_journaled():
+    w = _WorkerLoop(_StubReplica(shed=True))
+    try:
+        msg = {"method": "submit", "key": "K2", "trace": "K2",
+               "prompt": [1], "max_new": 1, "deadline_s": None}
+        r1 = rpc_call(w.addr, msg, 1.0)
+        assert r1["request"]["state"] == SHED
+        r2 = rpc_call(w.addr, dict(msg), 1.0)
+        # a refusal is not a decode: the retry gets a FRESH admission
+        # attempt, not the dedup'd shed verdict
+        assert r2.get("dedup") is None
+        assert w.replica.submits == 2
+    finally:
+        w.close()
+
+
+# -- blackhole: bounded cost + breaker recovery ----------------------------
+
+def test_blackholed_replica_costs_at_most_the_deadline():
+    w = _WorkerLoop()
+    try:
+        proxy = RpcReplicaProxy(
+            "b", addr=w.addr, timeout_s=0.15, retries=0,
+            breaker=CircuitBreaker(threshold=2, cooldown_s=0.2,
+                                   name="b"))
+        m = proxy.submit(np.ones(2, np.int32), 4, deadline_s=5.0,
+                         trace="tr-bh")
+        # now blackhole EVERY rpc (status polls included)
+        fault.configure("rpc.drop:1000")
+        m.deadline_t = proxy._clock() + 0.3   # 0.3s of budget left
+        t0 = time.perf_counter()
+        while not m.done and time.perf_counter() - t0 < 5.0:
+            proxy.step()
+            time.sleep(0.02)
+        wall = time.perf_counter() - t0
+        assert m.done, "blackholed request hung past its deadline"
+        assert m.verdict == VERDICT_EXPIRED_RPC
+        # budget (0.3) + one call timeout of grace (0.15) + slack —
+        # NEVER the 5s hang ceiling
+        assert wall < 2.0, wall
+        assert telemetry.counter("rpc.expired_unreachable").value >= 1
+        assert proxy.breaker.state == BREAKER_OPEN
+        assert proxy.alive           # unreachable is NOT dead
+        assert proxy.idle            # nothing left to wait on
+
+        # the replica comes back: the breaker's half-open probe heals
+        fault.reset()
+        time.sleep(0.25)             # cooldown elapses
+        proxy.step()                 # the probe
+        assert proxy.breaker.state == BREAKER_CLOSED
+        m2 = proxy.submit(np.ones(2, np.int32), 1, trace="tr-rec")
+        for _ in range(50):
+            proxy.step()
+            if m2.done:
+                break
+            time.sleep(0.01)
+        assert m2.state == FINISHED
+    finally:
+        w.close()
+
+
+def test_breaker_open_submit_skips_without_socket():
+    proxy = RpcReplicaProxy(
+        "c", addr=("127.0.0.1", 1), timeout_s=0.1, retries=0,
+        breaker=CircuitBreaker(threshold=1, cooldown_s=100.0,
+                               name="c"))
+    with pytest.raises(ReplicaLost):
+        proxy.submit(np.ones(1, np.int32), 1, trace="t")  # trips it
+    calls0 = telemetry.counter("rpc.calls").value
+    errs0 = telemetry.counter("rpc.conn_errors").value
+    with pytest.raises(ReplicaLost):
+        proxy.submit(np.ones(1, np.int32), 1, trace="t2")
+    # breaker-open: refused at the proxy, no socket burned
+    assert telemetry.counter("rpc.calls").value == calls0
+    assert telemetry.counter("rpc.conn_errors").value == errs0
+
+
+# -- Router over proxies ---------------------------------------------------
+
+def test_router_completes_over_rpc_proxies():
+    wa, wb = _WorkerLoop(_StubReplica("a")), _WorkerLoop(_StubReplica("b"))
+    try:
+        pa = RpcReplicaProxy("a", addr=wa.addr, timeout_s=1.0)
+        pb = RpcReplicaProxy("b", addr=wb.addr, timeout_s=1.0)
+        rt = Router([pa, pb])
+        rrs = [rt.submit(np.ones(2, np.int32), 3) for _ in range(4)]
+        rt.run_until_idle(max_steps=2000)
+        for _ in range(100):     # final harvest lag: one poll round
+            rt.step()
+            if all(rr.done for rr in rrs):
+                break
+            time.sleep(0.01)
+        assert all(rr.state == "completed" for rr in rrs), \
+            [(rr.state, rr.verdict) for rr in rrs]
+        assert all(len(rr.tokens) == 3 for rr in rrs)
+    finally:
+        wa.close()
+        wb.close()
+
+
+def test_incarnation_change_fails_over_to_successor(tmp_path):
+    """A replacement rewriting the slot's port file == confirmed death
+    of the old incarnation: the Router prunes it, the spawn callback
+    returns the successor proxy, victims re-decode there."""
+    wa = _WorkerLoop(_StubReplica("a", step_sleep=0.02))  # doomed
+    wc = _WorkerLoop(_StubReplica("c", step_sleep=0.001))  # successor
+    try:
+        pf = str(tmp_path / "serve-port-slot0.json")
+        write_port_file(pf, wa.addr[1], attempt=0)
+        pa = RpcReplicaProxy("slot0", port_file=pf, timeout_s=0.5)
+        spawned = []
+
+        def spawn():
+            fresh = pa.successor(timeout=5.0)
+            spawned.append(fresh)
+            return fresh
+
+        rt = Router([pa], spawn=spawn, max_retries=2)
+        rr = rt.submit(np.ones(2, np.int32), 50)  # long enough to be
+        rt.step()                                 # mid-flight
+        assert rr.state == "accepted"
+        # the launcher respawns slot 0: new pid/attempt, new port
+        doc = {"host": "127.0.0.1", "port": wc.addr[1],
+               "pid": os.getpid(), "attempt": 1, "t": time.time()}
+        with open(pf, "w") as f:
+            json.dump(doc, f)
+        deadline = time.time() + 10.0
+        while not rr.done and time.time() < deadline:
+            rt.step()
+            time.sleep(0.01)
+        assert rt.failovers == 1 and spawned
+        assert rr.state == "completed" and rr.retries == 1
+        assert len(rr.tokens) == 50
+        assert not pa.alive
+        # the re-decode landed on the successor (replica c's stub)
+        assert wc.replica.submits == 1
+    finally:
+        wa.close()
+        wc.close()
+
+
+def test_mute_connection_never_stalls_serving():
+    """Slow-loris defense: a connection that sends NO frame (health
+    probe, half-open socket, port scan) must cost the single-threaded
+    worker loop nothing — frames assemble non-blocking, so real calls
+    keep answering promptly while the mute socket just ages out."""
+    w = _WorkerLoop(_StubReplica("a"))
+    try:
+        mutes = [socket.create_connection(w.addr) for _ in range(5)]
+        time.sleep(0.05)               # the loop accepts them
+        t0 = time.perf_counter()
+        reply = rpc_call(w.addr, {"method": "health"}, 2.0, retries=0)
+        dt = time.perf_counter() - t0
+        assert reply["ok"] and dt < 0.5, dt
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=1.0)
+        m = proxy.submit(np.ones(2, np.int32), 2, trace="t-mute")
+        for _ in range(100):
+            proxy.step()
+            if m.done:
+                break
+            time.sleep(0.01)
+        assert m.state == FINISHED
+        for s in mutes:
+            s.close()
+    finally:
+        w.close()
+
+
+def test_router_drain_over_rpc_harvests_completions():
+    """Router.drain harvests exactly once after the drains return: the
+    proxy must observe every accepted request's FINAL state before
+    returning, never strand them 'running' on the bare ack."""
+    w = _WorkerLoop(_StubReplica("a", step_sleep=0.01))
+    try:
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=1.0)
+        rt = Router([proxy])
+        rrs = [rt.submit(np.ones(2, np.int32), 10) for _ in range(3)]
+        rt.step()
+        out = rt.drain()
+        assert out == [("a", EXIT_SERVE_DRAIN)]
+        assert all(rr.state == "completed" and len(rr.tokens) == 10
+                   for rr in rrs), [(rr.state, rr.verdict)
+                                    for rr in rrs]
+        assert not proxy.alive
+    finally:
+        w.close()
+
+
+# -- router journal torn-tail replay ---------------------------------------
+
+def test_journal_torn_tail_replay(tmp_path):
+    journal = str(tmp_path / "router-journal-slot0.jsonl")
+    w = _WorkerLoop()
+    try:
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=1.0)
+        rt = Router([proxy], journal_path=journal)
+        rrs = [rt.submit(np.ones(2, np.int32), 2) for _ in range(3)]
+        deadline = time.time() + 10.0
+        while not all(rr.done for rr in rrs) and time.time() < deadline:
+            rt.step()
+            time.sleep(0.01)
+        assert all(rr.state == "completed" for rr in rrs)
+    finally:
+        w.close()
+    # crash simulation: the writer died mid-append — the tail is a
+    # PARTIAL line (single-os.write discipline: earlier lines intact)
+    with open(journal, "ab") as f:
+        f.write(b'{"t": 1.0, "event": "accept", "rid": 99, "tr')
+    rt2 = Router([], journal_path=journal)
+    rep = rt2.replay_journal()
+    assert rep["torn"] == 1
+    assert rep["requests"] == 3
+    for rr in rrs:
+        replayed = rt2.request(rr.rid)
+        assert replayed is not None
+        assert replayed.state == "completed"      # at-most-once: never
+        assert replayed.verdict == "completed"    # re-executed
+        assert replayed.trace == rr.trace
+    assert rt2._next_rid == 3                     # no rid collision
+    # serve_report applies the same skip-and-count to the journal
+    sys_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "perf_probe")
+    import sys
+    sys.path.insert(0, sys_path)
+    try:
+        import serve_report
+        rep2 = serve_report.load_serve(str(tmp_path))
+        assert len(rep2["journal"]) >= 3 * 2      # accept+complete each
+        assert any("unparseable" in n for n in rep2["notes"])
+    finally:
+        sys.path.remove(sys_path)

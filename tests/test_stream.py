@@ -789,3 +789,110 @@ def test_continual_stream_publish_hotload_fast(tmp_path):
     assert e2 == epoch + 1
     sub.load_params(e2)
     assert gpt.generate(srv, probe, 4)[0].tolist() == t1
+
+
+# -- epoch-boundary prefetch-ahead (ISSUE 14 satellite) ----------------------
+
+@pytest.mark.stream
+def test_epoch_prefetch_bit_identical_and_counted(shard_set,
+                                                  monkeypatch):
+    """Speculative next-epoch decode must change NOTHING about what is
+    delivered — same ids, same order — and the counters prove the
+    speculation actually ran and was adopted."""
+    ss, total = shard_set
+    telemetry.reset()
+    with stream.StreamLoader(ss, 4, decode_fn=_decode, epoch=2, rank=0,
+                             world_size=1, num_workers=2,
+                             chunk_records=5) as ld:
+        a2 = _drain(ld)                    # arms epoch-3 speculation
+        spec = ld._spec
+        assert spec is not None and spec["epoch"] == 3
+        assert telemetry.counter("io.epoch_prefetch").value == \
+            len(spec["keys"]) > 0
+        ld.set_epoch(3)
+        a3 = _drain(ld)                    # consumes the speculation
+        assert telemetry.counter("io.epoch_prefetch_hits").value > 0
+    monkeypatch.setenv("MXTPU_STREAM_EPOCH_PREFETCH", "0")
+    with stream.StreamLoader(ss, 4, decode_fn=_decode, epoch=2, rank=0,
+                             world_size=1, num_workers=2,
+                             chunk_records=5) as ld0:
+        b2 = _drain(ld0)
+        assert ld0._spec is None           # knob off: no speculation
+        ld0.set_epoch(3)
+        b3 = _drain(ld0)
+    assert (a2, a3) == (b2, b3)            # bit-identical either way
+    assert sorted(a3) == list(range(total))
+
+
+@pytest.mark.stream
+def test_epoch_prefetch_invalidated_by_growth_and_skip(shard_set,
+                                                       tmp_path):
+    """A wrong guess must be DISCARDED, never served: growing the
+    manifest (sizes change) and jumping to a different epoch both
+    invalidate the speculation, and coverage stays exact."""
+    root = str(tmp_path / "ss2")
+    w = stream.ShardSetWriter(root)
+    w.write_recordio_shard(_int_records(range(8)))
+    ld = stream.StreamLoader(stream.load_shard_set(root), 4,
+                             decode_fn=_decode, epoch=0, rank=0,
+                             world_size=1, num_workers=2)
+    a0 = _drain(ld)
+    assert ld._spec is not None and ld._spec["epoch"] == 1
+    w.write_recordio_shard(_int_records(range(8, 14)))  # stream grows
+    hits0 = telemetry.counter("io.epoch_prefetch_hits").value
+    ld.set_epoch(1)                        # refresh picks the growth up
+    a1 = _drain(ld)
+    assert telemetry.counter("io.epoch_prefetch_hits").value == hits0
+    assert sorted(a0) == list(range(8))
+    assert sorted(a1) == list(range(14))   # new shard covered
+    # epoch skip: speculation was for epoch 2, we pin epoch 5
+    assert ld._spec is not None and ld._spec["epoch"] == 2
+    ld.set_epoch(5)
+    a5 = _drain(ld)
+    assert sorted(a5) == list(range(14))
+    ld.close()
+
+
+@pytest.mark.stream
+@pytest.mark.fault
+def test_epoch_prefetch_hides_decode_latency(shard_set, monkeypatch):
+    """The pin the satellite asks for: with a slow decoder
+    (io.decode.slow), the set_epoch boundary costs the consumer ~zero
+    pool spin-up when speculation ran — and a full chunk-decode delay
+    when it is disabled."""
+    import time as _time
+    ss, _total = shard_set
+    monkeypatch.setenv("MXTPU_FAULT_DELAY_SECS", "0.3")
+    fault.configure("io.decode.slow:1000")
+    try:
+        with stream.StreamLoader(ss, 4, decode_fn=_decode, epoch=0,
+                                 rank=0, world_size=1, num_workers=1,
+                                 chunk_records=16, prefetch=0) as ld:
+            _drain(ld)                     # arms + starts epoch-1 work
+            _time.sleep(1.3)               # the pool decodes ahead
+            telemetry.reset()
+            ld.set_epoch(1)
+            it = iter(ld)
+            t0 = _time.perf_counter()
+            next(it)
+            warm_dt = _time.perf_counter() - t0
+            list(it)                       # drain cleanly
+        assert warm_dt < 0.2, warm_dt      # never paid the 0.3s decode
+        spin_p99 = telemetry.histogram("io.pool_spinup").percentile(
+            0.99)
+        assert spin_p99 < 0.2, spin_p99
+        monkeypatch.setenv("MXTPU_STREAM_EPOCH_PREFETCH", "0")
+        with stream.StreamLoader(ss, 4, decode_fn=_decode, epoch=0,
+                                 rank=0, world_size=1, num_workers=1,
+                                 chunk_records=16, prefetch=0) as ld0:
+            _drain(ld0)
+            _time.sleep(1.3)
+            ld0.set_epoch(1)
+            it = iter(ld0)
+            t0 = _time.perf_counter()
+            next(it)
+            cold_dt = _time.perf_counter() - t0
+            list(it)
+        assert cold_dt >= 0.2, cold_dt     # the boundary pays decode
+    finally:
+        fault.reset()

@@ -1,0 +1,139 @@
+"""``Module.fit(train_data=StreamLoader)`` sugar (ISSUE 14 satellite,
+ROADMAP item 5 follow-up): a bare epoch-mode StreamLoader feeds the
+training loop directly — shapes peeked from the first batch, epoch
+boundaries driving ``set_epoch``, and the loader's exact-once cursor
+stamped into every checkpoint manifest the epoch callback writes."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import stream
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.stream.fit import StreamTrainIter
+
+pytestmark = pytest.mark.stream
+
+N, D, K, BATCH = 192, 10, 2, 32
+
+
+def _linear_shard_set(tmp_path, shards=3):
+    rng = np.random.RandomState(0)
+    W = rng.randn(D, K).astype(np.float32)
+    root = str(tmp_path / "ss")
+    w = stream.ShardSetWriter(root)
+    per = N // shards
+    for s in range(shards):
+        recs = []
+        for _ in range(per):
+            x = rng.randn(D).astype(np.float32)
+            y = float((x @ W).argmax())
+            recs.append(json.dumps({"x": x.tolist(), "y": y}))
+        w.write_jsonl_shard(recs)
+    w.seal()
+    return root, W
+
+
+def _decode(rec):
+    doc = json.loads(rec)
+    return (np.asarray(doc["x"], np.float32),
+            np.float32(doc["y"]))
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=K, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_fit_accepts_stream_loader_and_stamps_cursor(tmp_path):
+    root, W = _linear_shard_set(tmp_path)
+    (tmp_path / "ck").mkdir()
+    prefix = str(tmp_path / "ck" / "model")
+    loader = stream.StreamLoader(root, BATCH, decode_fn=_decode,
+                                 epoch=0, rank=0, world_size=1,
+                                 last_batch="discard", num_workers=2)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with loader:
+        mod.fit(loader, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5,
+                                  "momentum": 0.9},
+                initializer=mx.init.Xavier(), eval_metric="acc",
+                num_epoch=8,
+                epoch_end_callback=mx.callback.module_checkpoint(
+                    mod, prefix))
+    # it actually learned from the stream
+    rng = np.random.RandomState(1)
+    Xv = rng.randn(128, D).astype(np.float32)
+    Yv = (Xv @ W).argmax(1).astype(np.float32)
+    val = mx.io.NDArrayIter(Xv, Yv, batch_size=32)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+    # every checkpoint manifest carries the loader's exact-once cursor,
+    # paired with the epoch it was cut at (epoch e ends with the whole
+    # rank span consumed; set_epoch(e+1) happens AFTER the callback)
+    mgr = CheckpointManager(prefix)
+    for ckpt_epoch, stream_epoch in ((1, 0), (8, 7)):
+        info = mgr.manifest_info(ckpt_epoch)
+        cur = info["stream_cursor"]
+        assert cur["mode"] == "epoch"
+        assert cur["epoch"] == stream_epoch
+        assert cur["consumed"] == N
+        assert cur["sizes"] == [64, 64, 64]
+    # and the stamp is a valid resume input: a fully-consumed epoch
+    # resumes to an EMPTY remainder (nothing re-trained)
+    cur = mgr.manifest_info(8)["stream_cursor"]
+    with stream.StreamLoader(root, BATCH, decode_fn=_decode,
+                             epoch=7, rank=0, world_size=1,
+                             last_batch="discard", resume=[cur],
+                             prefetch=0) as ld2:
+        assert list(iter(ld2)) == []
+
+    # re-fitting the SAME module over a PLAIN iter must not stamp the
+    # stale stream cursor into the new run's checkpoints
+    rng2 = np.random.RandomState(2)
+    Xp = rng2.randn(64, D).astype(np.float32)
+    Yp = (Xp @ W).argmax(1).astype(np.float32)
+    mod.fit(mx.io.NDArrayIter(Xp, Yp, batch_size=32), optimizer="sgd",
+            num_epoch=1, epoch_end_callback=mx.callback
+            .module_checkpoint(mod, prefix), force_init=True,
+            initializer=mx.init.Xavier())
+    assert mgr.manifest_info(1).get("stream_cursor") is None
+
+
+def test_adapter_peek_delivers_first_batch_exactly_once(tmp_path):
+    root, _W = _linear_shard_set(tmp_path)
+    loader = stream.StreamLoader(root, BATCH, decode_fn=_decode,
+                                 epoch=0, rank=0, world_size=1,
+                                 last_batch="discard", prefetch=0)
+    with loader:
+        it = StreamTrainIter(loader)
+        shapes = [d.shape for d in it.provide_data]
+        assert shapes == [(BATCH, D)]
+        assert [d.shape for d in it.provide_label] == [(BATCH,)]
+        batches = list(iter(it))
+        # the peeked batch is yielded first, not dropped or re-read:
+        # one epoch == N/BATCH full batches, cursor covers the lot
+        assert len(batches) == N // BATCH
+        assert loader.cursor()["consumed"] == N
+        it.reset()
+        assert loader._epoch == 1
+
+
+def test_adapter_rejects_keep_and_follow(tmp_path):
+    root, _W = _linear_shard_set(tmp_path)
+    with stream.StreamLoader(root, BATCH, decode_fn=_decode,
+                             last_batch="keep", rank=0,
+                             world_size=1) as ld:
+        with pytest.raises(MXNetError, match="discard"):
+            StreamTrainIter(ld)
+    with stream.StreamLoader(root, BATCH, decode_fn=_decode,
+                             mode="follow", last_batch="discard",
+                             rank=0, world_size=1) as ld:
+        with pytest.raises(MXNetError, match="epoch-mode"):
+            StreamTrainIter(ld)

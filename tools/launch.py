@@ -51,6 +51,18 @@ straggler blame and a cross-rank chrome trace.
 - On real TPU pods, prefer the platform launcher (GKE/queued resources):
   every pod VM already runs one process; pass --use-env-ranks to adopt
   the platform-provided rank env instead of spawning.
+
+Serving-fleet mode (``--serve``, SERVING.md §9): the command is run as
+N INDEPENDENT serving-replica slots (tools/serve_worker.py) supervised
+per-slot — serving has no collective, so one replica dying replaces
+that replica instead of tearing the job down.  Exit 80 journals
+drain/replace and respawns without blame; crashes/SIGKILL/stalls
+respawn with backoff (AOT-warm via the shared cache) until
+``--evict-after`` consecutive failures evict the slot; every
+transition lands in ``<run-dir>/membership.json``.  Each slot
+publishes ``<run-dir>/serve-port-slot<K>.json`` (the router-proxy
+discovery + incarnation channel); ``<run-dir>/serve-stop`` stops the
+fleet gracefully.
 """
 from __future__ import annotations
 
@@ -615,6 +627,201 @@ def _restart_loop(args, run_once, cmd):
     return 1
 
 
+def _serve_spawn(args, mem, run_dir, hb_dir, cmd, slot, attempt):
+    """One serving-replica worker process for ``slot``: the training
+    env contract (slot == rank — serving has no collective world to
+    re-pack) plus the serve-plane exports: the slot's PORT FILE (the
+    router proxies' discovery + incarnation channel) and the shared
+    heartbeat dir (the PR-4 liveness files the proxies fuse into their
+    health view)."""
+    env = dict(os.environ)
+    env.update(_worker_env(args, mem, mem.world_size, slot, slot,
+                           attempt, None))
+    env.update({
+        "MXTPU_HEARTBEAT_DIR": hb_dir,
+        "MXTPU_SERVE_PORT_FILE":
+            os.path.join(run_dir, "serve-port-slot%d.json" % slot),
+    })
+    if args.cpu_fake_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    mem.record(attempt, "spawn", slot=slot)
+    return subprocess.Popen(cmd, env=env)
+
+
+def _serve_loop(args, cmd):
+    """The ``--serve`` fleet supervisor: N serving-replica processes,
+    each its own slot, supervised INDIVIDUALLY (serving has no
+    collective — one replica dying must replace that replica, never
+    tear the fleet down, which is the whole point of the
+    out-of-process shape).
+
+    Per-slot policy, journaled into ``membership.json`` like the
+    elastic trainer:
+
+    - exit 80 (graceful drain): ``drain`` + ``replace`` transitions,
+      respawned immediately with no backoff and no blame;
+    - retryable exits (SIGKILL, 75, 77, crashes): ``failure`` +
+      ``replace``, respawned with per-slot exponential backoff; the
+      respawn shares the launch's AOT cache so the replacement comes
+      up warm (0 foreground compiles).  A slot blamed
+      ``--evict-after`` consecutive times (or any permanent exit) is
+      evicted — a crash-looping replica must not burn the budget
+      forever;
+    - ``--max-restarts`` bounds TOTAL failure-respawns across the
+      fleet (drain respawns are planned and free);
+    - a worker whose heartbeat file goes stale past
+      ``--heartbeat-timeout`` is killed (SIGTERM→SIGKILL) and handled
+      as its exit code classifies.
+
+    The fleet runs until ``<run-dir>/serve-stop`` appears (the
+    operator/driver's shutdown handle — SIGTERM then asks each worker
+    to drain, exit 80) or every slot is down (exit 1)."""
+    mem = _Membership(args)
+    run_dir = args.run_dir
+    hb_dir = os.path.join(run_dir, "hb")
+    os.makedirs(hb_dir, exist_ok=True)
+    stop_path = os.path.join(run_dir, "serve-stop")
+    # a stop handle is a one-shot order to THIS fleet: a stale file
+    # from the previous fleet in a reused run dir must not drain the
+    # fresh one the moment it spawns
+    try:
+        os.unlink(stop_path)
+    except OSError:
+        pass
+    state = {}
+    for slot in list(mem.active):
+        state[slot] = {"attempt": 0, "streak": 0, "down": False,
+                       "next_spawn_at": None,
+                       "proc": _serve_spawn(args, mem, run_dir, hb_dir,
+                                            cmd, slot, 0)}
+    fail_respawns = 0
+    try:
+        while True:
+            if os.path.exists(stop_path):
+                print("launch.py: serve-stop requested — draining the "
+                      "fleet", file=sys.stderr, flush=True)
+                mem.record(0, "stop")
+                _escalate_kill(
+                    [st["proc"] for st in state.values()
+                     if st["proc"] is not None],
+                    signal.SIGTERM, args.kill_grace)
+                mem.record(0, "complete")
+                return 0
+            now = time.time()
+            if all(st["down"] for st in state.values()):
+                if all(st.get("clean") for st in state.values()):
+                    mem.record(0, "complete")
+                    return 0
+                mem.record(0, "gave_up",
+                           reason="every serving slot is down")
+                print("launch.py: every serving slot is down — giving "
+                      "up", file=sys.stderr, flush=True)
+                return 1
+            for slot, st in sorted(state.items()):
+                if st["down"]:
+                    continue
+                p = st["proc"]
+                if p is None:
+                    if now >= st["next_spawn_at"]:
+                        st["attempt"] += 1
+                        st["proc"] = _serve_spawn(
+                            args, mem, run_dir, hb_dir, cmd, slot,
+                            st["attempt"])
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    if args.heartbeat_timeout > 0:
+                        hb = os.path.join(hb_dir, "hb-%d.json" % slot)
+                        try:
+                            age = now - os.stat(hb).st_mtime
+                        except OSError:
+                            continue
+                        if age > args.heartbeat_timeout:
+                            print("launch.py: serve slot %d heartbeat "
+                                  "silent %.1fs — killing the wedged "
+                                  "replica" % (slot, age),
+                                  file=sys.stderr, flush=True)
+                            _escalate_kill([p], signal.SIGTERM,
+                                           args.kill_grace)
+                    continue
+                if rc == 0:
+                    # clean completion (e.g. a worker's own run-length
+                    # backstop): the slot is done — not blamed, not
+                    # respawned
+                    mem.record(st["attempt"], "complete", slot=slot)
+                    st["down"] = True
+                    st["clean"] = True
+                    st["proc"] = None
+                    continue
+                kind, reason = classify_exit(rc)
+                if kind == "clean":
+                    mem.record(st["attempt"], "drain", slot=slot,
+                               rc=rc, reason=reason)
+                    st["streak"] = 0
+                    st["proc"] = None
+                    st["next_spawn_at"] = now  # a drain is planned
+                    mem.record(st["attempt"], "replace", slot=slot)
+                    print("launch.py: serve slot %d drained "
+                          "gracefully; spinning replacement (no "
+                          "backoff)" % slot, file=sys.stderr,
+                          flush=True)
+                    continue
+                st["streak"] += 1
+                mem.record(st["attempt"], "failure", slot=slot, rc=rc,
+                           kind=kind, reason=reason,
+                           consecutive_failures=st["streak"])
+                print("launch.py: serve slot %d (attempt %d) failed: "
+                      "%s (%s)" % (slot, st["attempt"], kind, reason),
+                      file=sys.stderr, flush=True)
+                if kind == "permanent" or \
+                        st["streak"] >= max(1, args.evict_after):
+                    why = ("exit classified permanent"
+                           if kind == "permanent" else
+                           "%d consecutive failures (--evict-after "
+                           "%d)" % (st["streak"], args.evict_after))
+                    if slot in mem.active:
+                        mem.evict(st["attempt"], slot, why)
+                    st["down"] = True
+                    st["proc"] = None
+                    print("launch.py: serve slot %d evicted (%s)"
+                          % (slot, why), file=sys.stderr, flush=True)
+                    continue
+                if fail_respawns >= args.max_restarts:
+                    mem.record(st["attempt"], "gave_up", slot=slot,
+                               rc=rc,
+                               reason="--max-restarts %d exhausted"
+                               % args.max_restarts)
+                    st["down"] = True
+                    st["proc"] = None
+                    print("launch.py: serve slot %d down — restart "
+                          "budget exhausted" % slot, file=sys.stderr,
+                          flush=True)
+                    continue
+                fail_respawns += 1
+                delay = min(args.restart_backoff
+                            * (2 ** (st["streak"] - 1)),
+                            args.restart_backoff_max)
+                st["proc"] = None
+                st["next_spawn_at"] = now + delay
+                mem.record(st["attempt"], "replace", slot=slot,
+                           backoff_s=delay)
+                print("launch.py: respawning serve slot %d in %.2fs "
+                      "(failure respawn %d/%d)"
+                      % (slot, delay, fail_respawns,
+                         args.max_restarts),
+                      file=sys.stderr, flush=True)
+            time.sleep(0.15)
+    except KeyboardInterrupt:
+        print("launch.py: interrupt — stopping the serve fleet",
+              file=sys.stderr, flush=True)
+        _escalate_kill([st["proc"] for st in state.values()
+                        if st["proc"] is not None],
+                       signal.SIGINT, args.kill_grace)
+        mem.record(0, "interrupted")
+        return 1
+
+
 def launch_local(args, cmd):
     if args.dry_run:
         port = args.port or _free_port()
@@ -753,6 +960,21 @@ def main(argv=None):
                         help="virtual devices per worker process "
                         "(xla_force_host_platform_device_count; test "
                         "multi-chip-per-host jobs without hardware)")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving-fleet mode (local launcher): run "
+                        "the command as -n independent serving-replica "
+                        "slots (tools/serve_worker.py), each "
+                        "supervised INDIVIDUALLY — exit 80 journals "
+                        "drain/replace and respawns immediately; "
+                        "crashes/SIGKILL/stalls respawn with backoff "
+                        "(AOT-warm via the shared --aot-cache-dir), "
+                        "evicting a slot after --evict-after "
+                        "consecutive failures; every transition lands "
+                        "in <run-dir>/membership.json.  Each slot "
+                        "publishes <run-dir>/serve-port-slot<K>.json "
+                        "for router proxies "
+                        "(mxnet_tpu.serving.rpc.fleet_proxies); stop "
+                        "the fleet by creating <run-dir>/serve-stop")
     parser.add_argument("--elastic", action="store_true",
                         help="make world size a per-restart decision: a "
                         "worker slot blamed for --evict-after "
@@ -831,6 +1053,14 @@ def main(argv=None):
     args = parser.parse_args(argv)
     cmd = [c for c in args.command if c != "--"]
     assert cmd, "no command given"
+    if args.serve and args.launcher != "local":
+        print("launch.py: --serve is a local-launcher mode",
+              file=sys.stderr, flush=True)
+        return 2
+    if args.serve and not args.run_dir:
+        # the run dir is the fleet's rendezvous (port files, heartbeat
+        # tree, membership journal, serve-stop handle) — it must exist
+        args.run_dir = tempfile.mkdtemp(prefix="mxtpu-serve-")
     if args.elastic and args.launcher == "mpi":
         print("launch.py: --elastic is a local/ssh launcher feature "
               "(mpirun owns process placement; use your MPI runtime's "
@@ -876,6 +1106,30 @@ def main(argv=None):
             args.aot_cache_dir = auto_cache_dir = \
                 tempfile.mkdtemp(prefix="mxtpu-aot-")
     try:
+        if args.serve:
+            if args.dry_run:
+                # the real per-slot contract, so a pasted line
+                # reproduces what a launched replica actually sees
+                # (mem=None like launch_local's dry run: a DRY run
+                # must not journal a 'launch' transition into a run
+                # dir a live fleet may be using)
+                for slot in range(args.num_workers):
+                    env = _worker_env(args, None, args.num_workers,
+                                      slot, slot, 0, None)
+                    env.update({
+                        "MXTPU_HEARTBEAT_DIR":
+                            os.path.join(args.run_dir, "hb"),
+                        "MXTPU_SERVE_PORT_FILE": os.path.join(
+                            args.run_dir,
+                            "serve-port-slot%d.json" % slot),
+                    })
+                    envs = " ".join(
+                        "%s=%s" % (k, shlex.quote(v))
+                        for k, v in sorted(env.items()))
+                    print("%s %s" % (envs, " ".join(
+                        shlex.quote(c) for c in cmd)))
+                return 0
+            return _serve_loop(args, cmd)
         if args.launcher == "local":
             return launch_local(args, cmd)
         if args.launcher == "mpi":

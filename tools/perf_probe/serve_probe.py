@@ -45,10 +45,19 @@ batch occupancy.  Hard contracts asserted by ``BENCH_MODE=serve``
   loads as one file, and reconcile traced tokens with the
   ``serving.tokens``/``serving.goodput`` counters bit-exactly;
   ``measure_trace_overhead`` microbenches the per-decode-step tracing
-  cost in isolation (``MXTPU_SERVE_TRACE_BUDGET_US``, default 2).
+  cost in isolation (``MXTPU_SERVE_TRACE_BUDGET_US``, default 2);
+- **fleet drill** (``run_fleet``, ISSUE 14): the same contracts across
+  REAL process boundaries — serve_worker subprocesses behind the RPC
+  plane, one replica armed ``rpc.drop`` (circuit breaker trips, then
+  recovers via the half-open probe once the replica heals) and one
+  armed ``serve.replica.sigkill`` (real SIGKILL mid-probe → confirmed
+  death → journaled failover → a REPLACEMENT PROCESS spun on the
+  shared AOT cache with 0 foreground compiles) — 0 dropped, tokens
+  bit-identical to the unfaulted run, all hard-asserted.
 
 Usage: JAX_PLATFORMS=cpu python tools/perf_probe/serve_probe.py
-Prints one JSON object.
+Prints one JSON object.  ``--no-fleet`` / ``--no-spinup`` skip the
+subprocess-heavy sections.
 """
 import json
 import os
@@ -396,6 +405,190 @@ def run_degraded(net, workload, reference_tokens, num_slots=8,
     }
 
 
+# -- out-of-process fleet drill (ISSUE 14) ---------------------------------
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "..", "serve_worker.py")
+
+
+def _spawn_worker(run_dir, cache, slot, attempt, extra_env=None):
+    """One serve_worker subprocess for ``slot``: shared AOT cache
+    (replacements spin up warm), port file under ``run_dir``.  The
+    worker drains its variant stores before publishing the port file,
+    so 'fleet discoverable' implies 'cache durable'."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "MXTPU_AOT_CACHE_DIR": cache,
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(cache, "xla"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+        "MXTPU_WORKER_SLOT": str(slot),
+        "MXTPU_WORKER_RANK": str(slot),
+        "MXTPU_RESTART_ATTEMPT": str(attempt),
+        "MXTPU_SERVE_PORT_FILE":
+            os.path.join(run_dir, "serve-port-slot%d.json" % slot),
+    })
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(_WORKER),
+         "--max-seconds", "600"], env=env)
+
+
+def run_fleet(workload, reference_tokens):
+    """The out-of-process fleet drill (``BENCH_MODE=serve`` hard
+    contracts, ISSUE 14): REAL worker processes behind the RPC plane.
+
+    Two phases over one spun-up fleet:
+
+    1. **breaker drill** — worker b is armed ``rpc.drop:5`` from
+       spawn: its first five RPC replies are blackholed, the proxy's
+       calls time out, the circuit breaker TRIPS (placement skips b,
+       requests complete on a), then — once the site exhausts — the
+       half-open probe succeeds and the breaker CLOSES; post-recovery
+       requests are served by b again.  Contracts: every request
+       completes, ``trips >= 1``, final state ``closed``, b serves
+       after recovery.
+    2. **sigkill failover drill** — worker c is armed
+       ``serve.replica.sigkill:1``: it dies a REAL SIGKILL on its
+       first decode step (mid-probe, with accepted requests in
+       flight).  The router confirms the death (pid probe), fails the
+       victims over, and the spawn callback brings up a REPLACEMENT
+       process on the shared AOT cache.  Contracts: ZERO dropped
+       requests, tokens bit-identical to the unfaulted continuous
+       run, >= 1 failover, replacement 0 foreground compiles.
+    """
+    from mxnet_tpu.serving import Router
+    from mxnet_tpu.serving.rpc import (BREAKER_CLOSED,
+                                       CircuitBreaker,
+                                       RpcReplicaProxy,
+                                       port_file_path, wait_port_file)
+
+    run_dir = tempfile.mkdtemp(prefix="serve-fleet-")
+    cache = os.path.join(run_dir, "aot")
+    os.makedirs(cache)
+    procs = {}
+    try:
+        procs["a"] = _spawn_worker(run_dir, cache, 0, 0)
+        procs["b"] = _spawn_worker(
+            run_dir, cache, 1, 0,
+            {"MXTPU_FAULT": "rpc.drop:5",
+             "MXTPU_FAULT_ATTEMPTS": "0"})
+        procs["c"] = _spawn_worker(
+            run_dir, cache, 2, 0,
+            {"MXTPU_FAULT": "serve.replica.sigkill:1",
+             "MXTPU_FAULT_ATTEMPTS": "0"})
+        for slot in (0, 1, 2):
+            wait_port_file(port_file_path(run_dir, slot), timeout=300)
+
+        def proxy(slot, rid):
+            return RpcReplicaProxy(
+                rid, port_file=port_file_path(run_dir, slot),
+                timeout_s=0.25, retries=0,
+                breaker=CircuitBreaker(threshold=2, cooldown_s=0.4,
+                                       name=rid))
+
+        # ---- phase 1: breaker trip + recovery --------------------------
+        pa, pb = proxy(0, "a"), proxy(1, "b")
+        rt = Router([pa, pb])
+        reqs = [rt.submit(p, n) for _t, p, n in workload[:6]]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            rt.step()
+            if all(r.done for r in reqs) and \
+                    pb.breaker.state == BREAKER_CLOSED and \
+                    pb.breaker.trips >= 1:
+                break
+            time.sleep(0.02)
+        tripped, recovered = pb.breaker.trips, \
+            pb.breaker.state == BREAKER_CLOSED
+        post = [rt.submit(p, n) for _t, p, n in workload[6:10]]
+        deadline = time.monotonic() + 60
+        while not all(r.done for r in post) and \
+                time.monotonic() < deadline:
+            rt.step()
+            time.sleep(0.02)
+        breaker = {
+            "completed": sum(1 for r in reqs + post
+                             if r.state == "completed"),
+            "requests": len(reqs) + len(post),
+            "trips": tripped,
+            "recovered": recovered,
+            "final_state": pb.breaker.state,
+            "served_by_b_after_recovery": sum(
+                1 for r in post if r.state == "completed"
+                and r.replica_id == "b"),
+        }
+
+        # ---- phase 2: SIGKILL one replica mid-probe --------------------
+        pc = proxy(2, "c")
+        spawn_compiles = []
+
+        def spawn():
+            # the real supervised-respawn move: a fresh worker process
+            # for slot 2, then the successor proxy pinned to it
+            procs["c2"] = _spawn_worker(run_dir, cache, 2, 1)
+            fresh = pc.successor(replica_id="c2", timeout=300)
+            # the 0-foreground-compile contract must be MEASURED, not
+            # defaulted: an unreachable health probe is a failed
+            # drill, never a silent 0
+            compiles = None
+            for _ in range(20):
+                health = fresh.health()
+                compiles = (health.get("remote")
+                            or {}).get("serve_compiles")
+                if compiles is not None:
+                    break
+                time.sleep(0.25)
+            if compiles is None:
+                raise RuntimeError(
+                    "replacement health probe never answered — the "
+                    "foreground-compile contract cannot be verified: "
+                    "%r" % (health,))
+            spawn_compiles.append(compiles)
+            return fresh
+
+        rt2 = Router([pa, pc], spawn=spawn, max_retries=2)
+        rrs = []
+        pending = list(workload)
+        t_start = time.perf_counter()
+        while pending or not rt2.idle:
+            now = time.perf_counter() - t_start
+            while pending and pending[0][0] <= now:
+                _, prompt, max_new = pending.pop(0)
+                rrs.append(rt2.submit(prompt, max_new))
+            # reap exited children: a SIGKILLed worker must become a
+            # ProcessLookupError for the proxy's death probe, not a
+            # zombie that still answers kill(pid, 0)
+            for p in procs.values():
+                p.poll()
+            if rt2.step() == 0 and pending:
+                time.sleep(min(1e-4, max(0.0, pending[0][0] - now)))
+            if time.perf_counter() - t_start > 300:
+                raise RuntimeError("fleet drill did not drain")
+        completed = [rr for rr in rrs if rr.state == "completed"]
+        tokens = [rr.tokens for rr in completed]
+        return {
+            "requests": len(rrs),
+            "completed": len(completed),
+            "dropped": len(rrs) - len(completed),
+            "failovers": rt2.failovers,
+            "tokens_match_unfaulted": tokens == reference_tokens,
+            "replacement_spawns": len(spawn_compiles),
+            "replacement_foreground_compiles":
+                sum(c or 0 for c in spawn_compiles),
+            "retried": sum(1 for rr in rrs if rr.retries > 0),
+            "breaker": breaker,
+        }
+    finally:
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def measure_trace_overhead(slots=8, iters=2000, passes=5):
     """Isolated microbench of the per-decode-step tracing cost: one
     batched ``tokens`` event naming every resident trace (exactly what
@@ -487,7 +680,7 @@ def measure_spinup():
     }
 
 
-def run(spinup=True, degraded=True):
+def run(spinup=True, degraded=True, fleet=True):
     net = build_net()
     workload = make_workload()
     cont = run_continuous(net, workload)
@@ -507,6 +700,8 @@ def run(spinup=True, degraded=True):
     }
     if degraded:
         result["degraded"] = run_degraded(net, workload, cont_tokens)
+    if fleet:
+        result["fleet"] = run_fleet(workload, cont_tokens)
     if spinup:
         result["spinup"] = measure_spinup()
     return result
@@ -516,4 +711,5 @@ if __name__ == "__main__":
     if "--spinup-child" in sys.argv:
         _spinup_child()
     else:
-        print(json.dumps(run("--no-spinup" not in sys.argv)))
+        print(json.dumps(run("--no-spinup" not in sys.argv,
+                             fleet="--no-fleet" not in sys.argv)))
