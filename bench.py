@@ -744,6 +744,55 @@ def bench_serve():
             "continuous batching reached only %.2fx the sequential "
             "predictor baseline (contract: >= 2x tokens/s on the same "
             "mixed-length workload)" % speedup)
+    pfx = result["prefix"]
+    if pfx["hit_rate"] <= 0:
+        raise AssertionError(
+            "prefix-heavy workload produced a 0 hit-rate (contract: "
+            "shared system prompts MUST hit the prefix cache)")
+    if pfx["prefill_token_reduction"] < 0.30:
+        raise AssertionError(
+            "prefix caching cut prefill tokens by only %.1f%% on the "
+            "system-prompt workload (%d -> %d; contract: >= 30%% fewer "
+            "prefill tokens than cache-off on the same workload)"
+            % (100 * pfx["prefill_token_reduction"],
+               pfx["prefill_tokens_off"], pfx["prefill_tokens_on"]))
+    if not pfx["tokens_match_cache_off"]:
+        raise AssertionError(
+            "cache-on tokens diverged from cache-off on the same "
+            "workload (contract: prefix sharing changes capacity and "
+            "prefill cost, NEVER tokens — greedy and sampled alike)")
+    if pfx["decode_dispatches_per_step"] != 1.0:
+        raise AssertionError(
+            "with prefix cache + sampling enabled the decode loop "
+            "dispatched %.3f programs/step (contract: exactly 1.0 — "
+            "both multipliers ride the one-donated-program step)"
+            % pfx["decode_dispatches_per_step"])
+    if pfx["steady_state_compiles"] != 0:
+        raise AssertionError(
+            "prefix+sampling serving recompiled %d time(s) under churn "
+            "(contract: per-request sampling params are program INPUTS, "
+            "never a recompile)" % pfx["steady_state_compiles"])
+    if pfx["sampling_requests"] < 1:
+        raise AssertionError(
+            "the prefix workload exercised no sampled requests — the "
+            "sampling half of the contract is vacuous")
+    gqa = result["gqa"]
+    if gqa["kernel_max_err"] >= 1e-5:
+        raise AssertionError(
+            "GQA paged kernel diverged from the oracle at K_kv=%d "
+            "(max err %.2e; contract: kernel-vs-oracle equivalence at "
+            "mixed lengths)" % (gqa["kv_heads"], gqa["kernel_max_err"]))
+    if gqa["pool_bytes_gqa"] > gqa["pool_bytes_mha"]:
+        raise AssertionError(
+            "GQA page pools used MORE bytes (%d) than the multi-head "
+            "pools (%d) — the capacity comparison is unsound"
+            % (gqa["pool_bytes_gqa"], gqa["pool_bytes_mha"]))
+    if gqa["resident_multiplier"] < 1.5:
+        raise AssertionError(
+            "GQA at K_kv = H/2 fit only %.2fx residents in the same "
+            "page-pool bytes (%d -> %d; contract: >= 1.5x)"
+            % (gqa["resident_multiplier"], gqa["residents_mha"],
+               gqa["residents_gqa"]))
     deg = result["degraded"]
     if deg["dropped"] != 0:
         raise AssertionError(
@@ -864,6 +913,10 @@ def bench_serve():
         "vs_baseline": round(speedup / 2.0, 3),
         "speedup": speedup,
         "trace_overhead_us": trace_us,
+        "prefix_prefill_token_reduction":
+            pfx["prefill_token_reduction"],
+        "prefix_hit_rate": pfx["hit_rate"],
+        "gqa_resident_multiplier": gqa["resident_multiplier"],
         "serve": result,
     }))
 

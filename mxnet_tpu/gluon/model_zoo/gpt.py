@@ -31,7 +31,8 @@ from ..block import HybridBlock
 __all__ = ["GPTBlock", "GPTLM", "get_gpt", "gpt2_tiny",
            "gpt2_tiny_moe", "gpt2_small", "gpt2_medium",
            "pack_sequences", "packed_positions", "generate",
-           "decode_params", "paged_decode_step", "paged_prefill"]
+           "decode_params", "paged_decode_step", "paged_prefill",
+           "paged_suffix_prefill", "sample_tokens"]
 
 
 class GPTBlock(HybridBlock):
@@ -590,16 +591,129 @@ def generate(net, prompt_ids, n_new, temperature=0.0, seed=0, top_k=0,
 # generate, paged decode, training forward) together.
 
 
-def decode_params(net):
+def decode_params(net, kv_heads=None):
     """Public alias of the decode-path parameter indexer (fp32 values
     keyed by layer) — the tree ``paged_decode_step``/``paged_prefill``
     take as ``p``, and what :class:`mxnet_tpu.serving.ServingEngine`
-    snapshots at construction."""
-    return _decode_params(net)
+    snapshots at construction.
+
+    ``kv_heads``: serve with ``K_kv <= H`` KV heads (grouped-query /
+    multi-query attention, ISSUE 15).  ``None`` or ``H`` keeps the
+    trained multi-head layout bit-identical to before; a smaller value
+    MEAN-POOLS each group's K/V projection rows (the standard
+    MHA->GQA uptraining conversion, Ainslie et al.) so the serving KV
+    pools shrink ``H / K_kv``-fold.  The converted layer dicts carry
+    split ``q_w``/``k_w``/``v_w`` (+biases) instead of the fused
+    ``qkv_w``."""
+    p = _decode_params(net)
+    if kv_heads is None:
+        return p
+    n_heads = net.blocks._children[0].attn._num_heads
+    kv_heads = int(kv_heads)
+    if kv_heads == n_heads:
+        return p
+    if kv_heads < 1 or n_heads % kv_heads:
+        raise ValueError(
+            "kv_heads must divide the model's %d query heads, got %d"
+            % (n_heads, kv_heads))
+    d = int(p["wte"].shape[1]) // n_heads
+    g = n_heads // kv_heads
+    for lp in p["layers"]:
+        w = lp.pop("qkv_w").reshape(n_heads, 3, d, -1)
+        b = lp.pop("qkv_b").reshape(n_heads, 3, d)
+        lp["q_w"] = w[:, 0].reshape(n_heads * d, -1)
+        lp["q_b"] = b[:, 0].reshape(n_heads * d)
+        for name, idx in (("k", 1), ("v", 2)):
+            lp[name + "_w"] = (w[:, idx].reshape(kv_heads, g, d, -1)
+                               .mean(axis=1).reshape(kv_heads * d, -1))
+            lp[name + "_b"] = (b[:, idx].reshape(kv_heads, g, d)
+                               .mean(axis=1).reshape(kv_heads * d))
+    return p
+
+
+def _block_qkv_kv(lp, x, n_heads):
+    """Per-layer front half for the PAGED path: LN1 + projections with
+    a possibly-reduced KV head count.  A fused-``qkv_w`` layer dict
+    (``kv_heads == n_heads``) routes through :func:`_block_qkv`
+    unchanged — bit-identical to the pre-GQA serving path; a split
+    (GQA-converted) dict projects q at ``H`` heads and k/v at ``K_kv``.
+    Returns ``q [B, H, T, D], k, v [B, K_kv, T, D]``."""
+    if "qkv_w" in lp:
+        return _block_qkv(lp, x, n_heads)
+    b, t, c = x.shape
+    d = c // n_heads
+    kv_heads = lp["k_w"].shape[0] // d
+    h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+    q = (h @ lp["q_w"].T + lp["q_b"]).reshape(b, t, n_heads, d)
+    k = (h @ lp["k_w"].T + lp["k_b"]).reshape(b, t, kv_heads, d)
+    v = (h @ lp["v_w"].T + lp["v_b"]).reshape(b, t, kv_heads, d)
+    return (q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3))
+
+
+def _bcast_kv(k, n_heads):
+    """Broadcast ``K_kv`` KV heads over their query groups for a dense
+    einsum ([B, K_kv, T, D] -> [B, H, T, D]); identity when the counts
+    already agree (the fused multi-head path stays bit-identical)."""
+    import jax.numpy as jnp
+    kv_heads = k.shape[1]
+    if kv_heads == n_heads:
+        return k
+    return jnp.repeat(k, n_heads // kv_heads, axis=1)
+
+
+def _filter_logits_per_slot(logits, top_k, top_p):
+    """Per-slot dynamic top-k / nucleus filtering (jit-compatible:
+    sort-based, ``top_k``/``top_p`` are TRACED [S] arrays — per-request
+    sampling params are ordinary program inputs, never a recompile).
+    0 disables either filter for that slot.  Callers pass TEMPERATURE-
+    SCALED logits, mirroring :func:`_filter_logits`."""
+    import jax
+    import jax.numpy as jnp
+    v = logits.shape[-1]
+    # top-k: threshold at the k-th largest value of each row
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    idx = jnp.clip(top_k - 1, 0, v - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    logits = jnp.where((top_k[:, None] > 0) & (logits < kth), -1e30,
+                       logits)
+    # nucleus: smallest set whose mass >= top_p, on the (k-filtered)
+    # sampling distribution — same rule as the static filter
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p[:, None]
+    cutoff = jnp.min(jnp.where(keep_sorted, sorted_desc, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where((top_p[:, None] > 0) & (logits < cutoff), -1e30,
+                     logits)
+
+
+def sample_tokens(logits, temps, top_ks, top_ps, keys):
+    """Pick one token per slot from ``logits [S, V]`` under PER-SLOT
+    sampling params (ISSUE 15): ``temps`` f32 [S] (<= 0 -> greedy
+    argmax, bit-identical to the sampling-free path), ``top_ks`` int32
+    [S], ``top_ps`` f32 [S] (0 disables), ``keys`` uint32 [S, 2] raw
+    PRNG keys advanced FUNCTIONALLY — the returned ``new_keys`` is the
+    only state, so the n-th token of a request depends on (seed, n)
+    alone: same seed + params + prompt -> same tokens regardless of
+    batch composition, join/leave, hot-swap, or failover re-decode.
+
+    Returns ``(tokens int32 [S], new_keys uint32 [S, 2])``."""
+    import jax
+    import jax.numpy as jnp
+    greedy = logits.argmax(-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    filtered = _filter_logits_per_slot(scaled, top_ks, top_ps)
+    split = jax.vmap(jax.random.split)(keys)        # [S, 2, 2]
+    new_keys, subs = split[:, 0], split[:, 1]
+    sampled = jax.vmap(jax.random.categorical)(subs, filtered) \
+        .astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy), new_keys
 
 
 def paged_decode_step(p, tokens, positions, active, kv_pages,
-                      block_tables, n_heads):
+                      block_tables, n_heads, sampling=None):
     """ONE decode step for every serving slot — the whole resident batch
     advances one token in one traced program.
 
@@ -612,11 +726,17 @@ def paged_decode_step(p, tokens, positions, active, kv_pages,
       attend over nothing, so occupancy changes can NEVER perturb a
       resident slot's math (bit-checked by tests);
     - ``kv_pages``: list of per-layer ``(k_pages, v_pages)``, each
-      [num_pages, page_size, H, D] — donated by the caller's jit;
-    - ``block_tables``: int32 [S, max_pages_per_seq].
+      [num_pages, page_size, K_kv, D] — donated by the caller's jit.
+      ``K_kv < n_heads`` is grouped-query attention: the layer dicts
+      must be the matching :func:`decode_params` conversion;
+    - ``block_tables``: int32 [S, max_pages_per_seq];
+    - ``sampling``: None for greedy argmax (the pre-ISSUE-15 contract,
+      bit-identical), or ``(temps [S], top_ks [S], top_ps [S],
+      keys [S, 2])`` per-slot params (see :func:`sample_tokens`).
 
-    Returns ``(logits [S, V] fp32, next_tokens [S] int32 greedy,
-    new_kv_pages)``.
+    Returns ``(logits [S, V] fp32, next_tokens [S] int32, new_kv_pages)``
+    without sampling, or ``(logits, next_tokens, new_keys,
+    new_kv_pages)`` with it.
     """
     import jax.numpy as jnp
 
@@ -638,19 +758,56 @@ def paged_decode_step(p, tokens, positions, active, kv_pages,
     ctx = jnp.where(active, positions + 1, 0).astype(jnp.int32)
     new_pages = []
     for lp, (kc, vc) in zip(p["layers"], kv_pages):
-        q, k, v = _block_qkv(lp, x, n_heads)          # [S, H, 1, D]
-        kc = kc.at[phys, offs].set(k[:, :, 0, :])
+        q, k, v = _block_qkv_kv(lp, x, n_heads)     # q [S, H, 1, D]
+        kc = kc.at[phys, offs].set(k[:, :, 0, :])   # k/v [S, K_kv, 1, D]
         vc = vc.at[phys, offs].set(v[:, :, 0, :])
         o = paged_attention(q[:, :, 0, :], kc, vc, block_tables, ctx)
         x = _block_finish(lp, x, o.reshape(s_n, 1, c))
         new_pages.append((kc, vc))
     h = _ln(x[:, 0], p["lnf_g"], p["lnf_b"])
     logits = h @ p["wte"].T
-    return logits, logits.argmax(-1).astype(jnp.int32), new_pages
+    if sampling is None:
+        return logits, logits.argmax(-1).astype(jnp.int32), new_pages
+    temps, top_ks, top_ps, keys = sampling
+    # an all-greedy resident batch must not pay the sampling math
+    # (vocab sorts + categorical per slot): cond executes ONE branch.
+    # A sampled request is resident in every step that produces one of
+    # its tokens, so its key still advances exactly once per token —
+    # the per-request determinism law is composition-independent.
+    from jax import lax
+    nxt, new_keys = lax.cond(
+        jnp.any(temps > 0),
+        lambda: sample_tokens(logits, temps, top_ks, top_ps, keys),
+        lambda: (logits.argmax(-1).astype(jnp.int32), keys))
+    return logits, nxt, new_keys, new_pages
+
+
+def _first_token(logits, sampling, new_pages):
+    """Shared prefill tail: greedy 3-tuple, or per-request sampled
+    4-tuple with the functionally-advanced key (scalar flavor of
+    :func:`sample_tokens`; greedy requests skip the sampling math via
+    cond)."""
+    import jax.numpy as jnp
+    from jax import lax
+    if sampling is None:
+        return logits, logits.argmax(-1).astype(jnp.int32), new_pages
+    temp, top_k, top_p, key = sampling
+
+    def _sampled():
+        tok, new_key = sample_tokens(
+            logits[None], jnp.reshape(temp, (1,)).astype(jnp.float32),
+            jnp.reshape(top_k, (1,)).astype(jnp.int32),
+            jnp.reshape(top_p, (1,)).astype(jnp.float32), key[None])
+        return tok[0], new_key[0]
+
+    tok, new_key = lax.cond(
+        temp > 0, _sampled,
+        lambda: (logits.argmax(-1).astype(jnp.int32), key))
+    return logits, tok, new_key, new_pages
 
 
 def paged_prefill(p, tokens, prompt_len, block_table_row, kv_pages,
-                  n_heads):
+                  n_heads, sampling=None):
     """Admit one request: a single batched causal pass over its (padded)
     prompt that scatters every position's K/V into the slot's pages and
     returns the last prompt position's logits — the first generated
@@ -659,11 +816,14 @@ def paged_prefill(p, tokens, prompt_len, block_table_row, kv_pages,
     - ``tokens``: int32 [T_pad] — prompt padded to the engine's static
       prefill length (one compiled program for every prompt length);
     - ``prompt_len``: int32 scalar (traced — no per-length recompiles);
-    - ``block_table_row``: int32 [max_pages_per_seq] for this slot.
+    - ``block_table_row``: int32 [max_pages_per_seq] for this slot;
+    - ``sampling``: None for greedy, or scalar ``(temperature, top_k,
+      top_p, key)`` for the request's first token.
 
     Pad positions (>= prompt_len) are masked out of attention and their
     K/V is scattered to scratch page 0.  Returns ``(logits [V] fp32,
-    first_token int32, new_kv_pages)``.
+    first_token int32, new_kv_pages)`` (plus the advanced key before
+    ``new_kv_pages`` when sampling).
     """
     import jax
     import jax.numpy as jnp
@@ -682,12 +842,13 @@ def paged_prefill(p, tokens, prompt_len, block_table_row, kv_pages,
     offs = pos % page_size
     new_pages = []
     for lp, (kc, vc) in zip(p["layers"], kv_pages):
-        q, k, v = _block_qkv(lp, x, n_heads)          # [1, H, T_pad, D]
-        st = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        q, k, v = _block_qkv_kv(lp, x, n_heads)   # [1, H|K_kv, T_pad, D]
+        kd, vd = _bcast_kv(k, n_heads), _bcast_kv(v, n_heads)
+        st = jnp.einsum("bhqd,bhkd->bhqk", q, kd) / jnp.sqrt(
             jnp.float32(d))
         st = jnp.where(mask, st, -1e30)
         pr = jax.nn.softmax(st, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", pr, v)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pr, vd)
         o = o.transpose(0, 2, 1, 3).reshape(1, t_pad, c)
         kc = kc.at[phys, offs].set(k[0].transpose(1, 0, 2))
         vc = vc.at[phys, offs].set(v[0].transpose(1, 0, 2))
@@ -697,7 +858,102 @@ def paged_prefill(p, tokens, prompt_len, block_table_row, kv_pages,
     last = lax.dynamic_index_in_dim(h, prompt_len - 1, 0,
                                     keepdims=False)
     logits = last @ p["wte"].T
-    return logits, logits.argmax(-1).astype(jnp.int32), new_pages
+    return _first_token(logits, sampling, new_pages)
+
+
+def paged_suffix_prefill(p, tokens, prompt_len, prefix_len,
+                         block_table_row, cow_src, cow_dst, kv_pages,
+                         n_heads, sampling=None):
+    """Prefix-cache-aware admission (ISSUE 15): prefill ONLY the
+    un-cached suffix of a prompt whose leading ``prefix_len`` tokens'
+    K/V already sit in pages mapped by ``block_table_row`` (shared
+    full pages + optionally one copy-on-write page).
+
+    - ``tokens``: int32 [T_pad] — the SUFFIX tokens
+      (``prompt[prefix_len:]``), padded to the engine's static prefill
+      length; suffix position ``i`` is absolute position
+      ``prefix_len + i``;
+    - ``prompt_len`` / ``prefix_len``: int32 scalars, both TRACED — one
+      compiled program serves every hit length, and ``prefix_len == 0``
+      is a cache miss (full prefill) in the same program;
+    - ``cow_src`` / ``cow_dst``: int32 physical page ids.  The program
+      copies page ``cow_src`` into ``cow_dst`` per layer FIRST — the
+      copy-on-write for a prefix that ends mid-page: the donor page
+      stays immutable for its other readers while this request's
+      suffix tokens overwrite the copy's tail.  Pass scratch (0) for
+      both when no COW is needed (a scratch self-copy is a no-op);
+    - suffix queries attend over the cached prefix (gathered from the
+      pages through the block table, masked at ``prefix_len``) PLUS
+      the causal window of the suffix itself, in one joint softmax.
+
+    Returns like :func:`paged_prefill`: the logits are the LAST PROMPT
+    position's, so the first generated token is produced here (the
+    suffix is always >= 1 token — a fully-cached prompt still runs its
+    final position through the model).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    t_pad = tokens.shape[0]
+    page_size = kv_pages[0][0].shape[1]
+    mp = block_table_row.shape[0]
+    t_ctx = mp * page_size
+    suffix_len = prompt_len - prefix_len
+    positions = prefix_len + jnp.arange(t_pad)
+    x = (p["wte"][tokens] + p["wpe"][positions])[None]  # [1, T_pad, C]
+    c = x.shape[-1]
+    d = c // n_heads
+    i = jnp.arange(t_pad)
+    valid = i < suffix_len
+    # suffix-vs-suffix: causal within the window, pads masked
+    mask_suf = (jnp.tril(jnp.ones((t_pad, t_pad), bool))
+                & valid[None, :])[None, None]
+    # suffix-vs-cached-prefix: every suffix query sees every cached key
+    pre_valid = jnp.arange(t_ctx) < prefix_len
+    mask_pre = pre_valid[None, None, None, :]
+    phys = jnp.where(valid, block_table_row[positions // page_size], 0)
+    offs = positions % page_size
+    new_pages = []
+    for lp, (kc, vc) in zip(p["layers"], kv_pages):
+        # copy-on-write FIRST: the gather below must see the copy
+        kc = kc.at[cow_dst].set(kc[cow_src])
+        vc = vc.at[cow_dst].set(vc[cow_src])
+        q, k, v = _block_qkv_kv(lp, x, n_heads)
+        kd, vd = _bcast_kv(k, n_heads), _bcast_kv(v, n_heads)
+        # cached prefix K/V, gathered through the block table:
+        # [mp, page, K_kv, D] -> [1, H, t_ctx, D]
+        kp = _bcast_kv(kc[block_table_row].reshape(
+            t_ctx, -1, d).transpose(1, 0, 2)[None], n_heads)
+        vp = _bcast_kv(vc[block_table_row].reshape(
+            t_ctx, -1, d).transpose(1, 0, 2)[None], n_heads)
+        # positions past the cached prefix read scratch/unwritten pages
+        # whose contents are GARBAGE — a NaN there (e.g. a hot-swap
+        # canary's torn-weight writes to scratch) would poison the
+        # output through 0 * NaN even though its softmax weight is
+        # exactly zero.  Zero the V rows, not just the scores.
+        vp = jnp.where(pre_valid[None, None, :, None], vp, 0.0)
+        scale = jnp.sqrt(jnp.float32(d))
+        st_pre = jnp.where(mask_pre,
+                           jnp.einsum("bhqd,bhkd->bhqk", q, kp) / scale,
+                           -1e30)
+        st_suf = jnp.where(mask_suf,
+                           jnp.einsum("bhqd,bhkd->bhqk", q, kd) / scale,
+                           -1e30)
+        pr = jax.nn.softmax(jnp.concatenate([st_pre, st_suf], axis=-1),
+                            axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pr,
+                       jnp.concatenate([vp, vd], axis=2))
+        o = o.transpose(0, 2, 1, 3).reshape(1, t_pad, c)
+        kc = kc.at[phys, offs].set(k[0].transpose(1, 0, 2))
+        vc = vc.at[phys, offs].set(v[0].transpose(1, 0, 2))
+        x = _block_finish(lp, x, o)
+        new_pages.append((kc, vc))
+    h = _ln(x[0], p["lnf_g"], p["lnf_b"])             # [T_pad, C]
+    last = lax.dynamic_index_in_dim(h, suffix_len - 1, 0,
+                                    keepdims=False)
+    logits = last @ p["wte"].T
+    return _first_token(logits, sampling, new_pages)
 
 
 def get_gpt(num_layers, units, num_heads, vocab_size=50257, max_len=1024,

@@ -28,7 +28,8 @@ __all__ = ["imread", "imdecode", "imresize", "scale_down", "resize_short",
            "RandomOrderAug", "BrightnessJitterAug", "ContrastJitterAug",
            "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
            "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
-           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
+           "stream_decode_batch_fn"]
 
 
 def _to_np(src):
@@ -540,6 +541,52 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if mean is not None or std is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
+
+
+def stream_decode_batch_fn(data_shape, aug_list=None, label_width=1):
+    """Hook the image pipeline into the streaming data plane's decode
+    worker pool (ROADMAP item 5 follow-up): build a ``decode_batch_fn``
+    for :class:`mxnet_tpu.stream.StreamLoader` whose per-record work is
+    EXACTLY :class:`ImageIter`'s — ``recordio.unpack`` the .rec payload,
+    ``imdecode`` the image bytes, run the SAME ``aug_list`` augmenter
+    chain, transpose to CHW float32 — but executed by the loader's
+    parallel decode workers instead of inline on the training thread.
+
+    ``aug_list`` defaults to :func:`CreateAugmenter`'s for
+    ``data_shape`` (deterministic members only make the streaming and
+    in-memory pipelines bit-identical — test-pinned).  Returns
+    ``(data [C, H, W] float32, label)`` sample tuples; the loader's
+    default batchify stacks them into the same batch arrays
+    ``ImageIter.next()`` builds.
+
+    Thread-mode workers share the augmenter instances (the
+    deterministic ones are stateless); process-mode workers require the
+    aug_list to be picklable (CreateAugmenter's all are).
+    """
+    if aug_list is None:
+        aug_list = CreateAugmenter(data_shape)
+
+    def decode_batch(raws):
+        out = []
+        for raw in raws:
+            header, img = _recordio.unpack(raw)
+            data = imdecode(img) if not isinstance(img, _np.ndarray) \
+                else img
+            if len(_to_np(data).shape) == 0:
+                raise MXNetError("stream image record decoded to a "
+                                 "zero-rank array")
+            for aug in aug_list:
+                data = aug(data)
+            npdata = _to_np(data).transpose(2, 0, 1)
+            lab = _np.asarray(header.label)
+            if label_width > 1:
+                label = lab.astype(_np.float32).reshape(label_width)
+            else:
+                label = _np.float32(lab.ravel()[0])
+            out.append((npdata.astype(_np.float32, copy=False), label))
+        return out
+
+    return decode_batch
 
 
 class ImageIter(_mxio.DataIter):

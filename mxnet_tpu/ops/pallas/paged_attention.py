@@ -2,7 +2,7 @@
 
 The serving runtime (mxnet_tpu/serving/) keeps every resident sequence's
 KV history in fixed-size PAGES drawn from one shared pool
-(``k_pages``/``v_pages``: [num_pages, page_size, H, D]) with a
+(``k_pages``/``v_pages``: [num_pages, page_size, K_kv, D]) with a
 per-sequence BLOCK TABLE mapping logical page index -> physical page id
 — the vLLM/"Ragged Paged Attention" memory model (PAPERS.md, arXiv
 2604.15464) that lets mixed-length sequences share one kernel launch
@@ -13,10 +13,15 @@ Kernel shape (one launch serves ALL resident slots, any lengths):
 - grid ``(num_slots, max_pages_per_seq)`` with the page axis as the
   sequential innermost dimension, exactly like ``flash_attention.py``'s
   k-block sweep: each step streams ONE physical K/V page HBM->VMEM
-  while the online-softmax state (o, m, l) rides in VMEM scratch; the
-  head axis is an unrolled 2-D-matmul loop INSIDE the cell (all heads
-  of a slot read the same physical page — one fetch, H-fold fewer grid
-  cells);
+  while the online-softmax state (o, m, l) rides in VMEM scratch;
+- **grouped-query attention** (ISSUE 15): the pools carry ``K_kv <= H``
+  KV heads; the ``H`` query heads are processed in ``H // K_kv``-sized
+  GROUPS, one 2-D matmul pair per KV head, all inside the cell — the
+  one physical page fetch serves the WHOLE query group, so KV bytes
+  per token shrink by ``H / K_kv`` while the FLOPs stay put.
+  ``K_kv == H`` degenerates to classic multi-head (bit-identical to
+  the pre-GQA kernel: same shapes, same op order); ``K_kv == 1`` is
+  multi-query attention;
 - the block table and per-slot context lengths arrive via scalar
   prefetch (``pltpu.PrefetchScalarGridSpec``) so the BlockSpec index
   maps can do the logical->physical page translation — the gather IS
@@ -56,14 +61,16 @@ def _scratch(shape):
 
 
 def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                   o_acc, m_acc, l_acc, *, page_size, n_heads, scale):
+                   o_acc, m_acc, l_acc, *, page_size, n_heads, n_kv,
+                   scale):
     """One (slot, page) grid step: online-softmax accumulate the
-    physical page the block table routed in.  The head axis is an
-    UNROLLED loop of 2-D matmuls inside the cell (per-head rows of the
-    VMEM scratch), not a grid dimension: all heads of a slot read the
-    same physical page, so folding them into one cell fetches the page
-    once and cuts grid-cell overhead H-fold — which on the interpret
-    (CPU) path is most of the decode step's cost.  ``ctx_ref``/
+    physical page the block table routed in.  The KV-head axis is an
+    UNROLLED loop of 2-D matmuls inside the cell — each KV head's
+    page-row feeds its WHOLE query-head group (``g = n_heads // n_kv``
+    rows of the VMEM scratch) from one fetch, so grouped-query heads
+    cost no extra page bandwidth and folding heads into one cell cuts
+    grid-cell overhead ``n_kv``-fold (on the interpret/CPU path that
+    overhead is most of the decode step's cost).  ``ctx_ref``/
     ``bt_ref`` are the scalar-prefetched context lengths and block
     table (the index maps already consumed ``bt_ref`` for the page
     gather; only masking reads it here)."""
@@ -72,6 +79,7 @@ def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     ctx = ctx_ref[s]
+    g = n_heads // n_kv
 
     @pl.when(j == 0)
     def _init():
@@ -86,25 +94,26 @@ def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         pos = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
         in_range = pos < ctx
-        for h in range(n_heads):
-            q = q_ref[0, h:h + 1, :].astype(jnp.float32) * scale  # (1,D)
-            k = k_ref[0, :, h, :].astype(jnp.float32)     # (page, D)
-            v = v_ref[0, :, h, :].astype(jnp.float32)     # (page, D)
+        for kv in range(n_kv):
+            grp = slice(kv * g, (kv + 1) * g)
+            q = q_ref[0, grp, :].astype(jnp.float32) * scale   # (g, D)
+            k = k_ref[0, :, kv, :].astype(jnp.float32)   # (page, D)
+            v = v_ref[0, :, kv, :].astype(jnp.float32)   # (page, D)
             st = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)        # (1, page)
+                preferred_element_type=jnp.float32)        # (g, page)
             st = jnp.where(in_range, st, _NEG_INF)
-            m_prev = m_acc[h:h + 1, :]
+            m_prev = m_acc[grp, :]
             m_new = jnp.maximum(m_prev, st.max(axis=-1, keepdims=True))
             p = jnp.exp(st - m_new)
             corr = jnp.exp(m_prev - m_new)
-            l_acc[h:h + 1, :] = l_acc[h:h + 1, :] * corr + \
+            l_acc[grp, :] = l_acc[grp, :] * corr + \
                 p.sum(axis=-1, keepdims=True)
-            o_acc[h:h + 1, :] = o_acc[h:h + 1, :] * corr + \
+            o_acc[grp, :] = o_acc[grp, :] * corr + \
                 jax.lax.dot_general(
                     p, v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
-            m_acc[h:h + 1, :] = m_new
+            m_acc[grp, :] = m_new
 
     @pl.when(j == nj - 1)
     def _emit():
@@ -118,9 +127,12 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     """Decode attention for every resident slot in ONE kernel launch.
 
     - ``q``: [S, H, D] — the current token's query per slot;
-    - ``k_pages``/``v_pages``: [num_pages, page_size, H, D] — the shared
-      physical page pools (page 0 is the serving allocator's scratch
-      page, never referenced by an in-range block-table entry);
+    - ``k_pages``/``v_pages``: [num_pages, page_size, K_kv, D] — the
+      shared physical page pools (page 0 is the serving allocator's
+      scratch page, never referenced by an in-range block-table entry).
+      ``K_kv`` must divide ``H``; each KV head serves a contiguous
+      group of ``H // K_kv`` query heads (GQA; ``K_kv == H`` is classic
+      multi-head, ``K_kv == 1`` multi-query);
     - ``block_tables``: int32 [S, max_pages_per_seq] — logical page j of
       slot s lives in physical page ``block_tables[s, j]``;
     - ``context_lens``: int32 [S] — tokens of history per slot (0 for an
@@ -134,6 +146,11 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     from jax.experimental.pallas import tpu as pltpu
     s_n, h, d = q.shape
     page_size = k_pages.shape[1]
+    n_kv = k_pages.shape[2]
+    if h % n_kv:
+        raise ValueError(
+            "query heads (%d) must be a multiple of KV heads (%d)"
+            % (h, n_kv))
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = d ** -0.5
@@ -145,9 +162,9 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         grid=(s_n, max_pages),
         in_specs=[
             pl.BlockSpec((1, h, d), lambda s, j, c, b: (s, 0, 0)),
-            pl.BlockSpec((1, page_size, h, d),
+            pl.BlockSpec((1, page_size, n_kv, d),
                          lambda s, j, c, b: (b[s, j], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, h, d),
+            pl.BlockSpec((1, page_size, n_kv, d),
                          lambda s, j, c, b: (b[s, j], 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda s, j, c, b: (s, 0, 0)),
@@ -156,7 +173,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     )
     return pl.pallas_call(
         functools.partial(_decode_kernel, page_size=page_size,
-                          n_heads=h, scale=float(scale)),
+                          n_heads=h, n_kv=n_kv, scale=float(scale)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_n, h, d), q.dtype),
         interpret=_use_interpret(),
@@ -165,19 +182,25 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
                               context_lens, scale=None):
-    """O(S·T) jnp oracle: gather each slot's pages contiguous, dense
-    masked softmax attention.  Tests pin the kernel against this and
-    against ``flash_attention`` on the densely-packed equivalent."""
+    """O(S·T) jnp oracle: gather each slot's pages contiguous, broadcast
+    each KV head over its query group, dense masked softmax attention.
+    Tests pin the kernel against this and against ``flash_attention``
+    on the densely-packed equivalent."""
     s_n, h, d = q.shape
     page_size = k_pages.shape[1]
+    n_kv = k_pages.shape[2]
+    g = h // n_kv
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = d ** -0.5
     bt = jnp.asarray(block_tables, jnp.int32)
     ctx = jnp.asarray(context_lens, jnp.int32)
-    # [S, max_pages, page, H, D] -> [S, T_max, H, D]
-    k_seq = k_pages[bt].reshape(s_n, max_pages * page_size, h, d)
-    v_seq = v_pages[bt].reshape(s_n, max_pages * page_size, h, d)
+    # [S, max_pages, page, K_kv, D] -> [S, T_max, K_kv, D]
+    k_seq = k_pages[bt].reshape(s_n, max_pages * page_size, n_kv, d)
+    v_seq = v_pages[bt].reshape(s_n, max_pages * page_size, n_kv, d)
+    if g > 1:
+        k_seq = jnp.repeat(k_seq, g, axis=2)
+        v_seq = jnp.repeat(v_seq, g, axis=2)
     st = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
                     k_seq.astype(jnp.float32)) * scale
     mask = (jnp.arange(max_pages * page_size)[None, None, :]

@@ -40,11 +40,29 @@ Out-of-process fleet (ISSUE 14):
   never double-decodes) and a per-replica
   :class:`~mxnet_tpu.serving.rpc.CircuitBreaker`.
 
+Capacity multipliers (ISSUE 15):
+
+- :class:`~mxnet_tpu.serving.prefix_cache.PrefixCache` — refcounted
+  content-keyed prefix index: a prompt's longest page-aligned cached
+  prefix is mapped SHARED into its block table (copy-on-write on a
+  mid-page boundary) and only the suffix prefills;
+- grouped-query attention in the paged kernel
+  (``ServingEngine(kv_heads=...)`` / ``MXTPU_SERVE_KV_HEADS``): pools
+  carry ``K_kv <= H`` KV heads — KV bytes per token shrink
+  ``H / K_kv``-fold;
+- :class:`~mxnet_tpu.serving.scheduler.SamplingParams` — per-request
+  temperature / top-k / top-p decode with a seeded per-slot PRNG
+  advanced functionally inside the donated step: same (seed, params,
+  prompt) -> same tokens regardless of batch composition (per-request
+  determinism; greedy stays bit-identical).
+
 See SERVING.md for architecture, sizing, the env contract, and the
 "operating under failure" + §9 fleet runbooks.
 """
 from .kv_cache import PagedKVAllocator
-from .scheduler import ContinuousBatchingScheduler, Request
+from .prefix_cache import PrefixCache
+from .scheduler import (ContinuousBatchingScheduler, Request,
+                        SamplingParams)
 from .engine import ServingEngine
 from .slo import SLOController
 from .replica import (ServingReplica, CheckpointSubscriber, ReplicaLost,
@@ -53,8 +71,9 @@ from .router import Router, RouterRequest
 from .rpc import (RpcServer, RpcReplicaProxy, CircuitBreaker, RpcError,
                   fleet_proxies)
 
-__all__ = ["PagedKVAllocator", "ContinuousBatchingScheduler",
-           "Request", "ServingEngine", "SLOController",
+__all__ = ["PagedKVAllocator", "PrefixCache",
+           "ContinuousBatchingScheduler", "Request", "SamplingParams",
+           "ServingEngine", "SLOController",
            "ServingReplica", "CheckpointSubscriber", "ReplicaLost",
            "EXIT_SERVE_DRAIN", "Router", "RouterRequest",
            "RpcServer", "RpcReplicaProxy", "CircuitBreaker",

@@ -66,6 +66,36 @@ Survivability plane (ISSUE 11):
   back to the prior weights (serving/replica.py drives this from
   CheckpointManager publications).
 
+Capacity multipliers (ISSUE 15):
+
+- **refcounted prefix caching** (on by default;
+  ``MXTPU_SERVE_PREFIX_CACHE=0`` disables) — admission matches each
+  prompt's longest page-aligned cached prefix
+  (serving/prefix_cache.py), maps the shared pages into the block
+  table by reference (``PagedKVAllocator`` refcounts), copy-on-writes
+  a prefix that ends mid-page, and prefills ONLY the un-cached suffix
+  (``gpt.paged_suffix_prefill``, one program for every hit length —
+  ``prefix_len`` is traced).  Registration happens after a SUCCESSFUL
+  prefill; the ``serve.prefix.evict`` fault site force-drops the index
+  between steps (victims fall back to a full prefill with correct
+  tokens).  The headline win is ADMISSION CAPACITY (shared pages are
+  not re-stored) plus the prompt-quadratic prefill FLOPs skipped at
+  real prompt lengths; on the CPU interpret path a hit's wall time is
+  NOT lower than a miss's (the static-pad suffix window still runs
+  every position, plus the prefix gather).  Telemetry:
+  ``serving.prefix.{hits,miss,shared_pages,cow_copies,evictions}`` +
+  ``serving.prefill_tokens`` (logical tokens prefilled);
+- **grouped-query attention** (``kv_heads=`` / ``MXTPU_SERVE_KV_HEADS``)
+  — page pools shaped ``[num_pages, page_size, K_kv, D]`` with
+  ``K_kv <= H`` (decode_params mean-pools the K/V projections), so KV
+  bytes per resident token shrink ``H / K_kv``-fold and the same pool
+  bytes hold proportionally more sequences;
+- **per-request sampling decode** — temperature/top-k/top-p as
+  per-SLOT program inputs plus a seeded per-slot PRNG key advanced
+  functionally inside the donated step: same (seed, params, prompt) ->
+  same tokens regardless of batch composition, join/leave, hot-swap,
+  or failover re-decode (greedy = temp 0 stays bit-identical).
+
 Request-scope tracing (ISSUE 13, OBSERVABILITY.md §12): every request
 carries a trace id (minted here, or passed through from the Router so a
 failover re-decode stays ONE trace) and leaves a lifecycle event at each
@@ -97,10 +127,11 @@ from .. import telemetry as _telemetry
 from .. import watchdog as _watchdog
 from ..base import MXNetError
 from .kv_cache import PagedKVAllocator, SCRATCH_PAGE
+from .prefix_cache import PrefixCache
 from .scheduler import (ContinuousBatchingScheduler, EXPIRED, FAILED,
-                        FINISHED, VERDICT_COMPLETED, VERDICT_DRAINING,
-                        VERDICT_EXPIRED_DECODE, VERDICT_PREFILL_ERROR,
-                        VERDICT_REJECTED)
+                        FINISHED, SamplingParams, VERDICT_COMPLETED,
+                        VERDICT_DRAINING, VERDICT_EXPIRED_DECODE,
+                        VERDICT_PREFILL_ERROR, VERDICT_REJECTED)
 from .slo import SLOController
 
 __all__ = ["ServingEngine", "live_snapshot"]
@@ -148,13 +179,23 @@ class ServingEngine:
 
     def __init__(self, net, num_slots=4, page_size=16, num_pages=None,
                  max_prefill_len=32, max_seq_len=None, eos_id=None,
-                 record_logits=False, slo=None, default_deadline_s=None):
+                 record_logits=False, slo=None, default_deadline_s=None,
+                 kv_heads=None, prefix_cache=None):
         from ..gluon.model_zoo import gpt as _gpt
 
         self._gpt = _gpt
         self._net = net
-        self._p = _gpt.decode_params(net)
         self._n_heads = net.blocks._children[0].attn._num_heads
+        # grouped-query serving (ISSUE 15): K_kv <= H KV heads shrink
+        # the page pools H/K_kv-fold -> proportionally more resident
+        # sequences for the same pool bytes.  Explicit arg wins; env
+        # opt-in via MXTPU_SERVE_KV_HEADS; default = the model's H
+        # (bit-identical to the pre-GQA engine).
+        if kv_heads is None:
+            kv_heads = int(os.environ.get("MXTPU_SERVE_KV_HEADS", "0")) \
+                or self._n_heads
+        self.kv_heads = int(kv_heads)
+        self._p = _gpt.decode_params(net, kv_heads=self.kv_heads)
         self._n_layers = len(self._p["layers"])
         self._units = int(self._p["wte"].shape[1])
         self._vocab = int(self._p["wte"].shape[0])
@@ -180,9 +221,29 @@ class ServingEngine:
         self._record_logits = bool(record_logits)
 
         self.alloc = PagedKVAllocator(num_pages, self.page_size)
+        # refcounted prefix caching (ISSUE 15): on by default
+        # (MXTPU_SERVE_PREFIX_CACHE=0 / prefix_cache=False disables).
+        # Admission maps a prompt's longest page-aligned cached prefix
+        # into the block table by reference and prefills only the
+        # suffix — system-prompt-heavy traffic turns shared pages into
+        # a direct admission-capacity and TTFT multiplier.
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "MXTPU_SERVE_PREFIX_CACHE", "1") not in ("0", "off", "")
+        self._prefix = PrefixCache(self.alloc) if prefix_cache else None
         self.sched = ContinuousBatchingScheduler(
             self.num_slots, self.alloc, self.max_pages_per_seq,
-            max_seq_len=self.max_seq_len)
+            max_seq_len=self.max_seq_len, prefix_cache=self._prefix)
+        # per-request sampling decode (ISSUE 15): per-SLOT params
+        # arrays + functionally-advanced PRNG keys are ordinary decode
+        # program inputs — never a recompile.  Greedy slots (temp 0)
+        # take the argmax path bit-identically.  Env defaults apply to
+        # submits that pass no SamplingParams.
+        self._temps = _np.zeros(self.num_slots, _np.float32)
+        self._top_ks = _np.zeros(self.num_slots, _np.int32)
+        self._top_ps = _np.zeros(self.num_slots, _np.float32)
+        self._keys = _np.zeros((self.num_slots, 2), _np.uint32)
+        self.default_sampling = self._env_sampling()
 
         # survivability plane (ISSUE 11): SLO shed controller (explicit
         # arg wins; env opt-in via MXTPU_SERVE_SLO_P99_S; None = the
@@ -219,6 +280,32 @@ class ServingEngine:
             self.alloc.free_pages)
         _telemetry.gauge("serving.batch_occupancy").set(0)
 
+    @staticmethod
+    def _env_sampling():
+        """Fleet-wide sampling defaults (SERVING.md env table):
+        MXTPU_SERVE_TEMPERATURE / MXTPU_SERVE_TOP_K / MXTPU_SERVE_TOP_P
+        / MXTPU_SERVE_SEED.  All unset -> None (greedy), matching the
+        pre-ISSUE-15 contract bit-for-bit.  A filter knob (top-k/top-p)
+        with NO temperature set means temperature 1.0 — temp 0 would
+        silently argmax past the operator's filter."""
+        raw_temp = os.environ.get("MXTPU_SERVE_TEMPERATURE")
+        top_k = int(os.environ.get("MXTPU_SERVE_TOP_K", "0"))
+        top_p = float(os.environ.get("MXTPU_SERVE_TOP_P", "0"))
+        if raw_temp is None and top_k == 0 and top_p == 0:
+            return None
+        s = SamplingParams(
+            temperature=None if raw_temp is None else float(raw_temp),
+            top_k=top_k, top_p=top_p,
+            seed=int(os.environ.get("MXTPU_SERVE_SEED", "0")))
+        return None if s.greedy and not (top_k or top_p) else s
+
+    def params_from_net(self, net):
+        """The decode-param tree for THIS engine's configuration (the
+        hot-swap entry point: a GQA engine needs the same K/V head
+        pooling applied to the incoming weights, or swap_params would
+        rightly reject the shape mismatch)."""
+        return self._gpt.decode_params(net, kv_heads=self.kv_heads)
+
     # -- device state ------------------------------------------------------
     def _init_pages(self):
         """Per-layer (k_pages, v_pages) pools as FRESH XLA-owned buffers
@@ -233,7 +320,7 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
 
-        shape = (self.alloc.num_pages, self.page_size, self._n_heads,
+        shape = (self.alloc.num_pages, self.page_size, self.kv_heads,
                  self._head_dim)
         mk = jax.jit(lambda: jnp.zeros(shape, jnp.float32))
         return [(mk(), mk()) for _ in range(self._n_layers)]
@@ -243,11 +330,17 @@ class ServingEngine:
         """Everything about this engine that changes the traced programs
         but not the input shapes — goes into the AOT cache key the way
         Module passes its symbol/optimizer hash."""
-        return ("serve|L%d|h%d|u%d|v%d|ps%d|np%d|slots%d|mp%d|pf%d|%s"
-                % (self._n_layers, self._n_heads, self._units,
-                   self._vocab, self.page_size, self.alloc.num_pages,
-                   self.num_slots, self.max_pages_per_seq,
-                   self.max_prefill_len, type(self._net).__name__))
+        # NOTE: the prefix-cache flag is deliberately NOT in the key —
+        # cache-on and cache-off engines compile the SAME two programs
+        # (a miss/off prefill is the cond's dense branch), so they
+        # share AOT entries and the in-process memo
+        return ("serve|L%d|h%d|kv%d|u%d|v%d|ps%d|np%d|slots%d|mp%d|"
+                "pf%d|%s"
+                % (self._n_layers, self._n_heads, self.kv_heads,
+                   self._units, self._vocab, self.page_size,
+                   self.alloc.num_pages, self.num_slots,
+                   self.max_pages_per_seq, self.max_prefill_len,
+                   type(self._net).__name__))
 
     def _build_programs(self):
         import jax
@@ -255,15 +348,31 @@ class ServingEngine:
         gpt = self._gpt
         n_heads = self._n_heads
 
-        def decode(p, kv_pages, tokens, positions, active,
-                   block_tables):
-            return gpt.paged_decode_step(p, tokens, positions, active,
-                                         kv_pages, block_tables,
-                                         n_heads)
+        def decode(p, kv_pages, tokens, positions, active, block_tables,
+                   temps, top_ks, top_ps, keys):
+            return gpt.paged_decode_step(
+                p, tokens, positions, active, kv_pages, block_tables,
+                n_heads, sampling=(temps, top_ks, top_ps, keys))
 
-        def prefill(p, kv_pages, tokens, prompt_len, bt_row):
-            return gpt.paged_prefill(p, tokens, prompt_len, bt_row,
-                                     kv_pages, n_heads)
+        # ONE prefill program whether the prefix cache is on or off: a
+        # traced prefix_len of 0 (every admission with the cache off,
+        # every miss with it on) executes the classic dense branch via
+        # lax.cond — no page gather, no COW copy, bit-identical to and
+        # as cheap as the pre-prefix-cache prefill; only hits pay the
+        # gather.  Samples the request's FIRST token under its params.
+        def prefill(p, kv_pages, tokens, prompt_len, prefix_len,
+                    bt_row, cow_src, cow_dst, temp, top_k, top_p, key):
+            from jax import lax
+            samp = (temp, top_k, top_p, key)
+            return lax.cond(
+                prefix_len > 0,
+                lambda: gpt.paged_suffix_prefill(
+                    p, tokens, prompt_len, prefix_len, bt_row,
+                    cow_src, cow_dst, kv_pages, n_heads,
+                    sampling=samp),
+                lambda: gpt.paged_prefill(
+                    p, tokens, prompt_len, bt_row, kv_pages,
+                    n_heads, sampling=samp))
 
         def sds(x):
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
@@ -272,16 +381,27 @@ class ServingEngine:
         kv_ex = jax.tree_util.tree_map(sds, self._kv)
         s, mp, tp = self.num_slots, self.max_pages_per_seq, \
             self.max_prefill_len
-        i32 = _np.int32
+        i32, f32, u32 = _np.int32, _np.float32, _np.uint32
         decode_ex = (p_ex, kv_ex,
                      jax.ShapeDtypeStruct((s,), i32),
                      jax.ShapeDtypeStruct((s,), i32),
                      jax.ShapeDtypeStruct((s,), _np.bool_),
-                     jax.ShapeDtypeStruct((s, mp), i32))
+                     jax.ShapeDtypeStruct((s, mp), i32),
+                     jax.ShapeDtypeStruct((s,), f32),
+                     jax.ShapeDtypeStruct((s,), i32),
+                     jax.ShapeDtypeStruct((s,), f32),
+                     jax.ShapeDtypeStruct((s, 2), u32))
+        samp_ex = (jax.ShapeDtypeStruct((), f32),
+                   jax.ShapeDtypeStruct((), i32),
+                   jax.ShapeDtypeStruct((), f32),
+                   jax.ShapeDtypeStruct((2,), u32))
         prefill_ex = (p_ex, kv_ex,
                       jax.ShapeDtypeStruct((tp,), i32),
                       jax.ShapeDtypeStruct((), i32),
-                      jax.ShapeDtypeStruct((mp,), i32))
+                      jax.ShapeDtypeStruct((), i32),
+                      jax.ShapeDtypeStruct((mp,), i32),
+                      jax.ShapeDtypeStruct((), i32),
+                      jax.ShapeDtypeStruct((), i32)) + samp_ex
         extra = self._config_hash()
         self._decode = self._compile("decode", decode, decode_ex, extra)
         self._prefill = self._compile("prefill", prefill, prefill_ex,
@@ -390,7 +510,8 @@ class ServingEngine:
             pass
 
     # -- request intake ----------------------------------------------------
-    def submit(self, prompt, max_new, deadline_s=None, trace=None):
+    def submit(self, prompt, max_new, deadline_s=None, trace=None,
+               sampling=None):
         """Enqueue one request (prompt: 1-d int token array).  Returns
         the Request handle; tokens appear on it as the engine steps.
 
@@ -402,12 +523,22 @@ class ServingEngine:
         fast instead of waiting on a queue that will never serve them.
         Infeasible requests (can never fit) still raise ValueError.
 
+        ``sampling``: a :class:`SamplingParams` (or its dict form) for
+        per-request temperature/top-k/top-p decode with a seeded
+        per-slot PRNG — same (seed, params, prompt) -> same tokens
+        regardless of batch composition (the determinism law).  None
+        uses the engine's env default (greedy when unset, bit-identical
+        to the sampling-free engine).
+
         ``trace``: request-scope trace id.  None (direct callers) mints
         one here and this engine's terminal verdict event is FINAL; the
         Router passes its own id through so a failover re-decode on a
         survivor replica continues the same trace, and fleet-level
         terminality stays the Router's to stamp."""
         prompt = _np.asarray(prompt, _np.int32).reshape(-1)
+        sampling = SamplingParams.from_doc(sampling)
+        if sampling is None:
+            sampling = self.default_sampling
         # malformed-argument raises (the scheduler's Request rules)
         # happen BEFORE any trace event: they produce no handle, so
         # they must open no trace a verdict would then never close
@@ -424,7 +555,9 @@ class ServingEngine:
             trace, "submit" if owned else "place",
             args={"replica": self.trace_tag,
                   "prompt_len": int(prompt.size),
-                  "max_new": int(max_new), "deadline_s": deadline_s})
+                  "max_new": int(max_new), "deadline_s": deadline_s,
+                  "sampling": (None if sampling is None
+                               else sampling.to_doc())})
         if prompt.size > self.max_prefill_len:
             self._close_unplaced(trace, owned, VERDICT_REJECTED)
             raise ValueError(
@@ -457,6 +590,9 @@ class ServingEngine:
         req = self.sched.submit(prompt, max_new, deadline_s)
         req.trace = trace
         req.trace_owned = owned
+        req.sampling = sampling
+        if sampling is not None and not sampling.greedy:
+            _telemetry.counter("serving.sampling.requests").inc()
         if self._record_logits:
             req.logits_trace = []
         _telemetry.counter("serving.requests").inc()
@@ -507,7 +643,15 @@ class ServingEngine:
         goodput accounting — ``serving.goodput`` counts only tokens on
         requests that COMPLETED (reached every token within deadline),
         the numerator of the goodput-vs-raw-tokens split."""
+        slot = req.slot
         self.sched.finish(req, state, verdict=verdict, error=error)
+        # clear the slot's sampling rows: a stale temp > 0 would make
+        # every later ALL-GREEDY decode step pay the sampling math
+        # (the lax.cond predicate reads these rows)
+        if slot is not None:
+            self._temps[slot] = 0.0
+            self._top_ks[slot] = 0
+            self._top_ps[slot] = 0.0
         if req.verdict == VERDICT_COMPLETED:
             _telemetry.counter("serving.goodput").inc(len(req.tokens))
         self._close_trace(req)
@@ -531,10 +675,55 @@ class ServingEngine:
                                   req.max_new))
             _telemetry.counter("serving.expired_decode").inc()
 
+    def _arm_slot_sampling(self, req):
+        """Install the request's sampling params into its slot's rows
+        of the per-slot decode arrays and seed the slot's PRNG key.
+        Greedy requests (or None) zero the row — the decode program's
+        ``temp > 0`` select takes the argmax path for them.  Returns
+        the scalar (temp, top_k, top_p, key) the prefill consumes."""
+        import jax
+        s = req.sampling
+        slot = req.slot
+        if s is None or s.greedy:
+            self._temps[slot] = 0.0
+            self._top_ks[slot] = 0
+            self._top_ps[slot] = 0.0
+            self._keys[slot] = 0
+        else:
+            self._temps[slot] = s.temperature
+            self._top_ks[slot] = s.top_k
+            self._top_ps[slot] = s.top_p
+            self._keys[slot] = _np.asarray(
+                jax.random.PRNGKey(s.seed), _np.uint32)
+        return (_np.float32(self._temps[slot]),
+                _np.int32(self._top_ks[slot]),
+                _np.float32(self._top_ps[slot]),
+                self._keys[slot].copy())
+
+    def _note_prefix_admission(self, req):
+        """The prefix-cache accounting for one admission (hit/miss
+        split, shared-page and COW counters, prefilled-token counter —
+        the quantity the BENCH_MODE=serve prefix contract bounds)."""
+        suffix = int(req.prompt.size) - req.prefix_len
+        _telemetry.counter("serving.prefill_tokens").inc(suffix)
+        if self._prefix is None:
+            return
+        if req.prefix_len > 0:
+            _telemetry.counter("serving.prefix.hits").inc()
+            _telemetry.counter("serving.prefix.shared_pages").inc(
+                req.shared_count)
+            if req.cow_src is not None:
+                _telemetry.counter("serving.prefix.cow_copies").inc()
+        else:
+            _telemetry.counter("serving.prefix.miss").inc()
+
     def _admit_and_prefill(self):
         """Join phase: place queued requests into free slots and run one
         prefill dispatch each (pages donated through; the request's
-        first generated token comes back with it).  Each dispatch runs
+        first generated token comes back with it).  On a prefix-cache
+        hit only the UN-CACHED suffix prefills (shared pages were
+        mapped by reference at admission; a prefix ending mid-page is
+        copy-on-written inside the same dispatch).  Each dispatch runs
         under a ``serve.prefill`` watchdog guard (a wedged prefill is a
         diagnosable stall, not a silent hang); an injected
         ``serve.prefill.error`` fails THAT request deterministically —
@@ -549,7 +738,10 @@ class ServingEngine:
                 args={"replica": self.trace_tag, "slot": req.slot,
                       "rid": req.rid,
                       "queue_wait_s": round(req.queue_wait_s, 6),
-                      "pages": len(req.pages)})
+                      "pages": len(req.pages),
+                      "prefix_hit": req.prefix_len > 0,
+                      "prefix_len": req.prefix_len,
+                      "shared_pages": req.shared_count})
             if self._slo is not None:
                 self._slo.observe(req.queue_wait_s)
             try:
@@ -561,23 +753,49 @@ class ServingEngine:
                              error=str(e))
                 _telemetry.counter("serving.prefill_errors").inc()
                 continue
+            samp = self._arm_slot_sampling(req)
             toks = _np.zeros(self.max_prefill_len, _np.int32)
-            toks[:req.prompt.size] = req.prompt
+            # req.prefix_len is 0 with the cache off or on a miss: the
+            # suffix is then the whole prompt and the program's dense
+            # branch runs
+            suffix = req.prompt[req.prefix_len:]
+            toks[:suffix.size] = suffix
             t0 = time.perf_counter_ns()
             with _watchdog.guard("serve.prefill"):
-                logits, first, self._kv = self._prefill(
+                logits, first, new_key, self._kv = self._prefill(
                     self._p, self._kv, toks,
                     _np.int32(req.prompt.size),
-                    self.sched.block_tables[req.slot].copy())
+                    _np.int32(req.prefix_len),
+                    self.sched.block_tables[req.slot].copy(),
+                    _np.int32(req.cow_src if req.cow_src is not None
+                              else SCRATCH_PAGE),
+                    _np.int32(req.cow_dst if req.cow_dst is not None
+                              else SCRATCH_PAGE),
+                    *samp)
                 t1 = time.perf_counter_ns()
                 first = int(first)          # device sync
             t2 = time.perf_counter_ns()
+            # prefix/prefill-token accounting AFTER the dispatch
+            # landed: a prefill that failed (fault above) must not
+            # count tokens that were never prefilled
+            self._note_prefix_admission(req)
+            self._keys[req.slot] = _np.asarray(new_key, _np.uint32)
+            if self._prefix is not None:
+                # register the prompt's full pages under their content
+                # keys — ONLY now, after the prefill landed: a failed
+                # prefill must never leave the index naming pages whose
+                # contents never materialized (the cache stamps the
+                # cached_pages gauge itself)
+                self._prefix.insert(req.prompt,
+                                    self.sched.block_tables[req.slot])
             _telemetry.note_train_step(t0, t1, t2,
                                        where="serve_prefill")
             _telemetry.note_request_event(
                 req.trace, "prefill", t_ns=t0,
                 args={"dispatch_s": round((t1 - t0) * 1e-9, 9),
-                      "sync_s": round((t2 - t1) * 1e-9, 9)})
+                      "sync_s": round((t2 - t1) * 1e-9, 9),
+                      "prefill_tokens":
+                          int(req.prompt.size) - req.prefix_len})
             # the prefill's first token: one ``token`` event, stamped
             # BEFORE _note_token so a finish-on-first-token (max_new=1)
             # orders token -> verdict in the trace
@@ -618,6 +836,13 @@ class ServingEngine:
         before the decode dispatch WITHOUT renewing — exactly the
         production failure (a hung XLA dispatch / device lockup) the
         watchdog's exit-75 path exists for."""
+        # the ``serve.prefix.evict`` drill: force-drop the whole prefix
+        # index between steps — victims fall back to a full prefill
+        # with correct tokens (the cache is a capacity optimization,
+        # NEVER a correctness dependency; test-pinned)
+        if self._prefix is not None and _fault.trigger(
+                "serve.prefix.evict"):
+            self.drop_prefix_cache()
         self._expire_deadlines()
         placed = self._admit_and_prefill()
         # every placed request produced exactly one token in its prefill
@@ -654,12 +879,19 @@ class ServingEngine:
             active[req.slot] = True
 
         t0 = time.perf_counter_ns()
-        logits, nxt, self._kv = self._decode(
+        logits, nxt, new_keys, self._kv = self._decode(
             self._p, self._kv, tokens, positions, active,
-            self.sched.block_tables.copy())
+            self.sched.block_tables.copy(), self._temps.copy(),
+            self._top_ks.copy(), self._top_ps.copy(),
+            self._keys.copy())
         t1 = time.perf_counter_ns()
         nxt = _np.asarray(nxt)           # device sync barrier
         t2 = time.perf_counter_ns()
+        # per-slot PRNG state advances FUNCTIONALLY inside the donated
+        # program; the host copy is the only carry between steps
+        # (np.array, not asarray: a jax-backed view is read-only and
+        # admission writes per-slot rows)
+        self._keys = _np.array(new_keys, _np.uint32)
         _telemetry.note_train_step(t0, t1, t2, where="serve_step")
         # ONE batched ``tokens`` event per decode step naming every
         # advanced trace (all residents share the step's sync stamp
@@ -753,6 +985,12 @@ class ServingEngine:
                           "dur_s": round((time.perf_counter_ns() - t0)
                                          * 1e-9, 9)})
                 raise
+        # the prefix index names pages whose K/V was computed under the
+        # OLD weights: a post-swap hit would splice stale activations
+        # into a new-weights decode (silently wrong tokens).  Evict on
+        # SUCCESS only — a rolled-back swap keeps serving the weights
+        # the cache was built under, so the cache stays valid.
+        self.drop_prefix_cache()
         self.swaps += 1
         if epoch is not None:
             self.weights_epoch = epoch
@@ -772,9 +1010,13 @@ class ServingEngine:
         rolls back."""
         toks = _np.zeros(self.max_prefill_len, _np.int32)
         bt = _np.full(self.max_pages_per_seq, SCRATCH_PAGE, _np.int32)
+        samp = (_np.float32(0), _np.int32(0), _np.float32(0),
+                _np.zeros(2, _np.uint32))
         with _telemetry.span("serving.swap_canary", cat="serving"):
-            logits, _first, self._kv = self._prefill(
-                self._p, self._kv, toks, _np.int32(1), bt)
+            logits, _first, _key, self._kv = self._prefill(
+                self._p, self._kv, toks, _np.int32(1),
+                _np.int32(0), bt, _np.int32(SCRATCH_PAGE),
+                _np.int32(SCRATCH_PAGE), *samp)
             row = _np.asarray(logits)       # device sync
         if not _np.isfinite(row).all():
             raise MXNetError(
@@ -782,6 +1024,16 @@ class ServingEngine:
                 "new weights are torn or corrupt, rolling back")
 
     # -- drain / introspection ---------------------------------------------
+    def drop_prefix_cache(self):
+        """Evict every cached prefix entry (telemetry stamped inside
+        the cache's one eviction path).  The shared move of the
+        ``serve.prefix.evict`` drill, a successful weight hot-swap
+        (stale-K/V invalidation), and the replica drain's zero-pages
+        audit.  Returns entries dropped (0 with the cache off)."""
+        if self._prefix is None:
+            return 0
+        return self._prefix.evict_all()
+
     def start_drain(self):
         """Stop admitting: every subsequent submit comes back terminal
         with verdict ``draining``.  Residents and the already-accepted
@@ -801,6 +1053,10 @@ class ServingEngine:
             "replica": self.trace_tag,
             "decode_steps": self.decode_steps,
             "prefills": self.prefills,
+            "kv_heads": self.kv_heads,
+            "prefix_cached_pages": (None if self._prefix is None
+                                    else self._prefix.cached_pages),
+            "shared_pages": self.alloc.shared_pages,
             "swaps": self.swaps,
             "occupancy": self.sched.occupancy,
             "num_slots": self.num_slots,
@@ -820,9 +1076,18 @@ class ServingEngine:
         }
 
     # -- convenience -------------------------------------------------------
-    def generate(self, prompts, max_new):
+    def generate(self, prompts, max_new, sampling=None):
         """Batch convenience: submit everything, drain, return token
-        lists (prompt excluded) in submit order."""
-        reqs = [self.submit(p, max_new) for p in prompts]
+        lists (prompt excluded) in submit order.  ``sampling``: one
+        SamplingParams for all, or a per-prompt list."""
+        if not isinstance(sampling, (list, tuple)):
+            sampling = [sampling] * len(prompts)
+        elif len(sampling) != len(prompts):
+            raise ValueError(
+                "sampling list length %d != %d prompts (zip would "
+                "silently drop the tail)" % (len(sampling),
+                                             len(prompts)))
+        reqs = [self.submit(p, max_new, sampling=s)
+                for p, s in zip(prompts, sampling)]
         self.run_until_idle()
         return [r.tokens for r in reqs]

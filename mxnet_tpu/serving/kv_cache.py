@@ -1,10 +1,11 @@
-"""Paged KV-cache allocator: fixed-size blocks, block tables, free-list.
+"""Paged KV-cache allocator: fixed-size blocks, block tables, free-list,
+per-page refcounts.
 
-The device-side page pools (``[num_pages, page_size, H, D]`` per layer,
-owned by the serving engine and donated through every decode step) are
-dumb storage; THIS object is the authority over which physical page
-belongs to whom.  Design follows the vLLM/"Ragged Paged Attention"
-memory model (PAPERS.md, arXiv 2604.15464):
+The device-side page pools (``[num_pages, page_size, K_kv, D]`` per
+layer, owned by the serving engine and donated through every decode
+step) are dumb storage; THIS object is the authority over which
+physical page belongs to whom.  Design follows the vLLM/"Ragged Paged
+Attention" memory model (PAPERS.md, arXiv 2604.15464):
 
 - **fixed-size blocks** — a sequence of length L owns
   ``ceil(L / page_size)`` pages; internal fragmentation is bounded by
@@ -16,7 +17,16 @@ memory model (PAPERS.md, arXiv 2604.15464):
   pages for its WORST CASE (prompt + max_new_tokens) are free, reserved
   up front.  Decode can then never OOM mid-flight: admission is the
   single choke point, and a rejected request waits in the queue instead
-  of killing resident sequences (OOM-aware admission, ISSUE 9).
+  of killing resident sequences (OOM-aware admission, ISSUE 9);
+- **per-page refcounts** (ISSUE 15) — a physical page can back the SAME
+  token history for many sequences at once (refcounted prefix caching:
+  the prompt pages of a system-prompt-heavy workload are shared, not
+  re-stored).  ``allocate`` hands pages out at refcount 1, ``retain``
+  adds a reference, ``release`` drops one and only a page's LAST
+  release returns it to the free list.  Shared pages are read-only by
+  convention: the scheduler routes every write to pages whose refcount
+  is 1 (freshly-allocated suffix / copy-on-write pages), so sharing can
+  never corrupt another sequence's history.
 
 **Page 0 is reserved as the scratch page**: inactive serving slots and
 prompt padding scatter their K/V writes there, and no in-range block-
@@ -24,7 +34,7 @@ table entry ever points at it — that is what makes slot join/leave
 invisible (bit-exact) to resident slots.  The allocator simply never
 hands page 0 out.
 
-Pure host-side bookkeeping (lists of ints); nothing here touches jax.
+Pure host-side bookkeeping (ints); nothing here touches jax.
 """
 from __future__ import annotations
 
@@ -48,7 +58,7 @@ class PagedKVAllocator:
         # LIFO free list, scratch page excluded.  Reversed so the first
         # allocations hand out low page ids (stable, test-friendly).
         self._free = list(range(self.num_pages - 1, 0, -1))
-        self._allocated = set()
+        self._refs = {}          # page id -> refcount (>= 1)
 
     # -- sizing ------------------------------------------------------------
     def pages_for(self, tokens):
@@ -62,7 +72,16 @@ class PagedKVAllocator:
 
     @property
     def used_pages(self):
-        return len(self._allocated)
+        return len(self._refs)
+
+    @property
+    def shared_pages(self):
+        """Pages currently referenced more than once (prefix sharing)."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def refcount(self, page):
+        """Current reference count of ``page`` (0 when free)."""
+        return self._refs.get(int(page), 0)
 
     # -- admission ---------------------------------------------------------
     def can_reserve(self, n):
@@ -72,10 +91,11 @@ class PagedKVAllocator:
         return int(n) <= len(self._free)
 
     def allocate(self, n):
-        """Take ``n`` pages off the free list.  Raises MXNetError when
-        the pool cannot satisfy the request — callers are expected to
-        have asked :meth:`can_reserve` first (the scheduler does), so
-        this raising means an accounting bug, not load."""
+        """Take ``n`` pages off the free list (each at refcount 1).
+        Raises MXNetError when the pool cannot satisfy the request —
+        callers are expected to have asked :meth:`can_reserve` first
+        (the scheduler does), so this raising means an accounting bug,
+        not load."""
         n = int(n)
         if n > len(self._free):
             raise MXNetError(
@@ -83,45 +103,78 @@ class PagedKVAllocator:
                 "(admission should have rejected this request)"
                 % (n, len(self._free), self.num_pages - 1))
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def retain(self, pages):
+        """Add one reference to each already-allocated page — how a new
+        request maps a cached prefix page (or the prefix index pins a
+        page) without owning it.  Retaining a free page raises: sharing
+        storage nobody owns is a use-after-free in the making."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if p not in self._refs:
+                raise MXNetError(
+                    "retain of page %d which is not allocated (free or "
+                    "scratch/foreign page)" % p)
+        for p in pages:
+            self._refs[p] += 1
         return pages
 
     def release(self, pages):
-        """Return a sequence's pages to the free list (LIFO).  Double
-        frees and frees of never-allocated ids raise — both are
-        use-after-free bugs that would silently corrupt ANOTHER
-        sequence's history if let through."""
+        """Drop one reference per page; a page's LAST release returns it
+        to the free list (LIFO).  Releases of free/never-allocated ids
+        raise — over-release is a use-after-free bug that would silently
+        corrupt ANOTHER sequence's history if let through.  A DUPLICATE
+        page within one call raises too: no caller legitimately holds
+        two references through a single page list, and on a shared page
+        (refcount >= 2) the double decrement would silently steal
+        another holder's reference — the one double-free class plain
+        conservation cannot catch."""
+        pages = [int(p) for p in pages]
+        if len(set(pages)) != len(pages):
+            raise MXNetError(
+                "duplicate pages in one release call: %r (a double "
+                "free that refcounting would silently absorb)"
+                % sorted(pages))
         for p in pages:
-            p = int(p)
-            if p not in self._allocated:
+            if p not in self._refs:
                 raise MXNetError(
                     "release of page %d which is not allocated (double "
                     "free or scratch/foreign page)" % p)
-            self._allocated.remove(p)
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
 
     # -- invariants ----------------------------------------------------------
     def assert_conservation(self):
         """Page conservation: every usable page is in exactly ONE of
-        free-list / allocated-set, none twice, scratch in neither.
-        Raises MXNetError naming the violation.  Called by tests and by
-        the drain/mass-rejection paths — a request verdict that leaked
-        or duplicated a page would corrupt another sequence's history
-        long after the offending request is gone."""
+        free-list / allocated-map, none twice, scratch in neither, and
+        every allocated page carries a POSITIVE refcount.  Raises
+        MXNetError naming the violation.  Called by tests and by the
+        drain/mass-rejection paths — a request verdict that leaked,
+        duplicated, or double-freed a (possibly shared) page would
+        corrupt another sequence's history long after the offending
+        request is gone."""
         free = list(self._free)
         free_set = set(free)
         if len(free_set) != len(free):
             raise MXNetError("free-list holds duplicate pages: %r" % free)
-        if free_set & self._allocated:
+        if free_set & set(self._refs):
             raise MXNetError(
                 "pages both free and allocated: %r"
-                % sorted(free_set & self._allocated))
-        if SCRATCH_PAGE in free_set or SCRATCH_PAGE in self._allocated:
+                % sorted(free_set & set(self._refs)))
+        bad = sorted(p for p, c in self._refs.items() if c < 1)
+        if bad:
+            raise MXNetError(
+                "allocated pages with non-positive refcount: %r" % bad)
+        if SCRATCH_PAGE in free_set or SCRATCH_PAGE in self._refs:
             raise MXNetError("scratch page leaked into the pool")
         usable = self.num_pages - 1
-        if len(free_set) + len(self._allocated) != usable:
+        if len(free_set) + len(self._refs) != usable:
             raise MXNetError(
                 "page conservation violated: %d free + %d allocated != "
-                "%d usable" % (len(free_set), len(self._allocated),
-                               usable))
+                "%d usable" % (len(free_set), len(self._refs), usable))
         return True

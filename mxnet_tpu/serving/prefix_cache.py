@@ -1,0 +1,240 @@
+"""Content-keyed refcounted prefix index over the paged KV cache.
+
+System-prompt-heavy traffic re-prefills and re-stores identical KV
+pages for every request.  This index turns those pages into SHARED
+storage (ISSUE 15, the vLLM automatic-prefix-caching move on the
+arXiv 2604.15464 page model): after a request's prefill lands, its
+prompt's full pages are registered here under their content keys — the
+token-id prefix at page granularity — and a later prompt is matched
+against the index at admission:
+
+- the longest PAGE-ALIGNED cached prefix is mapped straight into the
+  new request's block table (``PagedKVAllocator.retain`` — the pages
+  are never copied, never re-prefilled, never re-stored);
+- one further page can be shared PARTIALLY — the new prompt diverges
+  (or simply ends) mid-page — via **copy-on-write**: the engine's
+  prefill program copies that physical page into a freshly-owned one
+  first, so the request can write its own suffix tokens into the copy
+  while the donor page stays immutable for everyone else;
+- the remaining suffix (always >= 1 token — the last prompt position
+  must run through the model to produce the first output token) is the
+  only part that prefills.
+
+The index holds ONE allocator reference per cached page (`retain` at
+insert), so cached pages survive their originating request; eviction —
+LRU, leaf-first, driven by admission pressure or the
+``serve.prefix.evict`` fault drill — drops that reference, and the
+allocator frees the page once no running request maps it either.
+
+Trie nodes key their children by the page's exact token tuple, so
+matching is exact by construction — no hash, no collision class that
+could alias two different histories.
+
+Pure host-side bookkeeping; nothing here touches jax.
+"""
+from __future__ import annotations
+
+import itertools
+
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    __slots__ = ("tokens", "page", "parent", "children", "last_used")
+
+    def __init__(self, tokens, page, parent):
+        self.tokens = tokens          # tuple of ints, exactly page_size
+        self.page = int(page)         # physical page id (one ref held)
+        self.parent = parent          # _Node or None (root child)
+        self.children = {}            # token tuple -> _Node
+        self.last_used = 0
+
+
+class PrefixCache:
+    """The prefix trie + its allocator refs.  Owned by the engine,
+    consulted by the scheduler at admission, inserted into by the
+    engine after each SUCCESSFUL prefill (a failed prefill registers
+    nothing — the index only ever names pages whose contents landed)."""
+
+    def __init__(self, alloc):
+        self.alloc = alloc
+        self.page_size = alloc.page_size
+        self._children = {}           # root: token tuple -> _Node
+        self._clock = itertools.count(1)
+        self._nodes = 0
+
+    # -- views -------------------------------------------------------------
+    @property
+    def cached_pages(self):
+        return self._nodes
+
+    def _walk(self):
+        stack = list(self._children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            yield node
+
+    # -- match -------------------------------------------------------------
+    def match(self, prompt):
+        """Longest cached prefix of ``prompt`` (1-d int tokens).
+
+        Returns ``(path, partial, overlap)``: ``path`` is the list of
+        matched full-page nodes (page-aligned prefix, possibly empty),
+        ``partial`` one further node sharing ``overlap >= 1`` leading
+        tokens with the prompt's next page (COW candidate; None when no
+        such node or the prompt ends exactly at the aligned boundary).
+        Touches every matched node's LRU clock."""
+        ps = self.page_size
+        toks = [int(t) for t in prompt]
+        path = []
+        children = self._children
+        i = 0
+        while i + ps <= len(toks):
+            node = children.get(tuple(toks[i:i + ps]))
+            if node is None:
+                break
+            path.append(node)
+            children = node.children
+            i += ps
+        partial, overlap = None, 0
+        rem = toks[i:]
+        if rem:
+            for node in children.values():
+                n = 0
+                for a, b in zip(node.tokens, rem):
+                    if a != b:
+                        break
+                    n += 1
+                if n > overlap:
+                    partial, overlap = node, n
+        now = next(self._clock)
+        for node in path:
+            node.last_used = now
+        if partial is not None:
+            partial.last_used = now
+        return path, partial, overlap
+
+    # -- insert ------------------------------------------------------------
+    def insert(self, prompt, block_row):
+        """Register ``prompt``'s full pages (``len(prompt) //
+        page_size`` of them — a partial final page is still being
+        written by the request's own decode, so it is never shared)
+        under their content keys, pinning each NEWLY-registered page
+        with one allocator reference.  ``block_row`` maps logical page
+        index -> physical page for this request.  Idempotent along
+        already-cached prefixes (shared pages are not re-registered).
+        Returns the number of new entries."""
+        ps = self.page_size
+        toks = [int(t) for t in prompt]
+        full = len(toks) // ps
+        children = self._children
+        parent = None
+        now = next(self._clock)
+        added = 0
+        for j in range(full):
+            key = tuple(toks[j * ps:(j + 1) * ps])
+            node = children.get(key)
+            if node is None:
+                page = int(block_row[j])
+                self.alloc.retain([page])
+                node = _Node(key, page, parent)
+                children[key] = node
+                self._nodes += 1
+                added += 1
+            node.last_used = now
+            parent = node
+            children = node.children
+        if added:
+            _telemetry.gauge("serving.prefix.cached_pages").set(
+                self._nodes)
+        return added
+
+    # -- eviction ----------------------------------------------------------
+    def _drop(self, node):
+        """Remove one LEAF node: release the index's page reference
+        (the allocator frees the page once no running request maps it)
+        and unlink it from its parent.  EVERY eviction path — admission
+        pressure, the ``serve.prefix.evict`` drill, hot-swap, drain —
+        funnels through here, so the eviction counter and the
+        cached-pages gauge are stamped in exactly one place."""
+        if node.children:
+            raise MXNetError("prefix-cache eviction of a non-leaf node")
+        self.alloc.release([node.page])
+        siblings = (node.parent.children if node.parent is not None
+                    else self._children)
+        del siblings[node.tokens]
+        self._nodes -= 1
+        _telemetry.counter("serving.prefix.evictions").inc()
+        _telemetry.gauge("serving.prefix.cached_pages").set(self._nodes)
+
+    def evict_for(self, need):
+        """Free cached pages (LRU, leaf-first) until the allocator can
+        reserve ``need`` pages or nothing evictable remains.  Returns
+        the number of entries dropped.  Dropping an entry whose page a
+        running request still maps releases only the index's reference
+        — the page stays allocated, so eviction keeps going.  One trie
+        walk + a heap: a parent becomes a candidate the moment its last
+        child is dropped (never re-walks the whole trie per drop)."""
+        import heapq
+        if self.alloc.can_reserve(need):
+            return 0
+        tiebreak = itertools.count()
+        heap = [(n.last_used, next(tiebreak), n) for n in self._walk()
+                if not n.children]
+        heapq.heapify(heap)
+        dropped = 0
+        while heap and not self.alloc.can_reserve(need):
+            _, _, node = heapq.heappop(heap)
+            parent = node.parent
+            self._drop(node)
+            dropped += 1
+            if parent is not None and not parent.children:
+                heapq.heappush(heap,
+                               (parent.last_used, next(tiebreak),
+                                parent))
+        return dropped
+
+    def evict_all(self):
+        """Drop every entry (the ``serve.prefix.evict`` fault drill:
+        a victim request must fall back to a full prefill with correct
+        tokens; also the hot-swap/drain invalidation).  One walk,
+        children dropped before their parents.  Returns the number of
+        entries dropped."""
+        nodes = list(self._walk())
+        # depth-sort descending so every node is a leaf when dropped
+        depth = {}
+        for n in nodes:
+            d, p = 0, n.parent
+            while p is not None:
+                d += 1
+                p = p.parent
+            depth[id(n)] = d
+        for n in sorted(nodes, key=lambda n: -depth[id(n)]):
+            self._drop(n)
+        return len(nodes)
+
+    # -- invariants --------------------------------------------------------
+    def assert_consistent(self):
+        """Every cached entry's page must be live in the allocator (the
+        index holds a reference, so a cached page can never be on the
+        free list) and node accounting must agree."""
+        seen = 0
+        for node in self._walk():
+            seen += 1
+            if self.alloc.refcount(node.page) < 1:
+                raise MXNetError(
+                    "prefix cache names page %d which the allocator "
+                    "does not hold allocated" % node.page)
+            if len(node.tokens) != self.page_size:
+                raise MXNetError(
+                    "prefix cache node with %d tokens != page_size %d"
+                    % (len(node.tokens), self.page_size))
+        if seen != self._nodes:
+            raise MXNetError(
+                "prefix cache node accounting drifted: walked %d, "
+                "counted %d" % (seen, self._nodes))
+        return True

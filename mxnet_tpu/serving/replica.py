@@ -111,13 +111,17 @@ class CheckpointSubscriber:
         for name, val in snapshot.items():
             params[name].set_data(val)
 
-    def load_params(self, epoch):
+    def load_params(self, epoch, engine=None):
         """Load epoch's verified params into the replica's net and
         return the fresh decode-param tree for
         ``ServingEngine.swap_params``.  The manager's load path drains
         async writers and re-validates the manifest, so a torn file can
         never reach the tree build; the engine's canary is the last line
-        (bit-rot between verification and read, ``serve.swap.torn``)."""
+        (bit-rot between verification and read, ``serve.swap.torn``).
+        ``engine``: build the tree in THAT engine's configuration
+        (``params_from_net`` applies its GQA head pooling — a
+        kv_heads-reduced engine would otherwise reject every swap for
+        shape mismatch)."""
         from ..gluon.model_zoo import gpt as _gpt
         _epoch, arg_params, _aux = self._mgr.load(epoch)
         params = dict(self._net.collect_params().items())
@@ -129,7 +133,8 @@ class CheckpointSubscriber:
         for name, val in arg_params.items():
             if name in params:
                 params[name].set_data(val)
-        tree = _gpt.decode_params(self._net)
+        tree = (engine.params_from_net(self._net) if engine is not None
+                else _gpt.decode_params(self._net))
         if _fault.trigger("serve.swap.torn"):
             # bit-rot between manifest verification and the read — the
             # canary (finite-logits decode) must catch it and roll back
@@ -160,11 +165,12 @@ class ServingReplica:
         self._steps = 0
 
     # -- request plane -----------------------------------------------------
-    def submit(self, prompt, max_new, deadline_s=None, trace=None):
+    def submit(self, prompt, max_new, deadline_s=None, trace=None,
+               sampling=None):
         if not self.alive:
             raise ReplicaLost("replica %s is dead" % self.replica_id)
         return self.engine.submit(prompt, max_new, deadline_s=deadline_s,
-                                  trace=trace)
+                                  trace=trace, sampling=sampling)
 
     def step(self):
         """One serving iteration, replica-flavored: the loss fault site,
@@ -228,7 +234,7 @@ class ServingReplica:
         snap = sub.snapshot_params()
         try:
             with _telemetry.span("serving.swap", cat="serving"):
-                params = sub.load_params(epoch)
+                params = sub.load_params(epoch, engine=self.engine)
                 self.engine.swap_params(params, epoch=epoch)
         except Exception as e:
             # BOTH halves roll back: the engine restored its tree
@@ -294,6 +300,11 @@ class ServingReplica:
                 "drain did not complete in %d steps (queue %d, "
                 "residents %d)" % (max_steps, self.engine.sched.queued,
                                    self.engine.sched.occupancy))
+        # the prefix index's pins are deliberate (cached prompts), not
+        # leaks: a draining replica serves nobody else, so drop them
+        # before the zero-pages audit — anything left after THAT is a
+        # genuine reservation leak
+        self.engine.drop_prefix_cache()
         if self.engine.alloc.used_pages:
             raise MXNetError(
                 "drain finished with %d pages still allocated — a "

@@ -7,9 +7,15 @@ least-loaded live replica.  The survivability contract:
 - **zero dropped accepted requests** — a replica dying mid-decode
   (:class:`~mxnet_tpu.serving.replica.ReplicaLost`, e.g. the
   ``serve.replica.lost`` drill) fails its incomplete requests over to a
-  live replica; greedy decode is deterministic, so the re-run produces
-  bit-identical tokens and the caller never observes the failover
-  beyond latency;
+  live replica; decode is per-request deterministic (greedy argmax, or
+  the seeded per-request sampling law), so the re-run reproduces the
+  victim's tokens and the caller never observes the failover beyond
+  latency.  Honest caveat (SERVING.md §2b): a survivor whose
+  prefix-cache state differs from the victim's computes first-token
+  logits through a different float program (suffix vs dense prefill,
+  ~1-ulp apart); token equality across cache states is an empirical
+  robustness property pinned by the seeded drills, not an algebraic
+  identity;
 - **at-most-once decode** — the journal is the authority: a request
   recorded ``completed`` is NEVER re-executed, even when the replica it
   ran on dies later; a mid-flight victim's partial tokens are discarded
@@ -61,7 +67,7 @@ from .. import telemetry as _telemetry
 from ..base import MXNetError
 from .replica import ReplicaLost
 from .scheduler import (EXPIRED, FAILED, FINISHED, REJECTED, SHED,
-                        VERDICT_REJECTED)
+                        SamplingParams, VERDICT_REJECTED)
 
 __all__ = ["Router", "RouterRequest"]
 
@@ -88,7 +94,7 @@ class RouterRequest:
 
     __slots__ = ("rid", "prompt", "max_new", "deadline_s", "deadline_t",
                  "state", "verdict", "error", "tokens", "replica_id",
-                 "retries", "trace", "_live", "_home")
+                 "retries", "trace", "sampling", "_live", "_home")
 
     def __init__(self, rid, prompt, max_new, deadline_s):
         self.rid = rid
@@ -107,6 +113,10 @@ class RouterRequest:
         self.replica_id = None  # journal/display only — never identity
         self.retries = 0
         self.trace = None       # request-scope trace id (router-minted)
+        self.sampling = None    # per-request SamplingParams (or None);
+                                # a failover re-placement carries the
+                                # SAME params + seed, so the re-decode
+                                # is bit-identical (determinism law)
         self._live = None      # the engine Request currently decoding
         self._home = None      # the replica OBJECT it decodes on (ids
                                # are caller-supplied and may collide)
@@ -242,10 +252,16 @@ class Router:
     def _gauge_live(self):
         _telemetry.gauge("router.live_replicas").set(len(self._live()))
 
-    def submit(self, prompt, max_new, deadline_s=None):
+    def submit(self, prompt, max_new, deadline_s=None, sampling=None):
         """Journal a request and place it.  The handle is terminal
         immediately when every live replica refused (typed verdict
         propagated) or none exist — fail fast, never a silent hang.
+
+        ``sampling``: per-request :class:`SamplingParams` (or dict),
+        carried through every placement INCLUDING failover re-decodes —
+        the per-request determinism law (same seed/params/prompt ->
+        same tokens) is what keeps the at-most-once journal sound for
+        sampled requests exactly as for greedy ones.
 
         The request-scope trace id is minted HERE (the fleet
         front-door): every engine it touches — the first placement, a
@@ -253,6 +269,7 @@ class Router:
         lifecycle events under this one id."""
         rr = RouterRequest(self._next_rid, prompt, max_new, deadline_s)
         rr.trace = _telemetry.mint_trace()
+        rr.sampling = SamplingParams.from_doc(sampling)
         self._next_rid += 1
         self._prune_journal()
         self._journal[rr.rid] = rr
@@ -261,7 +278,9 @@ class Router:
             rr.trace, "submit",
             args={"router": True, "rid": rr.rid,
                   "prompt_len": int(_np_size(prompt)),
-                  "max_new": int(max_new), "deadline_s": deadline_s})
+                  "max_new": int(max_new), "deadline_s": deadline_s,
+                  "sampling": (None if rr.sampling is None
+                               else rr.sampling.to_doc())})
         self._place(rr)
         return rr
 
@@ -329,10 +348,15 @@ class Router:
         remaining = (None if rr.deadline_t is None
                      else rr.deadline_t - time.perf_counter())
         refusal = None
+        # sampling is passed only when set: duck-typed replicas (test
+        # stubs, older proxies) that predate per-request sampling keep
+        # working for the greedy default
+        kw = {} if rr.sampling is None else {"sampling": rr.sampling}
         for r in candidates:
             try:
                 req = r.submit(rr.prompt, rr.max_new,
-                               deadline_s=remaining, trace=rr.trace)
+                               deadline_s=remaining, trace=rr.trace,
+                               **kw)
             except ReplicaLost:
                 continue
             except ValueError as e:

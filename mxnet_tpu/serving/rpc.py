@@ -602,12 +602,17 @@ class RpcServer:
             _telemetry.counter("rpc.dedup_hits").inc()
             return {"ok": True, "dedup": True,
                     "request": _req_doc(self._journal[key])}
+        # sampling forwarded only when set: duck-typed replicas (test
+        # stubs) that predate per-request sampling keep working for the
+        # greedy default
+        kw = {} if msg.get("sampling") is None \
+            else {"sampling": msg["sampling"]}
         try:
             req = self.replica.submit(
                 _np.asarray(msg["prompt"], _np.int32),
                 int(msg["max_new"]),
                 deadline_s=msg.get("deadline_s"),
-                trace=msg.get("trace"))
+                trace=msg.get("trace"), **kw)
         except ValueError as e:
             return {"ok": False, "error_type": "ValueError",
                     "error": str(e)}
@@ -837,7 +842,8 @@ class RpcReplicaProxy:
             return True
         return bool(self._status.get("idle", True))
 
-    def submit(self, prompt, max_new, deadline_s=None, trace=None):
+    def submit(self, prompt, max_new, deadline_s=None, trace=None,
+               sampling=None):
         if not self.alive:
             raise ReplicaLost("replica %s is dead" % self.replica_id)
         # argument conversion BEFORE the breaker check: a malformed
@@ -860,7 +866,10 @@ class RpcReplicaProxy:
             else time.monotonic() + max(0.05, float(deadline_s))
         msg = {"method": "submit", "key": key, "trace": trace,
                "prompt": [int(t) for t in prompt],
-               "max_new": int(max_new), "deadline_s": deadline_s}
+               "max_new": int(max_new), "deadline_s": deadline_s,
+               "sampling": (sampling.to_doc()
+                            if hasattr(sampling, "to_doc")
+                            else sampling)}
         try:
             addr = self._resolve()
             reply = rpc_call(addr, msg, self._timeout_s,

@@ -33,7 +33,7 @@ import numpy as _np
 
 from .kv_cache import PagedKVAllocator, SCRATCH_PAGE
 
-__all__ = ["Request", "ContinuousBatchingScheduler"]
+__all__ = ["Request", "SamplingParams", "ContinuousBatchingScheduler"]
 
 #: request lifecycle states.  FINISHED/REJECTED/EXPIRED/FAILED/SHED are
 #: terminal; every terminal request carries a typed ``verdict`` (and an
@@ -54,6 +54,63 @@ VERDICT_REJECTED = "rejected_infeasible"       # can never run here
 VERDICT_PREFILL_ERROR = "prefill_error"        # admission dispatch failed
 
 
+class SamplingParams:
+    """Per-request decode sampling (ISSUE 15): ``temperature <= 0`` is
+    greedy argmax (bit-identical to the sampling-free engine);
+    otherwise tokens are drawn from the temperature-scaled, top-k-
+    and/or nucleus-filtered distribution with a PRNG keyed by ``seed``
+    and advanced functionally per token — so the SAME (seed, params,
+    prompt) always yields the SAME tokens, regardless of batch
+    composition, join/leave, hot-swap, or a failover re-decode (the
+    per-request determinism law, test-pinned).  These are ordinary
+    decode-program INPUTS (a per-slot array), never a recompile."""
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature=None, top_k=0, top_p=0.0, seed=0):
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        if temperature is None:
+            # a filter knob with NO temperature means temperature 1.0:
+            # temp 0 would silently argmax past the caller's filter.
+            # An EXPLICIT temperature=0 still wins (greedy).  Same rule
+            # for every configuration path — constructor, dict/RPC
+            # docs, and the MXTPU_SERVE_* env defaults.
+            temperature = 1.0 if (self.top_k or self.top_p) else 0.0
+        self.temperature = float(temperature)
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+        if not 0.0 <= self.top_p <= 1.0:
+            raise ValueError("top_p must be in [0, 1]")
+
+    @property
+    def greedy(self):
+        return self.temperature <= 0.0
+
+    def to_doc(self):
+        """JSON-able form (the RPC/journal wire format)."""
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed}
+
+    @classmethod
+    def from_doc(cls, doc):
+        """Accepts None, an existing instance, or a dict."""
+        if doc is None or isinstance(doc, cls):
+            return doc
+        return cls(temperature=doc.get("temperature"),
+                   top_k=doc.get("top_k", 0),
+                   top_p=doc.get("top_p", 0.0),
+                   seed=doc.get("seed", 0))
+
+    def __repr__(self):
+        return ("SamplingParams(temperature=%g, top_k=%d, top_p=%g, "
+                "seed=%d)" % (self.temperature, self.top_k, self.top_p,
+                              self.seed))
+
+
 class Request:
     """One inference request: a prompt plus a decode budget, an optional
     deadline, and the latency stamps the serving histograms are built
@@ -65,7 +122,8 @@ class Request:
                  "first_token_t", "finish_t", "tokens", "state", "slot",
                  "pages", "logits_trace", "token_times", "deadline_s",
                  "deadline_t", "verdict", "error", "trace",
-                 "trace_owned")
+                 "trace_owned", "sampling", "prefix_len",
+                 "shared_count", "cow_src", "cow_dst")
 
     def __init__(self, rid, prompt, max_new, deadline_s=None):
         self.rid = rid
@@ -98,6 +156,17 @@ class Request:
         # final; False — the Router owns fleet-level terminality.
         self.trace = None
         self.trace_owned = True
+        # per-request sampling (ISSUE 15; None = greedy argmax)
+        self.sampling = None
+        # prefix-cache placement facts, stamped at admission:
+        # ``prefix_len`` tokens of the prompt whose K/V was already
+        # cached (0 = miss), ``shared_count`` whole pages mapped
+        # shared, ``cow_src``/``cow_dst`` the copy-on-write pair (None
+        # when the shared prefix ends on a page boundary)
+        self.prefix_len = 0
+        self.shared_count = 0
+        self.cow_src = None
+        self.cow_dst = None
 
     @property
     def done(self):
@@ -129,13 +198,17 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, num_slots, allocator, max_pages_per_seq,
-                 max_seq_len=None):
+                 max_seq_len=None, prefix_cache=None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if not isinstance(allocator, PagedKVAllocator):
             raise TypeError("allocator must be a PagedKVAllocator")
         self.num_slots = int(num_slots)
         self.alloc = allocator
+        #: optional serving.prefix_cache.PrefixCache — admission matches
+        #: each prompt's longest cached prefix and maps the shared pages
+        #: into the block table instead of allocating + re-prefilling
+        self.prefix = prefix_cache
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.max_seq_len = (int(max_seq_len) if max_seq_len is not None
                             else self.max_pages_per_seq
@@ -256,29 +329,89 @@ class ContinuousBatchingScheduler:
         return time.perf_counter() - self._queue[0].submit_t
 
     # -- placement ---------------------------------------------------------
+    def _match_prefix(self, head):
+        """Consult the prefix cache for the queue head: returns
+        ``(shared_nodes, cow_node, prefix_len)``.  The shared prefix is
+        capped at ``prompt - 1`` tokens — the LAST prompt position must
+        run through the model to produce the first output token, so a
+        fully-cached prompt still prefills (at least) one token; the
+        cap can turn the final shared page into a copy-on-write
+        partial."""
+        ps = self.alloc.page_size
+        path, partial, overlap = self.prefix.match(head.prompt)
+        prefix_len = min(len(path) * ps + overlap,
+                         int(head.prompt.size) - 1)
+        m, o = prefix_len // ps, prefix_len % ps
+        cow = None
+        if o > 0:
+            cow = path[m] if m < len(path) else partial
+        return path[:m], cow, prefix_len
+
     def admit(self):
         """Move queued requests into free slots while both a slot AND
         the worst-case page reservation are available (FIFO; stops at
-        the first request that doesn't fit — no reordering).  Returns
-        the newly-placed requests; the engine prefills each."""
+        the first request that doesn't fit — no reordering).  With a
+        prefix cache, the reservation counts ONLY un-shared pages
+        (shared prefix pages are mapped by reference), and admission
+        pressure evicts LRU cache entries before giving up.  Returns
+        the newly-placed requests; the engine prefills each (suffix
+        only, on a hit)."""
         placed = []
         while self._queue:
             slot = self._free_slot()
             if slot is None:
                 break
             head = self._queue[0]
-            need = self.alloc.pages_for(head.prompt.size + head.max_new)
-            if not self.alloc.can_reserve(need):
-                break  # OOM-aware admission: wait, don't evict
+            total = self.alloc.pages_for(head.prompt.size + head.max_new)
+            # match + reserve, re-matching after every eviction round:
+            # evict_for may drop the very nodes just matched (freeing
+            # their pages), and acting on that stale match would retain
+            # a freed/re-allocated page — the match must describe the
+            # index as it stands when pages are taken.  Terminates:
+            # each round either reserves or shrinks the cache by >= 1.
+            while True:
+                shared_nodes, cow, prefix_len = ([], None, 0)
+                if self.prefix is not None:
+                    shared_nodes, cow, prefix_len = \
+                        self._match_prefix(head)
+                need = total - len(shared_nodes)
+                if self.alloc.can_reserve(need):
+                    break
+                # cached-but-idle pages are the one reclaimable reserve
+                # (LRU leaves first).  A page some resident still maps
+                # is only un-pinned, not freed.
+                if self.prefix is None or \
+                        self.prefix.evict_for(need) == 0:
+                    shared_nodes = None
+                    break
+            if shared_nodes is None:
+                break  # OOM-aware admission: wait, don't evict residents
             self._queue.popleft()
-            head.pages = self.alloc.allocate(need)
+            owned = self.alloc.allocate(need)
+            shared = [n.page for n in shared_nodes]
+            if shared:
+                self.alloc.retain(shared)
+            head.pages = shared + owned
+            head.prefix_len = prefix_len
+            head.shared_count = len(shared)
+            if cow is not None:
+                # the request holds a reference on the DONOR page too:
+                # an eviction between admission and the prefill dispatch
+                # must not free the page the copy-on-write reads from
+                self.alloc.retain([cow.page])
+                head.pages = head.pages + [cow.page]
+                head.cow_src = cow.page
+                head.cow_dst = owned[0]
+            else:
+                head.cow_src = head.cow_dst = None
             head.slot = slot
             head.admit_t = time.perf_counter()
             head.state = RUNNING
             self._slots[slot] = head
             row = self.block_tables[slot]
             row[:] = SCRATCH_PAGE
-            row[:len(head.pages)] = head.pages
+            row[:len(shared)] = shared
+            row[len(shared):len(shared) + len(owned)] = owned
             placed.append(head)
         return placed
 

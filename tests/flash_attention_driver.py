@@ -305,15 +305,24 @@ def check_fused_backward():
 
 if __name__ == "__main__":
     jax.config.update("jax_default_matmul_precision", "float32")
-    check_forward()
-    check_cross_attention()
-    check_grads()
-    check_jit_odd_lengths()
-    check_grads_odd_lengths()
-    check_ring_flash()
-    check_op_and_layer_flash()
-    check_fused_backward()
-    check_fused_chunked()
-    check_segment_packing()
-    check_ring_segments()
-    print("FLASH_OK backend=%s" % jax.default_backend())
+    # two tiers (the PR-7 fast-sibling pattern, re-applied when the
+    # tier-1 wall crowded the 870 s budget): `core` covers every kernel
+    # entry point + the grad oracle in ~25 s; `extended` is the
+    # exhaustive ring / fused-backward / chunked-budget sweep (~160 s,
+    # driven by the slow test).
+    section = sys.argv[1] if len(sys.argv) > 1 else "core"
+    if section in ("core", "all"):
+        check_forward()
+        check_cross_attention()
+        check_grads()
+        check_jit_odd_lengths()
+        check_grads_odd_lengths()
+        check_op_and_layer_flash()
+        check_segment_packing()
+        print("FLASH_OK backend=%s" % jax.default_backend())
+    if section in ("extended", "all"):
+        check_ring_flash()
+        check_fused_backward()
+        check_fused_chunked()
+        check_ring_segments()
+        print("FLASH_EXTENDED_OK backend=%s" % jax.default_backend())

@@ -45,6 +45,17 @@ def _engine(net, **over):
     return ServingEngine(net, **kw)
 
 
+def _idle_pages_ok(eng):
+    """Idle-engine page accounting: no leaks beyond the prefix index's
+    own pins, conservation + index consistency intact."""
+    eng.alloc.assert_conservation()
+    cached = 0 if eng._prefix is None else eng._prefix.cached_pages
+    assert eng.alloc.used_pages == cached, \
+        (eng.alloc.used_pages, cached)
+    if eng._prefix is not None:
+        eng._prefix.assert_consistent()
+
+
 def _net(seed=0):
     np.random.seed(seed)
     mx.random.seed(seed)
@@ -141,7 +152,7 @@ def check_prefill_error(net):
         eng.alloc.assert_conservation()
         eng.run_until_idle()
         assert rb.tokens == _ref(net, pb, 4)
-        assert eng.alloc.used_pages == 0
+        _idle_pages_ok(eng)
         assert telemetry.counter("serving.prefill_errors").value >= 1
     finally:
         fault.reset()
@@ -169,8 +180,7 @@ def check_drain(net):
     # zero dropped ACCEPTED requests: queued-but-unadmitted ones finish too
     assert all(r.verdict == "completed" and len(r.tokens) == 5
                for r in accepted)
-    assert eng.alloc.used_pages == 0
-    eng.alloc.assert_conservation()
+    _idle_pages_ok(eng)
     assert not rep.alive
     hb = rep.health()
     assert hb["engine"]["draining"] and hb["engine"]["occupancy"] == 0
@@ -247,8 +257,7 @@ def section_router(net=None):
     assert spawn_compiles == [0], spawn_compiles
     for rep in rt._replicas:
         if rep.alive:
-            rep.engine.alloc.assert_conservation()
-            assert rep.engine.alloc.used_pages == 0
+            _idle_pages_ok(rep.engine)
     print("SERVING_ROUTER_OK")
 
 
@@ -334,7 +343,132 @@ def section_swap(net=None):
         "net still holds the torn epoch after rollback"
     assert all(np.isfinite(t) for t in r3.tokens)
     rep.engine.alloc.assert_conservation()
+
+    # ISSUE 15: a SUCCESSFUL swap must evict the prefix cache — its
+    # pages hold K/V computed under the old weights, and a post-swap
+    # hit would splice stale activations into a new-weights decode.
+    # probe2 is >= one full page, so its prefix caches.
+    probe2 = rng.randint(0, VOCAB, (10,)).astype(np.int32)
+    assert rep.engine.generate([probe2], 6)[0] == _ref(net, probe2, 6)
+    assert rep.engine._prefix.cached_pages >= 1
+    _publish(mgr, net, 5, perturb=5)
+    r5 = rep.submit(probe2, 6)
+    while not r5.done:
+        rep.step()
+    assert sub.applied_epoch == 5
+    ref5 = _ref(net, probe2, 6)          # net now holds epoch 5
+    assert r5.tokens == ref5, \
+        "post-swap decode served the prefix cache's stale pre-swap K/V"
+    # (and the rolled-back torn swap above did NOT evict: the cache
+    # stays valid for the weights actually serving)
     print("SERVING_SWAP_OK")
+
+
+# -- per-request determinism law (ISSUE 15) --------------------------------
+
+def section_sampling(net=None):
+    """The per-request determinism law: same (seed, sampling params,
+    prompt) -> same tokens, regardless of batch composition, across a
+    join/leave, and across a router failover re-decode.  Greedy
+    requests in a sampled batch still match the dense reference."""
+    from mxnet_tpu.serving import Router, SamplingParams, ServingReplica
+    net = net or _net()
+    rng = np.random.RandomState(11)
+    prompts = _prompts(rng, 5)
+    samps = [SamplingParams(temperature=0.8, top_k=24, seed=100 + i)
+             for i in range(3)] + [None,
+                                   SamplingParams(temperature=0.6,
+                                                  top_p=0.9, seed=55)]
+    # solo references: each request decoded ALONE (occupancy 1)
+    solo = _engine(net)
+    refs = []
+    for p, s in zip(prompts, samps):
+        refs.append(solo.generate([p], 6, sampling=s)[0])
+
+    # (a) different batch composition + join/leave churn: all five
+    # resident together, joining over successive steps
+    churn = _engine(net)
+    handles = []
+    for i, (p, s) in enumerate(zip(prompts, samps)):
+        handles.append(churn.submit(p, 6, sampling=s))
+        churn.step()                   # staggered joins; finishers leave
+    churn.run_until_idle()
+    for h, ref in zip(handles, refs):
+        assert h.tokens == ref, (h.tokens, ref)
+    # the greedy request equals the dense reference too
+    assert handles[3].tokens == _ref(net, prompts[3], 6)
+    _idle_pages_ok(churn)
+
+    # (b) failover re-decode: a replica dies mid-decode; the survivor
+    # re-decodes the victims BIT-identically (the at-most-once journal
+    # stays sound for sampled requests exactly as for greedy)
+    reps = [ServingReplica(_engine(net), replica_id="sa"),
+            ServingReplica(_engine(net), replica_id="sb")]
+    rt = Router(reps, max_retries=2)
+    rrs = [rt.submit(p, 6, sampling=s)
+           for p, s in zip(prompts, samps)]
+    rt.step()
+    fault.configure("serve.replica.lost:1")
+    try:
+        rt.run_until_idle()
+    finally:
+        fault.reset()
+    assert rt.failovers == 1
+    for rr, ref in zip(rrs, refs):
+        assert rr.state == "completed", (rr.rid, rr.state)
+        assert rr.tokens == ref, (rr.rid, rr.tokens, ref)
+    assert telemetry.counter("serving.sampling.requests").value > 0
+    # sanity: sampling actually samples (a hot temperature diverges
+    # from greedy for at least one request — not vacuous)
+    greedy_refs = [_ref(net, p, 6) for p in prompts[:3]]
+    assert any(refs[i] != greedy_refs[i] for i in range(3)), \
+        "sampled tokens identical to greedy — sampling is vacuous"
+    print("SERVING_SAMPLING_OK")
+    return net
+
+
+# -- prefix-cache eviction drill (ISSUE 15) --------------------------------
+
+def section_prefix_evict(net=None):
+    """``serve.prefix.evict`` force-drops the cached prefix index
+    between steps: the victim request falls back to a FULL prefill with
+    correct tokens — the cache is a capacity optimization, never a
+    correctness dependency."""
+    net = net or _net()
+    rng = np.random.RandomState(12)
+    sysp = rng.randint(0, VOCAB, (8,)).astype(np.int32)   # one full page
+    pa = np.concatenate([sysp, rng.randint(0, VOCAB, (3,))
+                         .astype(np.int32)])
+    pb = np.concatenate([sysp, rng.randint(0, VOCAB, (5,))
+                         .astype(np.int32)])
+    eng = _engine(net)
+    assert eng._prefix is not None, "prefix cache should default ON"
+    ra = eng.generate([pa], 4)[0]
+    assert ra == _ref(net, pa, 4)
+    assert eng._prefix.cached_pages >= 1
+    hits0 = telemetry.counter("serving.prefix.hits").value
+    fault.configure("serve.prefix.evict:1")
+    try:
+        rb = eng.submit(pb, 4)
+        eng.run_until_idle()
+        fired = fault.fire_count("serve.prefix.evict")
+    finally:
+        fault.reset()
+    assert fired == 1, fired
+    assert telemetry.counter("serving.prefix.evictions").value >= 1
+    # the victim MISSED (the index was dropped before its admission)
+    # and fell back to a full prefill with correct tokens
+    assert rb.prefix_len == 0 and rb.shared_count == 0
+    assert telemetry.counter("serving.prefix.hits").value == hits0
+    assert rb.tokens == _ref(net, pb, 4)
+    _idle_pages_ok(eng)
+    # and the cache re-warms: the same prompt now hits
+    rc = eng.submit(pb, 4)
+    eng.run_until_idle()
+    assert rc.prefix_len > 0 and rc.tokens == rb.tokens
+    _idle_pages_ok(eng)
+    print("SERVING_PREFIX_EVICT_OK")
+    return net
 
 
 # -- request-scope tracing laws (ISSUE 13) ---------------------------------
@@ -643,6 +777,10 @@ def main(section):
         section_router(net)
     if section in ("swap", "fast"):
         section_swap(net)
+    if section in ("sampling", "fast"):
+        net = section_sampling(net)
+    if section in ("prefix", "fast"):
+        section_prefix_evict(net)
     if section == "trace":
         section_trace()
     if section == "stall":

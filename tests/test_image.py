@@ -188,3 +188,52 @@ def test_det_flip_boxes():
     label = np.array([[0.0, 0.1, 0.2, 0.4, 0.6]], np.float32)
     _, out = aug(img, label.copy())
     assert np.allclose(out[0], [0.0, 0.6, 0.2, 0.9, 0.6])
+
+
+# -- streaming decode workers (ISSUE 15 satellite, ROADMAP item 5) ----------
+
+@pytest.mark.stream
+def test_stream_decode_batch_fn_matches_imageiter_bit_for_bit(
+        rec_file, tmp_path):
+    """The image pipeline through the streaming data plane's decode
+    worker pool (image.stream_decode_batch_fn -> StreamLoader) yields
+    batches BIT-IDENTICAL to the in-memory ImageIter over the same
+    records with the same (deterministic) augmenter chain — the decode
+    workers change where the work runs, never the numbers."""
+    from mxnet_tpu import stream
+    rec, idx = rec_file
+    data_shape = (3, 32, 32)
+    # deterministic members only: resize + center crop + cast +
+    # normalize (a rand_* augmenter would consume RNG in two different
+    # orders and the bit-for-bit contract would be vacuous)
+    augs = image.CreateAugmenter(data_shape, resize=40, mean=True,
+                                 std=True)
+
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    records = [reader.read_idx(i) for i in range(8)]
+    reader.close()
+
+    it = image.ImageIter(4, data_shape, path_imgrec=rec,
+                         path_imgidx=idx, aug_list=augs,
+                         last_batch_handle="discard")
+    ref = [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in it]
+    assert len(ref) == 2
+
+    w = stream.ShardSetWriter(str(tmp_path))
+    w.write_recordio_shard(records)
+    ss = stream.load_shard_set(os.path.join(str(tmp_path),
+                                            "shardset.json"))
+    ld = stream.StreamLoader(
+        ss, 4, decode_batch_fn=image.stream_decode_batch_fn(
+            data_shape, aug_list=augs),
+        epoch=0, rank=0, world_size=1, prefetch=0, num_workers=2,
+        last_batch="discard")
+    got = [(d.asnumpy(), lab.asnumpy()) for d, lab in ld]
+    ld.close()
+    assert len(got) == len(ref)
+    for (gd, gl), (rd, rl) in zip(got, ref):
+        assert gd.dtype == rd.dtype and gd.shape == rd.shape
+        assert gd.tobytes() == rd.tobytes(), \
+            "streaming image batch diverged from ImageIter bit-for-bit"
+        assert gl.astype(np.float32).tobytes() == \
+            rl.astype(np.float32).tobytes()

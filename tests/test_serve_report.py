@@ -203,10 +203,13 @@ def _synthetic_tree(tmp_path, torn_journal=True):
     tdir.mkdir(parents=True)
     evs = [
         _ev(0, 100.0, "T1", "submit", prompt_len=4, max_new=3,
-            router=True, rid=1),
+            router=True, rid=1,
+            sampling={"temperature": 0.8, "top_k": 20, "top_p": 0.0,
+                      "seed": 7}),
         _ev(1, 100.0, "T1", "place", replica="a"),
         _ev(2, 100.1, "T1", "admit", replica="a", slot=0,
-            queue_wait_s=0.1, pages=1),
+            queue_wait_s=0.1, pages=1, prefix_hit=True, prefix_len=3,
+            shared_pages=1),
         _ev(3, 100.1, "T1", "prefill", dispatch_s=0.02, sync_s=0.01),
         _ev(4, 100.13, "T1", "token"),
         _ev(5, 100.2, "", "swap", replica="a", ok=True, epoch=7,
@@ -226,14 +229,16 @@ def _synthetic_tree(tmp_path, torn_journal=True):
             router=True, rid=2),
         _ev(11, 100.05, "T2", "place", replica="a"),
         _ev(12, 100.35, "T2", "admit", replica="a", slot=1,
-            queue_wait_s=0.3, pages=1),
+            queue_wait_s=0.3, pages=1, prefix_hit=False, prefix_len=0,
+            shared_pages=0),
         _ev(13, 100.35, "T2", "prefill", dispatch_s=0.01, sync_s=0.0),
         # (T2's first token rides the step-7 batch above)
         _ev(14, 100.5, "T2", "retry", **{"from": "a", "retries": 1,
                                          "rid": 2}),
         _ev(15, 100.6, "T2", "place", replica="b"),
         _ev(16, 100.6, "T2", "admit", replica="b", slot=0,
-            queue_wait_s=0.0, pages=1),
+            queue_wait_s=0.0, pages=1, prefix_hit=True, prefix_len=4,
+            shared_pages=1),
         _ev(17, 100.6, "T2", "prefill", dispatch_s=0.01, sync_s=0.0),
         _ev(18, 100.7, "T2", "token"),
         _ev(19, 100.8, "", "tokens", replica="b", step=1,
@@ -312,6 +317,32 @@ def test_serve_report_reconstructs_lifecycles(tmp_path):
     # torn journal line skipped AND counted
     assert any("torn" in n for n in rep["data"]["notes"])
     assert len(rep["data"]["journal"]) == 3
+
+
+def test_serve_report_prefix_class_split(tmp_path):
+    """ISSUE 15: TTFT/queue-wait percentiles split by prefix hit/miss
+    class.  The class is the FIRST admission's (T2 missed on replica a;
+    its failover re-admission hitting on b must not flip it), and
+    never-admitted requests (T3) have no class."""
+    rep = serve_report.analyze(_synthetic_tree(tmp_path))
+    split = rep["prefix"]
+    assert set(split) == {"hit", "miss"}
+    assert split["hit"]["n"] == 1 and split["miss"]["n"] == 1
+    assert split["hit"]["mean_prefix_len"] == 3       # T1, not T2's b
+    assert split["hit"]["ttft_p50"] == 0.13
+    assert split["miss"]["ttft_p50"] == 0.3
+    assert split["miss"]["queue_p50"] == 0.3
+    assert split["hit"]["sampled"] == 1               # T1 sampled
+    assert split["miss"]["sampled"] == 0
+    reqs = rep["requests"]
+    assert reqs["T3"]["prefix_hit"] is None
+    assert reqs["T2"]["prefix_hit"] is False
+    assert reqs["T1"]["sampling"]["seed"] == 7
+    # the rendered report carries the table
+    import io
+    buf = io.StringIO()
+    serve_report.render(rep, out=buf)
+    assert "latency by prefix class" in buf.getvalue()
 
 
 def test_serve_report_arcs_and_blame(tmp_path):

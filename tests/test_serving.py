@@ -71,6 +71,89 @@ def test_allocator_oom_and_double_free():
         a.release([SCRATCH_PAGE])
 
 
+def test_allocator_refcounts_share_and_last_ref_frees():
+    """ISSUE 15 refcount laws: retain adds a reference, release drops
+    one, only the LAST release frees; conservation covers shared pages
+    and over-release raises."""
+    a = PagedKVAllocator(num_pages=6, page_size=4)
+    pages = a.allocate(2)
+    assert [a.refcount(p) for p in pages] == [1, 1]
+    assert a.shared_pages == 0
+    a.retain(pages)                       # a second sequence maps them
+    assert [a.refcount(p) for p in pages] == [2, 2]
+    assert a.shared_pages == 2
+    a.assert_conservation()
+    a.release(pages)                      # first reader leaves
+    assert [a.refcount(p) for p in pages] == [1, 1]
+    assert a.free_pages == 3 and a.used_pages == 2
+    a.release(pages)                      # last ref -> freed
+    assert a.free_pages == 5 and a.used_pages == 0
+    with pytest.raises(MXNetError, match="not allocated"):
+        a.release(pages)                  # over-release
+    with pytest.raises(MXNetError, match="not allocated"):
+        a.retain([pages[0]])              # retaining a free page
+    a.assert_conservation()
+
+
+def test_allocator_refcount_interleaved_conservation():
+    """Random retain/release churn over shared pages never leaks,
+    double-frees, or double-allocates (conservation with refcounts)."""
+    a = PagedKVAllocator(num_pages=9, page_size=2)
+    rng = np.random.RandomState(5)
+    owners = []                           # list of page-lists (refs)
+    for _ in range(120):
+        r = rng.rand()
+        if owners and r < 0.35:
+            a.release(owners.pop(rng.randint(len(owners))))
+        elif owners and r < 0.6:
+            share = owners[rng.randint(len(owners))]
+            a.retain(share)
+            owners.append(list(share))
+        elif a.free_pages >= 2:
+            owners.append(a.allocate(rng.randint(1, 3)))
+        a.assert_conservation()
+    for o in owners:
+        a.release(o)
+    assert a.free_pages == 8 and a.used_pages == 0
+    a.assert_conservation()
+
+
+def test_prefix_cache_match_insert_evict_host_side():
+    """PrefixCache trie laws without jax: page-aligned match, partial
+    (COW) match, LRU leaf eviction, index consistency."""
+    from mxnet_tpu.serving import PrefixCache
+    a = PagedKVAllocator(num_pages=12, page_size=4)
+    c = PrefixCache(a)
+    prompt = np.arange(10, dtype=np.int32)          # 2 full pages + 2
+    pages = a.allocate(3)
+    c.insert(prompt, pages)                          # caches 2 pages
+    assert c.cached_pages == 2
+    c.assert_consistent()
+    a.release(pages)                                 # request leaves
+    assert a.used_pages == 2                         # cache pins them
+    path, partial, overlap = c.match(prompt)
+    assert [n.page for n in path] == pages[:2]
+    assert partial is None and overlap == 0
+    # diverging prompt: full match on page 0, partial on page 1
+    div = np.array([0, 1, 2, 3, 4, 5, 99, 98], np.int32)
+    path, partial, overlap = c.match(div)
+    assert len(path) == 1 and partial is not None and overlap == 2
+    # no match at all
+    path, partial, overlap = c.match(np.full(8, 77, np.int32))
+    assert path == [] and partial is None
+    # eviction frees leaf-first and stops as soon as the reservation
+    # fits (never over-evicts)
+    assert not a.can_reserve(10)
+    dropped = c.evict_for(10)
+    assert dropped == 1 and a.can_reserve(10)
+    assert c.cached_pages == 1 and a.used_pages == 1
+    c.assert_consistent()
+    # evict_all drops the rest (the serve.prefix.evict drill's move)
+    assert c.evict_all() == 1
+    assert c.cached_pages == 0 and a.used_pages == 0
+    a.assert_conservation()
+
+
 # -- kernel + engine (clean subprocess, pallas-capable) --------------------
 
 def _run_driver(section):
@@ -95,12 +178,29 @@ def test_paged_attention_kernel():
 
 
 def test_serving_engine_invariants():
-    """Engine == dense generate at mixed lengths; EOS early-leave; slot
-    reuse leaks no stale KV; join/leave keeps resident logits
+    """Engine == dense generate at mixed lengths (greedy-vs-today
+    bit-identity, prefix cache at its default ON); EOS early-leave;
+    slot reuse leaks no stale KV; join/leave keeps resident logits
     bit-identical; OOM-aware admission queues and drains; exactly one
     dispatch per decode step with zero steady-state recompiles; serving
-    telemetry populated."""
-    assert "SERVING_ENGINE_OK" in _run_driver("engine")
+    telemetry populated.  Plus the fast ISSUE-15 siblings in the same
+    subprocess (AOT-memo-shared — no extra compiles): prefix sharing +
+    COW correctness vs the dense reference with refcount conservation,
+    and the per-request sampling laws (seeded reproducibility,
+    top_k=1 == greedy, per-slot isolation)."""
+    out = _run_driver("engine")
+    assert "SERVING_ENGINE_OK" in out
+    assert "SERVING_CAPACITY_FAST_OK" in out
+
+
+@pytest.mark.slow
+def test_serving_capacity_multipliers():
+    """ISSUE 15 compile-heavy engine laws (slow; fast siblings ride the
+    engine section): cache-off/cache-on greedy token identity, LRU
+    eviction under admission pressure, GQA join/leave bit-exactness,
+    and the >= 1.5x resident-capacity multiplier at K_kv = H/2 in the
+    same pool bytes."""
+    assert "SERVING_CAPACITY_OK" in _run_driver("capacity")
 
 
 # -- predictor satellite (no pallas needed) --------------------------------

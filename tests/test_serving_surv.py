@@ -52,6 +52,42 @@ def test_infeasible_reject_is_deterministic_and_reserves_nothing():
     a2.assert_conservation()
 
 
+def test_admit_rematches_prefix_after_eviction_drops_matched_nodes():
+    """ISSUE 15 regression (review finding): admission pressure can
+    evict the very prefix nodes the queue head just matched — the
+    match must be RE-RUN after eviction, or the scheduler would retain
+    a freed (possibly re-allocated) page as 'shared' while also
+    handing it out as an owned write target."""
+    from mxnet_tpu.serving import PrefixCache
+    a = PagedKVAllocator(6, 4)            # 5 usable pages
+    cache = PrefixCache(a)
+    s = ContinuousBatchingScheduler(2, a, 5, max_seq_len=20,
+                                    prefix_cache=cache)
+    prompt = np.arange(8, dtype=np.int32)   # 2 full pages
+    donor = a.allocate(2)
+    cache.insert(prompt, donor)
+    a.release(donor)                      # cache is now the only owner
+    assert a.used_pages == 2 and a.free_pages == 3
+    # head: same prompt, worst case 17 tokens = 5 pages.  The initial
+    # match is 1 shared + a COW donor (capped at prompt-1), need 4 > 3
+    # free -> evict_for drops the LRU leaf — the COW donor itself.
+    req = s.submit(prompt, 9)
+    placed = s.admit()
+    assert placed == [req]
+    # the stale match was discarded: after the eviction round the
+    # re-match keeps only the surviving full page, no COW
+    assert req.prefix_len == 4 and req.shared_count == 1
+    assert req.cow_src is None
+    row = s.block_tables[req.slot]
+    live = [p for p in row if p != 0]
+    assert len(live) == len(set(live)), \
+        "a physical page appears twice in the block table"
+    a.assert_conservation()
+    cache.assert_consistent()
+    s.finish(req)
+    a.assert_conservation()
+
+
 def test_queue_deadline_expiry_typed_verdict():
     a, s = _sched()
     q = s.submit(np.ones(3, np.int32), 2, deadline_s=1e-9)
@@ -99,8 +135,11 @@ def test_allocator_conservation_catches_corruption():
     with pytest.raises(MXNetError, match="both free and allocated"):
         a.assert_conservation()
     a._free.pop()
-    a._allocated.discard(pages[1])  # simulate a leaked page
+    a._refs.pop(pages[1])           # simulate a leaked page
     with pytest.raises(MXNetError, match="conservation"):
+        a.assert_conservation()
+    a._refs[pages[1]] = 0           # refcount corruption
+    with pytest.raises(MXNetError, match="refcount"):
         a.assert_conservation()
 
 
@@ -417,10 +456,15 @@ def test_surv_fast_sections():
     verdict + page release, graceful drain (exit 80, zero dropped
     accepted), router failover with at-most-once journal + AOT-warm
     replacement, live hot-swap (invisible to residents, takes effect,
-    torn swap rolls back) — one clean process."""
+    torn swap rolls back), the per-request sampling determinism law
+    (same seed/params -> identical tokens across batch compositions, a
+    join/leave, and a router failover re-decode), and the
+    serve.prefix.evict drill (victim falls back to a full prefill with
+    correct tokens) — one clean process."""
     _, out = _run_driver("fast")
     for marker in ("SERVING_LIFECYCLE_OK", "SERVING_ROUTER_OK",
-                   "SERVING_SWAP_OK"):
+                   "SERVING_SWAP_OK", "SERVING_SAMPLING_OK",
+                   "SERVING_PREFIX_EVICT_OK"):
         assert marker in out, out[-3000:]
 
 

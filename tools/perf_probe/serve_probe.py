@@ -53,7 +53,15 @@ batch occupancy.  Hard contracts asserted by ``BENCH_MODE=serve``
   armed ``serve.replica.sigkill`` (real SIGKILL mid-probe → confirmed
   death → journaled failover → a REPLACEMENT PROCESS spun on the
   shared AOT cache with 0 foreground compiles) — 0 dropped, tokens
-  bit-identical to the unfaulted run, all hard-asserted.
+  bit-identical to the unfaulted run, all hard-asserted;
+- **capacity multipliers** (``run_prefix`` / ``run_gqa``, ISSUE 15):
+  a system-prompt-heavy Poisson mix with per-request sampling on half
+  the requests, cache-on vs cache-off on the SAME workload — prefix
+  hit-rate > 0, >= 30% fewer prefill tokens, tokens bit-identical,
+  1.0 decode dispatch/step and 0 steady-state recompiles with cache +
+  sampling enabled; and grouped-query attention at ``K_kv = H/2`` —
+  kernel-vs-oracle equivalence at mixed ragged lengths plus >= 1.5x
+  resident sequences in the same page-pool bytes.
 
 Usage: JAX_PLATFORMS=cpu python tools/perf_probe/serve_probe.py
 Prints one JSON object.  ``--no-fleet`` / ``--no-spinup`` skip the
@@ -124,20 +132,26 @@ def _req_stats(ttfts, tpots, waits):
 
 
 def run_continuous(net, workload, num_slots=8, page_size=16,
-                   max_prefill_len=32, max_seq_len=48, num_pages=None):
+                   max_prefill_len=32, max_seq_len=48, num_pages=None,
+                   prefix_cache=None, sampling=None):
     """Open-loop drive of the ServingEngine; returns throughput, latency
     percentiles, occupancy, and the dispatch/compile accounting —
     WITH request-scope tracing live (it is always on: the 1.0
     dispatch/step and recompile contracts below therefore hold with the
     tracing plane enabled, and goodput must equal raw tokens on this
-    unfaulted run)."""
+    unfaulted run).
+
+    ``prefix_cache``: forwarded to the engine (None = its default);
+    ``sampling``: optional per-request SamplingParams list aligned with
+    the workload (None entries = greedy)."""
     from mxnet_tpu import profiler, telemetry
     from mxnet_tpu.serving import ServingEngine
     import numpy as np
 
     eng = ServingEngine(net, num_slots=num_slots, page_size=page_size,
                         max_prefill_len=max_prefill_len,
-                        max_seq_len=max_seq_len, num_pages=num_pages)
+                        max_seq_len=max_seq_len, num_pages=num_pages,
+                        prefix_cache=prefix_cache)
     # warmup: both programs execute once (first-call overhead, twin
     # hot-swap settle) before the timed workload
     eng.generate([np.zeros(4, np.int32)], max_new=2)
@@ -149,12 +163,15 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
 
     reqs = []
     pending = list(workload)
+    samp = list(sampling) if sampling is not None else [None] * len(
+        pending)
     t_start = time.perf_counter()
     while pending or not eng.sched.idle:
         now = time.perf_counter() - t_start
         while pending and pending[0][0] <= now:
             _, prompt, max_new = pending.pop(0)
-            reqs.append(eng.submit(prompt, max_new))
+            reqs.append(eng.submit(prompt, max_new,
+                                   sampling=samp[len(reqs)]))
         if eng.step() == 0 and pending:
             # idle gap before the next arrival: wait it out off-device
             time.sleep(min(1e-4, max(0.0, pending[0][0] - now)))
@@ -189,6 +206,18 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
         "mean_batch_occupancy": round(
             decode_tokens / max(1, decode_steps), 3),
         "tokens": [list(map(int, r.tokens)) for r in reqs],
+        # prefix-cache accounting (counters were reset above, so these
+        # are this run's deltas; all 0 with the cache off)
+        "prefill_tokens":
+            telemetry.counter("serving.prefill_tokens").value,
+        "prefix_hits": telemetry.counter("serving.prefix.hits").value,
+        "prefix_miss": telemetry.counter("serving.prefix.miss").value,
+        "prefix_shared_pages":
+            telemetry.counter("serving.prefix.shared_pages").value,
+        "prefix_cow_copies":
+            telemetry.counter("serving.prefix.cow_copies").value,
+        "sampling_requests":
+            telemetry.counter("serving.sampling.requests").value,
     }
     out.update(_req_stats([r.ttft_s for r in reqs],
                           [r.tpot_s for r in reqs
@@ -257,6 +286,140 @@ def run_sequential(net, workload, t_pad=48):
     }
     out.update(_req_stats(ttfts, tpots, waits))
     return out
+
+
+# -- capacity multipliers: prefix caching + GQA (ISSUE 15) ------------------
+
+def make_prefix_workload(n_requests=24, sys_len=24,
+                         mean_interarrival_s=0.004, tail_lens=(2, 8),
+                         new_tokens=(8, 16), vocab=256, seed=17):
+    """A system-prompt-heavy Poisson mix: every request shares one
+    ``sys_len``-token system prompt followed by a short unique tail —
+    the workload shape prefix caching exists for."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    sysp = rng.randint(0, vocab, sys_len).astype(np.int32)
+    t = 0.0
+    out = []
+    for _ in range(n_requests):
+        t += float(rng.exponential(mean_interarrival_s))
+        tail = rng.randint(0, vocab,
+                           int(rng.randint(tail_lens[0],
+                                           tail_lens[1] + 1))
+                           ).astype(np.int32)
+        out.append((t, np.concatenate([sysp, tail]),
+                    int(rng.randint(new_tokens[0],
+                                    new_tokens[1] + 1))))
+    return out
+
+
+def run_prefix(net, workload=None):
+    """The prefix-caching contract (hard-asserted by BENCH_MODE=serve):
+    on a prefix-heavy workload with per-request SAMPLING enabled,
+    cache-on must (a) hit (> 0 hit-rate), (b) prefill >= 30% fewer
+    tokens than cache-off on the SAME workload, (c) emit bit-identical
+    tokens (per-request determinism makes sampled tokens comparable
+    across engine configs), and (d) keep 1.0 decode dispatch/step with
+    0 steady-state recompiles — the caching + sampling machinery rides
+    the existing one-donated-program-per-step invariant."""
+    from mxnet_tpu.serving import SamplingParams
+    if workload is None:
+        workload = make_prefix_workload()
+    # every other request samples (seeded); the rest stay greedy — the
+    # bit-identity contract must hold for BOTH decode modes
+    sampling = [None if i % 2 == 0 else
+                SamplingParams(temperature=0.8, top_k=24, top_p=0.95,
+                               seed=4000 + i)
+                for i in range(len(workload))]
+    on = run_continuous(net, workload, sampling=sampling)
+    off = run_continuous(net, workload, sampling=sampling,
+                         prefix_cache=False)
+    admissions = on["prefix_hits"] + on["prefix_miss"]
+    reduction = (1.0 - on["prefill_tokens"] /
+                 max(1, off["prefill_tokens"]))
+    return {
+        "requests": len(workload),
+        "tokens_match_cache_off": on.pop("tokens") == off.pop("tokens"),
+        "prefill_tokens_on": on["prefill_tokens"],
+        "prefill_tokens_off": off["prefill_tokens"],
+        "prefill_token_reduction": round(reduction, 4),
+        "hit_rate": round(on["prefix_hits"] / max(1, admissions), 4),
+        "prefix_hits": on["prefix_hits"],
+        "shared_pages": on["prefix_shared_pages"],
+        "cow_copies": on["prefix_cow_copies"],
+        "sampling_requests": on["sampling_requests"],
+        "decode_dispatches_per_step": on["decode_dispatches_per_step"],
+        "steady_state_compiles": on["steady_state_compiles"],
+        "tokens_per_sec_on": on["tokens_per_sec"],
+        "tokens_per_sec_off": off["tokens_per_sec"],
+        "ttft_p50_ms_on": on["ttft_p50_ms"],
+        "ttft_p50_ms_off": off["ttft_p50_ms"],
+    }
+
+
+def run_gqa(net, pool_pages=13):
+    """The GQA capacity contract (hard-asserted by BENCH_MODE=serve):
+    at ``K_kv = H/2`` the SAME page-pool byte budget holds >= 1.5x the
+    resident sequences (page bytes scale with K_kv, so the budget buys
+    2x pages), with kernel-vs-oracle equivalence at mixed lengths."""
+    import numpy as np
+    from mxnet_tpu.ops.pallas.paged_attention import (
+        paged_attention, paged_attention_reference)
+    from mxnet_tpu.serving import ServingEngine
+
+    n_heads = net.blocks._children[0].attn._num_heads
+    assert n_heads % 2 == 0, n_heads
+    rng = np.random.RandomState(23)
+
+    # kernel-vs-oracle at K_kv = H/2, mixed ragged lengths
+    s, d, page, n_pages, mp = 5, 16, 8, 16, 4
+    kv = n_heads // 2
+    q = rng.randn(s, n_heads, d).astype(np.float32)
+    kp = rng.randn(n_pages, page, kv, d).astype(np.float32)
+    vp = rng.randn(n_pages, page, kv, d).astype(np.float32)
+    perm = rng.permutation(n_pages - 1) + 1
+    ctx_lens = [29, 5, 0, 17, 32]
+    bt = np.zeros((s, mp), np.int32)
+    k = 0
+    for i in range(s):
+        need = -(-max(1, ctx_lens[i]) // page)
+        bt[i, :need] = perm[k:k + need]
+        k += need
+    ctx = np.asarray(ctx_lens, np.int32)
+    out = np.asarray(paged_attention(q, kp, vp, bt, ctx))
+    ref = np.asarray(paged_attention_reference(q, kp, vp, bt, ctx))
+    kernel_err = float(np.abs(out - ref).max())
+
+    # resident capacity at the same pool bytes: identical worst-case
+    # requests, count concurrent residents (prefix cache off — unique
+    # prompts are the honest capacity baseline)
+    kw = dict(num_slots=16, page_size=16, max_prefill_len=32,
+              max_seq_len=48, prefix_cache=False)
+
+    def residents(kv_heads, num_pages):
+        eng = ServingEngine(net, kv_heads=kv_heads,
+                            num_pages=num_pages, **kw)
+        pool_bytes = sum(kc.nbytes + vc.nbytes for kc, vc in eng._kv)
+        for _ in range(16):
+            eng.submit(rng.randint(0, 256, (32,)).astype(np.int32), 16)
+        eng.step()
+        occ = eng.sched.occupancy
+        eng.run_until_idle()
+        return occ, pool_bytes
+
+    occ_mha, bytes_mha = residents(n_heads, pool_pages)
+    occ_gqa, bytes_gqa = residents(n_heads // 2, 2 * pool_pages - 1)
+    return {
+        "kv_heads": n_heads // 2,
+        "n_heads": n_heads,
+        "kernel_max_err": kernel_err,
+        "residents_mha": occ_mha,
+        "residents_gqa": occ_gqa,
+        "resident_multiplier": round(occ_gqa / max(1, occ_mha), 3),
+        "pool_bytes_mha": bytes_mha,
+        "pool_bytes_gqa": bytes_gqa,
+        "kv_bytes_per_token_ratio": round(bytes_gqa / bytes_mha, 4),
+    }
 
 
 # -- degraded mode: kill a replica mid-probe (ISSUE 11 + 13) ---------------
@@ -697,6 +860,8 @@ def run(spinup=True, degraded=True, fleet=True):
         "speedup_tokens_per_sec": round(
             cont["tokens_per_sec"] / seq["tokens_per_sec"], 2),
         "trace_overhead_us": measure_trace_overhead(),
+        "prefix": run_prefix(net),
+        "gqa": run_gqa(net),
     }
     if degraded:
         result["degraded"] = run_degraded(net, workload, cont_tokens)

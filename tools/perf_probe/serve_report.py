@@ -180,6 +180,8 @@ def build_requests(events):
                 "submit_t": None, "rid": None, "router": False,
                 "prompt_len": None, "max_new": None,
                 "deadline_s": None, "last_pos": -1,
+                "prefix_hit": None, "prefix_len": None,
+                "sampling": None,
             }
         return r
 
@@ -214,13 +216,24 @@ def build_requests(events):
                     r[k] = args.get(k)
             if args.get("rid") is not None and r["router"]:
                 r["rid"] = args.get("rid")
+            if r["sampling"] is None:
+                r["sampling"] = args.get("sampling")
         elif ev == "admit":
             r["segments"].append({
                 "replica": args.get("replica"), "t": e.get("t"),
                 "slot": args.get("slot"),
                 "queue_wait_s": args.get("queue_wait_s") or 0.0,
                 "prefill_s": 0.0, "tokens": 0, "end": None,
+                "prefix_hit": args.get("prefix_hit"),
+                "shared_pages": args.get("shared_pages"),
             })
+            # the request's prefix class is its FIRST admission's (a
+            # failover re-admission may hit where the original missed —
+            # the class the caller FELT is the first one)
+            if r["prefix_hit"] is None and \
+                    args.get("prefix_hit") is not None:
+                r["prefix_hit"] = bool(args.get("prefix_hit"))
+                r["prefix_len"] = args.get("prefix_len")
         elif ev == "prefill":
             if r["segments"]:
                 r["segments"][-1]["prefill_s"] += (
@@ -372,6 +385,51 @@ def verdict_latency_split(reqs):
             row[key + "_p50"] = _pct(vals, 0.5)
             row[key + "_p99"] = _pct(vals, 0.99)
         out[v] = row
+    return out
+
+
+def prefix_latency_split(reqs):
+    """TTFT / queue-wait percentiles split by prefix-cache class
+    (ISSUE 15): a ``hit`` request mapped shared pages and prefilled
+    only its suffix, a ``miss`` paid the full prefill.  The cache's
+    effect is thereby blameable per request like everything else in
+    the §12 plane.  Read the TTFT split with the hardware in mind: on
+    accelerators a hit skips the cached prefix's quadratic attention
+    and should beat the miss class; on the CPU interpret path the
+    static-pad suffix window plus the prefix gather make hit wall time
+    >= miss — there the cache's measurable wins are the queue-wait
+    split (admission capacity) and ``serving.prefill_tokens``.
+    Requests that were never admitted (shed, expired-in-queue) have no
+    class and are excluded."""
+    groups = {}
+    for r in reqs.values():
+        if r["prefix_hit"] is None or r["final"] is None:
+            continue
+        args = r["final"].get("args") or {}
+        g = groups.setdefault("hit" if r["prefix_hit"] else "miss",
+                              {"n": 0, "ttft": [], "queue": [],
+                               "prefix_len": [], "sampled": 0})
+        g["n"] += 1
+        if args.get("ttft_s") is not None:
+            g["ttft"].append(args["ttft_s"])
+        if args.get("queue_wait_s") is not None:
+            g["queue"].append(args["queue_wait_s"])
+        if r["prefix_len"]:
+            g["prefix_len"].append(r["prefix_len"])
+        if r["sampling"]:
+            g["sampled"] += 1
+    out = {}
+    for cls, g in groups.items():
+        ttft, queue = sorted(g["ttft"]), sorted(g["queue"])
+        out[cls] = {
+            "n": g["n"], "sampled": g["sampled"],
+            "ttft_p50": _pct(ttft, 0.5), "ttft_p99": _pct(ttft, 0.99),
+            "queue_p50": _pct(queue, 0.5),
+            "queue_p99": _pct(queue, 0.99),
+            "mean_prefix_len": (sum(g["prefix_len"])
+                                / len(g["prefix_len"])
+                                if g["prefix_len"] else 0),
+        }
     return out
 
 
@@ -613,6 +671,7 @@ def analyze(run_dir, slo_ttft=None):
                       "ok": not violations and not open_traces},
         "matrix": replica_matrix(reqs),
         "latency": verdict_latency_split(reqs),
+        "prefix": prefix_latency_split(reqs),
         "arcs": arcs, "linked_arcs": linked_arcs,
         "journal_retries": journal_retries,
         "blame": blame(reqs, slo_ttft),
@@ -666,6 +725,33 @@ def render(rep, out=sys.stdout):
                      _tr._fmt_s(g["queue_p99"])))
     _tr._table(("verdict", "n", "ttft_p50", "ttft_p99", "tpot_p50",
                 "queue_p50", "queue_p99"), rows, out)
+
+    if rep["prefix"]:
+        out.write("\n-- latency by prefix class (ISSUE 15) --\n")
+        rows = []
+        for cls in sorted(rep["prefix"]):
+            g = rep["prefix"][cls]
+            rows.append((cls, g["n"], g["sampled"],
+                         "%.1f" % g["mean_prefix_len"],
+                         _tr._fmt_s(g["ttft_p50"]),
+                         _tr._fmt_s(g["ttft_p99"]),
+                         _tr._fmt_s(g["queue_p50"]),
+                         _tr._fmt_s(g["queue_p99"])))
+        _tr._table(("prefix", "n", "sampled", "avg_len", "ttft_p50",
+                    "ttft_p99", "queue_p50", "queue_p99"), rows, out)
+        c = {}
+        for cc in data["counters"].values():
+            for key in ("serving.prefix.hits", "serving.prefix.miss",
+                        "serving.prefix.shared_pages",
+                        "serving.prefix.cow_copies",
+                        "serving.prefix.evictions",
+                        "serving.prefill_tokens",
+                        "serving.sampling.requests"):
+                if cc.get(key):
+                    c[key] = c.get(key, 0) + cc[key]
+        if c:
+            out.write("  " + "  ".join(
+                "%s=%d" % kv for kv in sorted(c.items())) + "\n")
 
     if rep["arcs"]:
         out.write("\n-- failover arcs (linked by trace id) --\n")
