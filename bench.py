@@ -696,7 +696,15 @@ def bench_serve():
       each), links failovers across replicas by trace id, names the
       killed replica in the blame section, emits a single loadable
       merged chrome trace, and reconciles traced tokens with the
-      serving.tokens counter bit-exactly.
+      serving.tokens counter bit-exactly;
+    - **speculative decoding** (ISSUE 16): on the acceptance-friendly
+      workload spec-on reaches >= 1.5x spec-off tokens/s with > 1.3
+      tokens per slot step, still exactly 1.0 decode dispatch/step and
+      0 steady-state recompiles, greedy tokens bit-identical to
+      spec-off, drafted == accepted + rejected, decode tokens ==
+      slot_steps + accepted - discarded, and mixed greedy/sampled
+      streams reproduce bit-exactly both on a re-run and across a
+      router failover re-decode.
     """
     import jax
     _perf_probe_path()
@@ -793,6 +801,62 @@ def bench_serve():
             "page-pool bytes (%d -> %d; contract: >= 1.5x)"
             % (gqa["resident_multiplier"], gqa["residents_mha"],
                gqa["residents_gqa"]))
+    spec = result["spec"]
+    if spec["speedup_tokens_per_sec"] < 1.5:
+        raise AssertionError(
+            "speculative decoding reached only %.2fx spec-off tokens/s "
+            "on the acceptance-friendly workload (contract: >= 1.5x — "
+            "verified drafts must multiply tokens per dispatch)"
+            % spec["speedup_tokens_per_sec"])
+    if not spec["tokens_match_spec_off"]:
+        raise AssertionError(
+            "spec-on greedy tokens diverged from spec-off on the same "
+            "workload (contract: acceptance emits the greedy chain "
+            "itself — speculation changes throughput, NEVER tokens)")
+    if spec["tokens_per_slot_step"] <= 1.3:
+        raise AssertionError(
+            "speculative decode committed only %.2f tokens per slot "
+            "participation (contract: > 1.3 — a non-speculative slot "
+            "step is exactly 1.0)" % spec["tokens_per_slot_step"])
+    if spec["decode_dispatches_per_step"] != 1.0:
+        raise AssertionError(
+            "with speculation enabled the decode loop dispatched %.3f "
+            "programs/step (contract: exactly 1.0 — draft + verify + "
+            "accept ride the ONE donated program)"
+            % spec["decode_dispatches_per_step"])
+    if spec["steady_state_compiles"] != 0:
+        raise AssertionError(
+            "speculative serving recompiled %d time(s) under churn "
+            "(contract: draft length is a MASK, never a shape)"
+            % spec["steady_state_compiles"])
+    if not spec["counter_identity_draft"] or \
+            not spec["counter_identity_tokens"]:
+        raise AssertionError(
+            "spec counters do not reconcile (drafted=%d accepted=%d "
+            "rejected=%d; contract: drafted == accepted + rejected AND "
+            "decode tokens == slot_steps + accepted - discarded)"
+            % (spec["draft_tokens"], spec["accepted"],
+               spec["rejected"]))
+    if spec["spec_off_drafted"] != 0:
+        raise AssertionError(
+            "the spec-off arm drafted %d token(s) (contract: spec_k=0 "
+            "means the drafter never runs)" % spec["spec_off_drafted"])
+    if not spec["sampled_repro_match"]:
+        raise AssertionError(
+            "a mixed greedy/sampled spec-on run did not repeat "
+            "bit-identically (contract: per-request functional PRNG — "
+            "same seed, same stream)")
+    if spec["failover_completed"] != spec["requests"] or \
+            spec["failover_failovers"] < 1 or \
+            not spec["failover_tokens_match"]:
+        raise AssertionError(
+            "spec-on router failover broke determinism (%d/%d "
+            "completed, %d failover(s), tokens_match=%s; contract: "
+            "sampled AND greedy streams survive the replacement "
+            "replica's re-decode bit-exactly)"
+            % (spec["failover_completed"], spec["requests"],
+               spec["failover_failovers"],
+               spec["failover_tokens_match"]))
     deg = result["degraded"]
     if deg["dropped"] != 0:
         raise AssertionError(
@@ -917,6 +981,9 @@ def bench_serve():
             pfx["prefill_token_reduction"],
         "prefix_hit_rate": pfx["hit_rate"],
         "gqa_resident_multiplier": gqa["resident_multiplier"],
+        "spec_speedup": spec["speedup_tokens_per_sec"],
+        "spec_tokens_per_slot_step": spec["tokens_per_slot_step"],
+        "spec_acceptance_rate": spec["acceptance_rate"],
         "serve": result,
     }))
 

@@ -89,6 +89,13 @@ Sites wired in this package:
                           launcher reaps rc -9 (retryable) and respawns
                           the slot; the router's proxy confirms the
                           death and fails accepted requests over.
+- ``serve.spec.poison``   corrupt every speculative DRAFT token between
+                          the drafter and the verify dispatch (ISSUE
+                          16): batched verification must reject the
+                          poisoned positions and the emitted stream
+                          stay exactly the non-speculative one — the
+                          self-correction law that makes draft quality
+                          a throughput knob, never a correctness one.
 - ``rpc.drop``            a serving RPC reply is blackholed: the server
                           processes the request (an accepted submit IS
                           journaled — the client retry dedups) but

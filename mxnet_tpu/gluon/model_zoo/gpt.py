@@ -782,6 +782,184 @@ def paged_decode_step(p, tokens, positions, active, kv_pages,
     return logits, nxt, new_keys, new_pages
 
 
+def _spec_accept_greedy(logits, tokens, draft_valid):
+    """Greedy prefix acceptance for one speculative-verify pass:
+    ``greedy_next[s, i]`` is the target's argmax continuation after
+    query position ``i``; draft token ``tokens[s, i+1]`` is accepted
+    iff every earlier draft matched AND it equals ``greedy_next[s, i]``.
+    The emitted chain is ``greedy_next[s, :n_new]`` — position
+    ``accepted_len`` is the free correction/bonus token, so ANY draft
+    content (including poisoned garbage) still yields the exact greedy
+    stream.  Returns ``(greedy_next [S, K], accepted_len [S])``."""
+    import jax.numpy as jnp
+    greedy_next = logits.argmax(-1).astype(jnp.int32)      # [S, K]
+    match = (greedy_next[:, :-1] == tokens[:, 1:]) & draft_valid
+    accepted = jnp.cumprod(match.astype(jnp.int32),
+                           axis=1).sum(axis=1)
+    return greedy_next, accepted
+
+
+def _spec_sample(logits, tokens, draft_valid, temps, top_ks, top_ps,
+                 keys):
+    """Rejection-sampling verification of the draft tokens (sampled
+    slots).  The drafter is DETERMINISTIC (it proposes draft ``d`` with
+    probability 1), so the accept test is ``u < p_i[d]`` against the
+    slot's filtered/temperature target distribution at position ``i``,
+    and the residual distribution on rejection is ``p_i`` with ``d``
+    masked out (renormalized inside ``categorical``).  The PRNG chain
+    advances ONE split per emitted token — the i-th token of the step
+    draws from the key after i splits, so the n-th token of a request
+    still depends on (seed, n, context) alone and per-request streams
+    reproduce across batch composition, churn, hot-swap, and failover
+    re-decode (for a FIXED spec configuration; spec-on sampled streams
+    need not match spec-off — only greedy is bit-pinned).
+
+    Returns ``(emitted [S, K], accepted_len [S], keys_after [K, S, 2])``
+    where ``keys_after[i]`` is the chain state after emitting ``i + 1``
+    tokens."""
+    import jax
+    import jax.numpy as jnp
+    s_n, k1, v = logits.shape
+    rep = lambda a: jnp.repeat(a, k1, axis=0)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+    filtered = _filter_logits_per_slot(
+        scaled.reshape(s_n * k1, v), rep(top_ks),
+        rep(top_ps)).reshape(s_n, k1, v)
+    vocab = jnp.arange(v)
+    cur = keys
+    emit, cont, keys_after = [], [], []
+    for i in range(k1):
+        sp = jax.vmap(jax.random.split)(cur)           # [S, 2, 2]
+        cur, sub = sp[:, 0], sp[:, 1]
+        keys_after.append(cur)
+        sp2 = jax.vmap(jax.random.split)(sub)
+        k_u, k_r = sp2[:, 0], sp2[:, 1]
+        f_i = filtered[:, i]                           # [S, V]
+        if i < k1 - 1:
+            d_i = tokens[:, i + 1]
+            probs = jax.nn.softmax(f_i, axis=-1)
+            p_d = jnp.take_along_axis(probs, d_i[:, None],
+                                      axis=-1)[:, 0]
+            u = jax.vmap(lambda kk: jax.random.uniform(kk, ()))(k_u)
+            accept = (u < p_d) & draft_valid[:, i]
+            masked = jnp.where(vocab[None, :] == d_i[:, None], -1e30,
+                               f_i)
+            resample = jax.vmap(jax.random.categorical)(
+                k_r, masked).astype(jnp.int32)
+            direct = jax.vmap(jax.random.categorical)(
+                k_r, f_i).astype(jnp.int32)
+            emit.append(jnp.where(draft_valid[:, i],
+                                  jnp.where(accept, d_i, resample),
+                                  direct))
+            cont.append(accept)
+        else:
+            # the bonus position: no draft beyond it, sample directly
+            emit.append(jax.vmap(jax.random.categorical)(
+                k_r, f_i).astype(jnp.int32))
+            cont.append(jnp.zeros(s_n, bool))
+    emit = jnp.stack(emit, axis=1)                     # [S, K]
+    cont = jnp.stack(cont, axis=1)
+    accepted = jnp.cumprod(cont.astype(jnp.int32), axis=1).sum(axis=1)
+    return emit, accepted, jnp.stack(keys_after)
+
+
+def paged_spec_decode_step(p, tokens, positions, active, draft_len,
+                           kv_pages, block_tables, n_heads,
+                           sampling=None):
+    """ONE speculative decode step for every serving slot: the slot's
+    last emitted token PLUS up to ``K - 1`` draft tokens run through
+    the target model together, and the longest verified prefix (plus
+    the free correction/bonus token) is emitted — up to ``K`` tokens
+    per slot from ONE dispatch, same donated-program discipline as
+    :func:`paged_decode_step` (occupancy and per-slot draft length are
+    masks, never shapes).
+
+    - ``tokens``: int32 [S, K] — ``tokens[s, 0]`` is the slot's current
+      (last emitted) token, ``tokens[s, 1:]`` the drafted continuation
+      (garbage past ``draft_len[s]``);
+    - ``positions``: int32 [S, K] — consecutive positions starting at
+      the slot's context length - 1 (host-clamped into the wpe table);
+    - ``active``: bool [S]; ``draft_len``: int32 [S] in
+      ``[0, K - 1]`` — how many draft tokens are real this step
+      (``0`` degenerates to the plain single-token decode step);
+    - ``sampling``: None for greedy, or the per-slot
+      ``(temps, top_ks, top_ps, keys)`` arrays.
+
+    Every query position's K/V is scattered into the slot's pages
+    before attention (rows past ``draft_len`` go to scratch); query
+    ``i`` attends through position ``positions[s, i]`` — the
+    per-position causal mask of batched verification
+    (``paged_attention_multi``).  Rejected draft positions need no
+    physical rollback: their page offsets sit beyond the slot's
+    committed context, so every later step masks them and the next
+    tokens overwrite them in place.
+
+    Returns ``(logits [S, K, V], out_tokens [S, K], n_new [S],
+    new_kv_pages)`` — the emitted tokens are ``out_tokens[s, :n_new[s]]``
+    — or, with ``sampling``, ``(logits, out_tokens, n_new, new_keys,
+    new_kv_pages)``.
+    """
+    import jax.numpy as jnp
+
+    s_n, k1 = tokens.shape
+    page_size = kv_pages[0][0].shape[1]
+    from ...ops.pallas.paged_attention import paged_attention_multi
+
+    qpos = jnp.arange(k1)
+    # query-row validity: the slot is live and the row is the current
+    # token (i == 0) or a real draft (i <= draft_len)
+    qmask = active[:, None] & (qpos[None, :] <= draft_len[:, None])
+    x = p["wte"][tokens] + p["wpe"][positions]          # [S, K, C]
+    c = x.shape[-1]
+    logical = positions // page_size
+    phys = jnp.where(qmask,
+                     jnp.take_along_axis(block_tables, logical, axis=1),
+                     0)
+    offs = positions % page_size
+    ctx = jnp.where(qmask, positions + 1, 0).astype(jnp.int32)
+    new_pages = []
+    for lp, (kc, vc) in zip(p["layers"], kv_pages):
+        q, k, v = _block_qkv_kv(lp, x, n_heads)   # q [S, H, K, D]
+        kc = kc.at[phys, offs].set(k.transpose(0, 2, 1, 3))
+        vc = vc.at[phys, offs].set(v.transpose(0, 2, 1, 3))
+        o = paged_attention_multi(q.transpose(0, 2, 1, 3), kc, vc,
+                                  block_tables, ctx)   # [S, K, H, D]
+        x = _block_finish(lp, x, o.reshape(s_n, k1, c))
+        new_pages.append((kc, vc))
+    h = _ln(x, p["lnf_g"], p["lnf_b"])
+    logits = h @ p["wte"].T                            # [S, K, V]
+    draft_valid = qmask[:, 1:]          # draft at input column i+1
+    greedy_next, acc_g = _spec_accept_greedy(logits, tokens,
+                                             draft_valid)
+    n_new_g = jnp.where(active, acc_g + 1, 0).astype(jnp.int32)
+    if sampling is None:
+        return logits, greedy_next, n_new_g, new_pages
+    temps, top_ks, top_ps, keys = sampling
+    from jax import lax
+
+    def _sampled():
+        emit, acc_s, keys_after = _spec_sample(
+            logits, tokens, draft_valid, temps, top_ks, top_ps, keys)
+        n_new_s = jnp.where(active, acc_s + 1, 0).astype(jnp.int32)
+        sampled_row = temps > 0
+        out = jnp.where(sampled_row[:, None], emit, greedy_next)
+        n_new = jnp.where(sampled_row, n_new_s, n_new_g)
+        # key after the last emitted token; untouched for greedy or
+        # inactive slots
+        sel = jnp.take_along_axis(
+            keys_after.transpose(1, 0, 2),
+            jnp.clip(n_new - 1, 0, k1 - 1)[:, None, None]
+            .astype(jnp.int32), axis=1)[:, 0]
+        new_keys = jnp.where((sampled_row & active)[:, None], sel,
+                             keys)
+        return out, n_new, new_keys
+
+    out_tokens, n_new, new_keys = lax.cond(
+        jnp.any(temps > 0), _sampled,
+        lambda: (greedy_next, n_new_g, keys))
+    return logits, out_tokens, n_new, new_keys, new_pages
+
+
 def _first_token(logits, sampling, new_pages):
     """Shared prefill tail: greedy 3-tuple, or per-request sampled
     4-tuple with the functionally-advanced key (scalar flavor of

@@ -180,6 +180,177 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     )(ctx, bt, q, k_pages, v_pages)
 
 
+def _verify_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                   o_acc, m_acc, l_acc, *, page_size, n_heads, n_kv,
+                   n_q, scale):
+    """One (slot, page) grid step of the speculative-verify sweep: the
+    SAME page stream as ``_decode_kernel`` but ``n_q`` query positions
+    per slot, each with its OWN context length (query position ``i``
+    attends through the draft token written at its position — the
+    per-position causal mask of batched verification).  One physical
+    page fetch serves every query position and every query-head group,
+    and ALL positions accumulate in one vectorised pass — the per-page
+    op count matches the single-query kernel instead of growing with
+    ``n_q`` (masked positions multiply their softmax weights by zero,
+    so a page past a row's context leaves that row's accumulators
+    untouched, exactly as if the page had been skipped).  Positions
+    with ``ctx == 0`` (inactive slot, or a query row past the slot's
+    draft length) never accumulate and emit zeros.  The scratch rows
+    are laid out ``[n_q * n_heads, D]`` KV-head major: row
+    ``kv * n_q * g + i * g + h`` holds position ``i``, group head
+    ``h`` of KV head ``kv``."""
+    pl = _pl()
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    g = n_heads // n_kv
+
+    @pl.when(j == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+
+    ctxv = ctx_ref[s]
+    ctx_max = jnp.max(ctxv)
+
+    @pl.when(j * page_size < ctx_max)
+    def _accumulate():
+        d = o_acc.shape[-1]
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        # [1, n_q * g, page] — broadcasts over the KV-head batch dim
+        maskf = jnp.repeat(pos < ctxv[:, None], g,
+                           axis=0)[None].astype(jnp.float32)
+        # every KV head in ONE batched dot: q [KV, n_q * g, D] against
+        # the page's k/v [page, KV, D] (batch dim 1), so the per-page
+        # op count stays constant in both heads and query positions
+        q = (q_ref[0].astype(jnp.float32) * scale).reshape(
+            n_q, n_kv, g, d).transpose(1, 0, 2, 3).reshape(
+            n_kv, n_q * g, d)
+        st = jax.lax.dot_general(
+            q, k_ref[0].astype(jnp.float32),
+            (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)    # [KV, n_q * g, page]
+        st = jnp.where(maskf > 0, st, _NEG_INF)
+        m_prev = m_acc[...].reshape(n_kv, n_q * g, 1)
+        m_new = jnp.maximum(m_prev, st.max(axis=-1, keepdims=True))
+        p = jnp.exp(st - m_new) * maskf
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_acc[...].reshape(n_kv, n_q * g, 1) * corr + \
+            p.sum(axis=-1, keepdims=True)
+        o_new = o_acc[...].reshape(n_kv, n_q * g, d) * corr + \
+            jax.lax.dot_general(
+                p, v_ref[0].astype(jnp.float32),
+                (((2,), (0,)), ((0,), (1,))),
+                preferred_element_type=jnp.float32)
+        m_acc[...] = m_new.reshape(n_kv * n_q * g, 1)
+        l_acc[...] = l_new.reshape(n_kv * n_q * g, 1)
+        o_acc[...] = o_new.reshape(n_kv * n_q * g, d)
+
+    @pl.when(j == nj - 1)
+    def _emit():
+        l_safe = jnp.maximum(l_acc[...], 1e-30)
+        d = o_acc.shape[-1]
+        o_ref[0] = (o_acc[...] / l_safe).reshape(
+            n_kv, n_q, g, d).transpose(1, 0, 2, 3).reshape(
+            n_q, n_heads, d).astype(o_ref.dtype)
+
+
+def paged_attention_multi(q, k_pages, v_pages, block_tables,
+                          context_lens, scale=None):
+    """Speculative-verify attention: ``n_q`` query positions per slot in
+    ONE kernel launch over the same paged pools.
+
+    - ``q``: [S, G, H, D] — G query positions per slot (the last
+      emitted token plus the draft tokens, already scattered into the
+      pages this step);
+    - ``context_lens``: int32 [S, G] — per-POSITION context length
+      (query ``i`` of slot ``s`` attends to positions
+      ``< context_lens[s, i]``; 0 masks the row to zeros — inactive
+      slots and rows past the slot's draft length).
+
+    Same grid, page stream, and per-page online softmax as
+    :func:`paged_attention` — one page fetch serves all G positions —
+    so ``G == 1`` with the same contexts reproduces the single-query
+    kernel's op order exactly.  Returns [S, G, H, D].
+    """
+    pl = _pl()
+    from jax.experimental.pallas import tpu as pltpu
+    s_n, n_q, h, d = q.shape
+    page_size = k_pages.shape[1]
+    n_kv = k_pages.shape[2]
+    if h % n_kv:
+        raise ValueError(
+            "query heads (%d) must be a multiple of KV heads (%d)"
+            % (h, n_kv))
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    ctx = jnp.asarray(context_lens, jnp.int32)
+    if ctx.shape != (s_n, n_q):
+        raise ValueError(
+            "context_lens must be [S, G] = %r, got %r"
+            % ((s_n, n_q), tuple(ctx.shape)))
+    bt = jnp.asarray(block_tables, jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_n, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, n_q, h, d),
+                         lambda s, j, c, b: (s, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, d),
+                         lambda s, j, c, b: (b[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, d),
+                         lambda s, j, c, b: (b[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n_q, h, d),
+                               lambda s, j, c, b: (s, 0, 0, 0)),
+        scratch_shapes=[_scratch((n_q * h, d)),
+                        _scratch((n_q * h, 1)),
+                        _scratch((n_q * h, 1))],
+    )
+    return pl.pallas_call(
+        functools.partial(_verify_kernel, page_size=page_size,
+                          n_heads=h, n_kv=n_kv, n_q=n_q,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_n, n_q, h, d), q.dtype),
+        interpret=_use_interpret(),
+    )(ctx, bt, q, k_pages, v_pages)
+
+
+def paged_attention_multi_reference(q, k_pages, v_pages, block_tables,
+                                    context_lens, scale=None):
+    """jnp oracle for :func:`paged_attention_multi`: per-position dense
+    masked softmax over the gathered pages; rows with ``ctx == 0``
+    come back zero (the kernel's empty-row contract)."""
+    s_n, n_q, h, d = q.shape
+    page_size = k_pages.shape[1]
+    n_kv = k_pages.shape[2]
+    g = h // n_kv
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    bt = jnp.asarray(block_tables, jnp.int32)
+    ctx = jnp.asarray(context_lens, jnp.int32)
+    k_seq = k_pages[bt].reshape(s_n, max_pages * page_size, n_kv, d)
+    v_seq = v_pages[bt].reshape(s_n, max_pages * page_size, n_kv, d)
+    if g > 1:
+        k_seq = jnp.repeat(k_seq, g, axis=2)
+        v_seq = jnp.repeat(v_seq, g, axis=2)
+    st = jnp.einsum("sihd,sthd->siht", q.astype(jnp.float32),
+                    k_seq.astype(jnp.float32)) * scale
+    mask = (jnp.arange(max_pages * page_size)[None, None, None, :]
+            < ctx[:, :, None, None])
+    st = jnp.where(mask, st, _NEG_INF)
+    p = jax.nn.softmax(st, axis=-1)
+    p = jnp.where(ctx[:, :, None, None] > 0, p, 0.0)
+    out = jnp.einsum("siht,sthd->sihd", p, v_seq.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
                               context_lens, scale=None):
     """O(S·T) jnp oracle: gather each slot's pages contiguous, broadcast

@@ -134,7 +134,7 @@ from .scheduler import (ContinuousBatchingScheduler, EXPIRED, FAILED,
                         VERDICT_PREFILL_ERROR, VERDICT_REJECTED)
 from .slo import SLOController
 
-__all__ = ["ServingEngine", "live_snapshot"]
+__all__ = ["ServingEngine", "live_snapshot", "ngram_draft"]
 
 # every live engine, weakly held: the crash postmortem
 # (telemetry.dump_postmortem) folds live_snapshot() in so a stalled or
@@ -163,6 +163,41 @@ def _env_float(name):
     return v if v > 0 else None
 
 
+def ngram_draft(context, k, max_n=3):
+    """Model-free n-gram drafter (prompt-lookup decoding): propose the
+    continuation of the LAST earlier occurrence of the context's
+    length-``n`` suffix, longest ``n`` first (``max_n`` .. 1).  Returns
+    up to ``k`` token ids, or ``[]`` when no suffix recurs — an honest
+    "no proposal" beats a random one (every rejected draft costs a
+    verify position).  Pure host-side numpy on ints; this is the default
+    ``spec_drafter`` and the reference signature for a plugged-in draft
+    net: ``(context int32[L], k) -> sequence of <= k token ids``."""
+    ctx = _np.asarray(context, _np.int64).reshape(-1)
+    n_ctx = int(ctx.size)
+    if k < 1 or n_ctx < 2:
+        return []
+    for n in range(min(int(max_n), n_ctx - 1), 0, -1):
+        suffix = ctx[n_ctx - n:]
+        # vectorized window-equality over every earlier start (the
+        # suffix's own start is excluded by the window count)
+        hit = _np.ones(n_ctx - n, _np.bool_)
+        for t in range(n):
+            hit &= ctx[t:t + n_ctx - n] == suffix[t]
+        starts = _np.flatnonzero(hit)
+        if starts.size:
+            # prefer the LATEST occurrence that still has a full-k
+            # continuation before the context's end (a periodic context
+            # shorter than its last period would otherwise truncate the
+            # draft to the cycle remainder); fall back to the latest
+            # occurrence overall for a partial draft
+            full = starts[starts + n + int(k) <= n_ctx]
+            j = int(full[-1]) if full.size else int(starts[-1])
+            cont = ctx[j + n:j + n + int(k)]
+            if cont.size:
+                return [int(t) for t in cont]
+    return []
+
+
 class ServingEngine:
     """Continuous-batching greedy-decode server over a model-zoo GPTLM.
 
@@ -180,7 +215,8 @@ class ServingEngine:
     def __init__(self, net, num_slots=4, page_size=16, num_pages=None,
                  max_prefill_len=32, max_seq_len=None, eos_id=None,
                  record_logits=False, slo=None, default_deadline_s=None,
-                 kv_heads=None, prefix_cache=None):
+                 kv_heads=None, prefix_cache=None, spec_k=None,
+                 spec_drafter=None):
         from ..gluon.model_zoo import gpt as _gpt
 
         self._gpt = _gpt
@@ -211,7 +247,33 @@ class ServingEngine:
                                              net._max_len))
         if self.max_prefill_len > self.max_seq_len:
             raise ValueError("max_prefill_len > max_seq_len")
-        self.max_pages_per_seq = -(-self.max_seq_len // self.page_size)
+        # speculative decoding (ISSUE 16): up to ``spec_k`` host-drafted
+        # tokens per slot are VERIFIED by the same single donated decode
+        # dispatch (no second program, no shape churn — k is a compile-
+        # time width, acceptance is a mask).  0 = off, the pre-spec
+        # engine bit-for-bit.  Explicit arg wins; env opt-in via
+        # MXTPU_SERVE_SPEC_K; ``spec_drafter`` plugs in a custom
+        # proposer (default: the model-free n-gram drafter above).
+        if spec_k is None:
+            spec_k = int(os.environ.get("MXTPU_SERVE_SPEC_K", "0") or 0)
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        if self.spec_k and \
+                self.max_seq_len + self.spec_k > net._max_len:
+            raise ValueError(
+                "speculative decoding needs max_seq_len + spec_k <= "
+                "the model's max_len (draft positions run past the "
+                "last committed token): %d + %d > %d — lower "
+                "max_seq_len or spec_k"
+                % (self.max_seq_len, self.spec_k, net._max_len))
+        self._drafter = (spec_drafter if spec_drafter is not None
+                         else ngram_draft)
+        # draft positions may spill past max_seq_len by up to spec_k
+        # tokens: the per-sequence page budget covers the worst case so
+        # a draft write can never land outside the request's own pages
+        self.max_pages_per_seq = -(-(self.max_seq_len + self.spec_k)
+                                   // self.page_size)
         if num_pages is None:
             # full capacity + scratch: every slot can hold a max-length
             # sequence.  Pass a smaller pool to get real admission
@@ -233,7 +295,15 @@ class ServingEngine:
         self._prefix = PrefixCache(self.alloc) if prefix_cache else None
         self.sched = ContinuousBatchingScheduler(
             self.num_slots, self.alloc, self.max_pages_per_seq,
-            max_seq_len=self.max_seq_len, prefix_cache=self._prefix)
+            max_seq_len=self.max_seq_len, prefix_cache=self._prefix,
+            spec_k=self.spec_k)
+        # host-side spec accounting (bench reconciles these against the
+        # serving.spec.* counters and the raw token counts):
+        # ``spec_slot_steps`` — active-slot decode participations;
+        # ``spec_discarded`` — accepted tokens dropped host-side by the
+        # max_new / EOS truncation (committed K/V, uncounted tokens)
+        self.spec_slot_steps = 0
+        self.spec_discarded = 0
         # per-request sampling decode (ISSUE 15): per-SLOT params
         # arrays + functionally-advanced PRNG keys are ordinary decode
         # program inputs — never a recompile.  Greedy slots (temp 0)
@@ -334,13 +404,18 @@ class ServingEngine:
         # cache-on and cache-off engines compile the SAME two programs
         # (a miss/off prefill is the cond's dense branch), so they
         # share AOT entries and the in-process memo
-        return ("serve|L%d|h%d|kv%d|u%d|v%d|ps%d|np%d|slots%d|mp%d|"
-                "pf%d|%s"
-                % (self._n_layers, self._n_heads, self.kv_heads,
-                   self._units, self._vocab, self.page_size,
-                   self.alloc.num_pages, self.num_slots,
-                   self.max_pages_per_seq, self.max_prefill_len,
-                   type(self._net).__name__))
+        h = ("serve|L%d|h%d|kv%d|u%d|v%d|ps%d|np%d|slots%d|mp%d|"
+             "pf%d|%s"
+             % (self._n_layers, self._n_heads, self.kv_heads,
+                self._units, self._vocab, self.page_size,
+                self.alloc.num_pages, self.num_slots,
+                self.max_pages_per_seq, self.max_prefill_len,
+                type(self._net).__name__))
+        if self.spec_k:
+            # appended only when ON: spec-off engines keep their
+            # pre-ISSUE-16 keys (and every AOT entry already on disk)
+            h += "|spec%d" % self.spec_k
+        return h
 
     def _build_programs(self):
         import jax
@@ -348,11 +423,25 @@ class ServingEngine:
         gpt = self._gpt
         n_heads = self._n_heads
 
-        def decode(p, kv_pages, tokens, positions, active, block_tables,
-                   temps, top_ks, top_ps, keys):
-            return gpt.paged_decode_step(
-                p, tokens, positions, active, kv_pages, block_tables,
-                n_heads, sampling=(temps, top_ks, top_ps, keys))
+        if self.spec_k:
+            # the spec-decode program: the SAME single donated dispatch
+            # per step, now scoring 1 + spec_k query positions per slot
+            # (the multi-query-position verify kernel) and returning
+            # the accepted token run per slot
+            def decode(p, kv_pages, tokens, positions, active,
+                       draft_len, block_tables, temps, top_ks, top_ps,
+                       keys):
+                return gpt.paged_spec_decode_step(
+                    p, tokens, positions, active, draft_len, kv_pages,
+                    block_tables, n_heads,
+                    sampling=(temps, top_ks, top_ps, keys))
+        else:
+            def decode(p, kv_pages, tokens, positions, active,
+                       block_tables, temps, top_ks, top_ps, keys):
+                return gpt.paged_decode_step(
+                    p, tokens, positions, active, kv_pages,
+                    block_tables, n_heads,
+                    sampling=(temps, top_ks, top_ps, keys))
 
         # ONE prefill program whether the prefix cache is on or off: a
         # traced prefix_len of 0 (every admission with the cache off,
@@ -382,15 +471,28 @@ class ServingEngine:
         s, mp, tp = self.num_slots, self.max_pages_per_seq, \
             self.max_prefill_len
         i32, f32, u32 = _np.int32, _np.float32, _np.uint32
-        decode_ex = (p_ex, kv_ex,
-                     jax.ShapeDtypeStruct((s,), i32),
-                     jax.ShapeDtypeStruct((s,), i32),
-                     jax.ShapeDtypeStruct((s,), _np.bool_),
-                     jax.ShapeDtypeStruct((s, mp), i32),
-                     jax.ShapeDtypeStruct((s,), f32),
-                     jax.ShapeDtypeStruct((s,), i32),
-                     jax.ShapeDtypeStruct((s,), f32),
-                     jax.ShapeDtypeStruct((s, 2), u32))
+        if self.spec_k:
+            k1 = self.spec_k + 1
+            decode_ex = (p_ex, kv_ex,
+                         jax.ShapeDtypeStruct((s, k1), i32),
+                         jax.ShapeDtypeStruct((s, k1), i32),
+                         jax.ShapeDtypeStruct((s,), _np.bool_),
+                         jax.ShapeDtypeStruct((s,), i32),
+                         jax.ShapeDtypeStruct((s, mp), i32),
+                         jax.ShapeDtypeStruct((s,), f32),
+                         jax.ShapeDtypeStruct((s,), i32),
+                         jax.ShapeDtypeStruct((s,), f32),
+                         jax.ShapeDtypeStruct((s, 2), u32))
+        else:
+            decode_ex = (p_ex, kv_ex,
+                         jax.ShapeDtypeStruct((s,), i32),
+                         jax.ShapeDtypeStruct((s,), i32),
+                         jax.ShapeDtypeStruct((s,), _np.bool_),
+                         jax.ShapeDtypeStruct((s, mp), i32),
+                         jax.ShapeDtypeStruct((s,), f32),
+                         jax.ShapeDtypeStruct((s,), i32),
+                         jax.ShapeDtypeStruct((s,), f32),
+                         jax.ShapeDtypeStruct((s, 2), u32))
         samp_ex = (jax.ShapeDtypeStruct((), f32),
                    jax.ShapeDtypeStruct((), i32),
                    jax.ShapeDtypeStruct((), f32),
@@ -511,7 +613,7 @@ class ServingEngine:
 
     # -- request intake ----------------------------------------------------
     def submit(self, prompt, max_new, deadline_s=None, trace=None,
-               sampling=None):
+               sampling=None, spec_k=None):
         """Enqueue one request (prompt: 1-d int token array).  Returns
         the Request handle; tokens appear on it as the engine steps.
 
@@ -534,9 +636,20 @@ class ServingEngine:
         one here and this engine's terminal verdict event is FINAL; the
         Router passes its own id through so a failover re-decode on a
         survivor replica continues the same trace, and fleet-level
-        terminality stays the Router's to stamp."""
+        terminality stays the Router's to stamp.
+
+        ``spec_k``: per-request speculative-decoding cap — None uses
+        the engine's ``spec_k``, 0 disables drafting for THIS request
+        (it still rides the spec program, with an empty draft), any
+        positive value caps the per-step draft at
+        ``min(engine.spec_k, spec_k)``.  Serialized over RPC like
+        sampling; it changes scheduling only, never the token stream
+        (acceptance is exact, so fewer drafts mean more steps for the
+        SAME tokens)."""
         prompt = _np.asarray(prompt, _np.int32).reshape(-1)
         sampling = SamplingParams.from_doc(sampling)
+        if spec_k is not None and int(spec_k) < 0:
+            raise ValueError("spec_k must be >= 0")
         if sampling is None:
             sampling = self.default_sampling
         # malformed-argument raises (the scheduler's Request rules)
@@ -591,6 +704,7 @@ class ServingEngine:
         req.trace = trace
         req.trace_owned = owned
         req.sampling = sampling
+        req.spec_k = None if spec_k is None else int(spec_k)
         if sampling is not None and not sampling.greedy:
             _telemetry.counter("serving.sampling.requests").inc()
         if self._record_logits:
@@ -866,6 +980,13 @@ class ServingEngine:
                         phase="serve_step", primary=False)
         _fault.stall_if("serve.decode.stall")
 
+        if self.spec_k:
+            produced += self._spec_decode_once(running)
+            if self.sched.idle:
+                _watchdog.release(self._lease)
+            self._publish_gauges()
+            return produced
+
         s = self.num_slots
         tokens = _np.zeros(s, _np.int32)
         positions = _np.zeros(s, _np.int32)
@@ -914,6 +1035,144 @@ class ServingEngine:
         if self.sched.idle:
             _watchdog.release(self._lease)
         self._publish_gauges()
+        return produced
+
+    # -- speculative decoding (ISSUE 16) -----------------------------------
+    def _draft_for(self, req):
+        """Host-side draft proposal for one resident, capped so no
+        accepted run can overshoot the request's budget by more than
+        the EOS/truncation slack (``max_new - produced - 1`` leaves
+        room for the bonus token).  The ``serve.spec.poison`` drill
+        corrupts the proposal BETWEEN draft and verify — verification
+        must then reject every poisoned position and the emitted stream
+        stay exactly the non-speculative one (self-correction is the
+        safety property the drill pins)."""
+        k = self.spec_k if req.spec_k is None \
+            else min(self.spec_k, int(req.spec_k))
+        cap = min(int(k), req.max_new - len(req.tokens) - 1)
+        if cap <= 0:
+            return []
+        ctx = _np.concatenate(
+            [req.prompt, _np.asarray(req.tokens, _np.int32)])
+        # clamp a buggy custom drafter into vocab: an out-of-range
+        # draft would index the embedding OOB inside the program
+        drafts = [int(t) % self._vocab
+                  for t in self._drafter(ctx, cap)][:cap]
+        if drafts and _fault.trigger("serve.spec.poison"):
+            drafts = [(d + 1) % self._vocab for d in drafts]
+        return drafts
+
+    def _spec_decode_once(self, running):
+        """The speculative decode dispatch: ONE donated program scores
+        each slot's last committed token plus up to ``spec_k`` drafted
+        tokens and commits the longest accepted prefix (+ the bonus
+        token from the last accepted position's distribution).  Greedy
+        slots accept by exact argmax match — the emitted stream is the
+        greedy chain itself, bit-identical to spec-off; sampled slots
+        verify by rejection sampling against the slot's functional PRNG
+        — one key advance per EMITTED token, so the per-request
+        determinism law (same seed -> same stream) survives any draft
+        quality, batch composition, or failover re-decode.  Pages past
+        the committed position hold only draft K/V during the dispatch
+        and are marked speculative for the duration — a release that
+        beats the commit/rollback is caught by the allocator, and
+        ``assert_conservation`` audits the marks.  Returns tokens
+        produced."""
+        s, k1 = self.num_slots, self.spec_k + 1
+        ps = self.page_size
+        tokens = _np.zeros((s, k1), _np.int32)
+        positions = _np.zeros((s, k1), _np.int32)
+        active = _np.zeros(s, _np.bool_)
+        draft_len = _np.zeros(s, _np.int32)
+        drafted = 0
+        marked = []
+        for req in running:
+            drafts = self._draft_for(req)
+            base = int(req.prompt.size) + len(req.tokens) - 1
+            tokens[req.slot, 0] = req.tokens[-1]
+            if drafts:
+                tokens[req.slot, 1:1 + len(drafts)] = drafts
+            positions[req.slot] = base + _np.arange(k1)
+            draft_len[req.slot] = len(drafts)
+            active[req.slot] = True
+            drafted += len(drafts)
+            # pages strictly past the one holding the committed
+            # position receive ONLY draft K/V this dispatch
+            row = self.sched.block_tables[req.slot]
+            for li in range(base // ps + 1,
+                            (base + len(drafts)) // ps + 1):
+                marked.append(int(row[li]))
+        if marked:
+            self.alloc.mark_speculative(marked)
+        if drafted:
+            _telemetry.counter("serving.spec.draft_tokens").inc(drafted)
+
+        t0 = time.perf_counter_ns()
+        try:
+            logits, out, n_new, new_keys, self._kv = self._decode(
+                self._p, self._kv, tokens, positions, active,
+                draft_len, self.sched.block_tables.copy(),
+                self._temps.copy(), self._top_ks.copy(),
+                self._top_ps.copy(), self._keys.copy())
+            t1 = time.perf_counter_ns()
+            out = _np.asarray(out)           # device sync barrier
+            n_new = _np.asarray(n_new)
+        finally:
+            # acceptance is decided the moment the dispatch returns:
+            # rejected positions are masked by every later read and
+            # overwritten in place, so commit/rollback is bookkeeping
+            # only — and a FAILED dispatch must not leave marks a later
+            # release would trip over
+            if marked:
+                self.alloc.clear_speculative(marked)
+        t2 = time.perf_counter_ns()
+        self._keys = _np.array(new_keys, _np.uint32)
+
+        accepted = rejected = rollbacks = 0
+        emitted = {}
+        for req in running:
+            n = int(n_new[req.slot])
+            dl = int(draft_len[req.slot])
+            accepted += n - 1
+            rejected += dl - (n - 1)
+            if n - 1 < dl:
+                rollbacks += 1
+            self.spec_slot_steps += 1
+            take = [int(t) for t in
+                    out[req.slot,
+                        :min(n, req.max_new - len(req.tokens))]]
+            if self.eos_id is not None and self.eos_id in take:
+                take = take[:take.index(self.eos_id) + 1]
+            # accepted-but-discarded tail: K/V committed, token counted
+            # nowhere — tracked so bench's token identity reconciles
+            self.spec_discarded += n - len(take)
+            emitted[req] = take
+        if accepted:
+            _telemetry.counter("serving.spec.accepted").inc(accepted)
+        if rejected:
+            _telemetry.counter("serving.spec.rejected").inc(rejected)
+        if rollbacks:
+            _telemetry.counter("serving.spec.rollbacks").inc(rollbacks)
+        _telemetry.note_train_step(t0, t1, t2, where="serve_step")
+        # the batched ``tokens`` event: one trace OCCURRENCE per token
+        # actually counted this step (serve_report len-weights
+        # occurrences, so traced tokens == serving.tokens stays exact)
+        _telemetry.note_request_event(
+            "", "tokens", t_ns=t2,
+            args={"replica": self.trace_tag, "step": self.decode_steps,
+                  "traces": [r.trace for r in running
+                             for _ in emitted[r]]})
+        self.decode_steps += 1
+        _watchdog.renew(self._lease, step=self.decode_steps,
+                        phase="serve_step")
+        logits_np = _np.asarray(logits) if self._record_logits else None
+        produced = 0
+        for req in list(running):
+            rows = None if logits_np is None else logits_np[req.slot]
+            for i, tok in enumerate(emitted[req]):
+                self._note_token(req, tok,
+                                 None if rows is None else rows[i])
+                produced += 1
         return produced
 
     def _publish_gauges(self):
@@ -1067,6 +1326,11 @@ class ServingEngine:
             "used_pages": self.alloc.used_pages,
             "num_pages": self.alloc.num_pages,
             "draining": self.draining,
+            "spec_k": self.spec_k,
+            "spec": (None if not self.spec_k else {
+                "slot_steps": self.spec_slot_steps,
+                "discarded": self.spec_discarded,
+                "speculative_pages": self.alloc.speculative_pages}),
             "weights_epoch": self.weights_epoch,
             "shedding": (self._slo.shedding if self._slo is not None
                          else False),

@@ -59,6 +59,12 @@ class PagedKVAllocator:
         # allocations hand out low page ids (stable, test-friendly).
         self._free = list(range(self.num_pages - 1, 0, -1))
         self._refs = {}          # page id -> refcount (>= 1)
+        # pages whose ONLY readable content is speculative draft K/V
+        # (ISSUE 16): marked by the engine around each spec-decode
+        # dispatch, cleared when the step's acceptance commits.  A page
+        # released while still marked is a rollback leak — caught at
+        # release time, not as a slow pool bleed.
+        self._spec = set()
 
     # -- sizing ------------------------------------------------------------
     def pages_for(self, tokens):
@@ -82,6 +88,45 @@ class PagedKVAllocator:
     def refcount(self, page):
         """Current reference count of ``page`` (0 when free)."""
         return self._refs.get(int(page), 0)
+
+    @property
+    def speculative_pages(self):
+        """Pages currently marked speculative (draft K/V not yet
+        committed by an acceptance decision).  Must be 0 between decode
+        steps and at drain — the engine marks before each speculative
+        dispatch and clears when the step's acceptance lands."""
+        return len(self._spec)
+
+    # -- speculative decoding (ISSUE 16) -----------------------------------
+    def mark_speculative(self, pages):
+        """Mark allocated pages as holding ONLY speculative draft K/V
+        (the pages a spec-decode dispatch writes beyond the slot's
+        committed context).  Marking a free/never-allocated page raises:
+        a draft write landing in storage nobody owns is page-table
+        corruption, not bookkeeping."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if p not in self._refs:
+                raise MXNetError(
+                    "speculative mark on page %d which is not "
+                    "allocated (free or scratch/foreign page)" % p)
+        self._spec.update(pages)
+        return pages
+
+    def clear_speculative(self, pages=None):
+        """Commit/rollback the speculative marks (``None`` = all).
+        Content-wise there is nothing to undo — rejected draft
+        positions sit beyond the committed context, so every later
+        read masks them and later tokens overwrite them in place;
+        this clears only the accounting."""
+        if pages is None:
+            n = len(self._spec)
+            self._spec.clear()
+            return n
+        pages = {int(p) for p in pages}
+        n = len(self._spec & pages)
+        self._spec -= pages
+        return n
 
     # -- admission ---------------------------------------------------------
     def can_reserve(self, n):
@@ -143,6 +188,16 @@ class PagedKVAllocator:
                 raise MXNetError(
                     "release of page %d which is not allocated (double "
                     "free or scratch/foreign page)" % p)
+            if self._refs[p] == 1 and p in self._spec:
+                # a rollback leak: the engine dispatched drafts into
+                # this page and is freeing it without ever committing
+                # or rolling back the acceptance — caught HERE, at the
+                # release, instead of surfacing later as a freed page
+                # whose stale draft K/V another slot inherits
+                raise MXNetError(
+                    "release of page %d while still marked "
+                    "speculative — a draft dispatch was never "
+                    "committed or rolled back" % p)
             self._refs[p] -= 1
             if self._refs[p] == 0:
                 del self._refs[p]
@@ -177,4 +232,11 @@ class PagedKVAllocator:
             raise MXNetError(
                 "page conservation violated: %d free + %d allocated != "
                 "%d usable" % (len(free_set), len(self._refs), usable))
+        # speculative marks (ISSUE 16) live strictly inside the
+        # allocated set: a mark on a free page means draft K/V landed
+        # in storage nobody owns
+        stray = sorted(self._spec - set(self._refs))
+        if stray:
+            raise MXNetError(
+                "speculative marks on non-allocated pages: %r" % stray)
         return True

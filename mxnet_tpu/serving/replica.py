@@ -166,11 +166,12 @@ class ServingReplica:
 
     # -- request plane -----------------------------------------------------
     def submit(self, prompt, max_new, deadline_s=None, trace=None,
-               sampling=None):
+               sampling=None, spec_k=None):
         if not self.alive:
             raise ReplicaLost("replica %s is dead" % self.replica_id)
         return self.engine.submit(prompt, max_new, deadline_s=deadline_s,
-                                  trace=trace, sampling=sampling)
+                                  trace=trace, sampling=sampling,
+                                  spec_k=spec_k)
 
     def step(self):
         """One serving iteration, replica-flavored: the loss fault site,
@@ -305,6 +306,14 @@ class ServingReplica:
         # before the zero-pages audit — anything left after THAT is a
         # genuine reservation leak
         self.engine.drop_prefix_cache()
+        if self.engine.alloc.speculative_pages:
+            # spec marks live only ACROSS one decode dispatch; one
+            # surviving to drain means some step's acceptance never
+            # committed or rolled back (ISSUE 16's rollback-leak audit)
+            raise MXNetError(
+                "drain finished with %d pages still marked speculative "
+                "— a draft dispatch was never committed or rolled back"
+                % self.engine.alloc.speculative_pages)
         if self.engine.alloc.used_pages:
             raise MXNetError(
                 "drain finished with %d pages still allocated — a "

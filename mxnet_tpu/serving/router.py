@@ -94,7 +94,8 @@ class RouterRequest:
 
     __slots__ = ("rid", "prompt", "max_new", "deadline_s", "deadline_t",
                  "state", "verdict", "error", "tokens", "replica_id",
-                 "retries", "trace", "sampling", "_live", "_home")
+                 "retries", "trace", "sampling", "spec_k", "_live",
+                 "_home")
 
     def __init__(self, rid, prompt, max_new, deadline_s):
         self.rid = rid
@@ -117,6 +118,11 @@ class RouterRequest:
                                 # a failover re-placement carries the
                                 # SAME params + seed, so the re-decode
                                 # is bit-identical (determinism law)
+        self.spec_k = None      # per-request spec-decode cap (ISSUE
+                                # 16); a scheduling knob only — carried
+                                # through failover like sampling, but
+                                # the token stream is identical at ANY
+                                # spec_k (acceptance is exact)
         self._live = None      # the engine Request currently decoding
         self._home = None      # the replica OBJECT it decodes on (ids
                                # are caller-supplied and may collide)
@@ -252,7 +258,8 @@ class Router:
     def _gauge_live(self):
         _telemetry.gauge("router.live_replicas").set(len(self._live()))
 
-    def submit(self, prompt, max_new, deadline_s=None, sampling=None):
+    def submit(self, prompt, max_new, deadline_s=None, sampling=None,
+               spec_k=None):
         """Journal a request and place it.  The handle is terminal
         immediately when every live replica refused (typed verdict
         propagated) or none exist — fail fast, never a silent hang.
@@ -270,6 +277,7 @@ class Router:
         rr = RouterRequest(self._next_rid, prompt, max_new, deadline_s)
         rr.trace = _telemetry.mint_trace()
         rr.sampling = SamplingParams.from_doc(sampling)
+        rr.spec_k = None if spec_k is None else int(spec_k)
         self._next_rid += 1
         self._prune_journal()
         self._journal[rr.rid] = rr
@@ -352,6 +360,8 @@ class Router:
         # stubs, older proxies) that predate per-request sampling keep
         # working for the greedy default
         kw = {} if rr.sampling is None else {"sampling": rr.sampling}
+        if rr.spec_k is not None:
+            kw["spec_k"] = rr.spec_k
         for r in candidates:
             try:
                 req = r.submit(rr.prompt, rr.max_new,

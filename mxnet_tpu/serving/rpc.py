@@ -607,6 +607,10 @@ class RpcServer:
         # greedy default
         kw = {} if msg.get("sampling") is None \
             else {"sampling": msg["sampling"]}
+        # spec_k rides the wire the same way (ISSUE 16): absent = the
+        # worker engine's own default
+        if msg.get("spec_k") is not None:
+            kw["spec_k"] = int(msg["spec_k"])
         try:
             req = self.replica.submit(
                 _np.asarray(msg["prompt"], _np.int32),
@@ -843,7 +847,7 @@ class RpcReplicaProxy:
         return bool(self._status.get("idle", True))
 
     def submit(self, prompt, max_new, deadline_s=None, trace=None,
-               sampling=None):
+               sampling=None, spec_k=None):
         if not self.alive:
             raise ReplicaLost("replica %s is dead" % self.replica_id)
         # argument conversion BEFORE the breaker check: a malformed
@@ -869,7 +873,8 @@ class RpcReplicaProxy:
                "max_new": int(max_new), "deadline_s": deadline_s,
                "sampling": (sampling.to_doc()
                             if hasattr(sampling, "to_doc")
-                            else sampling)}
+                            else sampling),
+               "spec_k": None if spec_k is None else int(spec_k)}
         try:
             addr = self._resolve()
             reply = rpc_call(addr, msg, self._timeout_s,

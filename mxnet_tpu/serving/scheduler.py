@@ -123,7 +123,7 @@ class Request:
                  "pages", "logits_trace", "token_times", "deadline_s",
                  "deadline_t", "verdict", "error", "trace",
                  "trace_owned", "sampling", "prefix_len",
-                 "shared_count", "cow_src", "cow_dst")
+                 "shared_count", "cow_src", "cow_dst", "spec_k")
 
     def __init__(self, rid, prompt, max_new, deadline_s=None):
         self.rid = rid
@@ -158,6 +158,9 @@ class Request:
         self.trace_owned = True
         # per-request sampling (ISSUE 15; None = greedy argmax)
         self.sampling = None
+        # per-request speculative-decoding cap (ISSUE 16; None = the
+        # engine's spec_k, 0 = no drafting for this request)
+        self.spec_k = None
         # prefix-cache placement facts, stamped at admission:
         # ``prefix_len`` tokens of the prompt whose K/V was already
         # cached (0 = miss), ``shared_count`` whole pages mapped
@@ -198,13 +201,21 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, num_slots, allocator, max_pages_per_seq,
-                 max_seq_len=None, prefix_cache=None):
+                 max_seq_len=None, prefix_cache=None, spec_k=0):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if not isinstance(allocator, PagedKVAllocator):
             raise TypeError("allocator must be a PagedKVAllocator")
         self.num_slots = int(num_slots)
         self.alloc = allocator
+        # speculative decoding (ISSUE 16): every admission's worst-case
+        # reservation extends by ``spec_k`` tokens — a spec-decode step
+        # may scatter up to k draft positions BEYOND the sequence's
+        # final committed length, and those writes must land in pages
+        # the request owns (never a neighbor's).  Acceptance variance
+        # itself is an occupancy/length concern (masks, not shapes),
+        # so this one static pad is the whole allocator story.
+        self.spec_k = int(spec_k)
         #: optional serving.prefix_cache.PrefixCache — admission matches
         #: each prompt's longest cached prefix and maps the shared pages
         #: into the block table instead of allocating + re-prefilling
@@ -252,7 +263,7 @@ class ContinuousBatchingScheduler:
             return ("request needs %d tokens (prompt %d + max_new %d) "
                     "but the engine serves at most %d per sequence"
                     % (worst, prompt_size, max_new, self.max_seq_len))
-        need = self.alloc.pages_for(worst)
+        need = self.alloc.pages_for(worst + self.spec_k)
         if need > self.alloc.num_pages - 1:
             # admission could never reserve this many pages even with
             # the pool idle — queueing it would deadlock the queue head
@@ -362,7 +373,10 @@ class ContinuousBatchingScheduler:
             if slot is None:
                 break
             head = self._queue[0]
-            total = self.alloc.pages_for(head.prompt.size + head.max_new)
+            # +spec_k: speculative draft positions may spill past the
+            # final committed length — the tail pages must be OWNED
+            total = self.alloc.pages_for(head.prompt.size + head.max_new
+                                         + self.spec_k)
             # match + reserve, re-matching after every eviction round:
             # evict_for may drop the very nodes just matched (freeing
             # their pages), and acting on that stale match would retain

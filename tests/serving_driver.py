@@ -4,8 +4,8 @@ pallas/checkify import chain breaks under the pytest process's stripped
 platform registry, same story as flash_attention_driver.py) by
 tests/test_serving.py.
 
-Usage: python serving_driver.py [kernel|engine]
-Prints SERVING_KERNEL_OK / SERVING_ENGINE_OK on success.
+Usage: python serving_driver.py [kernel|engine|capacity|spec_sweep]
+Prints SERVING_<SECTION>_OK markers on success.
 """
 import os
 import sys
@@ -118,6 +118,54 @@ def check_kernel_vs_dense_flash():
                                          block_q=8, block_k=8))
         err = np.abs(out[i] - ref[0, :, 0, :]).max()
         assert err < 1e-4, ("kernel vs dense flash", i, err)
+
+
+def check_kernel_multi_vs_reference():
+    """ISSUE 16 verify kernel: n_q query positions per slot, each with
+    its OWN per-position context (the causal mask of batched draft
+    verification) — vs the jnp oracle at mixed lengths, including rows
+    past a slot's draft length (ctx 0 -> zeros) and an inactive slot.
+    G == 1 must reproduce the single-query kernel BIT-identically (the
+    spec-off cost/math baseline)."""
+    from mxnet_tpu.ops.pallas.paged_attention import (
+        paged_attention, paged_attention_multi,
+        paged_attention_multi_reference)
+    rng = np.random.RandomState(14)
+    for s, h, kv, d, page, n_pages, mp, n_q, ctx_rows in (
+            # per-position causal ramps; slot 1 has a short draft (two
+            # dead rows), slot 2 is inactive (all rows masked)
+            (3, 4, 2, 16, 8, 16, 3, 4,
+             [[17, 18, 19, 20], [5, 6, 0, 0], [0, 0, 0, 0]]),
+            # MQA, ragged page counts, ctx crossing page boundaries
+            (2, 4, 1, 8, 4, 12, 4, 3,
+             [[7, 8, 9], [15, 16, 0]])):
+        q = rng.randn(s, n_q, h, d).astype(np.float32)
+        kp = rng.randn(n_pages, page, kv, d).astype(np.float32)
+        vp = rng.randn(n_pages, page, kv, d).astype(np.float32)
+        perm = rng.permutation(n_pages - 1) + 1
+        bt = np.zeros((s, mp), np.int32)
+        k = 0
+        for i in range(s):
+            need = -(-max(1, max(ctx_rows[i])) // page)
+            bt[i, :need] = perm[k:k + need]
+            k += need
+        ctx = np.asarray(ctx_rows, np.int32)
+        out = np.asarray(paged_attention_multi(q, kp, vp, bt, ctx))
+        ref = np.asarray(paged_attention_multi_reference(
+            q, kp, vp, bt, ctx))
+        err = np.abs(out - ref).max()
+        assert err < 1e-5, ("multi kernel vs reference", err)
+        assert np.all(np.isfinite(out))
+        dead = ctx == 0
+        assert np.all(out[dead] == 0.0), "masked rows must emit zeros"
+        # G = 1 degenerates to the single-query kernel's exact op order
+        ctx1 = ctx[:, :1]
+        out1 = np.asarray(paged_attention_multi(
+            q[:, :1], kp, vp, bt, ctx1))
+        base = np.asarray(paged_attention(q[:, 0], kp, vp, bt,
+                                          ctx1[:, 0]))
+        assert out1[:, 0].tobytes() == base.tobytes(), \
+            "G=1 verify kernel is not bit-identical to the decode kernel"
 
 
 # -- engine section --------------------------------------------------------
@@ -487,12 +535,138 @@ def check_sampling_laws(net):
     _idle_pages_ok(both)
 
 
+# -- speculative decoding (ISSUE 16) ----------------------------------------
+
+def _periodic(rng, n, period=3):
+    """A prompt whose greedy continuation the n-gram drafter can hit:
+    small random-weight GPTs continue periodic contexts periodically,
+    so these prompts make the spec checks non-vacuous (drafts actually
+    get accepted) without depending on any particular weight draw for
+    CORRECTNESS — the laws below hold for arbitrary acceptance."""
+    return np.resize(rng.randint(0, VOCAB, (period,)).astype(np.int32),
+                     n)
+
+
+def check_spec_greedy_laws(net):
+    """THE spec-decode determinism law, fast tier: a spec-on engine's
+    greedy stream is BIT-identical to the dense reference (== spec-off)
+    at mixed ragged lengths under staggered joins/leaves; drafting is
+    non-vacuous (accepted > 0) and cuts decode steps on a draftable
+    prompt; speculative page marks never outlive a step.  One spec
+    config (spec_k=4) so this whole block pays a single extra
+    compile set; later spec checks reuse the engine via the in-process
+    AOT memo."""
+    from mxnet_tpu import telemetry
+    rng = np.random.RandomState(16)
+    # ctor validation: draft positions must fit the wpe table
+    try:
+        _engine(net, spec_k=MAX_LEN - ENGINE_KW["max_seq_len"] + 1)
+        raise AssertionError("oversized spec_k accepted")
+    except ValueError as e:
+        assert "spec_k" in str(e)
+
+    on = _engine(net, spec_k=4)
+    prompts = [_periodic(rng, 12), rng.randint(0, VOCAB, (5,))
+               .astype(np.int32), _periodic(rng, 7)]
+    news = (8, 6, 7)
+    dt0 = telemetry.counter("serving.spec.draft_tokens").value
+    ac0 = telemetry.counter("serving.spec.accepted").value
+    handles = []
+    for p, n in zip(prompts, news):
+        handles.append(on.submit(p, n))
+        on.step()                    # staggered joins; finishers leave
+    on.run_until_idle()
+    for h, p, n in zip(handles, prompts, news):
+        assert h.tokens == _ref(net, p, n), (h.tokens, _ref(net, p, n))
+    drafted = telemetry.counter("serving.spec.draft_tokens").value - dt0
+    accepted = telemetry.counter("serving.spec.accepted").value - ac0
+    rejected = telemetry.counter("serving.spec.rejected").value
+    assert drafted > 0 and accepted > 0, (drafted, accepted)
+    assert accepted <= drafted
+    _idle_pages_ok(on)
+    assert on.alloc.speculative_pages == 0
+
+    # fewer decode steps than spec-off for the same tokens (the whole
+    # point): solo draftable prompt, spec-off takes one step per token
+    probe = _periodic(rng, 10)
+    off = _engine(net)
+    d_on0, d_off0 = on.decode_steps, off.decode_steps
+    t_on = on.generate([probe], 10)[0]
+    t_off = off.generate([probe], 10)[0]
+    assert t_on == t_off == _ref(net, probe, 10)
+    assert on.decode_steps - d_on0 < off.decode_steps - d_off0, \
+        (on.decode_steps - d_on0, off.decode_steps - d_off0)
+
+    # per-request override: spec_k=0 rides the SAME spec program with
+    # an empty draft — no drafting for this request, same tokens
+    dt1 = telemetry.counter("serving.spec.draft_tokens").value
+    r = on.submit(probe, 5, spec_k=0)
+    on.run_until_idle()
+    assert r.tokens == _ref(net, probe, 5)
+    assert telemetry.counter("serving.spec.draft_tokens").value == dt1
+    return on
+
+
+def check_spec_poison_drill(net, on):
+    """The serve.spec.poison drill: every draft corrupted between draft
+    and verify — verification must reject the poison and the emitted
+    stream stay EXACTLY the non-speculative greedy chain
+    (self-correction is the safety property, not draft quality)."""
+    from mxnet_tpu import fault, telemetry
+    rng = np.random.RandomState(17)
+    prompt = _periodic(rng, 11)
+    rej0 = telemetry.counter("serving.spec.rejected").value
+    fault.configure("serve.spec.poison:999")
+    try:
+        out = on.generate([prompt], 8)[0]
+        fired = fault.fire_count("serve.spec.poison")
+    finally:
+        fault.reset()
+    assert fired >= 1, "the poison site never fired (drill vacuous)"
+    assert out == _ref(net, prompt, 8), \
+        "poisoned drafts leaked into the emitted stream"
+    assert telemetry.counter("serving.spec.rejected").value > rej0
+    _idle_pages_ok(on)
+    assert on.alloc.speculative_pages == 0
+
+
+def check_spec_k_sweep(net):
+    """Exhaustive spec_k sweep (slow tier: every k compiles its own
+    decode program): greedy bit-identity, sampled seeded
+    reproducibility, and page accounting at k = 1, 2, 8 and 16 — 16 is
+    the wpe boundary (max_seq_len + k == the net's max_len)."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import SamplingParams
+    rng = np.random.RandomState(18)
+    prompts = [_periodic(rng, 11), rng.randint(0, VOCAB, (4,))
+               .astype(np.int32), _periodic(rng, 6, period=2)]
+    refs = [_ref(net, p, 8) for p in prompts]
+    ac0 = telemetry.counter("serving.spec.accepted").value
+    for k in (1, 2, 8, 16):
+        eng = _engine(net, spec_k=k)
+        handles = []
+        for p in prompts:
+            handles.append(eng.submit(p, 8))
+            eng.step()
+        eng.run_until_idle()
+        for h, ref in zip(handles, refs):
+            assert h.tokens == ref, (k, h.tokens, ref)
+        sp = SamplingParams(temperature=0.8, top_k=24, seed=7)
+        a = eng.generate([prompts[0]], 6, sampling=sp)[0]
+        b = eng.generate([prompts[0]], 6, sampling=sp)[0]
+        assert a == b, "sampled spec stream failed to reproduce at k=%d" % k
+        _idle_pages_ok(eng)
+        assert eng.alloc.speculative_pages == 0
+    assert telemetry.counter("serving.spec.accepted").value > ac0
+
+
 def main(section):
     if section in ("kernel", "all"):
         check_kernel_vs_reference_mixed_lengths()
         check_kernel_empty_slot_zero()
         check_kernel_vs_dense_flash()
         check_kernel_gqa_vs_reference()
+        check_kernel_multi_vs_reference()
         print("SERVING_KERNEL_OK")
     if section in ("engine", "all"):
         net = _net()
@@ -509,6 +683,12 @@ def main(section):
         check_prefix_sharing_and_cow(net)
         check_sampling_laws(net)
         print("SERVING_CAPACITY_FAST_OK")
+        # ISSUE 16 fast spec laws ride here too: ONE spec_k=4 config
+        # (one extra compile set for the whole block), the exhaustive
+        # per-k sweep lives in the slow `spec_sweep` section
+        spec_eng = check_spec_greedy_laws(net)
+        check_spec_poison_drill(net, spec_eng)
+        print("SERVING_SPEC_FAST_OK")
     if section in ("capacity", "all"):
         net = _net()
         check_prefix_cache_off_token_identity(net)
@@ -516,6 +696,10 @@ def main(section):
         check_gqa_engine_self_consistent(net)
         check_gqa_capacity_multiplier(net)
         print("SERVING_CAPACITY_OK")
+    if section in ("spec_sweep", "all"):
+        net = _net()
+        check_spec_k_sweep(net)
+        print("SERVING_SPEC_SWEEP_OK")
 
 
 if __name__ == "__main__":

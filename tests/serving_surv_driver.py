@@ -4,10 +4,12 @@ at-most-once decode, live weight hot-swap with rollback — run in a
 CLEAN process (no axon sitecustomize contamination, same story as
 serving_driver.py) by tests/test_serving_surv.py.
 
-Usage: python serving_surv_driver.py [fast|lifecycle|router|swap|stall|e2e]
+Usage: python serving_surv_driver.py
+       [fast|lifecycle|router|swap|sampling|spec|prefix|stall|e2e]
 
-- ``fast`` = lifecycle + router + swap in ONE process (one jax import,
-  engines share the AOT memo) — the tier-1 sibling of the slow e2e.
+- ``fast`` = lifecycle + router + swap + sampling + spec + prefix in
+  ONE process (one jax import, engines share the AOT memo) — the
+  tier-1 sibling of the slow e2e.
 - ``stall`` expects the WATCHDOG to kill this process: the caller arms
   MXTPU_FAULT="serve.decode.stall:1" + MXTPU_STALL_TIMEOUT and asserts
   exit code 75 plus a postmortem carrying the serving snapshot.
@@ -427,6 +429,106 @@ def section_sampling(net=None):
     return net
 
 
+# -- speculative decoding under churn/swap/failover (ISSUE 16) --------------
+
+def section_spec(net=None):
+    """The spec-decode determinism laws under survivability churn: a
+    spec-on engine's greedy stream is the dense chain whatever the
+    batch composition; SAMPLED spec streams reproduce for a fixed spec
+    config across solo decode, join/leave churn, a mid-decode weight
+    hot-swap (identical weights -> bit-invisible), and a router
+    failover re-decode (spec-on sampled streams are pinned to
+    THEMSELVES — only greedy is bit-pinned to spec-off); speculative
+    page marks never survive a step, an idle engine, or a drain."""
+    from mxnet_tpu.serving import (EXIT_SERVE_DRAIN, Router,
+                                   SamplingParams, ServingReplica)
+    net = net or _net()
+    rng = np.random.RandomState(21)
+    K = 3
+    motif = rng.randint(0, VOCAB, (3,)).astype(np.int32)
+    prompts = [np.resize(motif, 12),
+               rng.randint(0, VOCAB, (5,)).astype(np.int32),
+               np.resize(motif, 7),
+               rng.randint(0, VOCAB, (9,)).astype(np.int32)]
+    samps = [None,
+             SamplingParams(temperature=0.8, top_k=24, seed=201),
+             SamplingParams(temperature=0.7, top_p=0.9, seed=202),
+             None]
+    solo = _engine(net, spec_k=K)
+    refs = [solo.generate([p], 6, sampling=sp)[0]
+            for p, sp in zip(prompts, samps)]
+    _idle_pages_ok(solo)
+    assert solo.alloc.speculative_pages == 0
+    # greedy members ARE the dense chain, drafts notwithstanding
+    for i in (0, 3):
+        assert refs[i] == _ref(net, prompts[i], 6), i
+    # sampling actually sampled (non-vacuous law)
+    assert any(refs[i] != _ref(net, prompts[i], 6) for i in (1, 2)), \
+        "sampled spec tokens identical to greedy — sampling is vacuous"
+
+    # (a) join/leave churn: staggered joins, same spec config
+    acc0 = telemetry.counter("serving.spec.accepted").value
+    churn = _engine(net, spec_k=K)
+    handles = []
+    for p, sp in zip(prompts, samps):
+        handles.append(churn.submit(p, 6, sampling=sp))
+        churn.step()
+    churn.run_until_idle()
+    for h, ref in zip(handles, refs):
+        assert h.tokens == ref, (h.tokens, ref)
+    assert telemetry.counter("serving.spec.accepted").value > acc0, \
+        "nothing accepted across the churn run — spec is vacuous"
+    _idle_pages_ok(churn)
+    assert churn.alloc.speculative_pages == 0
+
+    # (b) identical-weights hot-swap mid-decode: bit-invisible to a
+    # speculative resident (greedy AND sampled)
+    sw = _engine(net, spec_k=K)
+    r0 = sw.submit(prompts[0], 6)
+    r1 = sw.submit(prompts[1], 6, sampling=samps[1])
+    sw.step()
+    sw.swap_params(sw.params_from_net(net), epoch=2)
+    sw.run_until_idle()
+    assert sw.swaps == 1
+    assert r0.tokens == refs[0] and r1.tokens == refs[1], \
+        "identical-weights swap perturbed a speculative resident"
+
+    # (c) failover re-decode: a replica dies mid-decode, the survivor
+    # re-decodes victims bit-identically — sampled and greedy alike
+    reps = [ServingReplica(_engine(net, spec_k=K), replica_id="ka"),
+            ServingReplica(_engine(net, spec_k=K), replica_id="kb")]
+    rt = Router(reps, max_retries=2)
+    rrs = [rt.submit(p, 6, sampling=sp)
+           for p, sp in zip(prompts, samps)]
+    rt.step()
+    fault.configure("serve.replica.lost:1")
+    try:
+        rt.run_until_idle()
+    finally:
+        fault.reset()
+    assert rt.failovers == 1
+    for rr, ref in zip(rrs, refs):
+        assert rr.state == "completed", (rr.rid, rr.state)
+        assert rr.tokens == ref, (rr.rid, rr.tokens, ref)
+    for rep in reps:
+        if rep.alive:
+            _idle_pages_ok(rep.engine)
+            assert rep.engine.alloc.speculative_pages == 0
+
+    # (d) graceful drain of a speculative replica: every accepted
+    # request completes, zero speculative marks left behind
+    rep = ServingReplica(_engine(net, spec_k=K), replica_id="kd")
+    hs = [rep.submit(p, 5) for p in prompts[:3]]
+    rep.step()
+    assert rep.drain() == EXIT_SERVE_DRAIN
+    assert all(h.verdict == "completed" and len(h.tokens) == 5
+               for h in hs)
+    assert rep.engine.alloc.speculative_pages == 0
+    _idle_pages_ok(rep.engine)
+    print("SERVING_SPEC_OK")
+    return net
+
+
 # -- prefix-cache eviction drill (ISSUE 15) --------------------------------
 
 def section_prefix_evict(net=None):
@@ -779,6 +881,8 @@ def main(section):
         section_swap(net)
     if section in ("sampling", "fast"):
         net = section_sampling(net)
+    if section in ("spec", "fast"):
+        net = section_spec(net)
     if section in ("prefix", "fast"):
         section_prefix_evict(net)
     if section == "trace":

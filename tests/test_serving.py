@@ -118,6 +118,44 @@ def test_allocator_refcount_interleaved_conservation():
     a.assert_conservation()
 
 
+def test_allocator_speculative_marks():
+    """ISSUE 16 host-side spec-page laws: marks are bookkeeping on
+    ALLOCATED pages only; a release that beats the commit/rollback
+    raises (a freed page whose stale draft K/V another slot would
+    inherit); conservation audits stray marks on freed pages."""
+    a = PagedKVAllocator(num_pages=6, page_size=4)
+    pages = a.allocate(2)
+    assert a.speculative_pages == 0
+    a.mark_speculative(pages)
+    assert a.speculative_pages == 2
+    a.assert_conservation()            # marks on live pages are legal
+    with pytest.raises(MXNetError, match="speculative"):
+        a.release(pages)               # rollback leak caught at release
+    assert a.clear_speculative(pages) == 2
+    assert a.speculative_pages == 0
+    a.release(pages)                   # cleared marks release fine
+    with pytest.raises(MXNetError, match="not allocated"):
+        a.mark_speculative(pages)      # marking free pages is corruption
+    # clear_speculative(None) commits/rolls back EVERYTHING (the
+    # failed-dispatch path) and reports how many marks it dropped
+    p2 = a.allocate(3)
+    a.mark_speculative(p2[:2])
+    assert a.clear_speculative() == 2
+    a.release(p2)
+    a.assert_conservation()
+    # a stray mark surviving past its page's free is the one corruption
+    # only the audit can see (every legal path clears before release)
+    p3 = a.allocate(1)
+    a.mark_speculative(p3)
+    a.clear_speculative(p3)
+    a.release(p3)
+    a._spec.add(p3[0])                 # simulate the bookkeeping bug
+    with pytest.raises(MXNetError, match="speculative"):
+        a.assert_conservation()
+    a._spec.discard(p3[0])
+    a.assert_conservation()
+
+
 def test_prefix_cache_match_insert_evict_host_side():
     """PrefixCache trie laws without jax: page-aligned match, partial
     (COW) match, LRU leaf eviction, index consistency."""
@@ -173,7 +211,10 @@ def _run_driver(section):
 
 def test_paged_attention_kernel():
     """Mixed-length equivalence vs the jnp oracle AND vs dense
-    flash_attention; empty slots emit zeros."""
+    flash_attention; empty slots emit zeros.  Covers the ISSUE-16
+    multi-query verify kernel too: per-position causal contexts vs the
+    oracle, masked rows emit zeros, and G=1 is bit-identical to the
+    single-query decode kernel."""
     assert "SERVING_KERNEL_OK" in _run_driver("kernel")
 
 
@@ -187,10 +228,17 @@ def test_serving_engine_invariants():
     subprocess (AOT-memo-shared — no extra compiles): prefix sharing +
     COW correctness vs the dense reference with refcount conservation,
     and the per-request sampling laws (seeded reproducibility,
-    top_k=1 == greedy, per-slot isolation)."""
+    top_k=1 == greedy, per-slot isolation).  The fast ISSUE-16 spec
+    laws ride the same subprocess: spec-on greedy streams bit-identical
+    to the dense reference under staggered join/leave at mixed ragged
+    lengths, drafting non-vacuous and strictly cheaper in decode steps,
+    the serve.spec.poison drill (corrupted drafts between draft and
+    verify -> all rejected, exact non-speculative stream), per-request
+    spec_k=0 override, and zero speculative page marks at idle."""
     out = _run_driver("engine")
     assert "SERVING_ENGINE_OK" in out
     assert "SERVING_CAPACITY_FAST_OK" in out
+    assert "SERVING_SPEC_FAST_OK" in out
 
 
 @pytest.mark.slow
@@ -201,6 +249,17 @@ def test_serving_capacity_multipliers():
     and the >= 1.5x resident-capacity multiplier at K_kv = H/2 in the
     same pool bytes."""
     assert "SERVING_CAPACITY_OK" in _run_driver("capacity")
+
+
+@pytest.mark.slow
+def test_serving_spec_k_sweep():
+    """ISSUE 16 exhaustive spec_k sweep (slow: every k compiles its own
+    spec-decode program; the fast single-config siblings ride the
+    engine section): greedy bit-identity to the dense reference,
+    sampled seeded reproducibility, and zero leaked speculative pages
+    at k = 1, 2, 8 and 16 — the wpe boundary where
+    max_seq_len + spec_k == the net's max_len."""
+    assert "SERVING_SPEC_SWEEP_OK" in _run_driver("spec_sweep")
 
 
 # -- predictor satellite (no pallas needed) --------------------------------

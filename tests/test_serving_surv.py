@@ -458,13 +458,17 @@ def test_surv_fast_sections():
     replacement, live hot-swap (invisible to residents, takes effect,
     torn swap rolls back), the per-request sampling determinism law
     (same seed/params -> identical tokens across batch compositions, a
-    join/leave, and a router failover re-decode), and the
-    serve.prefix.evict drill (victim falls back to a full prefill with
-    correct tokens) — one clean process."""
+    join/leave, and a router failover re-decode), the ISSUE-16
+    speculative-decoding determinism laws under the same churn (greedy
+    spec-on == dense chain in any batch composition; sampled spec
+    streams reproduce across churn, an identical-weights hot-swap, and
+    a failover re-decode; spec page marks never survive a step or a
+    drain), and the serve.prefix.evict drill (victim falls back to a
+    full prefill with correct tokens) — one clean process."""
     _, out = _run_driver("fast")
     for marker in ("SERVING_LIFECYCLE_OK", "SERVING_ROUTER_OK",
                    "SERVING_SWAP_OK", "SERVING_SAMPLING_OK",
-                   "SERVING_PREFIX_EVICT_OK"):
+                   "SERVING_SPEC_OK", "SERVING_PREFIX_EVICT_OK"):
         assert marker in out, out[-3000:]
 
 

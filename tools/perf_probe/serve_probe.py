@@ -133,7 +133,7 @@ def _req_stats(ttfts, tpots, waits):
 
 def run_continuous(net, workload, num_slots=8, page_size=16,
                    max_prefill_len=32, max_seq_len=48, num_pages=None,
-                   prefix_cache=None, sampling=None):
+                   prefix_cache=None, sampling=None, spec_k=None):
     """Open-loop drive of the ServingEngine; returns throughput, latency
     percentiles, occupancy, and the dispatch/compile accounting —
     WITH request-scope tracing live (it is always on: the 1.0
@@ -143,7 +143,8 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
 
     ``prefix_cache``: forwarded to the engine (None = its default);
     ``sampling``: optional per-request SamplingParams list aligned with
-    the workload (None entries = greedy)."""
+    the workload (None entries = greedy); ``spec_k``: speculative
+    decode depth (None = the engine's env default, 0 = off)."""
     from mxnet_tpu import profiler, telemetry
     from mxnet_tpu.serving import ServingEngine
     import numpy as np
@@ -151,7 +152,7 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
     eng = ServingEngine(net, num_slots=num_slots, page_size=page_size,
                         max_prefill_len=max_prefill_len,
                         max_seq_len=max_seq_len, num_pages=num_pages,
-                        prefix_cache=prefix_cache)
+                        prefix_cache=prefix_cache, spec_k=spec_k)
     # warmup: both programs execute once (first-call overhead, twin
     # hot-swap settle) before the timed workload
     eng.generate([np.zeros(4, np.int32)], max_new=2)
@@ -160,6 +161,7 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
     base = profiler.step_stats()
     d0, c0 = base["dispatch_count"], base["compile_count"]
     steps0, prefills0 = eng.decode_steps, eng.prefills
+    slot_steps0, discarded0 = eng.spec_slot_steps, eng.spec_discarded
 
     reqs = []
     pending = list(workload)
@@ -218,6 +220,22 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
             telemetry.counter("serving.prefix.cow_copies").value,
         "sampling_requests":
             telemetry.counter("serving.sampling.requests").value,
+        # speculative-decode accounting (ISSUE 16; all 0 with spec off).
+        # tokens_per_slot_step is the per-sequence multiplier — decode
+        # tokens per slot participation — exactly 1.0 for a
+        # non-speculative engine by construction
+        "spec_k": eng.spec_k,
+        "spec_draft_tokens":
+            telemetry.counter("serving.spec.draft_tokens").value,
+        "spec_accepted": telemetry.counter("serving.spec.accepted").value,
+        "spec_rejected": telemetry.counter("serving.spec.rejected").value,
+        "spec_rollbacks":
+            telemetry.counter("serving.spec.rollbacks").value,
+        "spec_slot_steps": eng.spec_slot_steps - slot_steps0,
+        "spec_discarded": eng.spec_discarded - discarded0,
+        "tokens_per_slot_step": round(
+            decode_tokens / (eng.spec_slot_steps - slot_steps0), 4)
+        if eng.spec_slot_steps > slot_steps0 else 1.0,
     }
     out.update(_req_stats([r.ttft_s for r in reqs],
                           [r.tpot_s for r in reqs
@@ -354,6 +372,162 @@ def run_prefix(net, workload=None):
         "tokens_per_sec_off": off["tokens_per_sec"],
         "ttft_p50_ms_on": on["ttft_p50_ms"],
         "ttft_p50_ms_off": off["ttft_p50_ms"],
+    }
+
+
+# -- speculative decoding (ISSUE 16) ---------------------------------------
+
+def make_spec_workload(net, n_requests=16, mean_interarrival_s=0.004,
+                       prompt_lens=(8, 14), new_tokens=(24, 40),
+                       pregen=10, vocab=256, seed=29, num_slots=8,
+                       page_size=16, max_prefill_len=16,
+                       max_seq_len=56):
+    """An acceptance-friendly Poisson workload for the speculative
+    decoder: every prompt is a short random seed followed by the
+    model's OWN greedy continuation (pre-generated once, untimed), so
+    the decode chain is self-similar from the first step and the
+    n-gram drafter has material to hit — the serving analog of
+    templated/system-prompt text, which is what speculative decoding
+    exists for.  Same trace for spec-on and spec-off."""
+    import numpy as np
+    from mxnet_tpu.serving import ServingEngine
+
+    rng = np.random.RandomState(seed)
+    seeds = [rng.randint(0, vocab, int(rng.randint(2, 5)))
+             .astype(np.int32) for _ in range(n_requests)]
+    pre = ServingEngine(net, num_slots=num_slots, page_size=page_size,
+                        max_prefill_len=max_prefill_len,
+                        max_seq_len=max_seq_len)
+    conts = pre.generate(seeds, max_new=pregen)
+    t = 0.0
+    out = []
+    for sd, cont in zip(seeds, conts):
+        t += float(rng.exponential(mean_interarrival_s))
+        plen = int(rng.randint(prompt_lens[0], prompt_lens[1] + 1))
+        prompt = np.concatenate(
+            [sd, np.asarray(cont, np.int32)])[:plen].astype(np.int32)
+        out.append((t, prompt,
+                    int(rng.randint(new_tokens[0], new_tokens[1] + 1))))
+    return out
+
+
+def run_spec(net=None, spec_k=6):
+    """The speculative-decoding contract (hard-asserted by
+    ``BENCH_MODE=serve``): spec-on vs spec-off on the SAME
+    acceptance-friendly workload, same engine geometry, both arms
+    driven twice (best wall per arm — single-pass wall on a shared
+    box is noisy; tokens must be identical across passes regardless).
+
+    What bench pins on this dict:
+
+    - ``speedup_tokens_per_sec`` >= 1.5 — the tentpole multiplier;
+    - ``tokens_per_slot_step`` > 1.3 — tokens per slot participation
+      (1.0 == non-speculative by construction);
+    - greedy bit-identity: spec-on tokens == spec-off tokens;
+    - 1.0 decode dispatch/step and 0 steady-state recompiles with
+      spec ON — drafts ride the SAME donated program;
+    - counter identity: drafted == accepted + rejected and
+      decode tokens == slot_steps + accepted - discarded;
+    - sampled reproducibility: a mixed greedy/sampled spec-on run
+      repeats bit-identically, and reproduces across a 2-replica
+      router failover (``serve.replica.lost``) onto a spun-up
+      replacement — the per-request determinism law survives the
+      re-decode.
+
+    The probe net is WIDER than the default (d_model 256): the
+    speculative program spends extra FLOPs per dispatch to verify k
+    drafts, so the win needs dispatch cost to be dominated by model
+    compute, exactly as on the real accelerator where decode is
+    bandwidth-bound.  See SERVING.md section 2c for when NOT to
+    enable."""
+    import numpy as np
+    from mxnet_tpu import fault
+    from mxnet_tpu.serving import (Router, SamplingParams,
+                                   ServingEngine, ServingReplica)
+
+    if net is None:
+        net = build_net(d_model=256)
+    kw = dict(num_slots=8, page_size=16, max_prefill_len=16,
+              max_seq_len=56)
+    workload = make_spec_workload(net, **kw)
+
+    def arm(k):
+        a = run_continuous(net, workload, spec_k=k, **kw)
+        b = run_continuous(net, workload, spec_k=k, **kw)
+        if a["tokens"] != b["tokens"]:
+            raise AssertionError(
+                "spec_k=%r emitted different tokens on identical "
+                "back-to-back runs" % k)
+        return a if a["tokens_per_sec"] >= b["tokens_per_sec"] else b
+
+    on, off = arm(spec_k), arm(0)
+    on_tokens, off_tokens = on.pop("tokens"), off.pop("tokens")
+
+    # mixed greedy/sampled determinism: same workload, every other
+    # request sampled; two identical runs, then the same requests
+    # replayed through a 2-replica router with one replica killed
+    # mid-flight — every stream must reproduce bit-exactly
+    sampling = [None if i % 2 == 0 else
+                SamplingParams(temperature=0.8, top_k=24, top_p=0.95,
+                               seed=5000 + i)
+                for i in range(len(workload))]
+    r1 = run_continuous(net, workload, sampling=sampling,
+                        spec_k=spec_k, **kw)
+    r2 = run_continuous(net, workload, sampling=sampling,
+                        spec_k=spec_k, **kw)
+    repro_match = r1["tokens"] == r2["tokens"]
+
+    def mk_replica(rid):
+        return ServingReplica(
+            ServingEngine(net, spec_k=spec_k, **kw), replica_id=rid)
+
+    rt = Router([mk_replica("sa"), mk_replica("sb")],
+                spawn=lambda: mk_replica("s-replacement"),
+                max_retries=2)
+    rrs = [rt.submit(p, m, sampling=sp)
+           for (_, p, m), sp in zip(workload, sampling)]
+    fault.configure("serve.replica.lost:1")
+    try:
+        steps = 0
+        while not rt.idle and steps < 10000:
+            rt.step()
+            steps += 1
+    finally:
+        fault.reset()
+    failover_tokens = [list(map(int, rr.tokens)) for rr in rrs]
+    failover_match = failover_tokens == r1["tokens"]
+
+    dec_on = on["total_tokens"] - on["prefill_dispatches"]
+    return {
+        "requests": len(workload),
+        "spec_k": spec_k,
+        "speedup_tokens_per_sec": round(
+            on["tokens_per_sec"] / off["tokens_per_sec"], 3),
+        "tokens_per_sec_on": on["tokens_per_sec"],
+        "tokens_per_sec_off": off["tokens_per_sec"],
+        "tokens_match_spec_off": on_tokens == off_tokens,
+        "tokens_per_slot_step": on["tokens_per_slot_step"],
+        "decode_steps_on": on["decode_steps"],
+        "decode_steps_off": off["decode_steps"],
+        "decode_dispatches_per_step": on["decode_dispatches_per_step"],
+        "steady_state_compiles": on["steady_state_compiles"],
+        "draft_tokens": on["spec_draft_tokens"],
+        "accepted": on["spec_accepted"],
+        "rejected": on["spec_rejected"],
+        "rollbacks": on["spec_rollbacks"],
+        "acceptance_rate": round(
+            on["spec_accepted"] / max(1, on["spec_draft_tokens"]), 4),
+        "counter_identity_draft": on["spec_draft_tokens"]
+        == on["spec_accepted"] + on["spec_rejected"],
+        "counter_identity_tokens": dec_on
+        == on["spec_slot_steps"] + on["spec_accepted"]
+        - on["spec_discarded"],
+        "spec_off_drafted": off["spec_draft_tokens"],
+        "sampled_repro_match": repro_match,
+        "failover_completed": sum(1 for rr in rrs
+                                  if rr.state == "completed"),
+        "failover_failovers": rt.failovers,
+        "failover_tokens_match": failover_match,
     }
 
 
@@ -862,6 +1036,7 @@ def run(spinup=True, degraded=True, fleet=True):
         "trace_overhead_us": measure_trace_overhead(),
         "prefix": run_prefix(net),
         "gqa": run_gqa(net),
+        "spec": run_spec(),
     }
     if degraded:
         result["degraded"] = run_degraded(net, workload, cont_tokens)

@@ -508,12 +508,23 @@ def accounting(data, reqs):
     flops/bytes-per-token from the compile-time cost attribution joined
     with the measured execution counts."""
     tokens = goodput = requests = dropped = 0
+    spec = {"draft_tokens": 0, "accepted": 0, "rejected": 0,
+            "rollbacks": 0}
     for c in data["counters"].values():
         tokens += c.get("serving.tokens", 0)
         goodput += c.get("serving.goodput", 0)
         requests += c.get("serving.requests", 0)
         dropped += c.get("serving.trace_dropped", 0)
+        for key in spec:
+            spec[key] += c.get("serving.spec." + key, 0)
     traced = sum(len(r["token_ts"]) for r in reqs.values())
+    # fleet tokens-per-dispatch (ISSUE 16): decode tokens over decode
+    # dispatches — 1.0 without speculation, > 1 when accepted drafts
+    # multiply what each donated dispatch commits
+    decode_steps = sum(s.get("decode_steps") or 0
+                       for s in data["status"].values())
+    prefills = sum(s.get("prefills") or 0
+                   for s in data["status"].values())
     flops = bytes_ = 0.0
     have_cost = False
     for snap in data["status"].values():
@@ -540,6 +551,11 @@ def accounting(data, reqs):
         else None,
         "bytes_per_token": (bytes_ / tokens) if have_cost and tokens
         else None,
+        "spec": spec if spec["draft_tokens"] else None,
+        "acceptance_rate": (spec["accepted"] / spec["draft_tokens"]
+                            if spec["draft_tokens"] else None),
+        "tokens_per_dispatch": ((tokens - prefills) / decode_steps
+                                if decode_steps else None),
     }
 
 
@@ -704,15 +720,28 @@ def render(rep, out=sys.stdout):
             out.write("  OPEN TRACE (no final verdict): %s\n" % tr)
 
     out.write("\n-- per-replica request matrix --\n")
+    # per-replica dispatch accounting from the status snapshots: the
+    # tokens-per-dispatch column (ISSUE 16) reads 1.00 on a
+    # non-speculative replica and > 1 where accepted drafts multiplied
+    # what each donated decode dispatch committed
+    snaps = {}
+    for snap in data["status"].values():
+        if snap.get("replica"):
+            snaps[snap["replica"]] = snap
     rows = []
     for tag in sorted(rep["matrix"]):
         m = rep["matrix"][tag]
-        rows.append((tag, m["admits"], m["tokens"], m["retries_out"],
+        snap = snaps.get(tag) or {}
+        steps = snap.get("decode_steps") or 0
+        pre = snap.get("prefills") or 0
+        tpd = ("%.2f" % ((m["tokens"] - pre) / steps)) if steps else "-"
+        rows.append((tag, m["admits"], m["tokens"], tpd,
+                     m["retries_out"],
                      "  ".join("%s=%d" % kv
                                for kv in sorted(m["verdicts"].items()))
                      or "-"))
-    _tr._table(("replica", "admits", "tokens", "lost", "verdicts"),
-               rows, out)
+    _tr._table(("replica", "admits", "tokens", "tok/disp", "lost",
+                "verdicts"), rows, out)
 
     out.write("\n-- latency by verdict class --\n")
     rows = []
@@ -801,6 +830,17 @@ def render(rep, out=sys.stdout):
         out.write("  cost per token: %.3g flops, %.3g bytes accessed "
                   "(compile-time attribution x measured executions)\n"
                   % (acc["flops_per_token"], acc["bytes_per_token"]))
+    if acc.get("spec"):
+        sp = acc["spec"]
+        out.write("  spec decode: drafted=%d accepted=%d rejected=%d "
+                  "rollbacks=%d  acceptance=%.1f%%  "
+                  "tokens/dispatch=%s\n"
+                  % (sp["draft_tokens"], sp["accepted"],
+                     sp["rejected"], sp["rollbacks"],
+                     100.0 * (acc["acceptance_rate"] or 0.0),
+                     "%.2f" % acc["tokens_per_dispatch"]
+                     if acc["tokens_per_dispatch"] is not None
+                     else "-"))
 
 
 def main(argv=None):

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Tier-1 wall-margin report (ISSUE 16): how many seconds of headroom
+the tier-1 suite has left against the CI wall.
+
+Usage::
+
+    python tools/tier1_margin.py /tmp/_t1.log [--wall 870]
+
+Parses the pytest summary line (``... in 743.21s (0:12:23) ...``) from
+a captured tier-1 log and prints the wall, the suite's elapsed
+seconds, and the remaining margin.  Exits 1 when the suite ran over
+the wall (negative margin), 2 when no summary line is found (the run
+died before pytest could report — e.g. the ``timeout`` harness killed
+it), so CI can gate on shrinking headroom instead of discovering the
+wall the hard way.
+"""
+import re
+import sys
+
+_SUMMARY = re.compile(r"\bin (\d+(?:\.\d+)?)s\b")
+
+
+def margin(log_text, wall=870.0):
+    """Return ``(elapsed_s, margin_s)`` from the LAST pytest summary
+    line in ``log_text``, or ``(None, None)`` when absent."""
+    hits = _SUMMARY.findall(log_text)
+    if not hits:
+        return None, None
+    elapsed = float(hits[-1])
+    return elapsed, wall - elapsed
+
+
+def main(argv):
+    args = [a for a in argv if not a.startswith("--")]
+    wall = 870.0
+    for a in argv:
+        if a.startswith("--wall"):
+            wall = float(a.split("=", 1)[1] if "=" in a
+                         else argv[argv.index(a) + 1])
+    if not args:
+        sys.stderr.write(__doc__)
+        return 2
+    with open(args[0]) as f:
+        text = f.read()
+    elapsed, m = margin(text, wall)
+    if elapsed is None:
+        print("tier1-margin: no pytest summary line found in %s "
+              "(run killed before reporting?)" % args[0])
+        return 2
+    print("tier1-margin: suite %.1fs, wall %.0fs, margin %+.1fs (%.0f%%"
+          " of wall used)" % (elapsed, wall, m, 100.0 * elapsed / wall))
+    return 1 if m < 0 else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
