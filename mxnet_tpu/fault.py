@@ -108,6 +108,24 @@ Sites wired in this package:
                           client-side (worker not up yet / already
                           gone): exercises the bounded retry + backoff
                           + jitter path deterministically.
+- ``rpc.heartbeat.drop``  ONLY heartbeat replies are blackholed while
+                          the data plane keeps answering (ISSUE 17):
+                          the proxy must raise a suspicion (gauge +
+                          counter) but NEVER confirm death — losing
+                          the control plane alone is not a failover.
+- ``rpc.partition``       asymmetric router→replica blackhole: every
+                          RPC from the router parks unanswered while
+                          the replica keeps decoding.  The router must
+                          fail over AND fence the zombie — its late
+                          completions come back under a fenced-out
+                          incarnation and are rejected
+                          (``rpc.fenced_results``), keeping
+                          at-most-once through a split brain.
+- ``serve.worker.zombie`` the worker swallows its ``drain`` RPC (no
+                          ack, no drain): the supervisor's stop path
+                          must escalate SIGTERM→SIGKILL and the
+                          replacement come up under a fresh
+                          incarnation the proxy confirms.
 - ``io.decode.slow``      bounded per-task delay in the decode worker
                           (``MXTPU_FAULT_DELAY_SECS``): the INPUT
                           flavor of the straggler — shows in

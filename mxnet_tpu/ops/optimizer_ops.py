@@ -36,9 +36,16 @@ def _live_rows(grad):
     return live_row_mask(grad).reshape((-1,) + (1,) * (grad.ndim - 1))
 
 
+#: per-step scalars (a scheduler's lr, Adam's bias-corrected lr) are traced
+#: arguments, not compile-time constants — one executable per shape, not one
+#: per value (registry.OpDef.dynamic_params)
+_DYN = ("lr", "wd", "rescale_grad")
+
+
 @register_op("sgd_update", arg_names=("weight", "grad"),
              param_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
-                             "clip_gradient": -1.0, "lazy_update": False})
+                             "clip_gradient": -1.0, "lazy_update": False},
+             dynamic_params=_DYN)
 def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, lazy_update=False):
     g = _rescale(grad, rescale_grad, clip_gradient)
@@ -54,7 +61,8 @@ def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
              num_outputs=2,
              param_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
                              "rescale_grad": 1.0, "clip_gradient": -1.0,
-                             "lazy_update": False})
+                             "lazy_update": False},
+             dynamic_params=_DYN)
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
     g = _rescale(grad, rescale_grad, clip_gradient)
@@ -69,7 +77,8 @@ def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 @register_op("mp_sgd_update", arg_names=("weight", "grad", "weight32"),
              num_outputs=2,
              param_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
-                             "clip_gradient": -1.0})
+                             "clip_gradient": -1.0},
+             dynamic_params=_DYN)
 def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0):
     # fp16 weights with fp32 master copy (mp_sgd_update in the reference)
@@ -81,7 +90,8 @@ def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
 @register_op("mp_sgd_mom_update",
              arg_names=("weight", "grad", "mom", "weight32"), num_outputs=3,
              param_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
-                             "rescale_grad": 1.0, "clip_gradient": -1.0})
+                             "rescale_grad": 1.0, "clip_gradient": -1.0},
+             dynamic_params=_DYN)
 def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
                        wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     grad = _rescale(grad.astype(jnp.float32), rescale_grad, clip_gradient)
@@ -94,7 +104,8 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
              num_outputs=3,
              param_defaults={"lr": 0.001, "beta1": 0.9, "beta2": 0.999,
                              "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
-                             "clip_gradient": -1.0, "lazy_update": False})
+                             "clip_gradient": -1.0, "lazy_update": False},
+             dynamic_params=_DYN)
 def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                  lazy_update=False):
@@ -116,7 +127,8 @@ def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
              num_outputs=2,
              param_defaults={"lr": 0.001, "gamma1": 0.95, "epsilon": 1e-8,
                              "wd": 0.0, "rescale_grad": 1.0,
-                             "clip_gradient": -1.0, "clip_weights": -1.0})
+                             "clip_gradient": -1.0, "clip_weights": -1.0},
+             dynamic_params=_DYN)
 def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                     clip_weights=-1.0):
@@ -132,7 +144,8 @@ def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
              arg_names=("weight", "grad", "n", "g", "delta"), num_outputs=4,
              param_defaults={"lr": 0.001, "gamma1": 0.95, "gamma2": 0.9,
                              "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
-                             "clip_gradient": -1.0, "clip_weights": -1.0})
+                             "clip_gradient": -1.0, "clip_weights": -1.0},
+             dynamic_params=_DYN)
 def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
                         gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                         clip_gradient=-1.0, clip_weights=-1.0):
@@ -386,7 +399,8 @@ def handle_guard_verdict(ok, optimizer, indices, streak, pre_num_update,
              num_outputs=3,
              param_defaults={"lr": 0.1, "lamda1": 0.01, "beta": 1.0,
                              "wd": 0.0, "rescale_grad": 1.0,
-                             "clip_gradient": -1.0})
+                             "clip_gradient": -1.0},
+             dynamic_params=_DYN)
 def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=-1.0):
     grad = _rescale(grad, rescale_grad, clip_gradient)
@@ -398,3 +412,48 @@ def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
         -(new_z - jnp.sign(new_z) * lamda1) /
         ((beta + jnp.sqrt(new_n)) / lr + wd))
     return new_weight, new_z, new_n
+
+
+@register_op("adamax_update", arg_names=("weight", "grad", "m", "u"),
+             num_outputs=3,
+             param_defaults={"lr": 0.002, "beta1": 0.9, "beta2": 0.999,
+                             "wd": 0.0, "rescale_grad": 1.0,
+                             "clip_gradient": -1.0},
+             dynamic_params=_DYN)
+def _adamax_update(weight, grad, m, u, lr=0.002, beta1=0.9, beta2=0.999,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    # ``lr`` arrives bias-corrected (lr / (1 - beta1^t)) from the host,
+    # like adam_update's — reference optimizer.py:927 AdaMax
+    g = _rescale(grad, rescale_grad, clip_gradient) + wd * weight
+    new_m = beta1 * m + (1.0 - beta1) * g
+    new_u = jnp.maximum(beta2 * u, jnp.abs(g))
+    return weight - lr * new_m / new_u, new_m, new_u
+
+
+@register_op("nadam_update", arg_names=("weight", "grad", "m", "v"),
+             num_outputs=3,
+             param_defaults={"lr": 0.001, "beta1": 0.9, "beta2": 0.999,
+                             "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
+                             "clip_gradient": -1.0, "momentum_t": 0.9,
+                             "momentum_t_1": 0.9, "m_schedule": 0.9,
+                             "m_schedule_next": 0.81, "coef2": 1.0},
+             dynamic_params=_DYN + ("momentum_t", "momentum_t_1",
+                                    "m_schedule", "m_schedule_next",
+                                    "coef2"))
+def _nadam_update(weight, grad, m, v, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                  momentum_t=0.9, momentum_t_1=0.9, m_schedule=0.9,
+                  m_schedule_next=0.81, coef2=1.0):
+    # Nesterov Adam (reference optimizer.py:975).  The momentum schedule
+    # (mu_t, mu_{t+1}, their running products, and 1 - beta2^t) is t-bound
+    # host state, so it rides in as dynamic scalars — one compiled program
+    # serves the whole training run.
+    g = _rescale(grad, rescale_grad, clip_gradient) + wd * weight
+    new_m = beta1 * m + (1.0 - beta1) * g
+    new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+    g_prime = g / (1.0 - m_schedule)
+    m_prime = new_m / (1.0 - m_schedule_next)
+    v_prime = new_v / coef2
+    m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+    return (weight - lr * m_bar / (jnp.sqrt(v_prime) + epsilon),
+            new_m, new_v)

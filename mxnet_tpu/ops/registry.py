@@ -48,7 +48,8 @@ class OpDef:
 
     def __init__(self, name, fn, arg_names=("data",), aux_names=(),
                  num_outputs=1, param_defaults=None, mutate_aux=False,
-                 backward_ignore=(), needs_rng=False, takes_train=False):
+                 backward_ignore=(), needs_rng=False, takes_train=False,
+                 dynamic_params=()):
         self.name = name
         self.fn = fn
         self._arg_names = arg_names
@@ -66,6 +67,15 @@ class OpDef:
         # op behaves differently in training: fn takes kwarg ``_train``
         # (the analogue of OpContext::is_train)
         self.takes_train = takes_train
+        # scalar params traced as jit ARGUMENTS instead of baked into the
+        # compiled program: values that vary per call (a scheduler's lr,
+        # Adam's bias-corrected lr, Nadam's momentum schedule) must not
+        # key the jit cache, else every step compiles a fresh executable
+        # and the cache grows one entry per distinct value.  Only params
+        # used purely arithmetically qualify — anything consulted by
+        # Python control flow (clip_gradient's sign test, lazy_update)
+        # must stay static.
+        self.dynamic_params = tuple(dynamic_params)
         self._jit_cache = {}
 
     # -- metadata ---------------------------------------------------------
@@ -92,7 +102,28 @@ class OpDef:
 
     # -- execution --------------------------------------------------------
     def jitted(self, **params):
-        """A jitted closure of fn over params (cached per param set)."""
+        """A jitted closure of fn over params, cached per STATIC param
+        set.  ``dynamic_params`` present in ``params`` ride as traced
+        scalar arguments: the returned callable still takes arrays only
+        (their current values are bound in a partial), so callers — and
+        the autograd tape replaying it — are none the wiser, but every
+        value of a dynamic param reuses one compiled executable."""
+        dyn_names = tuple(k for k in self.dynamic_params if k in params)
+        if dyn_names:
+            dyn_vals = tuple(float(params[k]) for k in dyn_names)
+            static = {k: v for k, v in params.items()
+                      if k not in dyn_names}
+            key = (dyn_names, _hashable(static))
+            fun = self._jit_cache.get(key)
+            if fun is None:
+                fn = functools.partial(self.fn, **static)
+
+                def _call(_dyn, *arrays):
+                    return fn(*arrays, **dict(zip(dyn_names, _dyn)))
+
+                fun = jax.jit(_call)
+                self._jit_cache[key] = fun
+            return functools.partial(fun, dyn_vals)
         key = _hashable(params)
         fun = self._jit_cache.get(key)
         if fun is None:
@@ -114,13 +145,14 @@ class OpDef:
 
 def register_op(name, arg_names=("data",), aux_names=(), num_outputs=1,
                 param_defaults=None, mutate_aux=False, backward_ignore=(),
-                needs_rng=False, takes_train=False):
+                needs_rng=False, takes_train=False, dynamic_params=()):
     """Decorator registering ``fn`` as operator ``name``."""
     def _reg(fn):
         op = OpDef(name, fn, arg_names=arg_names, aux_names=aux_names,
                    num_outputs=num_outputs, param_defaults=param_defaults,
                    mutate_aux=mutate_aux, backward_ignore=backward_ignore,
-                   needs_rng=needs_rng, takes_train=takes_train)
+                   needs_rng=needs_rng, takes_train=takes_train,
+                   dynamic_params=dynamic_params)
         _OP_REGISTRY[name] = op
         return fn
     return _reg

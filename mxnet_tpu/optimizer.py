@@ -20,11 +20,11 @@ import pickle
 import numpy
 
 from .ndarray import (NDArray, zeros, clip as nd_clip, sqrt as nd_sqrt,
-                      square as nd_square, sign as nd_sign,
-                      maximum as nd_maximum, abs as nd_abs)
+                      square as nd_square)
 from .ndarray import (sgd_update, sgd_mom_update, mp_sgd_update,
                       mp_sgd_mom_update, adam_update, rmsprop_update,
-                      rmspropalex_update, ftrl_update)
+                      rmspropalex_update, ftrl_update, adamax_update,
+                      nadam_update)
 from . import random as _random
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
@@ -519,14 +519,15 @@ class Adamax(Optimizer):
         self._update_count(index)
         t = self._index_update_count[index]
         lr /= (1.0 - self.beta1 ** t)
-        grad = _clip(grad * self.rescale_grad, self.clip_gradient) + \
-            wd * weight
         m_t, u_t = state
-        new_m = self.beta1 * m_t + (1.0 - self.beta1) * grad
-        new_u = nd_maximum(self.beta2 * u_t, nd_abs(grad))
-        m_t._set_data(new_m._data)
-        u_t._set_data(new_u._data)
-        weight._set_data((weight - lr * new_m / new_u)._data)
+        out = adamax_update(weight, grad, m_t, u_t, lr=lr, beta1=self.beta1,
+                            beta2=self.beta2, wd=wd,
+                            rescale_grad=self.rescale_grad,
+                            clip_gradient=(self.clip_gradient
+                                           if self.clip_gradient else -1.0))
+        weight._set_data(out[0]._data)
+        m_t._set_data(out[1]._data)
+        u_t._set_data(out[2]._data)
 
 
 @register
@@ -551,8 +552,6 @@ class Nadam(Optimizer):
         wd = self._get_wd(index)
         self._update_count(index)
         t = self._index_update_count[index]
-        grad = _clip(grad * self.rescale_grad, self.clip_gradient) + \
-            wd * weight
         momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 **
                                    (t * self.schedule_decay))
         momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
@@ -560,18 +559,18 @@ class Nadam(Optimizer):
         self.m_schedule = self.m_schedule * momentum_t
         m_schedule_next = self.m_schedule * momentum_t_1
         m_t, v_t = state
-        new_m = self.beta1 * m_t + (1.0 - self.beta1) * grad
-        new_v = self.beta2 * v_t + (1.0 - self.beta2) * nd_square(grad)
-        grad_prime = grad / (1.0 - self.m_schedule)
-        m_t_prime = new_m / (1.0 - m_schedule_next)
-        v_t_prime = new_v / (1.0 - self.beta2 ** t)
-        m_t_bar = (1.0 - momentum_t) * grad_prime + \
-            momentum_t_1 * m_t_prime
-        m_t._set_data(new_m._data)
-        v_t._set_data(new_v._data)
-        weight._set_data(
-            (weight - lr * m_t_bar / (nd_sqrt(v_t_prime) +
-                                      self.epsilon))._data)
+        out = nadam_update(weight, grad, m_t, v_t, lr=lr, beta1=self.beta1,
+                           beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                           rescale_grad=self.rescale_grad,
+                           clip_gradient=(self.clip_gradient
+                                          if self.clip_gradient else -1.0),
+                           momentum_t=momentum_t, momentum_t_1=momentum_t_1,
+                           m_schedule=self.m_schedule,
+                           m_schedule_next=m_schedule_next,
+                           coef2=1.0 - self.beta2 ** t)
+        weight._set_data(out[0]._data)
+        m_t._set_data(out[1]._data)
+        v_t._set_data(out[2]._data)
 
 
 @register

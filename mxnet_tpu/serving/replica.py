@@ -268,6 +268,17 @@ class ServingReplica:
         self.alive = False
         _watchdog.release(self.engine._lease)
 
+    def progress(self):
+        """The monotonic progress sequence the heartbeat RPC carries
+        (ISSUE 17): decode steps + installed weights epoch.  A replica
+        whose sequence advances is ALIVE whatever the transport says —
+        the proxy's fence-expiry confirmation requires this to have
+        stalled, so a busy replica behind a flaky link never gets
+        failed over for slowness alone."""
+        epoch = self.engine.weights_epoch
+        return {"decode_steps": int(self.engine.decode_steps),
+                "weights_epoch": None if epoch is None else int(epoch)}
+
     def health(self):
         """Lease-derived liveness + the engine snapshot: what a fleet
         health endpoint returns."""

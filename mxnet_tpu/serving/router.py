@@ -30,7 +30,16 @@ least-loaded live replica.  The survivability contract:
   fresh replica on failover (the PR-6 elastic replace move).  With a
   shared AOT cache / in-process memo the replacement comes up warm: 0
   foreground compiles before its first token (asserted by
-  ``BENCH_MODE=serve``'s degraded-mode contract).
+  ``BENCH_MODE=serve``'s degraded-mode contract);
+- **fencing** (ISSUE 17) — every placement is stamped with the
+  target's incarnation and the slot's fencing epoch; a failover bumps
+  the victim slot's epoch and enrolls the abandoned handles in a
+  bounded zombie watch.  A "dead" replica that was actually alive
+  behind a partition and finishes its work late gets that completion
+  REJECTED at the router (typed ``fenced`` verdict event +
+  ``rpc.fenced_results`` counter; journal replay treats ``fenced``
+  lines as non-terminal) — the split-brain case can be OBSERVED
+  violating nothing, instead of trusted not to happen.
 
 The journal can additionally be mirrored to a JSON-lines file
 (``journal_path``; defaults to ``$MXTPU_SERVE_JOURNAL`` — the
@@ -95,7 +104,7 @@ class RouterRequest:
     __slots__ = ("rid", "prompt", "max_new", "deadline_s", "deadline_t",
                  "state", "verdict", "error", "tokens", "replica_id",
                  "retries", "trace", "sampling", "spec_k", "_live",
-                 "_home")
+                 "_home", "_placed_inc")
 
     def __init__(self, rid, prompt, max_new, deadline_s):
         self.rid = rid
@@ -126,6 +135,8 @@ class RouterRequest:
         self._live = None      # the engine Request currently decoding
         self._home = None      # the replica OBJECT it decodes on (ids
                                # are caller-supplied and may collide)
+        self._placed_inc = None  # fencing token: the target's
+                                 # incarnation stamp at placement
 
     @property
     def done(self):
@@ -134,12 +145,23 @@ class RouterRequest:
 
 class Router:
     def __init__(self, replicas, spawn=None, max_retries=1,
-                 journal_path=None, journal_retention=4096):
+                 journal_path=None, journal_retention=4096,
+                 fence_watch_s=30.0):
         self._replicas = list(replicas)
         self._spawn = spawn
         self.max_retries = int(max_retries)
         self._journal = {}           # rid -> RouterRequest
         self._inflight = set()       # rids currently accepted somewhere
+        # -- fencing (ISSUE 17): per-slot epochs + the zombie watch --
+        # every failover bumps the victim slot's epoch; the victims'
+        # abandoned handles are WATCHED (bounded by fence_watch_s) so a
+        # zombie that finishes them behind a partition gets its late
+        # completion observed and REJECTED with the typed ``fenced``
+        # verdict event, instead of silently never being read — the
+        # at-most-once law stays auditable, not merely structural
+        self._fence_epoch = {}       # slot key -> fencing epoch
+        self._fenced = []            # [{rr, mirror, proxy, ...}]
+        self.fence_watch_s = float(fence_watch_s)
         # run-dir layout default (tools/launch.py exports it next to
         # the replica telemetry streams — serve_report's input contract)
         self._journal_path = (journal_path if journal_path is not None
@@ -205,14 +227,22 @@ class Router:
         only: a restarted router has no engine handle to harvest, and
         re-submitting is the CALLER's decision, not a silent replay.
 
-        Returns ``{"entries", "requests", "torn"}``."""
+        ``fenced`` entries — a zombie incarnation's late completion,
+        rejected at the router — replay as NON-TERMINAL: they are
+        counted and advance ``_next_rid``, but never fold into the
+        request's state or verdict (the fenced line describes the
+        fenced-out incarnation's rejected work; the request's own
+        story is told by its accept/retry/complete lines).
+
+        Returns ``{"entries", "requests", "torn", "fenced"}``."""
         path = path or self._journal_path
-        torn = applied = 0
+        torn = applied = fenced = 0
         try:
             with open(path, "rb") as f:
                 raw = f.read()
         except OSError:
-            return {"entries": 0, "requests": 0, "torn": 0}
+            return {"entries": 0, "requests": 0, "torn": 0,
+                    "fenced": 0}
         for line in raw.split(b"\n"):
             if not line.strip():
                 continue
@@ -228,9 +258,14 @@ class Router:
             if rr is None:
                 rr = RouterRequest(rid, None, 0, None)
                 self._journal[rid] = rr
+            rr.trace = doc.get("trace") or rr.trace
+            if rid >= self._next_rid:
+                self._next_rid = rid + 1
+            if doc.get("event") == "fenced":
+                fenced += 1
+                continue  # non-terminal: never folds state/verdict
             # later lines win: the journal is append-ordered, so the
             # last complete line per rid IS its newest known state
-            rr.trace = doc.get("trace") or rr.trace
             if doc.get("replica") is not None:
                 rr.replica_id = doc["replica"]
             if doc.get("state"):
@@ -239,10 +274,8 @@ class Router:
                 rr.verdict = doc["verdict"]
             if doc.get("retries"):
                 rr.retries = int(doc["retries"])
-            if rid >= self._next_rid:
-                self._next_rid = rid + 1
         return {"entries": applied, "requests": len(self._journal),
-                "torn": torn}
+                "torn": torn, "fenced": fenced}
 
     def request(self, rid):
         return self._journal.get(rid)
@@ -385,8 +418,16 @@ class Router:
             rr._home = r
             rr.replica_id = r.replica_id
             rr.state = "accepted"
+            # the fencing token: every placement is stamped with the
+            # target's incarnation (None for in-process replicas) and
+            # journaled under the slot's CURRENT fencing epoch — the
+            # audit record of which boot was entitled to this work
+            rr._placed_inc = getattr(r, "incarnation", None)
             self._inflight.add(rr.rid)
-            self._log("accept", rr)
+            self._log("accept", rr,
+                      incarnation=rr._placed_inc,
+                      fence_epoch=self._fence_epoch.get(
+                          self._slot_key(r), 0))
             return
         rr.state = "refused"
         rr.verdict = refusal.verdict if refusal is not None \
@@ -411,7 +452,70 @@ class Router:
             except ReplicaLost:
                 self._failover(r)
         self._harvest()
+        self._sweep_fenced()
         return produced
+
+    @staticmethod
+    def _slot_key(replica):
+        """The SLOT a replica occupies — the unit fencing epochs are
+        scoped to.  An explicit ``slot`` attribute wins; otherwise the
+        replica_id with its ``+attempt`` incarnation suffix stripped
+        (the launcher fleet convention: slot0, slot0+1, ... share a
+        slot)."""
+        slot = getattr(replica, "slot", None)
+        if slot is not None:
+            return str(slot)
+        return str(replica.replica_id).split("+", 1)[0]
+
+    def _sweep_fenced(self):
+        """Observe the zombie watch: poll each fenced-out incarnation's
+        abandoned handles (best-effort, breaker-free) and REJECT any
+        late completion with the typed ``fenced`` verdict event +
+        journal line — at-most-once made auditable when the 'dead'
+        replica was alive behind a partition.  Watches expire after
+        ``fence_watch_s`` or when the handle terminates without
+        finishing."""
+        if not self._fenced:
+            return
+        now = time.monotonic()
+        keep = []
+        for w in self._fenced:
+            poll = getattr(w["proxy"], "fenced_poll", None)
+            if poll is not None:
+                try:
+                    poll()
+                except Exception:
+                    pass  # a zombie watch must never hurt the router
+            m = w["mirror"]
+            if getattr(m, "state", None) == FINISHED:
+                rr = w["rr"]
+                toks = len(getattr(m, "tokens", None) or [])
+                _telemetry.counter("rpc.fenced_results").inc()
+                # the journal line carries the FENCED incarnation's
+                # identity and the epoch that fenced it out; replay
+                # treats it as non-terminal (the request's own state
+                # is told by its accept/retry/complete lines)
+                self._log("fenced", rr, state="fenced",
+                          verdict="fenced",
+                          replica=w["replica_id"],
+                          incarnation=w["incarnation"],
+                          fence_epoch=w["epoch"],
+                          tokens_rejected=toks)
+                # engine-scope event (trace in args): the trace's own
+                # lifecycle already closed — or will — with its ONE
+                # final verdict; the rejection is fleet news, not a
+                # lifecycle hop
+                _telemetry.note_request_event(
+                    "", "fenced",
+                    args={"replica": str(w["replica_id"]),
+                          "trace": rr.trace, "rid": rr.rid,
+                          "fence_epoch": w["epoch"],
+                          "tokens": toks})
+                continue
+            if getattr(m, "done", False) or now > w["expires"]:
+                continue
+            keep.append(w)
+        self._fenced = keep
 
     def _harvest(self):
         """Move terminal engine states into the journal.  Completion is
@@ -461,6 +565,14 @@ class Router:
         replica.alive = False
         self.failovers += 1
         _telemetry.counter("router.failovers").inc()
+        # fence the slot: bump its epoch BEFORE re-placing — anything
+        # the dead incarnation still returns is fenced out from here on
+        fence_key = self._slot_key(replica)
+        fence_epoch = self._fence_epoch.get(fence_key, 0) + 1
+        self._fence_epoch[fence_key] = fence_epoch
+        # why the failover ran, named by the liveness machine (RPC
+        # proxies); in-process replicas raise ReplicaLost directly
+        confirm_reason = getattr(replica, "confirmed_reason", None)
         self._harvest()   # completions from earlier steps stay completed
         if self._spawn is not None:
             try:
@@ -482,6 +594,18 @@ class Router:
                    if self._journal[rid].state == "accepted"
                    and self._journal[rid]._home is replica]
         for rr in victims:
+            # enroll the abandoned handle in the zombie watch: if the
+            # fenced-out incarnation finishes it behind a partition,
+            # the late completion is observed and rejected (typed
+            # ``fenced``), never silently unread
+            if rr._live is not None:
+                self._fenced.append({
+                    "rr": rr, "mirror": rr._live, "proxy": replica,
+                    "replica_id": replica.replica_id,
+                    "incarnation": getattr(replica, "incarnation",
+                                           None),
+                    "epoch": fence_epoch,
+                    "expires": time.monotonic() + self.fence_watch_s})
             rr.retries += 1
             rr._live = None
             rr._home = None
@@ -496,14 +620,17 @@ class Router:
                 self._close_trace(rr)
                 continue
             _telemetry.counter("router.retries").inc()
-            self._log("retry", rr, from_replica=replica.replica_id)
-            # the failover arc: same trace, victim named — the
-            # survivor's `place`/`admit` events continue it, and
-            # serve_report charges the re-decode window to this replica
+            self._log("retry", rr, from_replica=replica.replica_id,
+                      reason=confirm_reason, fence_epoch=fence_epoch)
+            # the failover arc: same trace, victim named, confirmation
+            # reason carried — the survivor's `place`/`admit` events
+            # continue it, and serve_report charges the re-decode
+            # window to this replica AND names why the arc ran
             _telemetry.note_request_event(
                 rr.trace, "retry",
                 args={"from": str(replica.replica_id),
-                      "retries": rr.retries, "rid": rr.rid})
+                      "retries": rr.retries, "rid": rr.rid,
+                      "reason": confirm_reason})
             self._place(rr)
         # prune: journal entries survive; the dead replica (and its
         # engine's page pools) do not

@@ -35,30 +35,47 @@ replica contract (``replica_id`` / ``alive`` / ``draining`` / ``load``
   after a cooldown ``half_open`` admits exactly ONE probe call →
   close on success, re-trip on probe failure.  Laws are unit-pinned
   with an injected clock (tests/test_serving_rpc.py).
-- **health fusion** — the proxy fuses the RPC-level view with the PR-4
-  launcher heartbeat files and the port-file incarnation stamp
-  (pid + attempt): a breaker that is merely open keeps the replica
-  ALIVE (it may just be slow — the breaker recovers), while a changed
-  incarnation, a dead pid, or a stale heartbeat past
-  ``MXTPU_RPC_DEAD_AFTER_S`` confirms process death and raises
-  :class:`~mxnet_tpu.serving.replica.ReplicaLost` so the Router runs
-  its journaled at-most-once failover.
+- **RPC-native liveness** (ISSUE 17) — every server answers a cheap
+  ``heartbeat`` call carrying its incarnation stamp (pid, attempt,
+  boot nonce) and a monotonic progress sequence (decode steps,
+  weights epoch); the proxy runs a two-stage
+  suspicion→confirmation verdict on THOSE, never on file mtimes —
+  the fleet trusts no filesystem it can't see.  Suspicion: no
+  successful heartbeat for ``MXTPU_RPC_SUSPECT_AFTER`` seconds
+  (counted + gauged, never acted on alone).  Confirmation (→
+  :class:`~mxnet_tpu.serving.replica.ReplicaLost` → journaled
+  at-most-once failover), typed by reason: ``incarnation`` (the
+  stamp changed — a replacement took the slot), ``kill_ack`` (the
+  supervisor reaped the corpse / a locally-watched pid vanished),
+  ``fence_expiry`` (suspicion sustained with zero progress past
+  ``MXTPU_RPC_DEAD_AFTER_S``, after which the Router FENCES the
+  incarnation — its late results are rejected, so the declaration
+  is safe even if the replica was alive behind a partition).  A
+  breaker-open transport wobble alone never fails over.  The port
+  file remains BOOTSTRAP DISCOVERY only.
 
 Fault sites drilled here (ROBUSTNESS.md §4): ``rpc.drop`` (the server
 reads a request and never replies — the client's per-call deadline is
 the only way out), ``rpc.delay`` (bounded server-side reply delay),
 ``rpc.conn.refused`` (client-side connection failure — exercises the
-retry/backoff path deterministically).  ``serve.replica.sigkill``
-(serving/replica.py) is the process-death twin of
-``serve.replica.lost``: a hard ``os.kill(SIGKILL)`` no in-process
-exception path can fake.
+retry/backoff path deterministically), ``rpc.heartbeat.drop``
+(liveness plane blackholed, data plane alive: suspicion without
+failover), ``rpc.partition`` (asymmetric router→replica blackhole,
+both planes cut on the link while the replica keeps decoding: fenced
+failover), ``serve.worker.zombie`` (drain orders ignored: supervisor
+escalation).  ``serve.replica.sigkill`` (serving/replica.py) is the
+process-death twin of ``serve.replica.lost``: a hard
+``os.kill(SIGKILL)`` no in-process exception path can fake.
 
 Telemetry (OBSERVABILITY.md §13): ``rpc.calls`` / ``rpc.retries`` /
 ``rpc.timeouts`` / ``rpc.conn_errors`` / ``rpc.dedup_hits`` /
 ``rpc.dropped_replies`` / ``rpc.expired_unreachable`` /
-``rpc.breaker_trips`` / ``rpc.breaker_recoveries`` counters, an
-``rpc.call`` phase histogram, and one ``rpc.breaker.<replica>`` gauge
-per proxy (0 closed / 1 half-open / 2 open).
+``rpc.breaker_trips`` / ``rpc.breaker_recoveries`` /
+``rpc.heartbeats`` / ``rpc.suspicions`` /
+``rpc.confirmations.<reason>`` / ``rpc.fenced_results`` counters, an
+``rpc.call`` phase histogram, one ``rpc.breaker.<replica>`` gauge per
+proxy (0 closed / 1 half-open / 2 open) and one
+``rpc.suspect.<replica>`` gauge (0 clear / 1 suspected).
 """
 from __future__ import annotations
 
@@ -82,7 +99,7 @@ from .scheduler import EXPIRED, SHED
 __all__ = ["RpcError", "CircuitBreaker", "RpcServer", "RpcReplicaProxy",
            "rpc_call", "send_frame", "recv_frame", "read_port_file",
            "write_port_file", "wait_port_file", "fleet_proxies",
-           "VERDICT_EXPIRED_RPC",
+           "mint_boot_nonce", "VERDICT_EXPIRED_RPC", "VERDICT_FENCED",
            "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN"]
 
 #: sanity cap on one frame (a garbage length prefix must fail fast,
@@ -93,6 +110,12 @@ MAX_FRAME_BYTES = 64 << 20
 #: whose deadline passed with no status obtainable — the bounded-cost
 #: guarantee under a blackholing replica (``rpc.drop``)
 VERDICT_EXPIRED_RPC = "expired_rpc"
+
+#: typed verdict event for a completion returned by a FENCED-OUT
+#: incarnation (a zombie behind a partition finishing work the router
+#: already failed over): rejected at the router, journaled
+#: non-terminally — the at-most-once law's split-brain defense
+VERDICT_FENCED = "fenced"
 
 BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = \
     "closed", "open", "half_open"
@@ -204,6 +227,9 @@ def rpc_call(addr, msg, timeout_s, retries=None, backoff_s=None,
             call_deadline = time.monotonic() + att_timeout
             with socket.create_connection(addr,
                                           timeout=att_timeout) as s:
+                # small framed messages on a one-shot connection:
+                # Nagle only adds latency here, never throughput
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 send_frame(s, msg)
                 reply = recv_frame(s, call_deadline)
             _telemetry.counter("rpc.calls").inc()
@@ -319,13 +345,27 @@ class CircuitBreaker:
 
 # -- port-file discovery ---------------------------------------------------
 
-def write_port_file(path, port, host="127.0.0.1", attempt=0):
+def mint_boot_nonce():
+    """A fresh per-boot nonce for the incarnation stamp: pids recycle
+    (containerized replicas are routinely pid 7) and attempt counters
+    reset across launcher restarts — the nonce is the component that
+    never collides across boots of the same slot."""
+    return "%08x" % random.getrandbits(32)
+
+
+def write_port_file(path, port, host="127.0.0.1", attempt=0,
+                    nonce=None):
     """Atomically publish where this worker incarnation listens.  The
-    (pid, attempt) pair is the incarnation stamp proxies pin: a
-    replacement rewrites the file, and the old incarnation's proxy
-    sees the change as confirmed death, never as a silent redirect."""
+    (pid, attempt, boot nonce) triple is the incarnation stamp proxies
+    pin: a replacement rewrites the file, and the old incarnation's
+    proxy sees the change as confirmed death, never as a silent
+    redirect.  The file is BOOTSTRAP DISCOVERY only — liveness and
+    death confirmation ride the heartbeat RPC, so a fleet spanning
+    hosts only needs the file visible where proxies are built."""
     doc = {"host": host, "port": int(port), "pid": os.getpid(),
            "attempt": int(attempt), "t": time.time()}
+    if nonce is not None:
+        doc["nonce"] = str(nonce)
     tmp = "%s.tmp-%d" % (path, os.getpid())
     with open(tmp, "w") as f:
         json.dump(doc, f)
@@ -357,6 +397,19 @@ def wait_port_file(path, timeout=30.0, min_attempt=None,
                    % (path, timeout,
                       "" if min_attempt is None
                       else " at attempt >= %d" % min_attempt))
+
+
+def _stamp_match(a, b):
+    """Do two incarnation stamps (pid, attempt, nonce) describe the
+    same boot?  A missing nonce (legacy port files, hand-built stamps)
+    is a wildcard — only two PRESENT-and-different components prove a
+    different incarnation.  None stamps never match (no evidence)."""
+    if a is None or b is None:
+        return False
+    for x, y in zip(a, b):
+        if x is not None and y is not None and x != y:
+            return False
+    return True
 
 
 # -- server ----------------------------------------------------------------
@@ -412,7 +465,7 @@ class RpcServer:
     #: the whole send without waiting
     SEND_TIMEOUT_S = 0.5
 
-    def __init__(self, replica, host="127.0.0.1", port=0):
+    def __init__(self, replica, host="127.0.0.1", port=0, attempt=None):
         self.replica = replica
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR,
@@ -421,6 +474,13 @@ class RpcServer:
         self._lsock.listen(64)
         self._lsock.setblocking(False)
         self.host, self.port = self._lsock.getsockname()[:2]
+        if attempt is None:
+            attempt = _env_int("MXTPU_RESTART_ATTEMPT", 0)
+        #: the incarnation stamp this server answers heartbeats with —
+        #: minted ONCE per boot; proxies pin it and any later change
+        #: IS confirmed death of this incarnation
+        self.incarnation = {"pid": os.getpid(), "attempt": int(attempt),
+                            "nonce": mint_boot_nonce()}
         self._journal = {}       # idempotence key -> engine Request
         self._parked = []        # [(conn, close_at)] rpc.drop victims
         self._pending = {}       # conn -> {"buf", "t0"} mid-frame reads
@@ -542,8 +602,31 @@ class RpcServer:
             except OSError:
                 pass
             return 0
+        if _fault.trigger("rpc.partition"):
+            # asymmetric partition: the router's frame ARRIVED but is
+            # never processed nor answered — control AND data plane cut
+            # on this link while the replica keeps decoding what it
+            # already accepted.  The fenced-failover drill's zombie.
+            self._parked.append(
+                (conn, time.monotonic() + self.PARK_SECS))
+            return 1
         self.calls += 1
         reply = self._dispatch(msg)
+        if reply is None:
+            # the handler chose to IGNORE the call (serve.worker.zombie
+            # drill): no reply, no close — the caller's deadline is its
+            # only way out, exactly a wedged worker
+            self._parked.append(
+                (conn, time.monotonic() + self.PARK_SECS))
+            return 1
+        if msg.get("method") == "heartbeat" and \
+                _fault.trigger("rpc.heartbeat.drop"):
+            # liveness plane cut, data plane alive: submits and status
+            # polls still answer — the fleet must record SUSPICION but
+            # never confirm death off this alone
+            self._parked.append(
+                (conn, time.monotonic() + self.PARK_SECS))
+            return 1
         _fault.delay_if("rpc.delay")
         if _fault.trigger("rpc.drop"):
             # blackhole: the request WAS processed (an accepted submit
@@ -577,9 +660,12 @@ class RpcServer:
                 return self._do_status(msg)
             if method == "health":
                 return self._do_health()
+            if method == "heartbeat":
+                return self._do_heartbeat()
             if method == "drain":
-                self.drain_requested = True
-                return {"ok": True, "draining": True}
+                return self._do_drain(msg)
+            if method == "inject":
+                return self._do_inject(msg)
             return {"ok": False, "error_type": "RpcError",
                     "error": "unknown rpc method %r" % (method,)}
         except Exception as e:  # never let a handler kill the worker
@@ -640,6 +726,69 @@ class RpcServer:
                             "draining": bool(rep.draining),
                             "load": int(rep.load),
                             "idle": bool(rep.idle)}}
+
+    def _do_heartbeat(self):
+        """The cheap liveness call: incarnation stamp + monotonic
+        progress sequence.  No engine work, no journal touch — safe to
+        answer at any poll cadence.  Progress comes from the replica's
+        ``progress()`` duck-type (decode steps + weights epoch) when it
+        has one; a stub without it reports None, which proxies treat as
+        'no progress signal', never as progress."""
+        rep = self.replica
+        prog = None
+        p = getattr(rep, "progress", None)
+        if callable(p):
+            try:
+                prog = p()
+            except Exception:
+                prog = None
+        if prog is None:
+            prog = {"decode_steps": None, "weights_epoch": None}
+        return {"ok": True, "incarnation": dict(self.incarnation),
+                "progress": prog,
+                "alive": bool(getattr(rep, "alive", True)),
+                "draining": bool(getattr(rep, "draining", False))}
+
+    def _do_drain(self, msg):
+        """Drain, authenticated by incarnation: a stale supervisor
+        order aimed at a replaced worker must not drain the newcomer.
+        An absent stamp (legacy callers, in-fleet router drains) is
+        accepted — authentication guards the CROSS-incarnation case,
+        not the trusting local one."""
+        want = msg.get("incarnation")
+        if want is not None:
+            mine = self.incarnation
+            for k in ("pid", "attempt", "nonce"):
+                w = want.get(k)
+                if w is not None and w != mine.get(k):
+                    return {"ok": False, "error_type": "RpcError",
+                            "error": "drain refused: incarnation "
+                                     "mismatch (order for %r, this is "
+                                     "%r)" % (want, mine)}
+        if _fault.trigger("serve.worker.zombie"):
+            # the zombie drill: the drain order is read and IGNORED —
+            # no reply (None parks the connection), no drain flag; the
+            # supervisor's escalation path (SIGTERM → SIGKILL +
+            # incarnation-confirmed replacement) is the only cure
+            return None
+        self.drain_requested = True
+        return {"ok": True, "draining": True}
+
+    def _do_inject(self, msg):
+        """Drill-plane fault arming (the ISSUE-17 partition drill): a
+        partition worth drilling must cut a link that ALREADY carries
+        accepted work, which env arming at spawn cannot stage — so the
+        drill harness arms the site over the wire mid-run (an empty
+        spec disarms).  Refused unless the worker was launched with
+        MXTPU_RPC_ALLOW_INJECT=1: production workers take no fault
+        orders over the wire."""
+        if os.environ.get("MXTPU_RPC_ALLOW_INJECT") != "1":
+            return {"ok": False, "error_type": "RpcError",
+                    "error": "inject refused: worker not launched "
+                             "with MXTPU_RPC_ALLOW_INJECT=1"}
+        spec = msg.get("spec") or ""
+        _fault.configure(spec)
+        return {"ok": True, "armed": spec}
 
     def _do_health(self):
         from .. import profiler as _profiler
@@ -706,26 +855,47 @@ class RpcReplicaProxy:
 
     ``step()`` polls the worker for the in-flight mirrors' status (the
     worker decodes autonomously — the poll is observation, not
-    drive).  Transport failures feed the breaker; the replica is
-    declared DEAD (→ failover) only when the health fusion confirms
-    it: incarnation changed, pid gone, or heartbeat stale past
-    ``dead_after_s``.  A merely-unreachable replica (tripped breaker)
-    keeps its requests until their own deadlines expire them with the
-    typed ``expired_rpc`` verdict — bounded cost, no failover churn,
-    and full recovery when the breaker's probe succeeds."""
+    drive) and, on its own cadence (``MXTPU_RPC_HEARTBEAT_S``), issues
+    the cheap ``heartbeat`` RPC.  Liveness is a two-stage verdict run
+    ENTIRELY on the RPC plane — no file mtimes, no shared filesystem:
+
+    - **suspicion** — no successful heartbeat for
+      ``MXTPU_RPC_SUSPECT_AFTER`` seconds.  Counted
+      (``rpc.suspicions``) and gauged (``rpc.suspect.<replica>``),
+      never acted on alone: a breaker-open transport wobble or a
+      blackholed liveness plane (``rpc.heartbeat.drop``) raises
+      suspicion, not failover.
+    - **confirmation** — ReplicaLost (→ Router failover) ONLY on
+      (a) an observed incarnation change — heartbeat stamp or
+      port-file stamp differs from the pinned (pid, attempt, nonce);
+      (b) a supervisor kill-ack — :meth:`note_kill_ack`, or a
+      port-file pid this host has watched vanish; or (c)
+      fencing-epoch expiry — suspicion sustained with ZERO observed
+      progress for ``dead_after_s``, after which the router fences
+      the incarnation (its late results are rejected) so declaring
+      it dead cannot violate at-most-once even if it was alive
+      behind a partition.
+
+    A merely-unreachable replica (tripped breaker) keeps its requests
+    until their own deadlines expire them with the typed
+    ``expired_rpc`` verdict — bounded cost, no failover churn, and
+    full recovery when the breaker's probe succeeds."""
 
     def __init__(self, replica_id, addr=None, port_file=None,
                  heartbeat_path=None, timeout_s=None, retries=None,
                  breaker=None, dead_after_s=None, clock=time.monotonic,
-                 rng=None):
+                 rng=None, heartbeat_s=None, suspect_after_s=None):
         if addr is None and port_file is None:
             raise ValueError("RpcReplicaProxy needs addr or port_file")
         self.replica_id = replica_id
         self.alive = True
         self._addr = tuple(addr) if addr is not None else None
         self._port_file = port_file
+        # legacy knob: PR-4 heartbeat FILES are no longer liveness
+        # evidence (a fleet spanning hosts shares no filesystem); kept
+        # only as an informational age in health()
         self._heartbeat_path = heartbeat_path
-        self._pin = None           # (pid, attempt) incarnation stamp
+        self._pin = None       # port-file (pid, attempt, nonce) stamp
         self._clock = clock
         self.breaker = breaker if breaker is not None else \
             CircuitBreaker(name=str(replica_id), clock=clock)
@@ -735,6 +905,11 @@ class RpcReplicaProxy:
             if retries is None else int(retries)
         self._dead_after_s = _env_float("MXTPU_RPC_DEAD_AFTER_S", 10.0) \
             if dead_after_s is None else float(dead_after_s)
+        self._hb_every_s = _env_float("MXTPU_RPC_HEARTBEAT_S", 0.5) \
+            if heartbeat_s is None else float(heartbeat_s)
+        self._suspect_after_s = \
+            _env_float("MXTPU_RPC_SUSPECT_AFTER", 2.0) \
+            if suspect_after_s is None else float(suspect_after_s)
         # deterministic jitter stream per proxy (decorrelated across
         # replicas, reproducible within one)
         self._rng = rng or random.Random(
@@ -743,8 +918,30 @@ class RpcReplicaProxy:
         self._status = {"alive": True, "draining": False, "idle": True,
                         "load": 0}
         self._last_ok_t = None
+        # -- liveness state (the suspicion→confirmation machine) -----
+        now = clock()
+        self._hb_pin = None        # first heartbeat-observed stamp
+        self._last_hb_try_t = None
+        self._last_hb_ok_t = now   # boot grace: not suspect at birth
+        self._last_progress_t = now
+        self._progress = None      # last (decode_steps, weights_epoch)
+        self.suspected = False
+        self.confirmed_reason = None
+        self._kill_acked = False
 
     # -- address / incarnation ---------------------------------------------
+    def _confirm_lost(self, reason, detail):
+        """Declare CONFIRMED death with a typed reason — the only
+        place ReplicaLost originates from liveness evidence, so every
+        failover arc can name why it ran."""
+        self.confirmed_reason = reason
+        _telemetry.counter("rpc.confirmations.%s" % reason).inc()
+        _telemetry.note_request_event(
+            "", "confirm",
+            args={"replica": str(self.replica_id), "reason": reason})
+        raise ReplicaLost("replica %s confirmed dead (%s): %s"
+                          % (self.replica_id, reason, detail))
+
     def _resolve(self):
         if self._port_file is None:
             return self._addr
@@ -753,22 +950,25 @@ class RpcReplicaProxy:
         except (OSError, ValueError) as e:
             raise RpcError("cannot read port file %s: %s"
                            % (self._port_file, e))
-        stamp = (doc.get("pid"), doc.get("attempt"))
+        stamp = (doc.get("pid"), doc.get("attempt"), doc.get("nonce"))
         if self._pin is None:
             self._pin = stamp
-        elif self._pin != stamp:
+        elif not _stamp_match(self._pin, stamp):
             # a replacement took the slot: this incarnation is gone
-            raise ReplicaLost(
-                "replica %s incarnation changed (pid/attempt %s -> "
-                "%s): a replacement took its slot"
-                % (self.replica_id, self._pin, stamp))
+            self._confirm_lost(
+                "incarnation",
+                "port file pid/attempt/nonce %s -> %s: a replacement "
+                "took the slot" % (self._pin, stamp))
         return (doc.get("host", "127.0.0.1"), int(doc["port"]))
 
     @property
     def incarnation(self):
-        """The (pid, attempt) stamp this proxy is pinned to (None
-        until the first successful resolve)."""
-        return self._pin
+        """The incarnation stamp (pid, attempt, nonce) this proxy is
+        pinned to: the port-file stamp when file-discovered, else the
+        first heartbeat-observed stamp (addr-only, multi-host case).
+        None until first contact.  The Router stamps placements with
+        this — the fencing token."""
+        return self._pin if self._pin is not None else self._hb_pin
 
     def successor(self, replica_id=None, timeout=60.0):
         """Wait for a REPLACEMENT incarnation at this slot's port file
@@ -789,21 +989,105 @@ class RpcReplicaProxy:
             rid, port_file=self._port_file,
             heartbeat_path=self._heartbeat_path,
             timeout_s=self._timeout_s, retries=self._retries,
-            dead_after_s=self._dead_after_s, clock=self._clock)
+            dead_after_s=self._dead_after_s, clock=self._clock,
+            heartbeat_s=self._hb_every_s,
+            suspect_after_s=self._suspect_after_s)
 
-    # -- health fusion ------------------------------------------------------
-    def _confirmed_dead(self):
-        """Fuse the non-RPC evidence: only a changed incarnation, a
-        vanished pid, or a stale PR-4 heartbeat file turns transport
-        failure into declared process death (→ Router failover).  A
-        replica that is merely slow or partitioned stays alive — its
-        breaker recovers; a failover would double-execute its work."""
+    # -- liveness: suspicion → confirmation ---------------------------------
+    def note_kill_ack(self):
+        """Supervisor hook: the process owner (launcher, drill driver)
+        reaped this incarnation's corpse.  The strongest confirmation
+        evidence there is — the next step() fails over immediately."""
+        self._kill_acked = True
+
+    def _note_progress(self):
+        self._last_progress_t = self._clock()
+
+    def _update_suspicion(self):
+        now = self._clock()
+        gap = now - self._last_hb_ok_t
+        was = self.suspected
+        self.suspected = gap > self._suspect_after_s
+        if self.suspected and not was:
+            _telemetry.counter("rpc.suspicions").inc()
+            _telemetry.gauge(
+                "rpc.suspect.%s" % self.replica_id).set(1)
+            _telemetry.note_request_event(
+                "", "suspect", args={"replica": str(self.replica_id),
+                                     "gap_s": round(gap, 3)})
+        elif was and not self.suspected:
+            _telemetry.gauge(
+                "rpc.suspect.%s" % self.replica_id).set(0)
+            _telemetry.note_request_event(
+                "", "suspect_clear",
+                args={"replica": str(self.replica_id),
+                      "gap_s": round(gap, 3)})
+
+    def _heartbeat_tick(self):
+        """Issue the liveness heartbeat on its own cadence.  Heartbeat
+        calls bypass the breaker (they ARE the liveness plane — the
+        breaker protects the data plane) and never feed it: a dropped
+        heartbeat raises suspicion, a tripped breaker must not also
+        starve the evidence channel that could clear it."""
+        now = self._clock()
+        if self._last_hb_try_t is not None and \
+                now - self._last_hb_try_t < self._hb_every_s:
+            self._update_suspicion()
+            return
+        self._last_hb_try_t = now
+        try:
+            addr = self._resolve()   # may confirm via port-file stamp
+            reply = rpc_call(
+                addr, {"method": "heartbeat"},
+                min(self._timeout_s, max(0.05, self._hb_every_s)),
+                retries=0, rng=self._rng)
+        except ReplicaLost:
+            raise
+        except (RpcError, OSError):
+            self._update_suspicion()
+            return
+        if not reply.get("ok"):
+            self._update_suspicion()
+            return
+        _telemetry.counter("rpc.heartbeats").inc()
+        inc = reply.get("incarnation") or {}
+        stamp = (inc.get("pid"), inc.get("attempt"), inc.get("nonce"))
+        if self._hb_pin is None:
+            self._hb_pin = stamp
+        elif not _stamp_match(self._hb_pin, stamp):
+            # the addr answers, but as a DIFFERENT boot: the pinned
+            # incarnation is gone (port recycled, container restarted)
+            self._confirm_lost(
+                "incarnation",
+                "heartbeat stamp %s -> %s" % (self._hb_pin, stamp))
+        self._last_hb_ok_t = self._clock()
+        prog = reply.get("progress") or {}
+        seq = (prog.get("decode_steps"), prog.get("weights_epoch"))
+        if self._progress is None or seq != self._progress:
+            self._note_progress()
+        self._progress = seq
+        self._update_suspicion()
+
+    def _confirm(self):
+        """Return the typed confirmation reason if this incarnation's
+        death is CONFIRMED, else None.  Suspicion alone never
+        confirms: the only roads are an observed incarnation change, a
+        supervisor kill-ack (incl. a locally-watched pid vanishing),
+        or fencing-epoch expiry — suspicion sustained with zero
+        observed progress for ``dead_after_s``, after which the router
+        fences the incarnation so the declaration cannot violate
+        at-most-once even if the replica was alive behind a
+        partition."""
+        if self._kill_acked:
+            return "kill_ack"
         if self._port_file is not None:
             try:
                 doc = read_port_file(self._port_file)
-                stamp = (doc.get("pid"), doc.get("attempt"))
-                if self._pin is not None and stamp != self._pin:
-                    return True
+                stamp = (doc.get("pid"), doc.get("attempt"),
+                         doc.get("nonce"))
+                if self._pin is not None and \
+                        not _stamp_match(self._pin, stamp):
+                    return "incarnation"
                 pid = doc.get("pid")
             except (OSError, ValueError):
                 pid = self._pin[0] if self._pin else None
@@ -811,18 +1095,17 @@ class RpcReplicaProxy:
                 try:
                     os.kill(int(pid), 0)
                 except ProcessLookupError:
-                    return True
+                    # the pid this host was told to watch is gone — the
+                    # local-supervisor flavor of a kill-ack
+                    return "kill_ack"
                 except (OSError, PermissionError):
                     pass  # not ours to probe (remote/other-user pid)
-        hb = self._heartbeat_path
-        if hb:
-            try:
-                age = time.time() - os.stat(hb).st_mtime
-                if age > self._dead_after_s:
-                    return True
-            except OSError:
-                pass  # no heartbeat written (yet): not evidence
-        return False
+        now = self._clock()
+        if self.suspected and \
+                now - self._last_hb_ok_t > self._dead_after_s and \
+                now - self._last_progress_t > self._dead_after_s:
+            return "fence_expiry"
+        return None
 
     # -- the replica duck-type ---------------------------------------------
     @property
@@ -890,6 +1173,7 @@ class RpcReplicaProxy:
                 % (self.replica_id, e))
         self.breaker.record_success()
         self._last_ok_t = self._clock()
+        self._note_progress()
         if not reply.get("ok"):
             if reply.get("error_type") == "ValueError":
                 raise ValueError(reply.get("error"))
@@ -902,19 +1186,21 @@ class RpcReplicaProxy:
         return m
 
     def step(self):
-        """One observation round: sweep locally-expired mirrors, then
-        (breaker permitting) poll the worker and fold the updates in.
-        Returns tokens newly observed.  Raises ReplicaLost only on
-        CONFIRMED process death — the Router's failover trigger."""
+        """One observation round: heartbeat tick (liveness plane),
+        sweep locally-expired mirrors, then (breaker permitting) poll
+        the worker and fold the updates in.  Returns tokens newly
+        observed.  Raises ReplicaLost only on CONFIRMED process death
+        (see :meth:`_confirm`) — the Router's failover trigger."""
         if not self.alive:
             raise ReplicaLost("replica %s is dead" % self.replica_id)
+        self._heartbeat_tick()
         self._sweep_expired()
         produced = 0
         if not self.breaker.allow():
-            if self._confirmed_dead():
-                raise ReplicaLost(
-                    "replica %s confirmed dead (breaker %s)"
-                    % (self.replica_id, self.breaker.state))
+            reason = self._confirm()
+            if reason:
+                self._confirm_lost(
+                    reason, "breaker %s" % self.breaker.state)
             return produced
         # the status call's socket deadline: never more than the
         # per-call cap, never more than the tightest in-flight
@@ -935,13 +1221,13 @@ class RpcReplicaProxy:
             raise
         except (RpcError, OSError):
             self.breaker.record_failure()
-            if self._confirmed_dead():
-                raise ReplicaLost(
-                    "replica %s unreachable and confirmed dead"
-                    % self.replica_id)
+            reason = self._confirm()
+            if reason:
+                self._confirm_lost(reason, "unreachable over rpc")
             return produced
         self.breaker.record_success()
         self._last_ok_t = self._clock()
+        self._note_progress()  # data-plane contact: blocks fence expiry
         if not reply.get("ok"):
             return produced
         for key, doc in (reply.get("requests") or {}).items():
@@ -952,10 +1238,10 @@ class RpcReplicaProxy:
                 # the worker no longer knows an accepted request: its
                 # journal did not survive (process replaced between
                 # polls) — that incarnation is gone
-                raise ReplicaLost(
-                    "replica %s lost accepted request %s (journal "
-                    "reset — process replaced?)"
-                    % (self.replica_id, key))
+                self._confirm_lost(
+                    "incarnation",
+                    "accepted request %s unknown to the worker "
+                    "(journal reset — process replaced?)" % (key,))
             before = len(m.tokens)
             m._update(doc)
             produced += max(0, len(m.tokens) - before)
@@ -994,7 +1280,14 @@ class RpcReplicaProxy:
         (the worker exits 80 after its post-drain linger).  Returns
         EXIT_SERVE_DRAIN."""
         addr = self._resolve()
-        reply = rpc_call(addr, {"method": "drain"}, self._timeout_s,
+        msg = {"method": "drain"}
+        pin = self.incarnation
+        if pin is not None:
+            # authenticated-by-incarnation: this order drains the boot
+            # we are pinned to, never a replacement that took the slot
+            msg["incarnation"] = {"pid": pin[0], "attempt": pin[1],
+                                  "nonce": pin[2]}
+        reply = rpc_call(addr, msg, self._timeout_s,
                          retries=self._retries, rng=self._rng)
         if not reply.get("ok"):
             raise RpcError("drain of replica %s refused: %s"
@@ -1024,20 +1317,69 @@ class RpcReplicaProxy:
         release here; the launcher reaps the corpse."""
         self.alive = False
 
+    def fenced_poll(self):
+        """Post-failover zombie watch: ONE best-effort status call at
+        the pinned incarnation's address, folding updates into the
+        stale mirrors the Router kept for fencing.  No breaker, no
+        liveness verdicts, no resurrection — the proxy stays dead;
+        this only makes the zombie's late completions OBSERVABLE so
+        the Router can reject them with the typed ``fenced`` verdict
+        instead of silently never reading them.  Returns the number of
+        mirrors updated (0 when unreachable or the slot's port file
+        already belongs to a replacement)."""
+        if not self._mirrors:
+            return 0
+        addr = self._addr
+        if addr is None:
+            try:
+                doc = read_port_file(self._port_file)
+            except (OSError, ValueError):
+                return 0
+            stamp = (doc.get("pid"), doc.get("attempt"),
+                     doc.get("nonce"))
+            if self._pin is not None and \
+                    not _stamp_match(self._pin, stamp):
+                return 0   # a replacement owns the slot's file now
+            addr = (doc.get("host", "127.0.0.1"), int(doc["port"]))
+        try:
+            reply = rpc_call(
+                addr, {"method": "status",
+                       "keys": sorted(self._mirrors)},
+                min(self._timeout_s, 0.5), retries=0, rng=self._rng)
+        except (RpcError, OSError):
+            return 0
+        if not reply.get("ok"):
+            return 0
+        updated = 0
+        for key, doc in (reply.get("requests") or {}).items():
+            m = self._mirrors.get(key)
+            if m is None or doc.get("state") == "unknown":
+                continue
+            m._update(doc)
+            updated += 1
+            if m.done:
+                del self._mirrors[key]
+        return updated
+
     def health(self):
-        """The fused health view: local breaker/heartbeat evidence
+        """The fused health view: breaker + liveness-machine state
         plus (reachable) the worker's own ``health()`` snapshot and
         foreground-compile count."""
         doc = {"replica_id": self.replica_id, "alive": self.alive,
                "breaker": self.breaker.state,
-               "incarnation": self._pin}
+               "incarnation": self.incarnation,
+               "suspected": self.suspected,
+               "confirmed_reason": self.confirmed_reason,
+               "heartbeat_age_s": round(
+                   self._clock() - self._last_hb_ok_t, 3)}
         hb = self._heartbeat_path
         if hb:
+            # legacy PR-4 file age: informational only, never evidence
             try:
-                doc["heartbeat_age_s"] = round(
+                doc["heartbeat_file_age_s"] = round(
                     time.time() - os.stat(hb).st_mtime, 3)
             except OSError:
-                doc["heartbeat_age_s"] = None
+                doc["heartbeat_file_age_s"] = None
         try:
             addr = self._resolve()
             reply = rpc_call(addr, {"method": "health"},
@@ -1060,14 +1402,13 @@ def port_file_path(run_dir, slot):
 def fleet_proxies(run_dir, slots, timeout=60.0, **kw):
     """Proxies for a ``tools/launch.py --serve`` fleet: one per slot,
     each pinned to the incarnation its port file currently publishes
-    (waits for workers still spinning up).  Heartbeat fusion uses the
-    launcher's run-dir heartbeat tree."""
+    (waits for workers still spinning up).  Liveness rides the
+    heartbeat RPC from here on; the port file is bootstrap discovery
+    only."""
     out = []
     for slot in slots:
         pf = port_file_path(run_dir, slot)
         wait_port_file(pf, timeout=timeout)
-        hb = os.path.join(run_dir, "hb", "hb-%d.json" % int(slot))
         out.append(RpcReplicaProxy(
-            "slot%d" % int(slot), port_file=pf, heartbeat_path=hb,
-            **kw))
+            "slot%d" % int(slot), port_file=pf, **kw))
     return out

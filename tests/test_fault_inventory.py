@@ -13,7 +13,11 @@ string passed to ``fault.trigger`` / ``check`` / ``stall_if`` /
 - every row in the table corresponds to a site in code (no stale
   docs describing drills that no longer exist);
 - every site is referenced by at least one file under ``tests/``
-  (the drill exists — a fault path with no test is undrilled).
+  (the drill exists — a fault path with no test is undrilled);
+- every ``rpc.*`` site's row names WHICH PLANE it cuts — control
+  (liveness/drain) vs data (submit/status) — because the whole point
+  of the ISSUE-17 liveness design is that the two planes fail
+  independently and the failover verdict must not confuse them.
 
 Adding a fault site therefore REQUIRES a §4 row and a test in the
 same change, mechanically.
@@ -60,20 +64,24 @@ def sites_in_code():
     return sites
 
 
-def sites_in_doc():
-    """Rows of the ROBUSTNESS.md §4 site table (between the §4 and §5
-    headings)."""
+def doc_rows():
+    """ROBUSTNESS.md §4 site table rows (between the §4 and §5
+    headings), as {site: full row text}."""
     with open(os.path.join(REPO, "ROBUSTNESS.md"),
               encoding="utf-8") as f:
         text = f.read()
     start = text.index("## 4. Fault injection")
     end = text.index("## 5.", start)
-    rows = set()
+    rows = {}
     for line in text[start:end].splitlines():
         m = _ROW_RE.match(line.strip())
         if m and m.group(1) != "site":
-            rows.add(m.group(1))
+            rows[m.group(1)] = line.strip()
     return rows
+
+
+def sites_in_doc():
+    return set(doc_rows())
 
 
 def test_every_code_site_documented_and_every_doc_row_live():
@@ -90,6 +98,26 @@ def test_every_code_site_documented_and_every_doc_row_live():
     assert not stale, (
         "ROBUSTNESS.md §4 documents fault sites no code checks "
         "anymore: %s — drop the rows or restore the drills" % stale)
+
+
+def test_every_rpc_site_row_names_its_plane():
+    """ISSUE 17: the liveness protocol's central claim is that the
+    control plane (heartbeat/drain) and the data plane (submit/status)
+    fail INDEPENDENTLY — a cut control plane with a healthy data plane
+    must never fail a replica over.  An operator triaging a drill row
+    therefore needs to know which plane each ``rpc.*`` site cuts; a
+    row that doesn't say is a row that can't be acted on."""
+    rows = doc_rows()
+    rpc_sites = sorted(s for s in sites_in_code()
+                       if s.startswith("rpc."))
+    assert rpc_sites, "no rpc.* sites found — the site scan rotted"
+    planes = ("control plane", "data plane", "both planes")
+    unnamed = [s for s in rpc_sites
+               if s in rows
+               and not any(p in rows[s].lower() for p in planes)]
+    assert not unnamed, (
+        "ROBUSTNESS.md §4 rows for rpc.* fault sites that never say "
+        "which plane (control vs data) the drill cuts: %s" % unnamed)
 
 
 def test_every_site_exercised_by_a_test():

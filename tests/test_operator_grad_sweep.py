@@ -893,7 +893,8 @@ SKIP_REASONS = {
     **{n: "optimizer update kernel, tested in test_optimizer.py" for n in
        ["sgd_update", "sgd_mom_update", "mp_sgd_update",
         "mp_sgd_mom_update", "adam_update", "rmsprop_update",
-        "rmspropalex_update", "ftrl_update"]},
+        "rmspropalex_update", "ftrl_update", "adamax_update",
+        "nadam_update"]},
 }
 
 

@@ -38,6 +38,26 @@ def test_optimizer_converges(name, kwargs):
         "%s failed to converge" % name
 
 
+def test_per_step_hyperparams_do_not_recompile():
+    """Adam-family updates fold t-varying scalars into their hyperparams
+    (bias-corrected lr; Nadam a whole momentum schedule).  Those must ride
+    as DYNAMIC jit arguments (OpDef.dynamic_params): baked in as statics,
+    every step compiled a fresh executable and the op's jit cache grew one
+    entry per step — unbounded under any lr scheduler."""
+    from mxnet_tpu.ops.registry import get_op
+    for opt_name, op_name, kwargs in [
+            ("adam", "adam_update", {"learning_rate": 0.3}),
+            ("adamax", "adamax_update", {"learning_rate": 0.5}),
+            ("nadam", "nadam_update", {"learning_rate": 0.3})]:
+        op = get_op(op_name)
+        before = len(op._jit_cache)
+        _quadratic_converges(opt_name, steps=25, **kwargs)
+        grown = len(op._jit_cache) - before
+        assert grown <= 1, (
+            "%s recompiled per step: %d new jit-cache entries for 25 steps"
+            % (op_name, grown))
+
+
 def test_sgd_exact_step():
     w0 = np.array([1.0, 2.0], np.float32)
     g = np.array([0.5, -0.5], np.float32)

@@ -234,7 +234,8 @@ def _synthetic_tree(tmp_path, torn_journal=True):
         _ev(13, 100.35, "T2", "prefill", dispatch_s=0.01, sync_s=0.0),
         # (T2's first token rides the step-7 batch above)
         _ev(14, 100.5, "T2", "retry", **{"from": "a", "retries": 1,
-                                         "rid": 2}),
+                                         "rid": 2,
+                                         "reason": "fence_expiry"}),
         _ev(15, 100.6, "T2", "place", replica="b"),
         _ev(16, 100.6, "T2", "admit", replica="b", slot=0,
             queue_wait_s=0.0, pages=1, prefix_hit=True, prefix_len=4,
@@ -256,6 +257,17 @@ def _synthetic_tree(tmp_path, torn_journal=True):
             final=False, replica="a", tokens=0),
         _ev(25, 100.56, "T3", "verdict", verdict="expired_queue",
             final=True, router=True, rid=3, tokens=0),
+        # trace-less liveness news about replica a (ISSUE 17): one
+        # wobble that clears, then the real death (fence expiry) and
+        # a fenced late completion rejected by the router
+        _ev(26, 100.45, "", "suspect", replica="a", gap_s=0.12),
+        _ev(27, 100.48, "", "suspect_clear", replica="a", gap_s=0.05),
+        _ev(28, 100.49, "", "suspect", replica="a", gap_s=0.31),
+        _ev(29, 100.5, "", "confirm", replica="a",
+            reason="fence_expiry", gap_s=0.31),
+        {"seq": 30, "t": 100.85, "trace": "", "event": "fenced",
+         "args": {"replica": "a", "trace": "T2", "rid": 2,
+                  "fence_epoch": 1, "tokens": 2}},
     ]
     # token math: T1 = 1 prefill + steps 6,7 = 3; T2 = step 7 + 1
     # prefill(b) + step 19 = 3 (one re-decoded); T3 = 0 -> traced 6
@@ -367,6 +379,44 @@ def test_serve_report_arcs_and_blame(tmp_path):
     assert rep["requests"]["T1"]["swap_s"] == pytest.approx(0.05)
 
 
+def test_serve_report_liveness_lane_and_confirmed_arcs(tmp_path):
+    """ISSUE 17: the per-replica liveness lane rebuilds suspicion
+    spans, the worst heartbeat gap, the typed confirmation reason, and
+    fenced-rejection counts from the TRACE-LESS liveness events — and
+    the failover arc names the confirmation reason the proxy fired
+    on."""
+    rep = serve_report.analyze(_synthetic_tree(tmp_path))
+    lanes = rep["liveness"]
+    assert set(lanes) == {"a"}
+    ln = lanes["a"]
+    # two suspicions: one cleared wobble, one that confirmed
+    assert ln["suspicions"] == 2
+    assert len(ln["spans"]) == 2
+    assert ln["spans"][0]["cleared"] is True
+    assert ln["spans"][0]["dur_s"] == pytest.approx(0.03)
+    assert ln["spans"][1]["cleared"] is False
+    assert ln["open_suspect_t"] is None
+    assert ln["max_gap_s"] == pytest.approx(0.31)
+    assert ln["confirmed"] == {"t": 100.5, "reason": "fence_expiry"}
+    assert ln["fenced"] == 1 and ln["fenced_tokens"] == 2
+    # the healthy survivor has no lane — no news is good news
+    assert "b" not in lanes
+    # the retry record and the linked arc both carry the reason
+    assert rep["requests"]["T2"]["retries"][0]["reason"] == \
+        "fence_expiry"
+    (arc,) = rep["arcs"]
+    assert arc["reasons"] == ["fence_expiry"]
+    # liveness events are replica news, never request lifecycle hops
+    assert rep["lifecycle"]["ok"], rep["lifecycle"]
+    import io
+    buf = io.StringIO()
+    serve_report.render(rep, out=buf)
+    text = buf.getvalue()
+    assert "per-replica liveness lane" in text
+    assert "confirmed fence_expiry" in text
+    assert "fence_expiry" in text
+
+
 def test_failover_phase_charges_nothing_for_tokenless_victims():
     """A replica killed while a request was accepted-but-queued (or
     pre-first-token) lost no progress: failover_s must be 0 — the
@@ -472,8 +522,8 @@ def test_serve_report_dedups_postmortem_ring_against_stream(tmp_path):
         "request_trace": [
             _ev(25, 100.56, "T3", "verdict", verdict="expired_queue",
                 final=True, router=True, rid=3, tokens=0),
-            _ev(26, 100.9, "T9", "submit", prompt_len=1, max_new=1),
-            _ev(27, 100.91, "T9", "verdict", verdict="shed",
+            _ev(31, 100.9, "T9", "submit", prompt_len=1, max_new=1),
+            _ev(32, 100.91, "T9", "verdict", verdict="shed",
                 final=True, tokens=0),
         ],
     }

@@ -26,7 +26,15 @@ Pinned laws:
   reads as confirmed death; victims re-decode on the successor);
 - Router journal torn-tail replay: a journal truncated mid-line
   replays every complete entry, skips-and-counts the partial one, and
-  preserves at-most-once for every completed rid.
+  preserves at-most-once for every completed rid;
+- RPC-native liveness (ISSUE 17): heartbeat RPCs carry the incarnation
+  stamp + progress sequence; ``rpc.heartbeat.drop`` raises suspicion
+  but NEVER fails over (data plane alive); ``rpc.partition`` confirms
+  via fence_expiry, fails over, and the zombie's late completion is
+  REJECTED with the typed ``fenced`` journal line (non-terminal on
+  replay); drain RPCs are authenticated by incarnation; a
+  ``serve.worker.zombie`` swallows its drain order (supervisor
+  escalation is the only cure); timed-out call bursts leak no fds.
 """
 import json
 import os
@@ -564,3 +572,294 @@ def test_journal_torn_tail_replay(tmp_path):
         assert any("unparseable" in n for n in rep2["notes"])
     finally:
         sys.path.remove(sys_path)
+
+
+def test_replay_journal_fenced_lines_are_non_terminal(tmp_path):
+    """A journal mixing accept/retry/complete, a FENCED late completion
+    (written AFTER the real complete — the zombie finished late), and a
+    torn tail: fenced lines are counted and advance rids but never fold
+    into the request's state; the torn line is skipped-and-counted."""
+    journal = str(tmp_path / "router-journal-slot0.jsonl")
+    lines = [
+        {"t": 1.0, "event": "accept", "rid": 0, "trace": "tr-0",
+         "replica": "slot0", "state": "accepted", "verdict": None,
+         "retries": 0, "incarnation": [11, 0, "aa"], "fence_epoch": 0},
+        {"t": 1.1, "event": "retry", "rid": 0, "trace": "tr-0",
+         "replica": None, "state": "accepted", "verdict": None,
+         "retries": 1, "from_replica": "slot0",
+         "reason": "fence_expiry", "fence_epoch": 1},
+        {"t": 1.2, "event": "accept", "rid": 0, "trace": "tr-0",
+         "replica": "slot0+1", "state": "accepted", "verdict": None,
+         "retries": 1, "incarnation": [12, 1, "bb"], "fence_epoch": 1},
+        {"t": 1.3, "event": "complete", "rid": 0, "trace": "tr-0",
+         "replica": "slot0+1", "state": "completed",
+         "verdict": "completed", "retries": 1, "tokens": 4},
+        {"t": 1.4, "event": "fenced", "rid": 0, "trace": "tr-0",
+         "replica": "slot0", "state": "fenced", "verdict": "fenced",
+         "retries": 1, "fence_epoch": 1, "tokens_rejected": 4},
+        {"t": 1.5, "event": "accept", "rid": 1, "trace": "tr-1",
+         "replica": "slot0+1", "state": "accepted", "verdict": None,
+         "retries": 0},
+    ]
+    with open(journal, "w") as f:
+        for doc in lines:
+            f.write(json.dumps(doc) + "\n")
+        f.write('{"t": 1.6, "event": "complete", "rid": 1, "tr')
+    rt = Router([], journal_path=journal)
+    rep = rt.replay_journal()
+    assert rep["torn"] == 1
+    assert rep["fenced"] == 1
+    assert rep["entries"] == 6
+    assert rep["requests"] == 2
+    r0 = rt.request(0)
+    # the fenced line came LAST but folded NOTHING: the request's own
+    # story (completed on slot0+1) stands — at-most-once survives the
+    # zombie's late completion across a router restart too
+    assert r0.state == "completed" and r0.verdict == "completed"
+    assert r0.replica_id == "slot0+1"
+    assert r0.retries == 1
+    r1 = rt.request(1)
+    assert r1.state == "accepted"     # the torn complete never applied
+    assert rt._next_rid == 2
+
+
+# -- RPC-native liveness: heartbeats, suspicion, fencing (ISSUE 17) --------
+
+def test_heartbeat_rpc_reports_incarnation_and_progress():
+    w = _WorkerLoop()
+    try:
+        r = rpc_call(w.addr, {"method": "heartbeat"}, 1.0)
+        assert r["ok"]
+        inc = r["incarnation"]
+        assert inc == w.server.incarnation
+        assert inc["pid"] == os.getpid()
+        assert set(r["progress"]) == {"decode_steps", "weights_epoch"}
+        # the stub has no progress() duck-type: that reads as "no
+        # progress signal", never as progress
+        assert r["progress"]["decode_steps"] is None
+        # two boots of the same pid/attempt still differ by nonce —
+        # the component that survives pid recycling
+        s2 = RpcServer(_StubReplica())
+        try:
+            assert s2.incarnation["nonce"] != inc["nonce"]
+        finally:
+            s2.close()
+    finally:
+        w.close()
+
+
+def test_heartbeat_drop_raises_suspicion_never_failover():
+    """``rpc.heartbeat.drop``: the liveness plane is blackholed while
+    submits/status keep answering.  The fleet must record suspicion
+    (counter + gauge + span) and keep serving — ZERO failovers, even
+    with the tightest dead_after window — then clear the suspicion
+    when the plane heals."""
+    w = _WorkerLoop(_StubReplica("a", step_sleep=0.005))
+    try:
+        telemetry.reset()
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=0.5,
+                                retries=0, heartbeat_s=0.02,
+                                suspect_after_s=0.1, dead_after_s=0.3)
+        rt = Router([proxy])
+        rr = rt.submit(np.ones(2, np.int32), 20)
+        rt.step()
+        assert rr.state == "accepted"
+        fault.configure("rpc.heartbeat.drop:100000")
+        deadline = time.time() + 15.0
+        while (not rr.done or not proxy.suspected) and \
+                time.time() < deadline:
+            rt.step()
+            time.sleep(0.01)
+        assert rr.state == "completed" and len(rr.tokens) == 20
+        assert proxy.suspected
+        assert telemetry.counter("rpc.suspicions").value >= 1
+        assert rt.failovers == 0
+        assert proxy.alive and proxy.confirmed_reason is None
+        # the liveness plane heals: suspicion clears, nothing died
+        fault.reset()
+        while proxy.suspected and time.time() < deadline:
+            rt.step()
+            time.sleep(0.01)
+        assert not proxy.suspected
+        assert rt.failovers == 0
+    finally:
+        w.close()
+
+
+def test_partition_fails_over_and_fences_the_zombie(tmp_path):
+    """``rpc.partition``: the router's link to replica a is blackholed
+    while a keeps decoding.  Confirmation types as ``fence_expiry``
+    (suspicion sustained, zero observed progress), the victim re-places
+    on the successor bit-identically, and the ZOMBIE's late completion
+    — a never died — is observed and REJECTED with the typed ``fenced``
+    journal line, which replays non-terminally."""
+    journal = str(tmp_path / "router-journal-slot0.jsonl")
+    wa = _WorkerLoop(_StubReplica("a", step_sleep=0.01))   # the zombie
+    wb = _WorkerLoop(_StubReplica("b", step_sleep=0.001))  # successor
+    try:
+        telemetry.reset()
+        pa = RpcReplicaProxy(
+            "slot0", addr=wa.addr, timeout_s=0.2, retries=0,
+            heartbeat_s=0.02, suspect_after_s=0.05, dead_after_s=0.3,
+            breaker=CircuitBreaker(threshold=1, cooldown_s=100.0,
+                                   name="slot0"))
+
+        def spawn():
+            # the partition heals the moment the replacement exists
+            # (finite drills end); the zombie then becomes REACHABLE —
+            # which is exactly what makes its late completion
+            # observable instead of silently unread
+            fault.reset()
+            return RpcReplicaProxy("slot0+1", addr=wb.addr,
+                                   timeout_s=1.0)
+
+        rt = Router([pa], spawn=spawn, max_retries=2,
+                    journal_path=journal)
+        rr = rt.submit(np.ones(2, np.int32), 25)
+        rt.step()
+        assert rr.state == "accepted"
+        fault.configure("rpc.partition:100000")
+        deadline = time.time() + 20.0
+        while rt.failovers == 0 and time.time() < deadline:
+            rt.step()
+            time.sleep(0.01)
+        assert rt.failovers == 1
+        assert pa.confirmed_reason == "fence_expiry"
+        assert not pa.alive
+        assert telemetry.counter(
+            "rpc.confirmations.fence_expiry").value >= 1
+        while not rr.done and time.time() < deadline:
+            rt.step()
+            time.sleep(0.01)
+        # the re-decode completed exactly once, bit-identical to the
+        # successor stub's deterministic stream
+        assert rr.state == "completed" and rr.retries == 1
+        assert rr.tokens == list(range(25))
+        while telemetry.counter("rpc.fenced_results").value == 0 and \
+                time.time() < deadline:
+            rt.step()
+            time.sleep(0.01)
+        assert telemetry.counter("rpc.fenced_results").value >= 1
+        with open(journal) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        completes = [ln for ln in lines
+                     if ln["event"] == "complete"
+                     and ln["rid"] == rr.rid]
+        fenced = [ln for ln in lines if ln["event"] == "fenced"]
+        retries = [ln for ln in lines if ln["event"] == "retry"]
+        assert len(completes) == 1          # at-most-once, audited
+        assert fenced and fenced[0]["replica"] == "slot0"
+        assert fenced[0]["fence_epoch"] == 1
+        assert fenced[0]["tokens_rejected"] == 25
+        assert retries and retries[0]["reason"] == "fence_expiry"
+        rt2 = Router([], journal_path=journal)
+        rep = rt2.replay_journal()
+        assert rep["fenced"] == 1
+        assert rt2.request(rr.rid).state == "completed"
+        assert rt2.request(rr.rid).verdict == "completed"
+    finally:
+        wa.close()
+        wb.close()
+
+
+def test_drain_rpc_authenticated_by_incarnation():
+    w = _WorkerLoop()
+    try:
+        wrong = {"pid": 1, "attempt": 99, "nonce": "deadbeef"}
+        r = rpc_call(w.addr, {"method": "drain", "incarnation": wrong},
+                     1.0)
+        assert not r["ok"] and "incarnation" in r["error"]
+        assert not w.server.drain_requested
+        r2 = rpc_call(w.addr,
+                      {"method": "drain",
+                       "incarnation": dict(w.server.incarnation)}, 1.0)
+        assert r2["ok"]
+        assert w.server.drain_requested
+    finally:
+        w.close()
+
+
+def test_zombie_swallows_drain_and_kill_ack_confirms():
+    """``serve.worker.zombie``: the drain order is read and IGNORED —
+    no ack, no drain flag; the caller's deadline is its only way out.
+    The supervisor's escalation (kill + ack) is then the typed
+    confirmation road for the proxy."""
+    w = _WorkerLoop(_StubReplica("a"))
+    try:
+        fault.configure("serve.worker.zombie:2")
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=0.2,
+                                retries=1)
+        with pytest.raises(RpcError):
+            proxy.drain(timeout=1.0)
+        assert not w.server.drain_requested
+        assert w.replica.alive
+        # the site disarmed (count burnt): a fresh order lands — in the
+        # real fleet this is the post-escalation REPLACEMENT accepting
+        r = rpc_call(w.addr, {"method": "drain"}, 1.0)
+        assert r["ok"] and w.server.drain_requested
+        # kill-ack is confirmation evidence on its own: a proxy whose
+        # supervisor reaped the corpse fails over on the next step
+        dead = RpcReplicaProxy("d", addr=("127.0.0.1", 1),
+                               timeout_s=0.1, retries=0)
+        dead.note_kill_ack()
+        with pytest.raises(ReplicaLost):
+            dead.step()
+        assert dead.confirmed_reason == "kill_ack"
+    finally:
+        w.close()
+
+
+def test_inject_rpc_gated_by_env(monkeypatch):
+    """The drill-plane ``inject`` method arms a fault site in a
+    RUNNING worker (the partition drill needs to cut a link that
+    already carries accepted work) — but ONLY when the worker was
+    launched with MXTPU_RPC_ALLOW_INJECT=1; production workers take
+    no fault orders over the wire."""
+    w = _WorkerLoop(_StubReplica("a"))
+    try:
+        monkeypatch.delenv("MXTPU_RPC_ALLOW_INJECT", raising=False)
+        r = rpc_call(w.addr, {"method": "inject",
+                              "spec": "rpc.drop:1"}, 1.0)
+        assert not r["ok"] and "MXTPU_RPC_ALLOW_INJECT" in r["error"]
+        assert fault.fire_count("rpc.drop") == 0
+        monkeypatch.setenv("MXTPU_RPC_ALLOW_INJECT", "1")
+        r = rpc_call(w.addr, {"method": "inject",
+                              "spec": "rpc.heartbeat.drop:1"}, 1.0)
+        assert r["ok"] and r["armed"] == "rpc.heartbeat.drop:1"
+        with pytest.raises(RpcError):   # the armed site fires
+            rpc_call(w.addr, {"method": "heartbeat"}, 0.3, retries=0)
+        # an empty spec disarms: the link heals
+        r = rpc_call(w.addr, {"method": "inject", "spec": ""}, 1.0)
+        assert r["ok"]
+        assert rpc_call(w.addr, {"method": "heartbeat"}, 1.0)["ok"]
+    finally:
+        w.close()
+
+
+# -- fd hygiene: the one-connection-per-call path --------------------------
+
+@pytest.mark.skipif(not os.path.isdir("/proc/self/fd"),
+                    reason="needs /proc/self/fd")
+def test_timed_out_call_burst_does_not_leak_fds():
+    """Every timeout/error branch of ``rpc_call`` must close its
+    socket — a listener that never accepts (calls connect via the
+    backlog, then time out waiting for the reply) is the worst case:
+    25 timed-out calls, zero fd growth."""
+    ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(64)
+        addr = ls.getsockname()[:2]
+
+        def fds():
+            return len(os.listdir("/proc/self/fd"))
+
+        with pytest.raises(RpcError):   # warm-up: lazy-import churn
+            rpc_call(addr, {"method": "health"}, 0.02, retries=0)
+        base = fds()
+        for _ in range(25):
+            with pytest.raises(RpcError):
+                rpc_call(addr, {"method": "health"}, 0.02, retries=0)
+        assert fds() <= base + 2, "timed-out rpc calls leaked fds"
+    finally:
+        ls.close()

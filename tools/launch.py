@@ -73,6 +73,7 @@ import shlex
 import shutil
 import signal
 import socket
+import struct
 import subprocess
 import sys
 import tempfile
@@ -627,13 +628,158 @@ def _restart_loop(args, run_once, cmd):
     return 1
 
 
+def _serve_port_doc(run_dir, slot):
+    """Read a slot's port file (bootstrap discovery: host/port plus the
+    incarnation stamp the worker minted at boot).  Raises OSError /
+    ValueError when the worker has not published yet."""
+    path = os.path.join(run_dir, "serve-port-slot%d.json" % slot)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _serve_rpc(run_dir, slot, msg, timeout=2.0):
+    """One length-framed JSON RPC to a serve worker, dependency-free.
+
+    The supervisor must not import the framework to supervise it (a
+    jax import in the launcher would cost seconds and a device lock),
+    so this is a deliberate stdlib-only mirror of
+    ``mxnet_tpu/serving/rpc.py``'s wire format: 4-byte big-endian
+    length + UTF-8 JSON, one connection per call.  Returns
+    ``(reply_doc, port_doc)``; raises OSError/ValueError on any
+    transport or framing trouble — callers treat that as "no answer",
+    never as death (confirmation needs an incarnation change or a
+    kill-ack, and the supervisor IS the kill-ack authority)."""
+    doc = _serve_port_doc(run_dir, slot)
+    payload = json.dumps(msg).encode("utf-8")
+    with socket.create_connection(
+            (doc.get("host", "127.0.0.1"), int(doc["port"])),
+            timeout=timeout) as s:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(timeout)
+        s.sendall(struct.pack(">I", len(payload)) + payload)
+        buf = b""
+        while len(buf) < 4:
+            chunk = s.recv(4 - len(buf))
+            if not chunk:
+                raise OSError("serve rpc: connection closed mid-frame")
+            buf += chunk
+        (n,) = struct.unpack(">I", buf)
+        body = b""
+        while len(body) < n:
+            chunk = s.recv(n - len(body))
+            if not chunk:
+                raise OSError("serve rpc: connection closed mid-frame")
+            body += chunk
+    return json.loads(body.decode("utf-8")), doc
+
+
+def _serve_stop_fleet(args, run_dir, state):
+    """Stop the fleet the control-plane way: order each live worker to
+    drain over an incarnation-authenticated ``drain`` RPC (the stamp
+    comes from the slot's own port file, so a replacement that took
+    the slot between discovery and the call refuses the stale order),
+    wait for the exit-80s, then escalate SIGTERM→SIGKILL on anything
+    that did not answer or did not die — which is exactly the
+    ``serve.worker.zombie`` drill: a worker that swallows its drain
+    RPC still leaves, it just leaves feet-first."""
+    for slot, st in sorted(state.items()):
+        if st["proc"] is None or st["down"]:
+            continue
+        try:
+            doc = _serve_port_doc(run_dir, slot)
+            inc = {"pid": doc.get("pid"),
+                   "attempt": doc.get("attempt"),
+                   "nonce": doc.get("nonce")}
+            reply, _ = _serve_rpc(run_dir, slot,
+                                  {"method": "drain",
+                                   "incarnation": inc},
+                                  timeout=2.0)
+            acked = bool(reply.get("ok"))
+        except (OSError, ValueError):
+            acked = False
+        if not acked:
+            print("launch.py: serve slot %d did not ack its drain RPC "
+                  "— will escalate with signals" % slot,
+                  file=sys.stderr, flush=True)
+    procs = [st["proc"] for st in state.values()
+             if st["proc"] is not None]
+    deadline = time.time() + max(args.kill_grace, 5.0)
+    while time.time() < deadline and \
+            any(p.poll() is None for p in procs):
+        time.sleep(0.1)
+    stragglers = [p for p in procs if p.poll() is None]
+    if stragglers:
+        print("launch.py: %d worker(s) still up after the drain RPCs "
+              "— escalating" % len(stragglers),
+              file=sys.stderr, flush=True)
+        _escalate_kill(stragglers, signal.SIGTERM, args.kill_grace)
+
+
+def _serve_hb_check(args, run_dir, hb_dir, slot, st, now):
+    """Per-slot liveness via the heartbeat RPC (ISSUE 17).
+
+    Before a worker's first successful heartbeat (engine still
+    building, port file unpublished) the PR-4 heartbeat FILE covers
+    the boot window — the watchdog thread touches it from process
+    start, so a worker wedged before it can even serve RPCs is still
+    caught.  From first contact on, only the RPC view counts: the
+    slot is killed when heartbeats have been silent past
+    ``--heartbeat-timeout`` AND the progress sequence (decode steps,
+    weights epoch) has not advanced either — a worker that answers
+    nothing but is provably decoding is partitioned, not wedged, and
+    killing it is the router's fencing problem, not ours."""
+    p = st["proc"]
+    if st["hb_ok_at"] is None:
+        # boot window: heartbeat-file mtime is the only signal
+        hb = os.path.join(hb_dir, "hb-%d.json" % slot)
+        try:
+            age = now - os.stat(hb).st_mtime
+        except OSError:
+            age = None
+        if age is not None and age > args.heartbeat_timeout:
+            print("launch.py: serve slot %d heartbeat silent %.1fs "
+                  "during boot — killing the wedged replica"
+                  % (slot, age), file=sys.stderr, flush=True)
+            _escalate_kill([p], signal.SIGTERM, args.kill_grace)
+    if now >= st["next_hb_at"]:
+        st["next_hb_at"] = now + min(1.0,
+                                     args.heartbeat_timeout / 4.0)
+        try:
+            reply, _doc = _serve_rpc(
+                run_dir, slot, {"method": "heartbeat"},
+                timeout=min(2.0, args.heartbeat_timeout))
+        except (OSError, ValueError):
+            reply = None
+        if reply is not None and reply.get("ok"):
+            st["hb_ok_at"] = now
+            prog = reply.get("progress") or {}
+            seq = (prog.get("decode_steps"),
+                   prog.get("weights_epoch"))
+            if seq != st["progress_seq"]:
+                st["progress_seq"] = seq
+                st["progress_at"] = now
+    ok_at = st["hb_ok_at"]
+    if ok_at is None:
+        return
+    hb_gap = now - ok_at
+    prog_gap = now - (st["progress_at"] if st["progress_at"]
+                      is not None else ok_at)
+    if hb_gap > args.heartbeat_timeout and \
+            prog_gap > args.heartbeat_timeout:
+        print("launch.py: serve slot %d heartbeat RPC silent %.1fs "
+              "with no decode progress — killing the wedged replica"
+              % (slot, hb_gap), file=sys.stderr, flush=True)
+        _escalate_kill([p], signal.SIGTERM, args.kill_grace)
+
+
 def _serve_spawn(args, mem, run_dir, hb_dir, cmd, slot, attempt):
     """One serving-replica worker process for ``slot``: the training
     env contract (slot == rank — serving has no collective world to
     re-pack) plus the serve-plane exports: the slot's PORT FILE (the
-    router proxies' discovery + incarnation channel) and the shared
-    heartbeat dir (the PR-4 liveness files the proxies fuse into their
-    health view)."""
+    bootstrap-discovery channel carrying the worker's incarnation
+    stamp) and the heartbeat dir (boot-window liveness only — once a
+    worker answers its first heartbeat RPC, the supervisor watches
+    the RPC view, not file mtimes)."""
     env = dict(os.environ)
     env.update(_worker_env(args, mem, mem.world_size, slot, slot,
                            attempt, None))
@@ -670,13 +816,17 @@ def _serve_loop(args, cmd):
       forever;
     - ``--max-restarts`` bounds TOTAL failure-respawns across the
       fleet (drain respawns are planned and free);
-    - a worker whose heartbeat file goes stale past
-      ``--heartbeat-timeout`` is killed (SIGTERM→SIGKILL) and handled
-      as its exit code classifies.
+    - liveness is the RPC view (ISSUE 17): the supervisor polls each
+      worker's ``heartbeat`` RPC and kills (SIGTERM→SIGKILL) a slot
+      whose heartbeats go silent past ``--heartbeat-timeout`` with no
+      decode-progress advance; heartbeat FILES cover only the boot
+      window before the worker publishes its port file.
 
     The fleet runs until ``<run-dir>/serve-stop`` appears (the
-    operator/driver's shutdown handle — SIGTERM then asks each worker
-    to drain, exit 80) or every slot is down (exit 1)."""
+    operator/driver's shutdown handle — each worker is ordered to
+    drain over an incarnation-authenticated RPC, exit 80, with
+    SIGTERM escalation for non-responders) or every slot is down
+    (exit 1)."""
     mem = _Membership(args)
     run_dir = args.run_dir
     hb_dir = os.path.join(run_dir, "hb")
@@ -693,6 +843,8 @@ def _serve_loop(args, cmd):
     for slot in list(mem.active):
         state[slot] = {"attempt": 0, "streak": 0, "down": False,
                        "next_spawn_at": None,
+                       "hb_ok_at": None, "progress_seq": None,
+                       "progress_at": None, "next_hb_at": 0.0,
                        "proc": _serve_spawn(args, mem, run_dir, hb_dir,
                                             cmd, slot, 0)}
     fail_respawns = 0
@@ -700,12 +852,10 @@ def _serve_loop(args, cmd):
         while True:
             if os.path.exists(stop_path):
                 print("launch.py: serve-stop requested — draining the "
-                      "fleet", file=sys.stderr, flush=True)
+                      "fleet over the control RPC", file=sys.stderr,
+                      flush=True)
                 mem.record(0, "stop")
-                _escalate_kill(
-                    [st["proc"] for st in state.values()
-                     if st["proc"] is not None],
-                    signal.SIGTERM, args.kill_grace)
+                _serve_stop_fleet(args, run_dir, state)
                 mem.record(0, "complete")
                 return 0
             now = time.time()
@@ -725,6 +875,12 @@ def _serve_loop(args, cmd):
                 if p is None:
                     if now >= st["next_spawn_at"]:
                         st["attempt"] += 1
+                        # fresh incarnation: the RPC liveness clock
+                        # restarts with it
+                        st["hb_ok_at"] = None
+                        st["progress_seq"] = None
+                        st["progress_at"] = None
+                        st["next_hb_at"] = 0.0
                         st["proc"] = _serve_spawn(
                             args, mem, run_dir, hb_dir, cmd, slot,
                             st["attempt"])
@@ -732,18 +888,8 @@ def _serve_loop(args, cmd):
                 rc = p.poll()
                 if rc is None:
                     if args.heartbeat_timeout > 0:
-                        hb = os.path.join(hb_dir, "hb-%d.json" % slot)
-                        try:
-                            age = now - os.stat(hb).st_mtime
-                        except OSError:
-                            continue
-                        if age > args.heartbeat_timeout:
-                            print("launch.py: serve slot %d heartbeat "
-                                  "silent %.1fs — killing the wedged "
-                                  "replica" % (slot, age),
-                                  file=sys.stderr, flush=True)
-                            _escalate_kill([p], signal.SIGTERM,
-                                           args.kill_grace)
+                        _serve_hb_check(args, run_dir, hb_dir, slot,
+                                        st, now)
                     continue
                 if rc == 0:
                     # clean completion (e.g. a worker's own run-length

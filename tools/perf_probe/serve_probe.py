@@ -54,6 +54,13 @@ batch occupancy.  Hard contracts asserted by ``BENCH_MODE=serve``
   death → journaled failover → a REPLACEMENT PROCESS spun on the
   shared AOT cache with 0 foreground compiles) — 0 dropped, tokens
   bit-identical to the unfaulted run, all hard-asserted;
+- **partition drill** (``run_partition``, ISSUE 17): the same fleet
+  with NO shared run dir (per-worker private tmp dirs, addr-pinned
+  proxies, one bootstrap port-file read) — heartbeat-only loss raises
+  suspicion but ZERO failovers; a real partition confirms
+  ``fence_expiry``, fails over, and FENCES the zombie's late
+  completions (0 double-delivered, bit-identical tokens,
+  ``rpc.fenced_results`` >= 1), all hard-asserted;
 - **capacity multipliers** (``run_prefix`` / ``run_gqa``, ISSUE 15):
   a system-prompt-heavy Poisson mix with per-request sampling on half
   the requests, cache-on vs cache-off on the SAME workload — prefix
@@ -926,6 +933,183 @@ def run_fleet(workload, reference_tokens):
         shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def run_partition(workload, reference_tokens):
+    """The ISSUE-17 partition drill: RPC-native liveness over a fleet
+    that shares NO run directory.  Every worker lives in a PRIVATE tmp
+    dir — its port file, heartbeat file, and telemetry are invisible
+    to its peers and to the router except for ONE bootstrap read of
+    the port file (the out-of-band discovery stand-in); after that the
+    proxies are addr-pinned and liveness rides the heartbeat RPC
+    alone.  The only shared artifact is the router host's own journal
+    — the multi-host seam.
+
+    Phase A — **heartbeat-only loss** (``rpc.heartbeat.drop``, armed
+    mid-run over the drill-plane ``inject`` RPC): worker b's heartbeat
+    replies park while its data plane keeps answering.  Laws: the
+    proxy records SUSPICION (``rpc.suspicions`` delta > 0), every
+    request completes, suspicion CLEARS when the control plane heals,
+    and there are ZERO failovers — breaker wobble or a cut control
+    plane alone never kills a replica that is still doing work.
+
+    Phase B — **real partition** (``rpc.partition``, a FINITE count so
+    the link heals once the armed budget is parked away): worker b
+    blackholes every inbound frame while holding accepted work.  The
+    proxy suspects, then confirms ``fence_expiry`` (heartbeat AND
+    progress silence past the lease); the router fails over, bumps the
+    slot's fencing epoch, and re-places the victims on a.  The zombie
+    keeps decoding behind the partition; when the link heals, its late
+    completions are observed and REJECTED (``rpc.fenced_results``,
+    journaled ``fenced`` lines).  Laws: >= 1 failover with the typed
+    ``fence_expiry`` reason, >= 1 fenced result, EXACTLY one terminal
+    journal line per rid (0 double-delivered), and the delivered
+    tokens bit-identical to the unfaulted run."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import Router
+    from mxnet_tpu.serving.rpc import (CircuitBreaker, RpcReplicaProxy,
+                                       port_file_path, rpc_call,
+                                       wait_port_file)
+
+    def cval(name):
+        return telemetry.counter(name).value
+
+    def inject(addr, spec, timeout=1.0):
+        return rpc_call(tuple(addr), {"method": "inject",
+                                      "spec": spec},
+                        timeout, retries=0)
+
+    cache = tempfile.mkdtemp(prefix="serve-part-aot-")
+    router_dir = tempfile.mkdtemp(prefix="serve-part-router-")
+    journal = os.path.join(router_dir, "router-journal.jsonl")
+    dirs, procs, addrs = {}, {}, {}
+    try:
+        for slot, tag in ((0, "a"), (1, "b")):
+            dirs[tag] = tempfile.mkdtemp(
+                prefix="serve-part-w%d-" % slot)
+            procs[tag] = _spawn_worker(
+                dirs[tag], cache, slot, 0,
+                {"MXTPU_RPC_ALLOW_INJECT": "1"})
+        for slot, tag in ((0, "a"), (1, "b")):
+            doc = wait_port_file(port_file_path(dirs[tag], slot),
+                                 timeout=300)
+            addrs[tag] = (doc.get("host", "127.0.0.1"),
+                          int(doc["port"]))
+
+        def proxy(tag):
+            # addr-pinned: NO port-file watching after bootstrap —
+            # liveness evidence is the heartbeat RPC only
+            return RpcReplicaProxy(
+                tag, addr=addrs[tag], timeout_s=0.25, retries=0,
+                heartbeat_s=0.05, suspect_after_s=0.2,
+                dead_after_s=0.8,
+                breaker=CircuitBreaker(threshold=1, cooldown_s=100.0,
+                                       name=tag))
+
+        pa, pb = proxy("a"), proxy("b")
+        rt = Router([pa, pb], journal_path=journal, max_retries=2)
+
+        # ---- phase A: control plane cut, data plane healthy ----------
+        base_susp = cval("rpc.suspicions")
+        inject(addrs["b"], "rpc.heartbeat.drop:100000")
+        reqs = [rt.submit(p, n) for _t, p, n in workload[:8]]
+        suspected_seen = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            rt.step()
+            suspected_seen = suspected_seen or pb.suspected
+            if all(r.done for r in reqs) and suspected_seen:
+                break
+            time.sleep(0.01)
+        inject(addrs["b"], "")          # heal the control plane
+        deadline = time.monotonic() + 30
+        while pb.suspected and time.monotonic() < deadline:
+            rt.step()
+            time.sleep(0.01)
+        phase_a = {
+            "requests": len(reqs),
+            "completed": sum(1 for r in reqs
+                             if r.state == "completed"),
+            "suspicions": cval("rpc.suspicions") - base_susp,
+            "suspect_cleared": not pb.suspected,
+            "failovers": rt.failovers,
+            "confirm_reason": pb.confirmed_reason,
+        }
+
+        # ---- phase B: real partition + fenced failover ---------------
+        base_fenced = cval("rpc.fenced_results")
+        base_conf = cval("rpc.confirmations.fence_expiry")
+        rrs = [rt.submit(p, n) for _t, p, n in workload]
+        on_b = sum(1 for rr in rrs if rr.replica_id == "b")
+        if on_b == 0:
+            raise RuntimeError(
+                "placement never used worker b — the partition would "
+                "cut an idle link and drill nothing")
+        # finite count: the partition heals once this budget is parked
+        # away (heartbeats, the breaker's one probe, the fenced sweep's
+        # polls, and the heal-spam below all burn it)
+        inject(addrs["b"], "rpc.partition:100")
+        healed = False
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            rt.step()
+            for p_ in procs.values():
+                p_.poll()
+            done = all(rr.done for rr in rrs)
+            if done and cval("rpc.fenced_results") - base_fenced >= 1:
+                break
+            if done and rt.failovers > phase_a["failovers"] \
+                    and not healed:
+                try:
+                    inject(addrs["b"], "", timeout=0.1)
+                    healed = True
+                except Exception:
+                    pass    # still partitioned: the attempt burned one
+            time.sleep(0.01)
+        completed = [rr for rr in rrs if rr.state == "completed"]
+        tokens = [rr.tokens for rr in completed]
+
+        # exactly-once off the journal: one terminal line per rid,
+        # fenced lines are separate typed events, never deliveries
+        terminal = {}
+        fenced_lines = []
+        with open(journal) as f:
+            for ln in f:
+                try:
+                    doc = json.loads(ln)
+                except ValueError:
+                    continue
+                if doc.get("event") == "fenced":
+                    fenced_lines.append(doc)
+                elif doc.get("event") == "complete":
+                    terminal[doc["rid"]] = \
+                        terminal.get(doc["rid"], 0) + 1
+        return {
+            "phase_a": phase_a,
+            "requests": len(rrs),
+            "completed": len(completed),
+            "dropped": len(rrs) - len(completed),
+            "failovers": rt.failovers,
+            "confirm_reason": pb.confirmed_reason,
+            "confirmations_fence_expiry":
+                cval("rpc.confirmations.fence_expiry") - base_conf,
+            "fenced_results":
+                cval("rpc.fenced_results") - base_fenced,
+            "fenced_journal_lines": len(fenced_lines),
+            "double_delivered":
+                sum(1 for v in terminal.values() if v > 1),
+            "victims_on_partitioned": on_b,
+            "tokens_match_unfaulted": tokens == reference_tokens,
+        }
+    finally:
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        for d in list(dirs.values()) + [cache, router_dir]:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def measure_trace_overhead(slots=8, iters=2000, passes=5):
     """Isolated microbench of the per-decode-step tracing cost: one
     batched ``tokens`` event naming every resident trace (exactly what
@@ -1042,6 +1226,7 @@ def run(spinup=True, degraded=True, fleet=True):
         result["degraded"] = run_degraded(net, workload, cont_tokens)
     if fleet:
         result["fleet"] = run_fleet(workload, cont_tokens)
+        result["partition"] = run_partition(workload, cont_tokens)
     if spinup:
         result["spinup"] = measure_spinup()
     return result

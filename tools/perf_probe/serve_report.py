@@ -19,6 +19,11 @@ faithfully; THIS tool answers the fleet-level questions none can alone:
   (admits, tokens, verdicts, retries-out) and TTFT / TPOT / queue-wait
   percentiles SPLIT BY VERDICT CLASS (a p99 that mixes completed and
   shed requests describes nothing);
+- **who was suspected, who was confirmed dead, and why** — a
+  per-replica liveness lane (ISSUE 17): suspicion spans from the RPC
+  heartbeat view, the worst observed heartbeat gap, the typed
+  confirmation reason (incarnation / kill_ack / fence_expiry) named on
+  each failover arc, and fenced late-completion rejections;
 - **SLO breach blame** — every deadline-missed / shed / failed-over
   (and, with ``--slo-ttft``, p99-breaching) request decomposed into its
   phase budget: queue wait, prefill, decode, hot-swap pauses, failover
@@ -245,7 +250,8 @@ def build_requests(events):
                 r["segments"][-1]["tokens"] += 1
         elif ev == "retry":
             r["retries"].append({"t": e.get("t"),
-                                 "from": args.get("from")})
+                                 "from": args.get("from"),
+                                 "reason": args.get("reason")})
             if r["segments"]:
                 r["segments"][-1]["end"] = e.get("t")
         elif ev == "verdict":
@@ -435,8 +441,10 @@ def prefix_latency_split(reqs):
 
 def failover_arcs(reqs):
     """Failed-over requests as linked arcs: one per retried trace —
-    victim replica, survivor replica, tokens lost/regained, and whether
-    the arc completed."""
+    victim replica, survivor replica, tokens lost/regained, whether
+    the arc completed, and the CONFIRMATION REASON the liveness
+    machine typed on each hop (ISSUE 17: incarnation / kill_ack /
+    fence_expiry; None for in-process ReplicaLost)."""
     arcs = []
     for tr, r in sorted(reqs.items()):
         if not r["retries"]:
@@ -445,6 +453,7 @@ def failover_arcs(reqs):
         arcs.append({
             "trace": tr, "rid": r["rid"],
             "victims": [ret["from"] for ret in r["retries"]],
+            "reasons": [ret.get("reason") for ret in r["retries"]],
             "path": hops,
             "survivor": hops[-1] if hops else None,
             "verdict": ((r["final"] or {}).get("args") or {})
@@ -452,6 +461,61 @@ def failover_arcs(reqs):
             "failover_s": (r["phases"] or {}).get("failover_s"),
         })
     return arcs
+
+
+def liveness_lanes(events):
+    """Per-replica liveness lane (ISSUE 17), rebuilt from the
+    trace-less liveness events the RPC proxies and the Router emit:
+    suspicion spans (``suspect`` → ``suspect_clear`` or ``confirm``),
+    the worst observed heartbeat gap, the confirmed death (typed
+    reason), and fenced late-completion rejections.  These events
+    carry no trace id by design — they are replica news, not request
+    lifecycle hops — so they never appear in ``build_requests``;
+    this lane is their home."""
+    lanes = {}
+
+    def lane(tag):
+        return lanes.setdefault(tag, {
+            "replica": tag, "suspicions": 0, "spans": [],
+            "open_suspect_t": None, "max_gap_s": 0.0,
+            "confirmed": None, "fenced": 0, "fenced_tokens": 0})
+
+    for e in events:
+        ev = e.get("event")
+        if ev not in ("suspect", "suspect_clear", "confirm", "fenced"):
+            continue
+        args = e.get("args") or {}
+        tag = args.get("replica")
+        if tag is None:
+            continue
+        ln = lane(tag)
+        t = e.get("t")
+        if ev == "suspect":
+            ln["suspicions"] += 1
+            ln["open_suspect_t"] = t
+            ln["max_gap_s"] = max(ln["max_gap_s"],
+                                  args.get("gap_s") or 0.0)
+        elif ev == "suspect_clear":
+            if ln["open_suspect_t"] is not None and t is not None:
+                ln["spans"].append(
+                    {"t": ln["open_suspect_t"],
+                     "dur_s": max(0.0, t - ln["open_suspect_t"]),
+                     "cleared": True})
+            ln["open_suspect_t"] = None
+            ln["max_gap_s"] = max(ln["max_gap_s"],
+                                  args.get("gap_s") or 0.0)
+        elif ev == "confirm":
+            if ln["open_suspect_t"] is not None and t is not None:
+                ln["spans"].append(
+                    {"t": ln["open_suspect_t"],
+                     "dur_s": max(0.0, t - ln["open_suspect_t"]),
+                     "cleared": False})
+                ln["open_suspect_t"] = None
+            ln["confirmed"] = {"t": t, "reason": args.get("reason")}
+        elif ev == "fenced":
+            ln["fenced"] += 1
+            ln["fenced_tokens"] += args.get("tokens") or 0
+    return lanes
 
 
 def blame(reqs, slo_ttft=None):
@@ -690,6 +754,7 @@ def analyze(run_dir, slo_ttft=None):
         "prefix": prefix_latency_split(reqs),
         "arcs": arcs, "linked_arcs": linked_arcs,
         "journal_retries": journal_retries,
+        "liveness": liveness_lanes(data["events"]),
         "blame": blame(reqs, slo_ttft),
         "accounting": accounting(data, reqs),
     }
@@ -782,16 +847,33 @@ def render(rep, out=sys.stdout):
             out.write("  " + "  ".join(
                 "%s=%d" % kv for kv in sorted(c.items())) + "\n")
 
+    if rep["liveness"]:
+        out.write("\n-- per-replica liveness lane (ISSUE 17) --\n")
+        rows = []
+        for tag in sorted(rep["liveness"]):
+            ln = rep["liveness"][tag]
+            conf = ln["confirmed"]
+            spans = len(ln["spans"]) + (
+                1 if ln["open_suspect_t"] is not None else 0)
+            rows.append((tag, ln["suspicions"], spans,
+                         _tr._fmt_s(ln["max_gap_s"]),
+                         conf["reason"] if conf else "-",
+                         ln["fenced"], ln["fenced_tokens"]))
+        _tr._table(("replica", "suspicions", "spans", "max_hb_gap",
+                    "confirmed", "fenced", "fenced_tok"), rows, out)
+
     if rep["arcs"]:
         out.write("\n-- failover arcs (linked by trace id) --\n")
         for a in rep["arcs"]:
-            out.write("  req %s [%s]: %s -> %s (%s, failover cost %s)"
-                      "\n"
+            reason = ", ".join(x for x in a.get("reasons") or [] if x)
+            out.write("  req %s [%s]: %s -> %s (%s, failover cost %s"
+                      "%s)\n"
                       % (a["rid"] if a["rid"] is not None
                          else a["trace"],
                          a["trace"], " + ".join(a["victims"]),
                          a["survivor"], a["verdict"],
-                         _tr._fmt_s(a["failover_s"])))
+                         _tr._fmt_s(a["failover_s"]),
+                         ", confirmed %s" % reason if reason else ""))
 
     if rep["blame"]:
         out.write("\n-- SLO breach blame --\n")

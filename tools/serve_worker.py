@@ -11,9 +11,13 @@ touched from two threads:
     →  drain-on-request (exit 80)  →  repeat
 
 Spin-up publishes a PORT FILE (``MXTPU_SERVE_PORT_FILE`` or
-``--port-file``) carrying host/port/pid/attempt — the incarnation
-stamp router proxies pin, so a replacement taking over the slot reads
-as confirmed death to the old proxy, never a silent redirect.  With
+``--port-file``) carrying host/port/pid/attempt/boot-nonce — the
+incarnation stamp router proxies pin, so a replacement taking over
+the slot reads as confirmed death to the old proxy, never a silent
+redirect.  The file is BOOTSTRAP DISCOVERY only (ISSUE 17): liveness
+rides the ``heartbeat`` RPC (incarnation + decode-progress sequence),
+and drain orders arrive as incarnation-authenticated ``drain`` RPCs —
+the worker trusts no shared filesystem once it is up.  With
 ``MXTPU_AOT_CACHE_DIR`` exported (the ``tools/launch.py --serve``
 default) a replacement spins up AOT-warm: 0 foreground serving
 compiles before its first token (the health RPC reports the count).
@@ -153,11 +157,18 @@ def main(argv=None):
     # compile the warm-spin-up contract forbids
     from mxnet_tpu import aot_cache
     aot_cache.drain(timeout=180)
-    server = RpcServer(replica, host=args.host, port=args.port)
+    server = RpcServer(replica, host=args.host, port=args.port,
+                       attempt=attempt)
+    # the port file repeats the server's OWN boot nonce: discovery and
+    # the heartbeat RPC describe the same incarnation, so a proxy can
+    # cross-check either channel without false mismatches
     write_port_file(args.port_file, server.port, host=args.host,
-                    attempt=attempt)
-    print("serve_worker: slot %s attempt %d serving on %s:%d (pid %d)"
-          % (slot, attempt, args.host, server.port, os.getpid()),
+                    attempt=attempt,
+                    nonce=server.incarnation["nonce"])
+    print("serve_worker: slot %s attempt %d serving on %s:%d (pid %d "
+          "nonce %s)"
+          % (slot, attempt, args.host, server.port, os.getpid(),
+             server.incarnation["nonce"]),
           file=sys.stderr, flush=True)
 
     # SIGTERM = polite drain request (the launcher teardown path): the
