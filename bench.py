@@ -734,6 +734,14 @@ def bench_serve():
       request; a real partition confirms the typed `fence_expiry`
       reason, fails over, and fences the zombie's late completions —
       0 double-delivered, >= 1 fenced result, tokens bit-identical;
+    - **telemetry plane** (ISSUE 18): the partition drill's router
+      host assembles fleet telemetry ONLY via telemetry_pull and
+      serve_report over that pull-only tree is green (lawful
+      lifecycles, bit-exact token accounting, >= 1 default alert rule
+      fired and rendered), fleet_top returns a complete live matrix,
+      and a pull per engine step leaves 1.0 decode dispatch/step with
+      0 recompiles, the steady-state pull itself under
+      MXTPU_TELEMETRY_PULL_BUDGET (default 2000 us, isolated);
     - **speculative decoding** (ISSUE 16): on the acceptance-friendly
       workload spec-on reaches >= 1.5x spec-off tokens/s with > 1.3
       tokens per slot step, still exactly 1.0 decode dispatch/step and
@@ -1046,6 +1054,46 @@ def bench_serve():
             "partition-drill tokens diverged from the unfaulted run "
             "(contract: the fenced failover re-decode is bit-identical "
             "greedy)")
+    coll = result["collector"]
+    pull_budget = float(os.environ.get("MXTPU_TELEMETRY_PULL_BUDGET",
+                                       "2000"))
+    if coll["decode_dispatches_per_step"] != 1.0 or \
+            coll["steady_state_compiles"] != 0:
+        raise AssertionError(
+            "a telemetry pull per engine step broke the hot path "
+            "(%.3f dispatch/step, %d recompile(s); contract: the "
+            "collector NEVER forces a dispatch or a recompile)"
+            % (coll["decode_dispatches_per_step"],
+               coll["steady_state_compiles"]))
+    if coll["pull_us"] > pull_budget:
+        raise AssertionError(
+            "a steady-state telemetry pull costs %.1f us isolated "
+            "(MXTPU_TELEMETRY_PULL_BUDGET %.0f us): the pull_snapshot "
+            "path regressed" % (coll["pull_us"], pull_budget))
+    tel = part["telemetry"]
+    if not (tel["lifecycle_ok"] and tel["accounting_exact"]):
+        raise AssertionError(
+            "serve_report on the PULL-ONLY partition tree was not "
+            "green (lifecycle_ok=%s accounting_exact=%s tokens=%s "
+            "traced=%s; contract: the router host's telemetry_pull "
+            "collector assembles the complete fleet record — no "
+            "shared-filesystem reads)"
+            % (tel["lifecycle_ok"], tel["accounting_exact"],
+               tel["tokens"], tel["traced_tokens"]))
+    if tel["alerts_fired"] < 1 or not tel["report_renders"]:
+        raise AssertionError(
+            "no default alert rule fired/rendered during the "
+            "partition drill (fired=%d rules=%s renders=%s; contract: "
+            "an open breaker or a fence confirmation trips the "
+            "default rules and the alerts lane shows it)"
+            % (tel["alerts_fired"], tel["alert_rules"],
+               tel["report_renders"]))
+    if tel["fleet_top"]["rows"] != 2 or \
+            not tel["fleet_top"]["complete"]:
+        raise AssertionError(
+            "fleet_top's live matrix was incomplete on the drill "
+            "fleet (%s; contract: one complete row per live worker "
+            "via status + telemetry_pull alone)" % (tel["fleet_top"],))
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": cont["tokens_per_sec"],
@@ -1062,6 +1110,8 @@ def bench_serve():
         "vs_baseline": round(speedup / 2.0, 3),
         "speedup": speedup,
         "trace_overhead_us": trace_us,
+        "collector_pull_us": coll["pull_us"],
+        "partition_alerts_fired": tel["alerts_fired"],
         "prefix_prefill_token_reduction":
             pfx["prefill_token_reduction"],
         "prefix_hit_rate": pfx["hit_rate"],

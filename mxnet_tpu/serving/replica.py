@@ -163,6 +163,11 @@ class ServingReplica:
         self.swap_poll_steps = max(1, int(swap_poll_steps))
         self.alive = True
         self._steps = 0
+        # alert-rule cadence (ISSUE 18): rules also run on every
+        # report()/pull, but a replica nobody polls must still notice
+        # its own shed/stall between emitter intervals — ~1/s from the
+        # decode loop, time-gated so the per-step cost is one clock read
+        self._next_alert_t = 0.0
 
     # -- request plane -----------------------------------------------------
     def submit(self, prompt, max_new, deadline_s=None, trace=None,
@@ -201,6 +206,10 @@ class ServingReplica:
         if self.subscriber is not None and \
                 self._steps % self.swap_poll_steps == 0:
             self.maybe_swap()
+        now = time.monotonic()
+        if now >= self._next_alert_t:
+            self._next_alert_t = now + 1.0
+            _telemetry.check_alerts(now)
         self._steps += 1
         return self.engine.step()
 
@@ -291,6 +300,8 @@ class ServingReplica:
             "alive": self.alive,
             "draining": self.draining,
             "lease_age_s": None if lease is None else lease["age_s"],
+            "alerts_fired":
+                _telemetry.counter("telemetry.alerts").value,
             "engine": self.engine.snapshot(),
         }
 

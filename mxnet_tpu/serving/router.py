@@ -146,7 +146,8 @@ class RouterRequest:
 class Router:
     def __init__(self, replicas, spawn=None, max_retries=1,
                  journal_path=None, journal_retention=4096,
-                 fence_watch_s=30.0):
+                 fence_watch_s=30.0, telemetry_dir=None,
+                 telemetry_interval_s=2.0):
         self._replicas = list(replicas)
         self._spawn = spawn
         self.max_retries = int(max_retries)
@@ -174,6 +175,16 @@ class Router:
         #: router pins every prompt + token list it ever served.
         self.journal_retention = (None if journal_retention is None
                                   else max(1, int(journal_retention)))
+        # -- fleet telemetry collector (ISSUE 18): when given a dir,
+        # the router host periodically pulls every RPC replica's
+        # telemetry over the wire and appends the returned lines to
+        # <dir>/stream-<replica_id>.jsonl — the same layout
+        # serve_report/telemetry_report already read, assembled with
+        # ZERO shared-filesystem telemetry reads
+        self.telemetry_dir = telemetry_dir
+        self.telemetry_interval_s = float(telemetry_interval_s)
+        self._tel_cursors = {}       # replica_id -> client-held cursor
+        self._next_tel_pull = 0.0
         self._next_rid = 0
         self.failovers = 0
         self._gauge_live()
@@ -453,7 +464,60 @@ class Router:
                 self._failover(r)
         self._harvest()
         self._sweep_fenced()
+        self.collect_telemetry()
         return produced
+
+    def collect_telemetry(self, force=False):
+        """Pull every live RPC replica's telemetry into
+        ``telemetry_dir`` (no-op without one, or between intervals
+        unless ``force``).  Per replica: resume from the client-held
+        cursor, append each returned line whole (single O_APPEND
+        ``os.write`` — the emitter's torn-line discipline), loop while
+        the worker declares ``more`` (bounded, so a firehose replica
+        cannot wedge the serving loop — the cursor resumes next round).
+        In-process replicas (no ``pull_telemetry``) are skipped: their
+        emitter already writes locally.  A failed pull is counted and
+        skipped — observability must never take the serving loop down.
+        Returns the number of lines appended."""
+        if not self.telemetry_dir:
+            return 0
+        now = time.monotonic()
+        if not force and now < self._next_tel_pull:
+            return 0
+        self._next_tel_pull = now + self.telemetry_interval_s
+        try:
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+        except OSError:
+            return 0
+        lines = 0
+        for r in list(self._replicas):
+            pull = getattr(r, "pull_telemetry", None)
+            if pull is None or not getattr(r, "alive", False):
+                continue
+            rid = str(r.replica_id).replace(os.sep, "_")
+            path = os.path.join(self.telemetry_dir,
+                                "stream-%s.jsonl" % rid)
+            try:
+                cursor = self._tel_cursors.get(rid)
+                for _ in range(8):
+                    reply = pull(cursor=cursor)
+                    cursor = reply["cursor"]
+                    data = (json.dumps(reply["line"])
+                            + "\n").encode("utf-8")
+                    fd = os.open(path, os.O_WRONLY | os.O_APPEND
+                                 | os.O_CREAT, 0o644)
+                    try:
+                        os.write(fd, data)
+                    finally:
+                        os.close(fd)
+                    lines += 1
+                    if not reply.get("more"):
+                        break
+                self._tel_cursors[rid] = cursor
+            except Exception:
+                _telemetry.counter(
+                    "router.telemetry_pull_errors").inc()
+        return lines
 
     @staticmethod
     def _slot_key(replica):

@@ -99,6 +99,7 @@ from .scheduler import EXPIRED, SHED
 __all__ = ["RpcError", "CircuitBreaker", "RpcServer", "RpcReplicaProxy",
            "rpc_call", "send_frame", "recv_frame", "read_port_file",
            "write_port_file", "wait_port_file", "fleet_proxies",
+           "pull_telemetry", "collect_telemetry",
            "mint_boot_nonce", "VERDICT_EXPIRED_RPC", "VERDICT_FENCED",
            "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN"]
 
@@ -666,6 +667,8 @@ class RpcServer:
                 return self._do_drain(msg)
             if method == "inject":
                 return self._do_inject(msg)
+            if method == "telemetry_pull":
+                return self._do_telemetry_pull(msg)
             return {"ok": False, "error_type": "RpcError",
                     "error": "unknown rpc method %r" % (method,)}
         except Exception as e:  # never let a handler kill the worker
@@ -789,6 +792,45 @@ class RpcServer:
         spec = msg.get("spec") or ""
         _fault.configure(spec)
         return {"ok": True, "armed": spec}
+
+    def _do_telemetry_pull(self, msg):
+        """Serve one incremental telemetry chunk (ISSUE 18): a full
+        report line on the ``mxtpu-telemetry-2`` schema plus the request
+        events and flight records newer than the CLIENT-held cursor
+        ``{"incarnation", "req_seq", "step_seq"}``.  The server keeps no
+        per-client state — the slice is read-only, so a lost reply is
+        recovered by re-pulling with the old cursor (idempotent), and
+        the pull can never steal an event from the file emitter's own
+        consumer cursor.  A cursor minted against a different
+        incarnation is declared ``reset`` (the seqs restart per boot —
+        honoring them would silently drop or duplicate) and the slice
+        restarts from the oldest surviving records.  Replies are bounded
+        (``max_events``, default MXTPU_TELEMETRY_PULL_EVENTS) with a
+        ``more`` flag, so one pull never stalls this single-threaded
+        decode/RPC loop.  The ``rpc.telemetry.drop`` fault site
+        blackholes the reply — observability plane only."""
+        if _fault.trigger("rpc.telemetry.drop"):
+            _telemetry.counter("rpc.telemetry.dropped_replies").inc()
+            return None  # park: the collector's deadline is its way out
+        cur = msg.get("cursor") or {}
+        want = cur.get("incarnation")
+        mine = dict(self.incarnation)
+        req_seq, step_seq, reset = None, None, False
+        if want is not None:
+            if _stamp_match((want.get("pid"), want.get("attempt"),
+                             want.get("nonce")),
+                            (mine["pid"], mine["attempt"],
+                             mine["nonce"])):
+                req_seq = cur.get("req_seq")
+                step_seq = cur.get("step_seq")
+            else:
+                reset = True  # declared discontinuity, never silent
+        doc, cursor, more = _telemetry.pull_snapshot(
+            req_seq, step_seq, msg.get("max_events"))
+        _telemetry.counter("rpc.telemetry.pulls").inc()
+        cursor["incarnation"] = mine
+        return {"ok": True, "incarnation": mine, "reset": reset,
+                "line": doc, "cursor": cursor, "more": bool(more)}
 
     def _do_health(self):
         from .. import profiler as _profiler
@@ -1361,6 +1403,19 @@ class RpcReplicaProxy:
                 del self._mirrors[key]
         return updated
 
+    def pull_telemetry(self, cursor=None, max_events=None,
+                       timeout_s=None):
+        """One ``telemetry_pull`` from this replica (ISSUE 18) —
+        deliberately breaker-free and retry-free: observability must
+        keep working exactly when the data plane is sick, and the
+        client-held cursor makes a failed pull free to retry at the
+        collector's own cadence."""
+        addr = self._resolve()
+        return pull_telemetry(
+            addr, cursor=cursor, max_events=max_events,
+            timeout_s=self._timeout_s if timeout_s is None
+            else timeout_s, retries=0, rng=self._rng)
+
     def health(self):
         """The fused health view: breaker + liveness-machine state
         plus (reachable) the worker's own ``health()`` snapshot and
@@ -1412,3 +1467,58 @@ def fleet_proxies(run_dir, slots, timeout=60.0, **kw):
         out.append(RpcReplicaProxy(
             "slot%d" % int(slot), port_file=pf, **kw))
     return out
+
+
+# -- telemetry collection (ISSUE 18) ---------------------------------------
+
+def pull_telemetry(addr, cursor=None, max_events=None, timeout_s=2.0,
+                   retries=0, **kw):
+    """One ``telemetry_pull`` against ``addr``; returns the reply doc
+    (``line`` / ``cursor`` / ``more`` / ``reset``).  Pass the previous
+    reply's ``cursor`` back to advance; the call is idempotent, so a
+    dropped reply just means the next pull re-reads the same slice."""
+    msg = {"method": "telemetry_pull"}
+    if cursor is not None:
+        msg["cursor"] = cursor
+    if max_events is not None:
+        msg["max_events"] = int(max_events)
+    reply = rpc_call(addr, msg, timeout_s, retries=retries, **kw)
+    if not reply.get("ok"):
+        raise RpcError("telemetry_pull failed: %s"
+                       % (reply.get("error"),))
+    return reply
+
+
+def collect_telemetry(path, addr, cursor=None, max_events=None,
+                      timeout_s=2.0, retries=0, max_pulls=8):
+    """Pull one replica's telemetry and append each returned line to
+    ``path`` — the collector primitive behind ``launch.py --serve`` and
+    the Router host.  Loops while the server says ``more`` (bounded by
+    ``max_pulls`` so a firehose replica cannot wedge the collector; the
+    held cursor resumes next round).  Lines land whole via a single
+    ``os.write`` on an O_APPEND fd, matching the file emitter's
+    torn-line discipline, so ``serve_report``/``telemetry_report`` read
+    the collected stream exactly like a local one.  Returns
+    ``{"cursor", "lines", "resets", "more"}``."""
+    lines = resets = 0
+    more = False
+    for _ in range(max(1, int(max_pulls))):
+        reply = pull_telemetry(addr, cursor=cursor,
+                               max_events=max_events,
+                               timeout_s=timeout_s, retries=retries)
+        cursor = reply["cursor"]
+        if reply.get("reset"):
+            resets += 1
+        data = (json.dumps(reply["line"]) + "\n").encode("utf-8")
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                     0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+        lines += 1
+        more = bool(reply.get("more"))
+        if not more:
+            break
+    return {"cursor": cursor, "lines": lines, "resets": resets,
+            "more": more}

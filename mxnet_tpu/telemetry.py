@@ -100,7 +100,11 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "start_emitter", "stop_emitter", "set_enabled", "enabled",
            "identity", "clock_anchor", "suppress_compile_accounting",
            "mint_trace", "note_request_event", "request_events",
-           "consume_request_events", "count_token_events"]
+           "consume_request_events", "count_token_events",
+           "request_events_since", "flight_records_since",
+           "pull_snapshot", "AlertRule", "add_alert_rule",
+           "alert_rules", "clear_alert_rules",
+           "install_default_alert_rules", "check_alerts"]
 
 SCHEMA_REPORT = "mxtpu-telemetry-2"
 SCHEMA_POSTMORTEM = "mxtpu-postmortem-2"
@@ -617,6 +621,28 @@ def flight_capacity():
     return _flight.maxlen
 
 
+def flight_records_since(step, max_records=None):
+    """Non-destructive cursor slice over the flight ring for the RPC
+    telemetry pull: ``(records, evicted, next_step, more)`` with the
+    same contract as :func:`request_events_since`, keyed on the
+    monotonic per-process ``step`` field.  ``step=None`` starts at the
+    oldest surviving record."""
+    _drain_steps()
+    with _drain_lock:
+        oldest = _flight[0][0] if _flight else _step_seq
+        if step is None:
+            step = oldest
+        evicted = max(0, oldest - step)
+        recs = [r for r in _flight if r[0] >= step]
+        more = False
+        if max_records is not None and len(recs) > max_records:
+            recs = recs[:max_records]
+            more = True
+        next_step = (recs[-1][0] + 1) if recs else max(step, oldest)
+        return ([dict(zip(_FLIGHT_FIELDS, r)) for r in recs],
+                evicted, next_step, more)
+
+
 # -- request-scope tracing (the serving plane, OBSERVABILITY.md §12) -------
 # One bounded ring of per-request lifecycle events, the serving twin of
 # the per-step flight ring: the hot path (a decode step's token batch)
@@ -630,8 +656,14 @@ def flight_capacity():
 _REQ_RING_CAP = max(64, _env_int("MXTPU_REQUEST_TRACE_EVENTS", 8192))
 _req_ring = collections.deque(maxlen=_REQ_RING_CAP)
 _req_seq = 0            # next event sequence number (monotonic)
-_req_emit_seq = 0       # first seq NOT yet shipped by the emitter
-_req_dropped = 0        # never-emitted events evicted since last consume
+# Per-consumer drain cursors (ISSUE 18): consumer name -> [next_seq,
+# dropped].  The file emitter, the postmortem drain, and the RPC
+# telemetry pull each hold their own cursor, so each sees every event
+# exactly once without stealing another consumer's deliveries.  The
+# "emitter" cursor is pre-registered at seq 0 so a process with no
+# stream file still counts every never-shipped eviction
+# (``serving.trace_dropped`` keeps its ISSUE-13 meaning).
+_req_cursors = {"emitter": [0, 0]}
 _pending_req = []
 _REQ_PENDING_MAX = 256
 _trace_seq = itertools.count()
@@ -668,8 +700,20 @@ def note_request_event(trace, event, t_ns=None, args=None):
         _drain_req_events()
 
 
+def _req_cursor(consumer):
+    """The named consumer's ``[next_seq, dropped]`` cell (callers hold
+    ``_drain_lock``).  A new consumer registers at the OLDEST seq the
+    ring still holds: it can drain everything that survives, and events
+    evicted before it existed were never its loss to declare."""
+    cur = _req_cursors.get(consumer)
+    if cur is None:
+        cur = _req_cursors[consumer] = [
+            _req_ring[0][0] if _req_ring else _req_seq, 0]
+    return cur
+
+
 def _drain_req_events():
-    global _req_seq, _req_dropped
+    global _req_seq
     with _drain_lock:
         batch = list(_pending_req)
         if not batch:
@@ -678,15 +722,25 @@ def _drain_req_events():
         ring = _req_ring
         seq = _req_seq
         dropped = 0
+        cursors = list(_req_cursors.values())
         t_off = _unix_base - _perf_base * 1e-9
         for (trace, event, t, args) in batch:
-            if len(ring) == ring.maxlen and ring[0][0] >= _req_emit_seq:
-                dropped += 1    # evicting an event nothing ever shipped
+            if len(ring) == ring.maxlen:
+                ev_seq = ring[0][0]
+                missed = False
+                for cur in cursors:
+                    if ev_seq >= cur[0]:
+                        cur[1] += 1     # evicting an event this consumer
+                        missed = True   # never drained
+                if missed:
+                    dropped += 1
             ring.append((seq, t_off + t * 1e-9, trace, event, args))
             seq += 1
         _req_seq = seq
         if dropped:
-            _req_dropped += dropped
+            # counted once per evicted-before-anyone-shipped-it event,
+            # however many consumers missed it (each cursor still carries
+            # its own per-consumer count)
             counter("serving.trace_dropped").inc(dropped)
 
 
@@ -703,19 +757,49 @@ def request_events():
         return _req_dicts(list(_req_ring))
 
 
-def consume_request_events():
-    """``(new_events, dropped)`` since the last consume — the emitter's
-    per-line payload.  Advances the cursor, so each event ships exactly
-    once across the stream's lines; ``dropped`` counts events evicted
-    from the ring before any line could carry them (burst faster than
-    the emitter interval — the reader must know the record has a gap)."""
-    global _req_emit_seq, _req_dropped
+def consume_request_events(consumer="emitter"):
+    """``(new_events, dropped)`` since this CONSUMER's last consume —
+    the emitter's per-line payload.  Advances the consumer's own cursor,
+    so each event ships exactly once per consumer across the stream's
+    lines; ``dropped`` counts events evicted from the ring before this
+    consumer could drain them (burst faster than its interval — the
+    reader must know the record has a gap).  Distinct consumer names
+    never steal each other's events (ISSUE 18: the file emitter and the
+    RPC telemetry pull run concurrently against one ring)."""
     _drain_req_events()
     with _drain_lock:
-        evs = [r for r in _req_ring if r[0] >= _req_emit_seq]
-        dropped, _req_dropped = _req_dropped, 0
-        _req_emit_seq = _req_seq
+        cur = _req_cursor(consumer)
+        evs = [r for r in _req_ring if r[0] >= cur[0]]
+        dropped, cur[1] = cur[1], 0
+        cur[0] = _req_seq
         return _req_dicts(evs), dropped
+
+
+def request_events_since(seq, max_events=None):
+    """Non-destructive cursor slice for the RPC telemetry pull:
+    ``(events, evicted, next_seq, more)`` — every surviving event with
+    ``seq >= seq`` (oldest first, at most ``max_events``), the count of
+    events the ring evicted after the client's cursor but before this
+    pull could see them (declared loss, never silent), the cursor to
+    present next, and whether more events remain right now (bounded
+    chunking: the caller re-pulls instead of one reply stalling the
+    single-threaded RPC/decode loop).  ``seq=None`` starts at the oldest
+    surviving event with nothing declared lost.  The server holds no
+    per-client state — the client-held cursor makes a re-pull after a
+    dropped reply idempotent."""
+    _drain_req_events()
+    with _drain_lock:
+        oldest = _req_ring[0][0] if _req_ring else _req_seq
+        if seq is None:
+            seq = oldest
+        evicted = max(0, oldest - seq)
+        evs = [r for r in _req_ring if r[0] >= seq]
+        more = False
+        if max_events is not None and len(evs) > max_events:
+            evs = evs[:max_events]
+            more = True
+        next_seq = (evs[-1][0] + 1) if evs else max(seq, oldest)
+        return _req_dicts(evs), evicted, next_seq, more
 
 
 def count_token_events(events):
@@ -734,20 +818,206 @@ def count_token_events(events):
     return n
 
 
-def _unconsume_request_events(evs, dropped):
+def _unconsume_request_events(evs, dropped, consumer="emitter"):
     """Roll a failed emit's consume back: the events never reached the
-    stream, so the cursor returns to the first unshipped seq and the
-    drop count is restored — the next successful line carries them.
-    (Events the ring evicts while the cursor is transiently advanced
-    escape the drop accounting — a write failing in the same instant
-    the ring overflows — which is as far as best-effort telemetry
-    reaches.)"""
-    global _req_emit_seq, _req_dropped
+    stream, so the consumer's cursor returns to the first unshipped seq
+    and its drop count is restored — the next successful line carries
+    them.  (Events the ring evicts while the cursor is transiently
+    advanced escape the drop accounting — a write failing in the same
+    instant the ring overflows — which is as far as best-effort
+    telemetry reaches.)"""
     with _drain_lock:
+        cur = _req_cursor(consumer)
         if evs:
-            _req_emit_seq = min(_req_emit_seq, evs[0]["seq"])
+            cur[0] = min(cur[0], evs[0]["seq"])
         if dropped:
-            _req_dropped += dropped
+            cur[1] += dropped
+
+
+# -- alert rules (ISSUE 18) ------------------------------------------------
+# Small declarative alerting over the live registry: a rule watches one
+# metric (counter delta, gauge predicate, or counter-delta ratio) and,
+# when it holds, emits a typed trace-less ``alert`` request event into
+# the same stream every consumer already drains — the file emitter, the
+# RPC telemetry pull, and postmortems all carry alerts for free, and
+# ``serve_report`` / ``fleet_top`` render them.  Evaluated on drain:
+# every ``report()`` (so every emitted line, every pull, every
+# postmortem) runs :func:`check_alerts` first.
+
+_ALERT_OPS = {
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+}
+
+
+class AlertRule(object):
+    """One declarative alert rule.
+
+    Kinds:
+
+    - ``counter_delta`` — fires when the counter rose by more than
+      ``threshold`` (default 0) since the previous evaluation; the
+      firing's value is the delta.
+    - ``gauge`` — fires while ``gauge <op> threshold`` holds.  A
+      ``metric`` ending in ``.*`` watches every registered gauge under
+      that prefix (one independent firing per matching name — e.g.
+      ``rpc.breaker.*`` alerts per replica).
+    - ``ratio`` — numerator/denominator counter DELTAS since the last
+      evaluation (``metric`` / ``metric2``); fires when the denominator
+      moved and the ratio satisfies ``<op> threshold``.
+
+    ``window_s`` rate-limits firings: once a rule fires for a metric it
+    stays quiet for that metric until the window elapses — a
+    still-held gauge predicate re-alerts every window (a breaker still
+    open a minute later should say so again), a counter burst within
+    one window alerts once."""
+
+    __slots__ = ("name", "kind", "metric", "metric2", "op", "threshold",
+                 "severity", "window_s", "_prev", "_last_fired")
+
+    def __init__(self, name, metric, kind="gauge", op=">", threshold=0,
+                 metric2=None, severity="warn", window_s=60.0):
+        if kind not in ("counter_delta", "gauge", "ratio"):
+            raise ValueError("unknown alert kind: %r" % (kind,))
+        if op not in _ALERT_OPS:
+            raise ValueError("unknown alert op: %r" % (op,))
+        if kind == "ratio" and not metric2:
+            raise ValueError("ratio rules need metric2")
+        self.name = name
+        self.kind = kind
+        self.metric = metric
+        self.metric2 = metric2
+        self.op = op
+        self.threshold = threshold
+        self.severity = severity
+        self.window_s = window_s
+        self._prev = {}        # metric name -> last counter value(s)
+        self._last_fired = {}  # metric name -> monotonic fire time
+
+    def _metric_names(self):
+        if self.kind == "gauge" and self.metric.endswith(".*"):
+            pre = self.metric[:-1]      # keep the trailing dot
+            with _reg_lock:
+                return [n for n in _gauges if n.startswith(pre)]
+        return [self.metric]
+
+    def _reset_state(self):
+        self._prev.clear()
+        self._last_fired.clear()
+
+    def evaluate(self, now):
+        """``[(metric_name, value), ...]`` firings this evaluation.
+        Caller holds ``_alert_lock`` (rule state is mutated)."""
+        fired = []
+        op = _ALERT_OPS[self.op]
+        for name in self._metric_names():
+            if self.kind == "gauge":
+                g = _gauges.get(name)
+                v = None if g is None else g.value
+                hold = v is not None and op(v, self.threshold)
+                val = v
+            elif self.kind == "counter_delta":
+                c = _counters.get(name)
+                v = 0 if c is None else c.value
+                delta = v - self._prev.get(name, 0)
+                self._prev[name] = v
+                hold = delta > self.threshold
+                val = delta
+            else:  # ratio of deltas
+                c1 = _counters.get(name)
+                c2 = _counters.get(self.metric2)
+                v1 = 0 if c1 is None else c1.value
+                v2 = 0 if c2 is None else c2.value
+                key = (name, self.metric2)
+                p1, p2 = self._prev.get(key, (0, 0))
+                d1, d2 = v1 - p1, v2 - p2
+                self._prev[key] = (v1, v2)
+                hold = d2 > 0 and op(d1 / d2, self.threshold)
+                val = (d1 / d2) if d2 > 0 else None
+            if not hold:
+                continue
+            last = self._last_fired.get(name)
+            if last is not None and now - last < self.window_s:
+                continue
+            self._last_fired[name] = now
+            fired.append((name, val))
+        return fired
+
+
+_alert_rules = []
+_alert_lock = threading.Lock()
+
+
+def add_alert_rule(name, metric, kind="gauge", op=">", threshold=0,
+                   metric2=None, severity="warn", window_s=60.0):
+    """Install (or replace, by name) one alert rule; returns it."""
+    rule = AlertRule(name, metric, kind=kind, op=op, threshold=threshold,
+                     metric2=metric2, severity=severity, window_s=window_s)
+    with _alert_lock:
+        _alert_rules[:] = [r for r in _alert_rules if r.name != name]
+        _alert_rules.append(rule)
+    return rule
+
+
+def alert_rules():
+    """The installed rules (live objects; treat as read-only)."""
+    with _alert_lock:
+        return list(_alert_rules)
+
+
+def clear_alert_rules():
+    with _alert_lock:
+        del _alert_rules[:]
+
+
+def install_default_alert_rules():
+    """The stock fleet-health rules (OBSERVABILITY.md §14); installed at
+    import, idempotent (add_alert_rule replaces by name)."""
+    add_alert_rule("slo_shed_engaged", "serving.shed",
+                   kind="counter_delta", severity="warn", window_s=30.0)
+    add_alert_rule("watchdog_stall", "watchdog.stalls",
+                   kind="counter_delta", severity="critical",
+                   window_s=30.0)
+    add_alert_rule("breaker_open", "rpc.breaker.*", kind="gauge",
+                   op=">=", threshold=2, severity="critical",
+                   window_s=30.0)
+    add_alert_rule("replica_fenced", "rpc.confirmations.fence_expiry",
+                   kind="counter_delta", severity="critical",
+                   window_s=30.0)
+    add_alert_rule("fenced_writeback", "rpc.fenced_results",
+                   kind="counter_delta", severity="warn", window_s=30.0)
+    add_alert_rule("goodput_collapse", "serving.goodput",
+                   kind="ratio", metric2="serving.tokens", op="<",
+                   threshold=0.5, severity="warn", window_s=30.0)
+
+
+def check_alerts(now=None):
+    """Evaluate every installed rule against the live registry; each
+    firing increments ``telemetry.alerts`` and records a trace-less
+    ``alert`` request event (``args`` = rule/severity/metric/value) that
+    rides the normal drain to every consumer.  Returns the fired args
+    dicts.  Called from :func:`report` so every emitted line, RPC pull,
+    and postmortem evaluates on drain; replicas also call it
+    periodically from their serve loop."""
+    if now is None:
+        now = time.monotonic()
+    fired = []
+    with _alert_lock:
+        for rule in _alert_rules:
+            for (mname, val) in rule.evaluate(now):
+                args = {"rule": rule.name, "severity": rule.severity,
+                        "metric": mname}
+                if val is not None:
+                    args["value"] = (round(val, 6)
+                                     if isinstance(val, float) else val)
+                fired.append(args)
+    for args in fired:
+        # counter always counts (registry stays live under
+        # MXTPU_TELEMETRY_OFF); the event records only while enabled
+        counter("telemetry.alerts").inc()
+        note_request_event("", "alert", args=args)
+    return fired
 
 
 # -- reporting -------------------------------------------------------------
@@ -787,7 +1057,10 @@ def report():
     histograms (from spans / train steps), free histograms, profiler
     step_stats, flight-ring occupancy, and the job-scope identity +
     clock anchor (schema mxtpu-telemetry-2).  This is the emitter's line
-    format and StepStatsMonitor's data source."""
+    format and StepStatsMonitor's data source.  Alert rules are
+    evaluated first ("on drain"), so the snapshot and any consumer
+    draining events right after it see this evaluation's firings."""
+    check_alerts()
     _drain_steps()
     with _reg_lock:
         counters = {n: c.value for n, c in _counters.items()}
@@ -825,6 +1098,46 @@ def report():
     return doc
 
 
+_PULL_EVENTS_DEFAULT = max(1, _env_int("MXTPU_TELEMETRY_PULL_EVENTS",
+                                       2048))
+
+
+def pull_snapshot(req_seq=None, step_seq=None, max_events=None):
+    """One telemetry-pull payload (ISSUE 18): ``(line_doc, cursor,
+    more)``.  ``line_doc`` is a full :func:`report` document on the
+    ``mxtpu-telemetry-2`` schema, extended with the request events and
+    flight records newer than the client-held cursor —
+    ``req_events``/``req_dropped`` exactly as the file emitter writes
+    them (``req_dropped`` here = events evicted past the CLIENT's
+    cursor, declared per pull), plus ``last_steps``/``steps_dropped``
+    for the flight-ring slice — so a collector can append the line
+    verbatim to a ``stream-*.jsonl`` file and every existing report
+    reads it unchanged.  ``cursor`` is ``{"req_seq", "step_seq"}`` to
+    present next; ``more`` says a chunk boundary was hit (``max_events``
+    bounds BOTH slices; default ``MXTPU_TELEMETRY_PULL_EVENTS``) and the
+    client should pull again.  Purely read-only on the server: no
+    consumer cursor moves, so a lost reply costs nothing — the client
+    re-pulls with its old cursor."""
+    if max_events is None:
+        max_events = _PULL_EVENTS_DEFAULT
+    doc = report()
+    evs, evicted, next_seq, more_ev = request_events_since(
+        req_seq, max_events)
+    recs, steps_dropped, next_step, more_st = flight_records_since(
+        step_seq, max_events)
+    if evs:
+        doc["req_events"] = evs
+    if evicted:
+        doc["req_dropped"] = evicted
+    if recs:
+        doc["last_steps"] = recs
+    if steps_dropped:
+        doc["steps_dropped"] = steps_dropped
+    cursor = {"req_seq": next_seq, "step_seq": next_step}
+    doc["pull"] = dict(cursor, more=bool(more_ev or more_st))
+    return doc, cursor, bool(more_ev or more_st)
+
+
 def reset():
     """Clear every metric, the flight ring, and the step sequence (tests
     and benches; the monotonic XLA compile-event count is exempt)."""
@@ -834,13 +1147,15 @@ def reset():
     # zeroed histograms nor re-append them into the just-cleared ring.
     # Lock order _drain_lock -> _reg_lock matches _drain_steps (via
     # _span_hist); nothing takes them in the reverse order.
-    global _req_seq, _req_emit_seq, _req_dropped
+    global _req_seq
     with _drain_lock:
         del _pending_steps[:]
         del _pending_req[:]
         _pending_faults.clear()
         _req_ring.clear()
-        _req_seq = _req_emit_seq = _req_dropped = 0
+        _req_seq = 0
+        _req_cursors.clear()
+        _req_cursors["emitter"] = [0, 0]
         with _reg_lock:
             # zero IN PLACE: hot callers hold metric objects (counter()'s
             # documented contract), and clearing the dicts would orphan
@@ -862,6 +1177,12 @@ def reset():
         prof = _profiler()
         _last_dispatch = prof._dispatch_count
         _last_compile = prof._compile_count
+    # alert-rule deltas baseline against the just-zeroed counters (a
+    # stale _prev would read the first post-reset increments as a
+    # negative delta and go quiet); rate-limit windows re-arm too
+    with _alert_lock:
+        for r in _alert_rules:
+            r._reset_state()
     _dumped = False
 
 
@@ -1116,4 +1437,5 @@ def _maybe_start_emitter():
 
 install_crash_hooks()
 _install_compile_hook()
+install_default_alert_rules()
 _maybe_start_emitter()

@@ -34,8 +34,18 @@ Pinned laws:
   REJECTED with the typed ``fenced`` journal line (non-terminal on
   replay); drain RPCs are authenticated by incarnation; a
   ``serve.worker.zombie`` swallows its drain order (supervisor
-  escalation is the only cure); timed-out call bursts leak no fds.
+  escalation is the only cure); timed-out call bursts leak no fds;
+- telemetry pull plane (ISSUE 18): per-consumer drain cursors deliver
+  every event exactly once to EACH of two concurrent consumers with
+  per-consumer eviction counts; the ``telemetry_pull`` RPC is
+  non-destructive and idempotent under a client-held cursor; bounded
+  chunks reassemble complete and duplicate-free; a cursor minted
+  against a dead incarnation is a DECLARED reset, never silent
+  loss/duplication; ``rpc.telemetry.drop`` parks only the
+  observability plane and the re-pull recovers; alert rules fire into
+  the same stream and window-suppress re-firings.
 """
+import collections
 import json
 import os
 import socket
@@ -52,6 +62,7 @@ from mxnet_tpu.serving import (CircuitBreaker, ReplicaLost, Router,
 from mxnet_tpu.serving.replica import EXIT_SERVE_DRAIN
 from mxnet_tpu.serving.rpc import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
                                    BREAKER_OPEN, VERDICT_EXPIRED_RPC,
+                                   collect_telemetry, pull_telemetry,
                                    recv_frame, rpc_call, send_frame,
                                    write_port_file)
 from mxnet_tpu.serving.scheduler import FINISHED, SHED
@@ -863,3 +874,263 @@ def test_timed_out_call_burst_does_not_leak_fds():
         assert fds() <= base + 2, "timed-out rpc calls leaked fds"
     finally:
         ls.close()
+
+
+# -- telemetry pull plane: cursor laws, chunking, drops, alerts (ISSUE 18) --
+
+def _note_probe(tag, n):
+    """Stamp ``n`` recognizable events; returns the probe's filter."""
+    for i in range(n):
+        telemetry.note_request_event("", "law_probe",
+                                     args={"tag": tag, "i": i})
+
+    def mine(evs):
+        return [e for e in evs if e["event"] == "law_probe"
+                and (e.get("args") or {}).get("tag") == tag]
+    return mine
+
+
+def test_two_consumers_each_see_every_event_exactly_once():
+    """PR-13's exactly-once drain, now PER CONSUMER: the file emitter
+    and a second drain cursor run against one ring and neither steals
+    from the other — each consumer sees every event exactly once across
+    its own consume calls."""
+    telemetry.reset()
+    mine = _note_probe("dual", 6)
+    evs_a, drop_a = telemetry.consume_request_events("emitter")
+    evs_b, drop_b = telemetry.consume_request_events("second")
+    assert len(mine(evs_a)) == 6 and drop_a == 0
+    assert len(mine(evs_b)) == 6 and drop_b == 0
+    # consumed-for-A is NOT consumed-for-B: both cursors advanced past
+    # the batch independently, and a re-consume delivers nothing twice
+    assert mine(telemetry.consume_request_events("emitter")[0]) == []
+    assert mine(telemetry.consume_request_events("second")[0]) == []
+    mine2 = _note_probe("dual2", 3)
+    assert len(mine2(telemetry.consume_request_events("second")[0])) == 3
+    assert len(mine2(telemetry.consume_request_events("emitter")[0])) == 3
+
+
+def test_slow_consumer_eviction_counted_per_consumer():
+    """A consumer that drains slower than the ring turns over is the
+    ONLY one whose record gains a gap — and the gap is declared on its
+    own cursor (``dropped``), not smeared across every consumer."""
+    telemetry.reset()
+    ring = telemetry._req_ring
+    telemetry._req_ring = collections.deque(maxlen=8)
+    try:
+        # register both cursors at seq 0, then let only "fast" keep up
+        telemetry.consume_request_events("fast")
+        telemetry.consume_request_events("slow")
+        _note_probe("burst1", 6)
+        evs, dropped = telemetry.consume_request_events("fast")
+        assert len(evs) == 6 and dropped == 0
+        # 12 more events through a ring of 8: everything before the
+        # final 8 is evicted under "slow"'s still-parked cursor
+        _note_probe("burst2", 12)
+        evs, dropped = telemetry.consume_request_events("fast")
+        assert dropped == 4          # 12 new - 8 surviving, fast's own
+        assert len(evs) == 8
+        evs, dropped = telemetry.consume_request_events("slow")
+        assert dropped == 10         # 6 + 12 noted, only 8 survive
+        assert len(evs) == 8
+        # both recovered: the next batch is exactly-once again for each
+        _note_probe("burst3", 2)
+        assert telemetry.consume_request_events("fast")[1] == 0
+        assert telemetry.consume_request_events("slow")[1] == 0
+    finally:
+        telemetry._req_ring = ring
+        telemetry.reset()
+
+
+def test_telemetry_pull_is_nondestructive_and_idempotent():
+    """The ``telemetry_pull`` RPC serves a read-only slice under a
+    CLIENT-held cursor: pulling never moves the emitter's cursor, and
+    re-presenting an old cursor re-reads the same slice — a dropped
+    reply costs nothing."""
+    telemetry.reset()
+    w = _WorkerLoop(_StubReplica("a"))
+    try:
+        mine = _note_probe("pull", 5)
+        r1 = pull_telemetry(w.addr, timeout_s=2.0)
+        assert r1["ok"] and not r1["reset"]
+        assert r1["line"]["schema"] == "mxtpu-telemetry-2"
+        got1 = mine(r1["line"].get("req_events") or [])
+        assert len(got1) == 5
+        # idempotent re-pull: the server held no per-client state, so
+        # the same (None) cursor re-reads the very same events
+        r1b = pull_telemetry(w.addr, timeout_s=2.0)
+        assert ([e["seq"] for e in mine(r1b["line"].get("req_events")
+                                        or [])]
+                == [e["seq"] for e in got1])
+        # ...and the pull stole nothing from the emitter's own cursor
+        evs, dropped = telemetry.consume_request_events("emitter")
+        assert len(mine(evs)) == 5 and dropped == 0
+        # advancing the returned cursor is exact: only newer events
+        mine2 = _note_probe("pull2", 3)
+        r2 = pull_telemetry(w.addr, cursor=r1["cursor"], timeout_s=2.0)
+        evs2 = r2["line"].get("req_events") or []
+        assert len(mine2(evs2)) == 3 and not mine(evs2)
+        assert not r2["reset"]
+        assert telemetry.counter("rpc.telemetry.pulls").value >= 3
+    finally:
+        w.close()
+        telemetry.reset()
+
+
+def test_telemetry_pull_chunks_reassemble_complete():
+    """Bounded chunks: ``max_events`` caps every reply and sets
+    ``more``; walking the cursor reassembles the full record with no
+    duplicate and no hole."""
+    telemetry.reset()
+    w = _WorkerLoop(_StubReplica("a"))
+    try:
+        mine = _note_probe("chunk", 10)
+        seqs, cursor, pulls = [], None, 0
+        while True:
+            r = pull_telemetry(w.addr, cursor=cursor, max_events=3,
+                               timeout_s=2.0)
+            cursor = r["cursor"]
+            evs = r["line"].get("req_events") or []
+            assert len(evs) <= 3
+            seqs += [e["seq"] for e in mine(evs)]
+            pulls += 1
+            if not r["more"]:
+                break
+            assert r["line"]["pull"]["more"]
+        assert pulls > 1, "10 events in 3-event chunks must span pulls"
+        assert len(seqs) == 10 and len(set(seqs)) == 10
+        assert seqs == sorted(seqs)
+    finally:
+        w.close()
+        telemetry.reset()
+
+
+def test_telemetry_pull_incarnation_reset_declared_across_restart():
+    """A cursor minted against a dead incarnation would index a
+    different boot's seq space — honoring it silently drops or
+    duplicates.  The successor DECLARES the discontinuity
+    (``reset: True``) and restarts the slice from the oldest surviving
+    record, so the collector re-reads rather than loses."""
+    telemetry.reset()
+    w1 = _WorkerLoop(_StubReplica("a"))
+    addr1 = w1.addr
+    try:
+        _note_probe("before", 4)
+        r1 = pull_telemetry(addr1, timeout_s=2.0)
+        held = r1["cursor"]
+        assert held["incarnation"]["nonce"]
+    finally:
+        w1.close()
+    # events the old incarnation never shipped under the held cursor
+    mine_after = _note_probe("after", 3)
+    w2 = _WorkerLoop(_StubReplica("a2"))   # fresh boot nonce
+    try:
+        r2 = pull_telemetry(w2.addr, cursor=held, timeout_s=2.0)
+        assert r2["reset"], "stale-incarnation cursor must be declared"
+        assert (r2["incarnation"]["nonce"]
+                != held["incarnation"]["nonce"])
+        # the reset slice restarts from the oldest surviving event:
+        # nothing after the held cursor is silently skipped
+        evs = r2["line"].get("req_events") or []
+        assert len(mine_after(evs)) == 3
+        # and the NEW cursor advances cleanly on this incarnation
+        r3 = pull_telemetry(w2.addr, cursor=r2["cursor"], timeout_s=2.0)
+        assert not r3["reset"]
+        assert not mine_after(r3["line"].get("req_events") or [])
+    finally:
+        w2.close()
+        telemetry.reset()
+
+
+def test_telemetry_drop_parks_reply_and_repull_recovers():
+    """``rpc.telemetry.drop`` blackholes ONE pull reply — the
+    observability plane only: the collector eats its deadline, the data
+    plane never notices, and the client-held cursor makes the re-pull
+    idempotent — the record comes through complete."""
+    telemetry.reset()
+    w = _WorkerLoop(_StubReplica("a"))
+    try:
+        mine = _note_probe("dropped", 4)
+        fault.configure("rpc.telemetry.drop:1")
+        with pytest.raises(RpcError):
+            pull_telemetry(w.addr, timeout_s=0.3, retries=0)
+        assert telemetry.counter(
+            "rpc.telemetry.dropped_replies").value == 1
+        # the data plane stayed up throughout the drill
+        assert rpc_call(w.addr, {"method": "health"}, 1.0)["ok"]
+        # re-pull with the same (absent) cursor: nothing was consumed
+        # server-side, so the lost reply's events all arrive now
+        r = pull_telemetry(w.addr, timeout_s=2.0)
+        assert len(mine(r["line"].get("req_events") or [])) == 4
+        assert not r["reset"]
+    finally:
+        w.close()
+        telemetry.reset()
+
+
+def test_collect_telemetry_appends_emitter_shaped_stream(tmp_path):
+    """The collector primitive lands pulled lines in a stream file the
+    existing readers parse unchanged, and a held cursor across collect
+    calls keeps the file duplicate-free."""
+    telemetry.reset()
+    w = _WorkerLoop(_StubReplica("a"))
+    path = str(tmp_path / "stream-pulled.jsonl")
+    try:
+        mine = _note_probe("collect", 4)
+        out1 = collect_telemetry(path, w.addr, timeout_s=2.0)
+        assert out1["lines"] >= 1 and out1["resets"] == 0
+        mine2 = _note_probe("collect2", 2)
+        out2 = collect_telemetry(path, w.addr, cursor=out1["cursor"],
+                                 timeout_s=2.0)
+        assert out2["lines"] >= 1
+        docs = [json.loads(ln) for ln in
+                open(path, encoding="utf-8") if ln.strip()]
+        assert all(d["schema"] == "mxtpu-telemetry-2" for d in docs)
+        evs = [e for d in docs for e in d.get("req_events") or []]
+        assert len(mine(evs)) == 4 and len(mine2(evs)) == 2
+        seqs = [e["seq"] for e in evs]
+        assert len(seqs) == len(set(seqs)), "held cursor must dedup"
+    finally:
+        w.close()
+        telemetry.reset()
+
+
+def test_alert_rules_fire_into_stream_and_window_suppress():
+    """A counter-delta rule fires once per window however bursty the
+    counter, the firing rides the request-event stream every consumer
+    already drains (including the RPC pull), and the counter
+    ``telemetry.alerts`` counts every firing."""
+    telemetry.reset()
+    rules = telemetry.alert_rules()
+    telemetry.clear_alert_rules()
+    w = _WorkerLoop(_StubReplica("a"))
+    try:
+        telemetry.add_alert_rule("law_burst", "law.alert_probe",
+                                 kind="counter_delta",
+                                 severity="critical", window_s=30.0)
+        telemetry.counter("law.alert_probe").inc(5)
+        fired = telemetry.check_alerts(now=100.0)
+        assert [f["rule"] for f in fired] == ["law_burst"]
+        assert fired[0]["value"] == 5 and fired[0]["severity"] == \
+            "critical"
+        assert telemetry.counter("telemetry.alerts").value == 1
+        # window suppression: a fresh burst inside the window is quiet
+        telemetry.counter("law.alert_probe").inc(2)
+        assert telemetry.check_alerts(now=110.0) == []
+        # ...and re-alerts once the window elapses
+        telemetry.counter("law.alert_probe").inc(1)
+        refired = telemetry.check_alerts(now=131.0)
+        assert [f["rule"] for f in refired] == ["law_burst"]
+        # the firings ride the SAME stream the pull drains: trace-less
+        # typed events, rendered by serve_report/fleet_top downstream
+        r = pull_telemetry(w.addr, timeout_s=2.0)
+        alerts = [e for e in r["line"].get("req_events") or []
+                  if e["event"] == "alert"]
+        assert [a["args"]["rule"] for a in alerts] == ["law_burst"] * 2
+        assert alerts[0]["trace"] == ""
+    finally:
+        w.close()
+        telemetry.clear_alert_rules()
+        for r in rules:
+            telemetry._alert_rules.append(r)
+        telemetry.reset()

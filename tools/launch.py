@@ -772,6 +772,47 @@ def _serve_hb_check(args, run_dir, hb_dir, slot, st, now):
         _escalate_kill([p], signal.SIGTERM, args.kill_grace)
 
 
+def _serve_telemetry_pull(args, run_dir, slot, st, now):
+    """Collector half of the RPC telemetry plane (ISSUE 18): pull the
+    slot's newly-drained telemetry over the ``telemetry_pull`` RPC and
+    append each returned line to ``<telemetry-dir>/stream-slot<K>.
+    jsonl`` — the exact layout the in-worker file emitter writes and
+    serve_report/job_report/telemetry_report already read, but
+    assembled over the wire (the multi-host seam: the supervisor needs
+    no shared filesystem with its workers).  The cursor is
+    supervisor-held; a worker replacement declares ``reset`` in-band
+    (the line schema carries the new identity), a missed pull just
+    resumes at the old cursor next interval, and the per-pull chunk
+    loop is bounded so one firehose worker cannot wedge supervision.
+    Lines land whole via single O_APPEND writes, so readers can apply
+    the usual torn-tail skip-and-count discipline."""
+    if now < st["next_tel_at"]:
+        return
+    st["next_tel_at"] = now + args.telemetry_pull_interval
+    path = os.path.join(args.telemetry_dir,
+                        "stream-slot%d.jsonl" % slot)
+    try:
+        for _ in range(8):
+            msg = {"method": "telemetry_pull"}
+            if st["tel_cursor"] is not None:
+                msg["cursor"] = st["tel_cursor"]
+            reply, _doc = _serve_rpc(run_dir, slot, msg, timeout=2.0)
+            if not reply.get("ok"):
+                return
+            st["tel_cursor"] = reply.get("cursor")
+            line = (json.dumps(reply["line"]) + "\n").encode("utf-8")
+            fd = os.open(path,
+                         os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+            if not reply.get("more"):
+                return
+    except (OSError, ValueError, KeyError):
+        pass  # no answer is a missed interval, never a supervision event
+
+
 def _serve_spawn(args, mem, run_dir, hb_dir, cmd, slot, attempt):
     """One serving-replica worker process for ``slot``: the training
     env contract (slot == rank — serving has no collective world to
@@ -845,8 +886,11 @@ def _serve_loop(args, cmd):
                        "next_spawn_at": None,
                        "hb_ok_at": None, "progress_seq": None,
                        "progress_at": None, "next_hb_at": 0.0,
+                       "tel_cursor": None, "next_tel_at": 0.0,
                        "proc": _serve_spawn(args, mem, run_dir, hb_dir,
                                             cmd, slot, 0)}
+    pull_telemetry = bool(args.telemetry_dir) and \
+        args.telemetry_pull_interval > 0
     fail_respawns = 0
     try:
         while True:
@@ -855,6 +899,15 @@ def _serve_loop(args, cmd):
                       "fleet over the control RPC", file=sys.stderr,
                       flush=True)
                 mem.record(0, "stop")
+                if pull_telemetry:
+                    # last collection before the workers drain away:
+                    # short runs must still leave a complete tree
+                    now = time.time()
+                    for slot, st in sorted(state.items()):
+                        if st["proc"] is not None and not st["down"]:
+                            st["next_tel_at"] = 0.0
+                            _serve_telemetry_pull(args, run_dir, slot,
+                                                  st, now)
                 _serve_stop_fleet(args, run_dir, state)
                 mem.record(0, "complete")
                 return 0
@@ -890,6 +943,9 @@ def _serve_loop(args, cmd):
                     if args.heartbeat_timeout > 0:
                         _serve_hb_check(args, run_dir, hb_dir, slot,
                                         st, now)
+                    if pull_telemetry:
+                        _serve_telemetry_pull(args, run_dir, slot, st,
+                                              now)
                     continue
                 if rc == 0:
                     # clean completion (e.g. a worker's own run-length
@@ -1186,6 +1242,13 @@ def main(argv=None):
     parser.add_argument("--kill-grace", type=float, default=5.0,
                         help="seconds to wait between teardown "
                         "escalation steps (SIGINT/SIGTERM → SIGKILL)")
+    parser.add_argument("--telemetry-pull-interval", type=float,
+                        default=2.0,
+                        help="--serve only: seconds between "
+                        "telemetry_pull RPC collections per slot "
+                        "(appended to <telemetry-dir>/stream-slot<K>"
+                        ".jsonl — fleet observability with no shared "
+                        "filesystem reads; 0 disables the collector)")
     parser.add_argument("--aot-cache-dir", default=None,
                         help="compiled-executable warm-start cache "
                         "exported to workers as MXTPU_AOT_CACHE_DIR (+ "

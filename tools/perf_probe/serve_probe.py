@@ -61,6 +61,17 @@ batch occupancy.  Hard contracts asserted by ``BENCH_MODE=serve``
   ``fence_expiry``, fails over, and FENCES the zombie's late
   completions (0 double-delivered, bit-identical tokens,
   ``rpc.fenced_results`` >= 1), all hard-asserted;
+- **telemetry plane** (ISSUE 18): the partition drill's router host
+  assembles per-replica telemetry ONLY via the ``telemetry_pull`` RPC
+  (the workers' private dirs hold no readable stream) and
+  ``serve_report`` over that pull-only tree must be green — lawful
+  lifecycles, bit-exact traced-vs-counter token accounting, >= 1
+  default alert rule fired and rendered — while
+  ``fleet_top.collect_matrix`` returns a complete live matrix;
+  ``measure_collector_impact`` pulls after EVERY engine step and the
+  hot-path contracts (1.0 decode dispatch/step, 0 steady-state
+  recompiles) must survive, with the steady-state pull itself under
+  ``MXTPU_TELEMETRY_PULL_BUDGET`` µs (default 2000);
 - **capacity multipliers** (``run_prefix`` / ``run_gqa``, ISSUE 15):
   a system-prompt-heavy Poisson mix with per-request sampling on half
   the requests, cache-on vs cache-off on the SAME workload — prefix
@@ -962,10 +973,31 @@ def run_partition(workload, reference_tokens):
     journaled ``fenced`` lines).  Laws: >= 1 failover with the typed
     ``fence_expiry`` reason, >= 1 fenced result, EXACTLY one terminal
     journal line per rid (0 double-delivered), and the delivered
-    tokens bit-identical to the unfaulted run."""
+    tokens bit-identical to the unfaulted run.
+
+    **Telemetry plane (ISSUE 18)** rides the same drill: the workers
+    export no ``MXTPU_TELEMETRY`` (their private tmp dirs hold no
+    stream files), so the ONLY way the router host assembles fleet
+    telemetry is the ``telemetry_pull`` RPC — a collector loop in both
+    phases appends each worker's pulled lines to
+    ``<router_dir>/telemetry/stream-{a,b}.jsonl``, the router process
+    runs the default alert rules locally (its proxies own the breaker
+    and fence evidence, so ``breaker_open`` / ``replica_fenced`` fire
+    HERE) and emits its own line into the same tree, and
+    ``serve_report.analyze`` over that pull-only tree must be green:
+    lawful lifecycles, traced-vs-counter token accounting bit-exact
+    (the zombie's behind-the-partition decode included — its stream is
+    pulled after the heal), and >= 1 fired alert in the alerts lane.
+    ``fleet_top.collect_matrix`` against the live fleet must return a
+    complete matrix (every row up with an engine block)."""
+    import io
+
+    import fleet_top as _ft
+    import serve_report as _sr
     from mxnet_tpu import telemetry
     from mxnet_tpu.serving import Router
     from mxnet_tpu.serving.rpc import (CircuitBreaker, RpcReplicaProxy,
+                                       collect_telemetry,
                                        port_file_path, rpc_call,
                                        wait_port_file)
 
@@ -977,10 +1009,36 @@ def run_partition(workload, reference_tokens):
                                       "spec": spec},
                         timeout, retries=0)
 
+    # clean registry in the router process: the pulled tree gets the
+    # router's OWN stream line too, and stale counters from earlier
+    # in-process probes would break the bit-exact reconciliation
+    telemetry.reset()
     cache = tempfile.mkdtemp(prefix="serve-part-aot-")
     router_dir = tempfile.mkdtemp(prefix="serve-part-router-")
     journal = os.path.join(router_dir, "router-journal.jsonl")
+    tel_dir = os.path.join(router_dir, "telemetry")
+    os.makedirs(tel_dir)
+    tel_cursors = {}
+    tel_stats = {"lines": 0, "errors": 0, "resets": 0}
     dirs, procs, addrs = {}, {}, {}
+
+    def pull_workers(timeout=0.2):
+        # the collector: cursor-resumed telemetry_pull per worker into
+        # the router host's tree.  A partitioned worker's pull parks
+        # (counted, never fatal) — the client-held cursor makes the
+        # post-heal retry pick up exactly where the last one ended
+        for tag, addr in addrs.items():
+            path = os.path.join(tel_dir, "stream-%s.jsonl" % tag)
+            try:
+                res = collect_telemetry(
+                    path, tuple(addr), cursor=tel_cursors.get(tag),
+                    timeout_s=timeout)
+                tel_cursors[tag] = res["cursor"]
+                tel_stats["lines"] += res["lines"]
+                tel_stats["resets"] += res["resets"]
+            except Exception:
+                tel_stats["errors"] += 1
+
     try:
         for slot, tag in ((0, "a"), (1, "b")):
             dirs[tag] = tempfile.mkdtemp(
@@ -1012,10 +1070,15 @@ def run_partition(workload, reference_tokens):
         inject(addrs["b"], "rpc.heartbeat.drop:100000")
         reqs = [rt.submit(p, n) for _t, p, n in workload[:8]]
         suspected_seen = False
+        next_pull = time.monotonic() + 1.0
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             rt.step()
             suspected_seen = suspected_seen or pb.suspected
+            if time.monotonic() >= next_pull:
+                next_pull = time.monotonic() + 1.0
+                pull_workers()
+                telemetry.check_alerts()
             if all(r.done for r in reqs) and suspected_seen:
                 break
             time.sleep(0.01)
@@ -1034,6 +1097,21 @@ def run_partition(workload, reference_tokens):
             "confirm_reason": pb.confirmed_reason,
         }
 
+        # live fleet matrix between phases: both workers healthy again,
+        # so every row must come back complete (up, engine block,
+        # heartbeat RTT) — the fleet_top --once contract in-process
+        matrix = _ft.collect_matrix(
+            [(t, tuple(addrs[t])) for t in ("a", "b")], timeout_s=2.0)
+        mbuf = io.StringIO()
+        _ft.render_matrix(matrix, mbuf)
+        fleet_top = {
+            "rows": len(matrix["rows"]),
+            "complete": all(r.get("up") and r.get("engine")
+                            and r.get("hb_rtt_ms") is not None
+                            for r in matrix["rows"]),
+            "renders": "replica" in mbuf.getvalue(),
+        }
+
         # ---- phase B: real partition + fenced failover ---------------
         base_fenced = cval("rpc.fenced_results")
         base_conf = cval("rpc.confirmations.fence_expiry")
@@ -1048,11 +1126,19 @@ def run_partition(workload, reference_tokens):
         # polls, and the heal-spam below all burn it)
         inject(addrs["b"], "rpc.partition:100")
         healed = False
+        next_pull = time.monotonic() + 1.0
         deadline = time.monotonic() + 240
         while time.monotonic() < deadline:
             rt.step()
             for p_ in procs.values():
                 p_.poll()
+            if time.monotonic() >= next_pull:
+                next_pull = time.monotonic() + 1.0
+                # b's pulls park while partitioned (each burns one of
+                # the armed budget, same as any inbound frame) and
+                # resume from the held cursor after the heal
+                pull_workers()
+                telemetry.check_alerts()
             done = all(rr.done for rr in rrs)
             if done and cval("rpc.fenced_results") - base_fenced >= 1:
                 break
@@ -1066,6 +1152,54 @@ def run_partition(workload, reference_tokens):
             time.sleep(0.01)
         completed = [rr for rr in rrs if rr.state == "completed"]
         tokens = [rr.tokens for rr in completed]
+
+        # telemetry finale: make sure the link is healed, then pull
+        # each worker to quiescence (cursor stops advancing) — the
+        # zombie's behind-the-partition decode must be IN the tree or
+        # the traced-vs-counter reconciliation below can't be exact
+        try:
+            inject(addrs["b"], "", timeout=0.5)
+        except Exception:
+            pass
+        settle = time.monotonic() + 20
+        while time.monotonic() < settle:
+            before = {t: (tel_cursors.get(t) or {}).get("req_seq")
+                      for t in addrs}
+            pull_workers(timeout=1.0)
+            after = {t: (tel_cursors.get(t) or {}).get("req_seq")
+                     for t in addrs}
+            if after == before and all(v is not None
+                                       for v in after.values()):
+                break
+            time.sleep(0.2)
+        telemetry.check_alerts()
+        # the router host's own line joins the same tree: its registry
+        # holds the fleet-level events (submits, finals, fenced, the
+        # alerts its rules fired) the workers never see
+        telemetry._emit_line(
+            os.path.join(tel_dir, "stream-router.jsonl"), final=True)
+
+        # serve_report over the PULL-ONLY tree (the workers' private
+        # dirs were never read): green or the drill fails
+        rep = _sr.analyze(router_dir)
+        rbuf = io.StringIO()
+        _sr.render(rep, rbuf)
+        acc = rep["accounting"]
+        telemetry_out = {
+            "pulled_lines": tel_stats["lines"],
+            "pull_errors": tel_stats["errors"],
+            "cursor_resets": tel_stats["resets"],
+            "streams": sorted(os.listdir(tel_dir)),
+            "lifecycle_ok": rep["lifecycle"]["ok"],
+            "accounting_exact": bool(acc["tokens_match"]),
+            "tokens": acc["tokens"],
+            "traced_tokens": acc["traced_tokens"],
+            "alerts_fired": len(rep["alerts"]),
+            "alert_rules": sorted({a["rule"] for a in rep["alerts"]
+                                   if a["rule"]}),
+            "report_renders": "fired alerts" in rbuf.getvalue(),
+            "fleet_top": fleet_top,
+        }
 
         # exactly-once off the journal: one terminal line per rid,
         # fenced lines are separate typed events, never deliveries
@@ -1098,6 +1232,7 @@ def run_partition(workload, reference_tokens):
                 sum(1 for v in terminal.values() if v > 1),
             "victims_on_partitioned": on_b,
             "tokens_match_unfaulted": tokens == reference_tokens,
+            "telemetry": telemetry_out,
         }
     finally:
         for p in procs.values():
@@ -1133,6 +1268,77 @@ def measure_trace_overhead(slots=8, iters=2000, passes=5):
         results.append((time.perf_counter_ns() - t0) / 1e3 / iters)
         telemetry.reset()
     return round(sorted(results)[len(results) // 2], 3)
+
+
+def measure_collector_impact(net=None, n_requests=12, iters=200,
+                             passes=5):
+    """Collector-on-the-hot-path microbench (ISSUE 18): drives the
+    engine open-loop while running ``telemetry.pull_snapshot`` — the
+    entire ``telemetry_pull`` handler body minus the socket — after
+    EVERY engine step, far denser than the supervisor's default 2 s
+    interval, and checks the serving hot-path contracts survive
+    (exactly 1.0 decode dispatch/step, 0 steady-state recompiles: the
+    pull must never force a dispatch or a recompile).  Then times the
+    steady-state pull itself hot (cursor caught up: the report
+    snapshot dominates), median of ``passes``, for the
+    ``MXTPU_TELEMETRY_PULL_BUDGET`` budget (µs, default 2000)."""
+    from mxnet_tpu import profiler, telemetry
+    from mxnet_tpu.serving import ServingEngine
+    import numpy as np
+
+    if net is None:
+        net = build_net()
+    workload = make_workload(n_requests=n_requests)
+    eng = ServingEngine(net, num_slots=8, page_size=16,
+                        max_prefill_len=32, max_seq_len=48)
+    eng.generate([np.zeros(4, np.int32)], max_new=2)
+    profiler.reset_step_stats()
+    telemetry.reset()
+    base = profiler.step_stats()
+    d0, c0 = base["dispatch_count"], base["compile_count"]
+    steps0, prefills0 = eng.decode_steps, eng.prefills
+
+    cursor = {"req_seq": None, "step_seq": None}
+    pulls = 0
+    reqs, pending = [], list(workload)
+    t_start = time.perf_counter()
+    while pending or not eng.sched.idle:
+        now = time.perf_counter() - t_start
+        while pending and pending[0][0] <= now:
+            _, prompt, max_new = pending.pop(0)
+            reqs.append(eng.submit(prompt, max_new))
+        if eng.step() == 0 and pending:
+            time.sleep(min(1e-4, max(0.0, pending[0][0] - now)))
+        _doc, cursor, more = telemetry.pull_snapshot(
+            cursor.get("req_seq"), cursor.get("step_seq"))
+        pulls += 1
+        while more:     # chunked tail, same as a collector's loop
+            _doc, cursor, more = telemetry.pull_snapshot(
+                cursor.get("req_seq"), cursor.get("step_seq"))
+            pulls += 1
+
+    stats = profiler.step_stats()
+    decode_steps = eng.decode_steps - steps0
+    prefills = eng.prefills - prefills0
+    dispatches = stats["dispatch_count"] - d0
+
+    # isolated steady-state pull cost (caught-up cursor, warm registry)
+    results = []
+    for _ in range(passes):
+        t0 = time.perf_counter_ns()
+        for _i in range(iters):
+            _doc, cursor, _more = telemetry.pull_snapshot(
+                cursor.get("req_seq"), cursor.get("step_seq"))
+        results.append((time.perf_counter_ns() - t0) / 1e3 / iters)
+    return {
+        "pulls": pulls,
+        "decode_steps": decode_steps,
+        "decode_dispatches_per_step": round(
+            (dispatches - prefills) / max(1, decode_steps), 4),
+        "steady_state_compiles": stats["compile_count"] - c0,
+        "pull_us": round(sorted(results)[len(results) // 2], 1),
+        "tokens": sum(len(r.tokens) for r in reqs),
+    }
 
 
 # -- AOT-warm replica spin-up (restart_probe pattern) ----------------------
@@ -1218,6 +1424,7 @@ def run(spinup=True, degraded=True, fleet=True):
         "speedup_tokens_per_sec": round(
             cont["tokens_per_sec"] / seq["tokens_per_sec"], 2),
         "trace_overhead_us": measure_trace_overhead(),
+        "collector": measure_collector_impact(net),
         "prefix": run_prefix(net),
         "gqa": run_gqa(net),
         "spec": run_spec(),

@@ -82,9 +82,9 @@ def load_serve(run_dir):
     found = _tr.discover_run_dir(run_dir)
     notes = []
     events = {}          # (proc key, seq) -> event dict (+"_pid")
-    counters = {}        # proc key -> last counters dict
+    counters = {}        # proc key -> merged counters dict
     status = {}          # (proc key, engine tag) -> engine snapshot
-    flights = []         # (proc key, [flight recs]) from finals / pms
+    flights = {}         # proc key -> {step: flight rec}
     req_dropped = 0
     journal = []
 
@@ -108,37 +108,46 @@ def load_serve(run_dir):
             events.setdefault((pkey, e.get("seq")), dict(e, _pid=pid))
         return pkey
 
+    def _merge_counters(pkey, new):
+        # counters are monotonic, so max-merge per process keeps
+        # whichever artifact saw more — a process can leave SEVERAL
+        # views of the same registry (its own emitter stream, the
+        # ISSUE-18 pulled stream on the collector host, a postmortem),
+        # and whichever file parses last must not roll the totals back
+        cur = counters.setdefault(pkey, new)
+        if cur is not new:
+            for k, v in new.items():
+                old = cur.get(k)
+                if isinstance(v, (int, float)) and \
+                        isinstance(old, (int, float)):
+                    cur[k] = max(old, v)
+                elif k not in cur:
+                    cur[k] = v
+
+    def _fold_flights(pkey, recs):
+        # dedup by (process, step): pulled lines carry INCREMENTAL
+        # flight slices, so one process's records arrive spread over
+        # many lines (and possibly twice, via its own final line too)
+        by_step = flights.setdefault(pkey, {})
+        for rec in recs:
+            by_step.setdefault(rec.get("step"), rec)
+
     for path in found["streams"]:
         for doc in _tr.parse_artifact(path, notes):
             pkey = _fold(doc, doc.get("req_events") or [])
             req_dropped += doc.get("req_dropped", 0)
             if doc.get("counters"):
-                counters[pkey] = doc["counters"]
+                _merge_counters(pkey, doc["counters"])
             for snap in doc.get("serving") or []:
                 status[(pkey, snap.get("replica"))] = snap
             if doc.get("last_steps"):
-                flights.append((pkey, doc["last_steps"]))
+                _fold_flights(pkey, doc["last_steps"])
     for path in found["postmortems"]:
         docs = _tr.parse_artifact(path, notes)
         if docs:
             doc = docs[-1]
             pkey = _fold(doc, doc.get("request_trace") or [])
-            # a postmortem is the AT-DEATH view — newer than the last
-            # periodic stream line by up to one emitter interval.
-            # Counters are monotonic, so max-merge keeps whichever
-            # artifact saw more (a stale stream line must not produce
-            # a spurious traced-vs-counter mismatch for a crash, the
-            # exact scenario this tool serves)
-            pm = doc.get("counters") or {}
-            cur = counters.setdefault(pkey, pm)
-            if cur is not pm:
-                for k, v in pm.items():
-                    old = cur.get(k)
-                    if isinstance(v, (int, float)) and \
-                            isinstance(old, (int, float)):
-                        cur[k] = max(old, v)
-                    elif k not in cur:
-                        cur[k] = v
+            _merge_counters(pkey, doc.get("counters") or {})
             for snap in doc.get("serving") or []:
                 key = (pkey, snap.get("replica"))
                 old = status.get(key)
@@ -151,8 +160,12 @@ def load_serve(run_dir):
                 journal.append(doc)
     evs = sorted(events.values(),
                  key=lambda e: (e.get("t", 0), e.get("seq", 0)))
+    flight_list = [(pk, [by_step[s] for s in sorted(
+                        by_step, key=lambda s: (s is None, s))])
+                   for pk, by_step in flights.items()]
     return {"run_dir": run_dir, "events": evs, "journal": journal,
-            "counters": counters, "status": status, "flights": flights,
+            "counters": counters, "status": status,
+            "flights": flight_list,
             "req_dropped": req_dropped, "notes": notes}
 
 
@@ -518,6 +531,26 @@ def liveness_lanes(events):
     return lanes
 
 
+def alert_lanes(events):
+    """Fired alert-rule events (ISSUE 18), in fleet time order.  Like
+    liveness events these are trace-less replica news — invisible to
+    ``build_requests`` — so the alerts lane is their only rendering;
+    each row names the rule, severity, the metric that tripped it, the
+    observed value, and the pid that fired it."""
+    out = []
+    for e in events:
+        if e.get("event") != "alert":
+            continue
+        args = e.get("args") or {}
+        out.append({"t": e.get("t"), "pid": e.get("_pid"),
+                    "rule": args.get("rule"),
+                    "severity": args.get("severity"),
+                    "metric": args.get("metric"),
+                    "value": args.get("value")})
+    out.sort(key=lambda a: a["t"] or 0)
+    return out
+
+
 def blame(reqs, slo_ttft=None):
     """The SLO breach blame list: every request whose terminal verdict
     is not ``completed``, every failed-over request, and (with
@@ -755,6 +788,7 @@ def analyze(run_dir, slo_ttft=None):
         "arcs": arcs, "linked_arcs": linked_arcs,
         "journal_retries": journal_retries,
         "liveness": liveness_lanes(data["events"]),
+        "alerts": alert_lanes(data["events"]),
         "blame": blame(reqs, slo_ttft),
         "accounting": accounting(data, reqs),
     }
@@ -861,6 +895,22 @@ def render(rep, out=sys.stdout):
                          ln["fenced"], ln["fenced_tokens"]))
         _tr._table(("replica", "suspicions", "spans", "max_hb_gap",
                     "confirmed", "fenced", "fenced_tok"), rows, out)
+
+    if rep.get("alerts"):
+        out.write("\n-- fired alerts (ISSUE 18) --\n")
+        t0 = min((a["t"] for a in rep["alerts"]
+                  if a["t"] is not None), default=None)
+        rows = []
+        for a in rep["alerts"]:
+            rows.append((
+                _tr._fmt_s(a["t"] - t0) if a["t"] is not None
+                and t0 is not None else "-",
+                a["severity"] or "-", a["rule"] or "?",
+                a["metric"] or "-",
+                a["value"] if a["value"] is not None else "-",
+                a["pid"] if a["pid"] is not None else "-"))
+        _tr._table(("t+", "severity", "rule", "metric", "value",
+                    "pid"), rows, out)
 
     if rep["arcs"]:
         out.write("\n-- failover arcs (linked by trace id) --\n")

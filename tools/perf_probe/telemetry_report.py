@@ -426,6 +426,7 @@ def render_file(path, out=sys.stdout):
         span = last.get("time_unix", 0) - docs[0].get("time_unix", 0)
         ctx = " (%d samples over %s)" % (len(docs), _fmt_s(span))
     _render_watchdog_timeline(docs, out)
+    _render_alert_timeline(docs, out)
     render_report(last, out, context=ctx)
 
 
@@ -519,6 +520,28 @@ def _render_watchdog_timeline(docs, out):
         out.write("  +%s: %d stall(s) detected (lease_age %s)\n"
                   % (_fmt_s(t), n, _fmt_s(age) if age is not None
                      else "-"))
+
+
+def _render_alert_timeline(docs, out):
+    """Call out the alert-rule firings (ISSUE 18) riding a stream as
+    trace-less ``alert`` request events, so a timeline's rule verdicts
+    (breaker opened, watchdog stalled, goodput collapsed, ...) read at
+    the top without grepping req_events by hand."""
+    t0 = docs[0].get("time_unix", 0)
+    fired = []
+    for doc in docs:
+        for e in doc.get("req_events") or []:
+            if e.get("event") == "alert":
+                fired.append((e.get("t", 0) - t0, e.get("args") or {}))
+    if not fired:
+        return
+    out.write("== ALERTS: %d rule firing(s) in this timeline ==\n"
+              % len(fired))
+    for t, a in sorted(fired):
+        out.write("  +%s: [%s] %s (%s=%s)\n"
+                  % (_fmt_s(max(0.0, t)), a.get("severity", "?"),
+                     a.get("rule", "?"), a.get("metric", "?"),
+                     a.get("value", "-")))
 
 
 def main(argv):

@@ -180,6 +180,7 @@ def main(argv=None):
     t_end = (time.monotonic() + args.max_seconds
              if args.max_seconds > 0 else None)
     rc = 0
+    next_alert_t = 0.0
     try:
         while True:
             if t_end is not None and time.monotonic() > t_end:
@@ -202,9 +203,17 @@ def main(argv=None):
                 break
             if not replica.idle:
                 replica.step()
-            elif subscriber is not None:
-                # an idle replica still hot-swaps fresh publications
-                replica.maybe_swap()
+            else:
+                if subscriber is not None:
+                    # an idle replica still hot-swaps publications
+                    replica.maybe_swap()
+                # idle alert cadence (ISSUE 18): replica.step() runs
+                # the rules while decoding; an idle worker must still
+                # notice its own stall/breaker state between pulls
+                now = time.monotonic()
+                if now >= next_alert_t:
+                    next_alert_t = now + 1.0
+                    telemetry.check_alerts(now)
     except ReplicaLost as e:
         # a standalone replica dies retryable — the launcher respawns
         # the slot and the router's proxy confirms the death
