@@ -749,7 +749,18 @@ def bench_serve():
       spec-off, drafted == accepted + rejected, decode tokens ==
       slot_steps + accepted - discarded, and mixed greedy/sampled
       streams reproduce bit-exactly both on a re-run and across a
-      router failover re-decode.
+      router failover re-decode;
+    - **streamed delivery** (ISSUE 19): cursor-pull streaming delivers
+      every accepted request's tokens EXACTLY ONCE — in-process
+      (streamed TTFT p50 < 0.5x the unary completion p50, polling
+      leaves 1.0 dispatch/step and 0 recompiles), across a real
+      SIGKILL failover mid-stream (no gap, no duplicate, bit-identical
+      to unfaulted; a blackholed poll reply recovered by an idempotent
+      re-poll at the same cursor), under cancellation (typed
+      `cancelled` verdict mid-decode AND queued, slot + KV pages back,
+      survivors unperturbed), and under client vanish (the abandon
+      sweep reclaims orphans with the typed `abandoned` verdict, page
+      conservation green, the `orphan_reclaim` default alert fires).
     """
     import jax
     _perf_probe_path()
@@ -1094,6 +1105,112 @@ def bench_serve():
             "fleet_top's live matrix was incomplete on the drill "
             "fleet (%s; contract: one complete row per live worker "
             "via status + telemetry_pull alone)" % (tel["fleet_top"],))
+    stream = result["stream"]
+    sm = stream["streamed"]
+    if not sm["exactly_once"]:
+        raise AssertionError(
+            "in-process streaming broke exactly-once assembly "
+            "(contract: the cursor-pull chunks concatenate to the "
+            "engine's token list — no gap, no duplicate)")
+    if sm["decode_dispatches_per_step"] != 1.0 or \
+            sm["steady_state_compiles"] != 0:
+        raise AssertionError(
+            "polling the stream broke the hot path (%.3f "
+            "dispatch/step, %d recompile(s); contract: poll reads a "
+            "host-side buffer — it NEVER touches the donated program)"
+            % (sm["decode_dispatches_per_step"],
+               sm["steady_state_compiles"]))
+    if sm["ttft_vs_unary_ratio"] >= 0.5:
+        raise AssertionError(
+            "streamed TTFT p50 (%.1fms) is %.2fx the unary completion "
+            "p50 (%.1fms) on the mixed-length workload (contract: "
+            "< 0.5x — the first chunk must beat the full reply)"
+            % (sm["streamed_ttft_p50_ms"], sm["ttft_vs_unary_ratio"],
+               sm["unary_completion_p50_ms"]))
+    can = stream["cancel"]
+    if can["mid_decode_verdict"] != "cancelled" or \
+            can["queued_verdict"] != "cancelled" or \
+            not can["idempotent"]:
+        raise AssertionError(
+            "cancel did not land the typed terminal verdict "
+            "(mid_decode=%r queued=%r idempotent=%s; contract: "
+            "`cancelled` between decode steps, for queued requests, "
+            "and a repeat cancel is a no-op)"
+            % (can["mid_decode_verdict"], can["queued_verdict"],
+               can["idempotent"]))
+    if not (can["survivors_completed"] and can["survivor_tokens_match"]
+            and can["pages_restored"] and can["conservation_ok"]):
+        raise AssertionError(
+            "cancellation perturbed the batch (survivors_completed=%s "
+            "tokens_match=%s pages_restored=%s conservation=%s; "
+            "contract: a cancel frees slot + KV pages and the "
+            "survivors' greedy streams are untouched)"
+            % (can["survivors_completed"],
+               can["survivor_tokens_match"], can["pages_restored"],
+               can["conservation_ok"]))
+    van = stream["vanish"]
+    if van["orphans"] < 1 or not van["abandoned_verdicts"] or \
+            van["abandoned_counter"] < van["orphans"]:
+        raise AssertionError(
+            "the serve.client.vanish drill reclaimed no orphan "
+            "(orphans=%s verdicts_ok=%s counter=%s; contract: a "
+            "stream unpolled past MXTPU_SERVE_ABANDON_S lands the "
+            "typed `abandoned` verdict + counter)"
+            % (van["orphans"], van["abandoned_verdicts"],
+               van["abandoned_counter"]))
+    if not (van["pages_restored"] and van["conservation_ok"]
+            and van["survivors_completed"]
+            and van["survivor_streams_exact"]):
+        raise AssertionError(
+            "orphan reclamation leaked (pages_restored=%s "
+            "conservation=%s survivors_completed=%s survivors_exact=%s"
+            "; contract: reclaim returns every page to the free pool "
+            "with the conservation audit green and live pollers "
+            "unperturbed)"
+            % (van["pages_restored"], van["conservation_ok"],
+               van["survivors_completed"],
+               van["survivor_streams_exact"]))
+    if not van["alert_fired"]:
+        raise AssertionError(
+            "the orphan_reclaim default alert did not fire on the "
+            "vanish drill (contract: abandoned-counter movement trips "
+            "the default rule)")
+    sf = stream["fleet"]
+    if sf["dropped"] != 0 or not sf["exactly_once"]:
+        raise AssertionError(
+            "the kill-mid-stream fleet drill broke exactly-once "
+            "delivery (dropped=%d exactly_once=%s; contract: every "
+            "accepted request's tokens arrive exactly once across a "
+            "real SIGKILL failover — no gap, no duplicate)"
+            % (sf["dropped"], sf["exactly_once"]))
+    if not sf["tokens_match_unfaulted"]:
+        raise AssertionError(
+            "streamed fleet tokens diverged from the unfaulted "
+            "reference (contract: the survivor's re-decode is "
+            "bit-identical, so the cursor stays valid across the "
+            "kill)")
+    if sf["failovers"] < 1 or not sf["killed_mid_stream"] or \
+            sf["streams_resumed_across_kill"] < 1:
+        raise AssertionError(
+            "the SIGKILL never landed mid-stream (failovers=%d "
+            "mid_stream=%s resumed=%d; contract: >= 1 stream with a "
+            "non-zero cursor at kill time resumes on the replacement "
+            "with no client-visible gap)"
+            % (sf["failovers"], sf["killed_mid_stream"],
+               sf["streams_resumed_across_kill"]))
+    if sf["drop_blackholed_replies"] < 1 or \
+            not sf["drop_repoll_contiguous"]:
+        raise AssertionError(
+            "the serve.stream.drop site never bit, or the re-poll "
+            "tore the stream (blackholed=%d contiguous=%s; contract: "
+            "a dropped poll reply is recovered by an idempotent "
+            "re-poll at the SAME cursor)"
+            % (sf["drop_blackholed_replies"],
+               sf["drop_repoll_contiguous"]))
+    if sf["replacement_spawns"] < 1:
+        raise AssertionError(
+            "the streamed fleet drill never spawned a replacement — "
+            "the resume-across-failover contract was not exercised")
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": cont["tokens_per_sec"],
@@ -1119,6 +1236,10 @@ def bench_serve():
         "spec_speedup": spec["speedup_tokens_per_sec"],
         "spec_tokens_per_slot_step": spec["tokens_per_slot_step"],
         "spec_acceptance_rate": spec["acceptance_rate"],
+        "streamed_ttft_p50_ms": sm["streamed_ttft_p50_ms"],
+        "streamed_ttft_vs_unary": sm["ttft_vs_unary_ratio"],
+        "stream_orphans_reclaimed": van["orphans"],
+        "stream_kill_resumed": sf["streams_resumed_across_kill"],
         "serve": result,
     }))
 

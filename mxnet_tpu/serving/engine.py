@@ -128,8 +128,10 @@ from .. import watchdog as _watchdog
 from ..base import MXNetError
 from .kv_cache import PagedKVAllocator, SCRATCH_PAGE
 from .prefix_cache import PrefixCache
-from .scheduler import (ContinuousBatchingScheduler, EXPIRED, FAILED,
-                        FINISHED, SamplingParams, VERDICT_COMPLETED,
+from .scheduler import (CANCELLED, ContinuousBatchingScheduler, EXPIRED,
+                        FAILED, FINISHED, QUEUED, RUNNING,
+                        SamplingParams, VERDICT_ABANDONED,
+                        VERDICT_CANCELLED, VERDICT_COMPLETED,
                         VERDICT_DRAINING, VERDICT_EXPIRED_DECODE,
                         VERDICT_PREFILL_ERROR, VERDICT_REJECTED)
 from .slo import SLOController
@@ -322,6 +324,22 @@ class ServingEngine:
         self.default_deadline_s = (default_deadline_s
                                    if default_deadline_s is not None
                                    else _env_float("MXTPU_SERVE_DEADLINE_S"))
+        # streamed token delivery (ISSUE 19): every placed request is
+        # reachable by trace id for cursor polls; terminal requests stay
+        # registered (their token buffer is the re-poll recovery store)
+        # until terminal + MXTPU_SERVE_STREAM_TTL_S.  A request whose
+        # last poll is older than MXTPU_SERVE_ABANDON_S (unset = off —
+        # unary clients never poll and must never be reclaimed) is
+        # reclaimed with verdict ``abandoned`` before admission, like
+        # the deadline sweeps.
+        self._streams = {}          # trace -> Request
+        self._waiting = set()       # traces whose last poll got 0 tokens
+        self.abandoned = 0          # orphans reclaimed by THIS engine
+        ttl = _env_float("MXTPU_SERVE_STREAM_TTL_S")
+        self.stream_ttl_s = 60.0 if ttl is None else ttl
+        self.abandon_s = _env_float("MXTPU_SERVE_ABANDON_S")
+        self.stream_chunk = int(
+            os.environ.get("MXTPU_SERVE_STREAM_CHUNK", "0") or 0) or 64
         self.draining = False
         self.swaps = 0
         # distinct watchdog lease key per engine in this process: one
@@ -703,6 +721,7 @@ class ServingEngine:
         req = self.sched.submit(prompt, max_new, deadline_s)
         req.trace = trace
         req.trace_owned = owned
+        self._streams[trace] = req
         req.sampling = sampling
         req.spec_k = None if spec_k is None else int(spec_k)
         if sampling is not None and not sampling.greedy:
@@ -788,6 +807,118 @@ class ServingEngine:
                       "tokens" % (req.deadline_s, len(req.tokens),
                                   req.max_new))
             _telemetry.counter("serving.expired_decode").inc()
+
+    # -- streamed delivery (ISSUE 19) --------------------------------------
+    def poll(self, trace, cursor=0, max_tokens=None):
+        """One cursor pull against a request's emitted-token buffer:
+        returns the tokens after ``cursor`` (bounded chunk) plus the
+        terminal verdict / ``more`` flag, or None for an unknown trace
+        (never placed here, or already swept after terminal +
+        ``stream_ttl_s``).  Stateless and idempotent — the client holds
+        the cursor, so a dropped reply is recovered by re-polling the
+        SAME cursor and the integer index can never deliver a token
+        twice or skip one.  ``req.tokens`` is append-only until
+        terminal, which is what makes the slice law safe.  A successful
+        poll stamps ``last_poll_t`` — the orphan sweep's liveness
+        evidence."""
+        req = self._streams.get(trace)
+        if req is None:
+            return None
+        now = time.perf_counter()
+        req.last_poll_t = now
+        cursor = max(0, int(cursor))
+        chunk = (self.stream_chunk if max_tokens is None
+                 else max(1, int(max_tokens)))
+        toks = [int(t) for t in req.tokens[cursor:cursor + chunk]]
+        new_cursor = cursor + len(toks)
+        more = (not req.done) or new_cursor < len(req.tokens)
+        _telemetry.counter("serving.stream.polls").inc()
+        if toks:
+            self._waiting.discard(trace)
+            _telemetry.counter("serving.stream.delivered").inc(
+                len(toks))
+            # one trace-less ``poll`` event per DELIVERING poll: the
+            # serve_report delivery phase joins emit stamps to first-
+            # coverage stamps through these (empty polls carry no new
+            # coverage, so they stay off the event stream)
+            _telemetry.note_request_event(
+                "", "poll",
+                args={"replica": self.trace_tag, "trace": req.trace,
+                      "rid": req.rid, "cursor": new_cursor})
+        elif not req.done:
+            self._waiting.add(trace)
+        return {"trace": req.trace, "rid": req.rid,
+                "cursor": new_cursor, "tokens": toks, "more": more,
+                "state": req.state, "verdict": req.verdict,
+                "error": req.error, "done": req.done}
+
+    def cancel(self, trace):
+        """Client-initiated teardown: lands the typed terminal verdict
+        ``cancelled`` between decode steps (this is called from the
+        dispatch gaps — RPC handling and router harvests both sit
+        between ``step()`` calls), releasing slot + pages through the
+        one `_finish` exit path.  Idempotent: cancelling a terminal
+        request reports its existing verdict; unknown traces return
+        None."""
+        req = self._streams.get(trace)
+        if req is None:
+            return None
+        if not req.done:
+            if req.state == RUNNING:
+                self._finish(req, CANCELLED, verdict=VERDICT_CANCELLED,
+                             error="cancelled by client after %d of %d "
+                                   "tokens" % (len(req.tokens),
+                                               req.max_new))
+            else:
+                self.sched.cancel_queued(
+                    req, error="cancelled by client while queued")
+                self._close_trace(req)
+            self._waiting.discard(trace)
+            _telemetry.counter("serving.stream.cancelled").inc()
+        return {"trace": req.trace, "rid": req.rid,
+                "state": req.state, "verdict": req.verdict,
+                "tokens": len(req.tokens)}
+
+    def sweep_streams(self):
+        """The pre-admission stream sweep (runs with the deadline
+        sweeps): (a) reclaim orphans — any request a client STARTED
+        streaming (``last_poll_t`` set) and then went silent on for
+        more than ``abandon_s`` exits with verdict ``abandoned``,
+        releasing slot + pages, so a vanished client can never pin the
+        KV pool; (b) drop terminal buffers older than terminal +
+        ``stream_ttl_s`` (after which a poll is a declared unknown, not
+        a silent gap)."""
+        now = time.perf_counter()
+        if self.abandon_s is not None:
+            for req in list(self.sched.running):
+                if req.last_poll_t is not None and \
+                        now - req.last_poll_t > self.abandon_s:
+                    self._finish(
+                        req, CANCELLED, verdict=VERDICT_ABANDONED,
+                        error="no poll for %.3fs (abandon_s %.3fs); "
+                              "orphan reclaimed after %d of %d tokens"
+                              % (now - req.last_poll_t, self.abandon_s,
+                                 len(req.tokens), req.max_new))
+                    self.abandoned += 1
+                    _telemetry.counter("serving.stream.abandoned").inc()
+            for req in [r for r in self._streams.values()
+                        if r.state == QUEUED]:
+                if req.last_poll_t is not None and \
+                        now - req.last_poll_t > self.abandon_s:
+                    self.sched.cancel_queued(
+                        req, verdict=VERDICT_ABANDONED,
+                        error="no poll for %.3fs while queued; orphan "
+                              "reclaimed" % (now - req.last_poll_t))
+                    self._close_trace(req)
+                    self.abandoned += 1
+                    _telemetry.counter("serving.stream.abandoned").inc()
+        dead = [tr for tr, r in self._streams.items()
+                if r.done and r.finish_t is not None
+                and now - r.finish_t > self.stream_ttl_s]
+        for tr in dead:
+            del self._streams[tr]
+            self._waiting.discard(tr)
+            _telemetry.counter("serving.stream.expired").inc()
 
     def _arm_slot_sampling(self, req):
         """Install the request's sampling params into its slot's rows
@@ -958,6 +1089,7 @@ class ServingEngine:
                 "serve.prefix.evict"):
             self.drop_prefix_cache()
         self._expire_deadlines()
+        self.sweep_streams()
         placed = self._admit_and_prefill()
         # every placed request produced exactly one token in its prefill
         produced = len(placed)
@@ -1332,6 +1464,14 @@ class ServingEngine:
                 "discarded": self.spec_discarded,
                 "speculative_pages": self.alloc.speculative_pages}),
             "weights_epoch": self.weights_epoch,
+            "stream": {
+                "live": sum(1 for r in self._streams.values()
+                            if not r.done and r.last_poll_t is not None),
+                "waiting": len(self._waiting),
+                "retained": sum(1 for r in self._streams.values()
+                                if r.done),
+                "abandoned": self.abandoned,
+            },
             "shedding": (self._slo.shedding if self._slo is not None
                          else False),
             "slo": (self._slo.state() if self._slo is not None

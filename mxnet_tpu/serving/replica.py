@@ -178,6 +178,20 @@ class ServingReplica:
                                   trace=trace, sampling=sampling,
                                   spec_k=spec_k)
 
+    def poll(self, trace, cursor=0, max_tokens=None):
+        """Streamed-delivery cursor pull (ISSUE 19): the engine's token
+        buffer after ``cursor``.  Works on a DEAD replica too — the
+        buffers a terminal request retains are exactly what a client
+        recovering a dropped reply needs, and refusing them would turn
+        every failover into a declared gap."""
+        return self.engine.poll(trace, cursor=cursor,
+                                max_tokens=max_tokens)
+
+    def cancel(self, trace):
+        """Client-initiated teardown (ISSUE 19): terminal verdict
+        ``cancelled`` between decode steps, slot + pages released."""
+        return self.engine.cancel(trace)
+
     def step(self):
         """One serving iteration, replica-flavored: the loss fault site,
         then (between decode steps — exactly the hot-swap window) a

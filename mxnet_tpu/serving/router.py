@@ -75,8 +75,8 @@ import time
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from .replica import ReplicaLost
-from .scheduler import (EXPIRED, FAILED, FINISHED, REJECTED, SHED,
-                        SamplingParams, VERDICT_REJECTED)
+from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, REJECTED,
+                        SHED, SamplingParams, VERDICT_REJECTED)
 
 __all__ = ["Router", "RouterRequest"]
 
@@ -85,7 +85,7 @@ VERDICT_RETRIES_EXHAUSTED = "retries_exhausted"
 VERDICT_NO_REPLICAS = "no_live_replicas"
 
 #: engine states that are terminal-but-not-success (propagated verdicts)
-_TERMINAL_FAILURES = (REJECTED, EXPIRED, FAILED, SHED)
+_TERMINAL_FAILURES = (REJECTED, EXPIRED, FAILED, SHED, CANCELLED)
 
 
 def _np_size(prompt):
@@ -294,6 +294,94 @@ class Router:
     @property
     def requests(self):
         return list(self._journal.values())
+
+    # -- streamed delivery (ISSUE 19) --------------------------------------
+    def poll(self, rid, cursor=0, max_tokens=None):
+        """Fleet-level token pull: tokens emitted after ``cursor`` plus
+        a ``more`` flag — the delivery-plane twin of the telemetry
+        cursor.  The cursor is an ABSOLUTE token index, and the
+        determinism law is what makes it survive failover: a survivor's
+        re-decode is bit-identical, so index ``cursor`` names the same
+        token on the victim and on the survivor — the client sees no
+        gap and no duplicate across a failover it never has to know
+        happened.
+
+        The poll is FORWARDED to the live replica whenever it speaks
+        ``poll`` (RPC proxies, in-process replicas): the worker-side
+        engine is what tracks ``last_poll_t``, so forwarding is what
+        keeps an actively-polled stream out of the abandon sweep.  A
+        dropped reply (``serve.stream.drop``, an unreachable worker)
+        falls back to the local mirror's token slice — still
+        exactly-once by index — with ``more=True`` so the client keeps
+        polling.  A completed request serves straight from the
+        journal's token list; polling a terminal request is always
+        answerable (idempotent re-poll law)."""
+        rr = self._journal.get(rid)
+        if rr is None:
+            return None
+        cursor = max(0, int(cursor))
+        doc = {"rid": rr.rid, "trace": rr.trace, "cursor": cursor,
+               "tokens": [], "more": not rr.done, "state": rr.state,
+               "verdict": rr.verdict, "done": rr.done}
+        toks = rr.tokens
+        if toks is None and rr._live is not None:
+            # mid-decode: ask the replica that is decoding it — the
+            # authoritative buffer, and the poll that feeds the
+            # worker's abandon clock
+            fwd = getattr(rr._home, "poll", None)
+            if fwd is not None:
+                try:
+                    reply = fwd(rr.trace, cursor, max_tokens)
+                except ReplicaLost:
+                    reply = None
+                if reply is not None and reply.get("known", True):
+                    doc["cursor"] = int(reply.get("cursor", cursor))
+                    doc["tokens"] = [int(t) for t in
+                                     reply.get("tokens") or []]
+                    # `more` and terminality come from the ROUTER's
+                    # view: an engine-terminal verdict that has not
+                    # been harvested yet is still in flight fleet-wise
+                    # (it may fail over); only journal state is final
+                    return doc
+            # reply dropped / worker unreachable / fresh incarnation:
+            # serve the mirror's slice — same absolute indexing, and
+            # `more=True` keeps the client polling through recovery
+            toks = getattr(rr._live, "tokens", None)
+            if toks is not None:
+                sliced = [int(t) for t in (
+                    toks[cursor:] if max_tokens is None
+                    else toks[cursor:cursor + max(1, int(max_tokens))])]
+                doc["tokens"] = sliced
+                doc["cursor"] = cursor + len(sliced)
+            return doc
+        if toks is not None:
+            sliced = [int(t) for t in (
+                toks[cursor:] if max_tokens is None
+                else toks[cursor:cursor + max(1, int(max_tokens))])]
+            doc["tokens"] = sliced
+            doc["cursor"] = cursor + len(sliced)
+            doc["more"] = (not rr.done) or doc["cursor"] < len(toks)
+        return doc
+
+    def cancel(self, rid):
+        """Client-initiated teardown: forward to the replica decoding
+        the request; the engine lands the typed ``cancelled`` verdict
+        between decode steps (slot + pages released), the next
+        ``_harvest`` journals it terminal.  Idempotent — cancelling a
+        terminal request reports its existing verdict."""
+        rr = self._journal.get(rid)
+        if rr is None:
+            return None
+        if not rr.done and rr._home is not None:
+            fwd = getattr(rr._home, "cancel", None)
+            if fwd is not None:
+                try:
+                    fwd(rr.trace)
+                except ReplicaLost:
+                    pass  # the failover path owns this request now
+            self._harvest()
+        return {"rid": rr.rid, "trace": rr.trace, "state": rr.state,
+                "verdict": rr.verdict, "done": rr.done}
 
     # -- placement ---------------------------------------------------------
     def _live(self):

@@ -63,7 +63,9 @@ retry/backoff path deterministically), ``rpc.heartbeat.drop``
 failover), ``rpc.partition`` (asymmetric router→replica blackhole,
 both planes cut on the link while the replica keeps decoding: fenced
 failover), ``serve.worker.zombie`` (drain orders ignored: supervisor
-escalation).  ``serve.replica.sigkill`` (serving/replica.py) is the
+escalation), ``serve.stream.drop`` (a ``poll`` reply blackholed —
+delivery plane only; the client's cursor makes the re-poll exact).
+``serve.replica.sigkill`` (serving/replica.py) is the
 process-death twin of ``serve.replica.lost``: a hard
 ``os.kill(SIGKILL)`` no in-process exception path can fake.
 
@@ -669,6 +671,10 @@ class RpcServer:
                 return self._do_inject(msg)
             if method == "telemetry_pull":
                 return self._do_telemetry_pull(msg)
+            if method == "poll":
+                return self._do_poll(msg)
+            if method == "cancel":
+                return self._do_cancel(msg)
             return {"ok": False, "error_type": "RpcError",
                     "error": "unknown rpc method %r" % (method,)}
         except Exception as e:  # never let a handler kill the worker
@@ -831,6 +837,78 @@ class RpcServer:
         cursor["incarnation"] = mine
         return {"ok": True, "incarnation": mine, "reset": reset,
                 "line": doc, "cursor": cursor, "more": bool(more)}
+
+    def _stream_target(self, msg):
+        """Resolve a poll/cancel target to the ENGINE trace id.  The
+        wire key is the idempotence key (the router's trace, or
+        ``anon-<trace>`` for untraced submits); the journal maps it to
+        the engine Request whose own ``trace`` the engine's stream
+        registry is keyed by.  A key the journal no longer holds may
+        still BE an engine trace (in-process callers) — pass it
+        through."""
+        key = msg.get("trace") if msg.get("trace") is not None \
+            else msg.get("key")
+        req = self._journal.get(key)
+        return key if req is None else req.trace
+
+    def _do_poll(self, msg):
+        """Streamed token delivery (ISSUE 19): one cursor pull against
+        a request's emitted-token buffer, the delivery-plane twin of
+        ``telemetry_pull``.  Server-side stateless — the CLIENT holds
+        the integer token cursor, so a dropped reply is recovered by an
+        idempotent re-poll of the same cursor (no gap, no duplicate by
+        the slice law).  A cursor minted against a different
+        incarnation is declared ``reset`` — this boot's buffers restart
+        (a failed-over request re-decodes bit-identically, so the
+        ROUTER maps the integer cursor onto the survivor; at worker
+        level the discontinuity is declared, never silent).  Replies
+        are bounded chunks (``max_tokens`` / MXTPU_SERVE_STREAM_CHUNK)
+        with a ``more`` flag.  The ``serve.stream.drop`` fault site
+        blackholes the reply — delivery plane only; the decode loop
+        never notices."""
+        if _fault.trigger("serve.stream.drop"):
+            _telemetry.counter("serving.stream.dropped_replies").inc()
+            return None  # park: the client's deadline + re-poll recover
+        mine = dict(self.incarnation)
+        want = msg.get("incarnation")
+        reset = False
+        if want is not None and not _stamp_match(
+                (want.get("pid"), want.get("attempt"),
+                 want.get("nonce")),
+                (mine["pid"], mine["attempt"], mine["nonce"])):
+            reset = True  # declared discontinuity, never silent
+        cursor = max(0, int(msg.get("cursor") or 0))
+        poll = getattr(self.replica, "poll", None)
+        doc = None
+        if callable(poll):
+            doc = poll(self._stream_target(msg), cursor,
+                       msg.get("max_tokens"))
+        if doc is None:
+            return {"ok": True, "known": False, "incarnation": mine,
+                    "reset": reset, "cursor": cursor, "tokens": [],
+                    "more": False, "state": "unknown", "verdict": None,
+                    "done": False}
+        out = {"ok": True, "known": True, "incarnation": mine,
+               "reset": reset}
+        out.update(doc)
+        return out
+
+    def _do_cancel(self, msg):
+        """Client-initiated teardown (ISSUE 19): lands the typed
+        terminal verdict ``cancelled`` between decode steps (this
+        single-threaded loop interleaves RPC handling with
+        ``replica.step()``), releasing slot + pages.  Idempotent — a
+        re-sent cancel reports the existing terminal verdict."""
+        cancel = getattr(self.replica, "cancel", None)
+        doc = None
+        if callable(cancel):
+            doc = cancel(self._stream_target(msg))
+        if doc is None:
+            return {"ok": True, "known": False, "state": "unknown",
+                    "verdict": None}
+        out = {"ok": True, "known": True}
+        out.update(doc)
+        return out
 
     def _do_health(self):
         from .. import profiler as _profiler
@@ -1415,6 +1493,55 @@ class RpcReplicaProxy:
             addr, cursor=cursor, max_events=max_events,
             timeout_s=self._timeout_s if timeout_s is None
             else timeout_s, retries=0, rng=self._rng)
+
+    def poll(self, trace, cursor=0, max_tokens=None, timeout_s=None):
+        """One streamed-delivery cursor pull (ISSUE 19) — deliberately
+        breaker-free and retry-free like :meth:`pull_telemetry`: the
+        client-held cursor makes a failed poll free to re-issue, and a
+        delivery plane gated by the data-plane breaker would go dark
+        exactly when a streaming client most needs the verdict.
+        Returns the reply doc (``tokens`` / ``cursor`` / ``more`` /
+        ``state`` / ``verdict`` / ``reset`` / ``known``) or None when
+        the worker is unreachable or blackholed (``serve.stream.drop``)
+        — the caller re-polls the SAME cursor."""
+        msg = {"method": "poll", "trace": trace,
+               "cursor": max(0, int(cursor))}
+        if max_tokens is not None:
+            msg["max_tokens"] = int(max_tokens)
+        pin = self.incarnation
+        if pin is not None:
+            msg["incarnation"] = {"pid": pin[0], "attempt": pin[1],
+                                  "nonce": pin[2]}
+        try:
+            addr = self._resolve()
+            reply = rpc_call(addr, msg,
+                             self._timeout_s if timeout_s is None
+                             else float(timeout_s),
+                             retries=0, rng=self._rng)
+        except ReplicaLost:
+            raise
+        except (RpcError, OSError):
+            return None
+        if not reply.get("ok"):
+            return None
+        self._note_progress()  # delivery-plane contact is contact
+        return reply
+
+    def cancel(self, trace, timeout_s=None):
+        """Land a ``cancel`` on the worker (ISSUE 19).  Returns the
+        reply doc or None when unreachable (the caller may re-send —
+        cancel is idempotent)."""
+        try:
+            addr = self._resolve()
+            reply = rpc_call(addr, {"method": "cancel", "trace": trace},
+                             self._timeout_s if timeout_s is None
+                             else float(timeout_s),
+                             retries=self._retries, rng=self._rng)
+        except ReplicaLost:
+            raise
+        except (RpcError, OSError):
+            return None
+        return reply if reply.get("ok") else None
 
     def health(self):
         """The fused health view: breaker + liveness-machine state

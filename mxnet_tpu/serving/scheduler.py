@@ -43,6 +43,10 @@ __all__ = ["Request", "SamplingParams", "ContinuousBatchingScheduler"]
 QUEUED, RUNNING, FINISHED, REJECTED, EXPIRED, FAILED, SHED = \
     "queued", "running", "finished", "rejected", "expired", "failed", \
     "shed"
+#: terminal state for client-initiated teardown (ISSUE 19): an explicit
+#: ``cancel`` or an orphan reclaim (vanished streaming client) — the
+#: verdict (``cancelled`` vs ``abandoned``) says which.
+CANCELLED = "cancelled"
 
 #: typed verdicts a terminal request can carry
 VERDICT_COMPLETED = "completed"                # every token produced
@@ -52,6 +56,8 @@ VERDICT_SHED = "shed"                          # SLO shed at admission
 VERDICT_DRAINING = "draining"                  # replica refusing intake
 VERDICT_REJECTED = "rejected_infeasible"       # can never run here
 VERDICT_PREFILL_ERROR = "prefill_error"        # admission dispatch failed
+VERDICT_CANCELLED = "cancelled"                # client asked for teardown
+VERDICT_ABANDONED = "abandoned"                # poller vanished; reclaimed
 
 
 class SamplingParams:
@@ -123,7 +129,8 @@ class Request:
                  "pages", "logits_trace", "token_times", "deadline_s",
                  "deadline_t", "verdict", "error", "trace",
                  "trace_owned", "sampling", "prefix_len",
-                 "shared_count", "cow_src", "cow_dst", "spec_k")
+                 "shared_count", "cow_src", "cow_dst", "spec_k",
+                 "last_poll_t")
 
     def __init__(self, rid, prompt, max_new, deadline_s=None):
         self.rid = rid
@@ -170,6 +177,12 @@ class Request:
         self.shared_count = 0
         self.cow_src = None
         self.cow_dst = None
+        # streaming delivery (ISSUE 19): perf_counter stamp of the last
+        # successful ``poll`` against this request.  None means no
+        # client ever streamed it — a unary request, which the orphan
+        # sweep must NEVER reclaim (only a poller that started and then
+        # went silent counts as vanished).
+        self.last_poll_t = None
 
     @property
     def done(self):
@@ -318,6 +331,26 @@ class ContinuousBatchingScheduler:
                 keep.append(req)
         self._queue = keep
         return expired
+
+    def cancel_queued(self, req, verdict=VERDICT_CANCELLED, error=None,
+                      now=None):
+        """Terminal teardown for a QUEUED request (ISSUE 19): it holds
+        no slot and no pages, so cancellation is pure bookkeeping — the
+        request leaves the FIFO (survivor order preserved) with a typed
+        verdict.  Residents go through :meth:`finish` instead, which
+        also releases slot + pages."""
+        if now is None:
+            now = time.perf_counter()
+        assert req.state == QUEUED, req.state
+        keep = collections.deque(r for r in self._queue if r is not req)
+        assert len(keep) == len(self._queue) - 1, "request not queued"
+        self._queue = keep
+        req.state = CANCELLED
+        req.verdict = verdict
+        if error is not None:
+            req.error = error
+        req.finish_t = now
+        return req
 
     def expired_running(self, now=None):
         """Residents whose deadline has passed — the engine finishes

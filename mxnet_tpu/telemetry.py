@@ -990,6 +990,8 @@ def install_default_alert_rules():
     add_alert_rule("goodput_collapse", "serving.goodput",
                    kind="ratio", metric2="serving.tokens", op="<",
                    threshold=0.5, severity="warn", window_s=30.0)
+    add_alert_rule("orphan_reclaim", "serving.stream.abandoned",
+                   kind="counter_delta", severity="warn", window_s=30.0)
 
 
 def check_alerts(now=None):

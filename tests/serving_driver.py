@@ -660,6 +660,136 @@ def check_spec_k_sweep(net):
     assert telemetry.counter("serving.spec.accepted").value > ac0
 
 
+# -- streamed delivery (ISSUE 19; rides the engine section's AOT memo) -----
+
+def check_stream_cursor_laws(net):
+    """Cursor laws at the engine: chunks reassemble to the unary
+    stream, re-polling a cursor is idempotent, ``more=False`` carries
+    the terminal verdict, and polling never dispatches or recompiles
+    (it reads a host-side buffer)."""
+    from mxnet_tpu import profiler
+    rng = np.random.RandomState(19)
+    prompt = rng.randint(0, VOCAB, (6,)).astype(np.int32)
+    ref = _ref(net, prompt, 8)
+    eng = _engine(net)
+    eng.generate([prompt[:4]], max_new=2)        # warm (AOT memo)
+    profiler.reset_step_stats()
+    req = eng.submit(prompt, 8)
+    assembled = []
+    while not req.done:
+        eng.step()
+        reply = eng.poll(req.trace, cursor=len(assembled))
+        assert reply["cursor"] == len(assembled) + len(reply["tokens"])
+        assembled += reply["tokens"]
+    tail = eng.poll(req.trace, cursor=len(assembled))
+    assembled += tail["tokens"]
+    assert assembled == ref == req.tokens, (assembled, ref)
+    assert tail["more"] is False and tail["verdict"] == "completed"
+    # idempotence + bounded chunks: same cursor, same slice, twice
+    a = eng.poll(req.trace, cursor=2, max_tokens=3)
+    b = eng.poll(req.trace, cursor=2, max_tokens=3)
+    assert a["tokens"] == b["tokens"] == ref[2:5]
+    assert a["more"] is True               # terminal but not drained
+    stats = profiler.step_stats()
+    assert stats.get("compile_count", 0) == 0, \
+        "polling recompiled: %s" % stats
+    assert eng.decode_steps == len(ref), \
+        (eng.decode_steps, len(ref))       # 1.0 dispatch per token step
+    # unknown trace: a typed None, never a crash
+    assert eng.poll("never-a-trace", 0) is None
+    # TTL expiry: terminal buffers past stream_ttl_s sweep away and a
+    # late poll is a DECLARED unknown (serving.stream.expired counts)
+    eng.stream_ttl_s = 0.0
+    eng.sweep_streams()
+    assert eng.poll(req.trace, cursor=0) is None
+    _idle_pages_ok(eng)
+
+
+def check_stream_cancel(net):
+    """The typed ``cancelled`` verdict: mid-decode (slot + pages
+    released between decode steps) AND queued; idempotent; survivors'
+    streams bit-identical to their unfaulted references."""
+    rng = np.random.RandomState(20)
+    prompts = [rng.randint(0, VOCAB, (6,)).astype(np.int32)
+               for _ in range(4)]                # num_slots=3 → 1 queues
+    refs = [_ref(net, p, 8) for p in prompts]
+    eng = _engine(net)
+    free0 = eng.alloc.free_pages
+    reqs = [eng.submit(p, 8) for p in prompts]
+    eng.step()
+    assert reqs[3].state == "queued"
+    eng.step()
+    mid = eng.cancel(reqs[1].trace)              # resident, mid-decode
+    assert mid["verdict"] == "cancelled"
+    assert reqs[1].done and reqs[1].verdict == "cancelled"
+    assert 0 < len(reqs[1].tokens) < 8           # partial tokens kept
+    que = eng.cancel(reqs[3].trace)              # still queued
+    assert que["verdict"] == "cancelled"
+    again = eng.cancel(reqs[1].trace)            # idempotent no-op
+    assert again["verdict"] == "cancelled"
+    eng.run_until_idle()
+    for i in (0, 2):
+        assert reqs[i].state == "finished"
+        assert reqs[i].tokens == refs[i], \
+            "cancel perturbed survivor %d" % i
+    cached = 0 if eng._prefix is None else eng._prefix.cached_pages
+    assert eng.alloc.free_pages == free0 - cached
+    _idle_pages_ok(eng)
+
+
+def check_stream_abandon_reclaim(net):
+    """The ``serve.client.vanish`` drill at the engine: pollers fall
+    silent mid-stream, and after MXTPU_SERVE_ABANDON_S the sweep
+    reclaims the orphans with the typed ``abandoned`` verdict — pages
+    back in the pool, conservation green, the still-polling survivor
+    and the never-polled UNARY request both untouched."""
+    import time as _time
+    from mxnet_tpu import fault, telemetry
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(0, VOCAB, (5,)).astype(np.int32)
+               for _ in range(3)]
+    refs = [_ref(net, p, 8) for p in prompts]
+    os.environ["MXTPU_SERVE_ABANDON_S"] = "0.05"
+    try:
+        eng = _engine(net)
+    finally:
+        del os.environ["MXTPU_SERVE_ABANDON_S"]
+    assert eng.abandon_s == 0.05
+    c0 = telemetry.counter("serving.stream.abandoned").value
+    reqs = [eng.submit(p, 8) for p in prompts]
+    # reqs[0] and reqs[1] become STREAMS (polled); reqs[2] stays unary
+    cursors = [0, 0]
+    vanished = set()
+    fault.configure("serve.client.vanish:1")
+    try:
+        for step in range(40):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+            for i in (0, 1):
+                if i in vanished or reqs[i].done:
+                    continue
+                if i == 1 and step >= 2 and \
+                        fault.trigger("serve.client.vanish"):
+                    vanished.add(i)      # poller dies; process lives
+                    continue
+                reply = eng.poll(reqs[i].trace, cursor=cursors[i])
+                cursors[i] += len(reply["tokens"])
+            _time.sleep(0.02)            # real time ages last_poll_t
+    finally:
+        fault.reset()
+    assert vanished == {1}
+    assert reqs[1].done and reqs[1].verdict == "abandoned", \
+        (reqs[1].state, reqs[1].verdict)
+    assert telemetry.counter("serving.stream.abandoned").value > c0
+    assert eng.snapshot()["stream"]["abandoned"] >= 1
+    # the survivor poller and the unary request were NEVER reclaimed
+    assert reqs[0].state == "finished" and reqs[0].tokens == refs[0]
+    assert reqs[2].state == "finished" and reqs[2].tokens == refs[2], \
+        "a never-polled unary request must not be swept as an orphan"
+    _idle_pages_ok(eng)
+
+
 def main(section):
     if section in ("kernel", "all"):
         check_kernel_vs_reference_mixed_lengths()
@@ -689,6 +819,14 @@ def main(section):
         spec_eng = check_spec_greedy_laws(net)
         check_spec_poison_drill(net, spec_eng)
         print("SERVING_SPEC_FAST_OK")
+        # ISSUE 19 streamed delivery rides the SAME subprocess too:
+        # default ENGINE_KW engines, AOT-memo-shared — cursor laws,
+        # cancel, and the vanish/abandon drill cost decode steps and a
+        # few 20 ms sleeps, never a compile
+        check_stream_cursor_laws(net)
+        check_stream_cancel(net)
+        check_stream_abandon_reclaim(net)
+        print("SERVING_STREAM_OK")
     if section in ("capacity", "all"):
         net = _net()
         check_prefix_cache_off_token_identity(net)

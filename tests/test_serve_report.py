@@ -476,6 +476,103 @@ def test_failover_phase_nets_out_duplicates_on_second_retry():
     assert p["failover_s"] == pytest.approx(0.5, abs=1e-6)
 
 
+def _poll(seq, t, trace, cursor):
+    """A trace-less delivery-plane poll event (the event's own trace
+    field is empty like tokens/swap; the polled trace rides in args)."""
+    return {"seq": seq, "t": t, "trace": "", "event": "poll",
+            "args": {"replica": "a", "trace": trace, "cursor": cursor}}
+
+
+def test_delivery_phase_charges_poll_gaps_not_decode():
+    """ISSUE 19: a streamed token nobody has pulled yet is the CLIENT's
+    latency — the emit -> first-covering-poll window is delivery_s, not
+    decode_s.  And a tail re-poll AFTER the final verdict is lawful
+    (idempotent re-polls are the whole point), never an
+    'events after final verdict' lifecycle violation."""
+    evs = [
+        _ev(0, 10.0, "S", "submit", prompt_len=2, max_new=2,
+            router=True, rid=1),
+        _ev(1, 10.0, "S", "admit", replica="a", slot=0,
+            queue_wait_s=0.0, pages=1),
+        _ev(2, 10.1, "S", "token"),
+        # cursor=1: token 0 delivered 0.05s after emit
+        _poll(3, 10.15, "S", 1),
+        _ev(4, 10.2, "S", "token"),
+        # cursor=2: token 1 delivered 0.3s after emit
+        _poll(5, 10.5, "S", 2),
+        _ev(6, 10.55, "S", "verdict", verdict="completed", final=True,
+            router=True, rid=1, tokens=2),
+        # tail re-poll after the verdict (client confirming the end)
+        _poll(7, 10.6, "S", 2),
+    ]
+    reqs = serve_report.build_requests(evs)
+    p = reqs["S"]["phases"]
+    assert p["delivery_s"] == pytest.approx(0.35, abs=1e-6)
+    assert p["decode_s"] == pytest.approx(0.2, abs=1e-6)
+    assert reqs["S"]["dominant"] == "delivery"
+    violations, open_traces = serve_report.lifecycle_check(reqs)
+    assert violations == [] and open_traces == []
+
+
+def test_delivery_phase_merges_overlapping_poll_windows():
+    """One slow poll covering two emits is ONE gap, not two: the
+    per-token windows overlap and must be union-merged, else a single
+    lazy poller double-charges delivery past wall time."""
+    evs = [
+        _ev(0, 10.0, "M", "submit", prompt_len=2, max_new=2,
+            router=True, rid=1),
+        _ev(1, 10.0, "M", "admit", replica="a", slot=0,
+            queue_wait_s=0.0, pages=1),
+        _ev(2, 10.1, "M", "token"),
+        _ev(3, 10.2, "M", "token"),
+        # one poll covers both tokens: windows (10.1,10.5)+(10.2,10.5)
+        # merge to 0.4s, NOT 0.7s
+        _poll(4, 10.5, "M", 2),
+        _ev(5, 10.55, "M", "verdict", verdict="completed", final=True,
+            router=True, rid=1, tokens=2),
+    ]
+    p = serve_report.build_requests(evs)["M"]["phases"]
+    assert p["delivery_s"] == pytest.approx(0.4, abs=1e-6)
+
+
+def test_stream_latency_split_and_unpolled_completed_delivery():
+    """stream_latency_split classes a trace by whether any poll named
+    it: the streamed TTFT clock is submit -> first DELIVERING poll
+    (cursor past 0), the unary clock is the engine ttft_s stamp plus
+    the full-reply completion time.  A never-polled COMPLETED request
+    charges its last-token -> verdict window (the unary reply riding
+    back) to delivery, not decode."""
+    evs = [
+        _ev(0, 10.0, "S", "submit", prompt_len=2, max_new=1,
+            router=True, rid=1),
+        _ev(1, 10.0, "S", "admit", replica="a", slot=0,
+            queue_wait_s=0.0, pages=1),
+        _ev(2, 10.1, "S", "token"),
+        _poll(3, 10.15, "S", 1),
+        _ev(4, 10.2, "S", "verdict", verdict="completed", final=True,
+            router=True, rid=1, tokens=1),
+        _ev(5, 10.0, "U", "submit", prompt_len=2, max_new=2,
+            router=True, rid=2),
+        _ev(6, 10.0, "U", "admit", replica="a", slot=1,
+            queue_wait_s=0.0, pages=1),
+        _ev(7, 10.1, "U", "token"),
+        _ev(8, 10.2, "U", "token"),
+        _ev(9, 10.4, "U", "verdict", verdict="completed", final=True,
+            router=True, rid=2, tokens=2, ttft_s=0.1),
+    ]
+    reqs = serve_report.build_requests(evs)
+    st = serve_report.stream_latency_split(reqs)
+    assert st["streamed"]["n"] == 1
+    assert st["streamed"]["ttft_p50"] == pytest.approx(0.15, abs=1e-6)
+    assert st["unary"]["n"] == 1
+    assert st["unary"]["ttft_p50"] == pytest.approx(0.1, abs=1e-6)
+    assert st["unary"]["completion_p50"] == pytest.approx(0.4, abs=1e-6)
+    # the never-polled completed request's ride-back window is delivery
+    pu = reqs["U"]["phases"]
+    assert pu["delivery_s"] == pytest.approx(0.2, abs=1e-6)
+    assert pu["decode_s"] == pytest.approx(0.2, abs=1e-6)
+
+
 def test_serve_report_accounting_and_latency_split(tmp_path):
     rep = serve_report.analyze(_synthetic_tree(tmp_path))
     acc = rep["accounting"]

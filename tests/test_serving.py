@@ -234,11 +234,18 @@ def test_serving_engine_invariants():
     lengths, drafting non-vacuous and strictly cheaper in decode steps,
     the serve.spec.poison drill (corrupted drafts between draft and
     verify -> all rejected, exact non-speculative stream), per-request
-    spec_k=0 override, and zero speculative page marks at idle."""
+    spec_k=0 override, and zero speculative page marks at idle.
+    The fast ISSUE-19 streaming laws ride here as well: poll-cursor
+    idempotence + chunk reassembly against the unary stream, the typed
+    `cancelled` verdict (mid-decode, queued, idempotent — survivors
+    bit-identical, pages conserved), and the serve.client.vanish
+    abandon-sweep drill (typed `abandoned` verdict, unary requests
+    never reclaimed)."""
     out = _run_driver("engine")
     assert "SERVING_ENGINE_OK" in out
     assert "SERVING_CAPACITY_FAST_OK" in out
     assert "SERVING_SPEC_FAST_OK" in out
+    assert "SERVING_STREAM_OK" in out
 
 
 @pytest.mark.slow

@@ -1134,3 +1134,223 @@ def test_alert_rules_fire_into_stream_and_window_suppress():
         for r in rules:
             telemetry._alert_rules.append(r)
         telemetry.reset()
+
+
+# -- streamed delivery: cursor laws, cancel, drop drill (ISSUE 19) ---------
+
+class _StreamStub(_StubReplica):
+    """The stub, delivery-plane flavored: requests carry a trace, and
+    ``poll``/``cancel`` implement the engine's cursor contract (pure
+    function of (request state, cursor); typed ``cancelled`` verdict)
+    so the WIRE's laws are testable without a model."""
+
+    def submit(self, prompt, max_new, deadline_s=None, trace=None,
+               **kw):
+        r = super().submit(prompt, max_new, deadline_s=deadline_s,
+                           trace=trace)
+        r.trace = trace if trace is not None else "stub-%d" % r.rid
+        return r
+
+    def _find(self, trace):
+        for r in self.reqs:
+            if getattr(r, "trace", None) == trace:
+                return r
+        return None
+
+    def poll(self, trace, cursor=0, max_tokens=None):
+        r = self._find(trace)
+        if r is None:
+            return None
+        cursor = max(0, int(cursor))
+        chunk = r.tokens[cursor:] if max_tokens is None else \
+            r.tokens[cursor:cursor + max(1, int(max_tokens))]
+        new = cursor + len(chunk)
+        return {"trace": trace, "rid": r.rid, "cursor": new,
+                "tokens": [int(t) for t in chunk],
+                "more": (not r.done) or new < len(r.tokens),
+                "state": r.state, "verdict": r.verdict,
+                "error": r.error, "done": r.done}
+
+    def cancel(self, trace):
+        r = self._find(trace)
+        if r is None:
+            return None
+        if not r.done:
+            r.state = "cancelled"
+            r.verdict = "cancelled"
+        return {"trace": trace, "rid": r.rid, "state": r.state,
+                "verdict": r.verdict, "done": r.done}
+
+
+def test_poll_chunks_reassemble_and_repoll_is_idempotent():
+    """Cursor laws 1+2 (SERVING.md §10) over the real wire: bounded
+    chunks concatenate to the full token list, and re-polling the SAME
+    cursor returns the SAME tokens — the recovery move for a dropped
+    reply costs nothing and tears nothing."""
+    w = _WorkerLoop(_StreamStub("a"))
+    try:
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=1.0)
+        m = proxy.submit(np.ones(2, np.int32), 6, trace="tr-s1")
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            reply = proxy.poll("tr-s1", cursor=0)
+            if reply is not None and not reply["more"]:
+                break
+            time.sleep(0.01)
+        # bounded-chunk walk: max_tokens=2 forces 3 chunks
+        assembled, cursor = [], 0
+        for _ in range(16):
+            reply = proxy.poll("tr-s1", cursor=cursor, max_tokens=2)
+            assert reply is not None and reply["known"]
+            assert len(reply["tokens"]) <= 2
+            assert reply["cursor"] == cursor + len(reply["tokens"])
+            assembled += reply["tokens"]
+            cursor = reply["cursor"]
+            if not reply["more"]:
+                break
+        assert assembled == [0, 1, 2, 3, 4, 5]   # rid 0: 0*10 + pos
+        assert reply["verdict"] == "completed" and reply["done"]
+        # idempotence: the same cursor yields the same slice, twice
+        a = proxy.poll("tr-s1", cursor=2, max_tokens=2)
+        b = proxy.poll("tr-s1", cursor=2, max_tokens=2)
+        assert a["tokens"] == b["tokens"] == [2, 3]
+        assert m.key == "tr-s1"   # the wire key IS the trace
+    finally:
+        w.close()
+
+
+def test_stream_drop_blackholes_reply_and_repoll_recovers():
+    """The ``serve.stream.drop`` drill (delivery plane only): the poll
+    reply is parked, the client's per-call deadline is the only way
+    out, and the idempotent re-poll at the SAME cursor recovers
+    exactly the tokens the dropped reply carried."""
+    telemetry.reset()
+    w = _WorkerLoop(_StreamStub("a"))
+    try:
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=1.0)
+        proxy.submit(np.ones(2, np.int32), 4, trace="tr-d1")
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            reply = proxy.poll("tr-d1", cursor=0)
+            if reply is not None and not reply["more"]:
+                break
+            time.sleep(0.01)
+        fault.configure("serve.stream.drop:1")
+        t0 = time.monotonic()
+        dropped = proxy.poll("tr-d1", cursor=1, timeout_s=0.3)
+        waited = time.monotonic() - t0
+        assert dropped is None           # blackholed, deadline paid
+        assert waited < 2.0              # bounded by the call deadline
+        assert telemetry.counter(
+            "serving.stream.dropped_replies").value == 1
+        recovered = proxy.poll("tr-d1", cursor=1)
+        assert recovered is not None and recovered["known"]
+        assert recovered["tokens"] == [1, 2, 3]   # no gap, no dup
+        # the drill cut ONLY delivery: the data plane kept answering
+        assert proxy.health().get("alive")
+    finally:
+        w.close()
+        telemetry.reset()
+
+
+def test_cancel_rpc_lands_typed_verdict_and_is_idempotent():
+    """Cancel over the wire: the typed terminal ``cancelled`` verdict
+    lands, a repeat cancel is a no-op answering the same terminal
+    state, and a subsequent poll reports ``more=False`` with the
+    verdict attached."""
+    w = _WorkerLoop(_StreamStub("a", step_sleep=0.05))
+    try:
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=1.0)
+        proxy.submit(np.ones(2, np.int32), 1000, trace="tr-c1")
+        reply = proxy.cancel("tr-c1")
+        assert reply is not None and reply["known"]
+        assert reply["verdict"] == "cancelled" and reply["done"]
+        again = proxy.cancel("tr-c1")
+        assert again["verdict"] == "cancelled" and again["done"]
+        polled = proxy.poll("tr-c1", cursor=0)
+        assert polled["more"] is False
+        assert polled["verdict"] == "cancelled"
+    finally:
+        w.close()
+
+
+def test_poll_unknown_trace_answers_known_false():
+    """A trace the worker never saw (or aged out past the stream TTL)
+    answers ``known=False`` — typed, never a hang or a crash."""
+    w = _WorkerLoop(_StreamStub("a"))
+    try:
+        proxy = RpcReplicaProxy("a", addr=w.addr, timeout_s=1.0)
+        reply = proxy.poll("tr-never", cursor=3)
+        assert reply is not None
+        assert reply["known"] is False and reply["more"] is False
+        assert reply["state"] == "unknown"
+        unknown_cancel = proxy.cancel("tr-never")
+        assert unknown_cancel["known"] is False
+    finally:
+        w.close()
+
+
+def test_poll_incarnation_mismatch_declares_reset():
+    """Cursor law 4: a poll carrying a cursor minted against a
+    DIFFERENT incarnation is answered with ``reset=True`` — the
+    discontinuity is declared, never silent (the router maps the
+    cursor onto the survivor's bit-identical re-decode)."""
+    w = _WorkerLoop(_StreamStub("a"))
+    try:
+        mine = w.server.incarnation
+        ok = rpc_call(w.addr, {
+            "method": "poll", "trace": "tr-x", "cursor": 0,
+            "incarnation": {"pid": mine["pid"],
+                            "attempt": mine["attempt"],
+                            "nonce": mine["nonce"]}}, 1.0)
+        assert ok["ok"] and ok["reset"] is False
+        stale = rpc_call(w.addr, {
+            "method": "poll", "trace": "tr-x", "cursor": 0,
+            "incarnation": {"pid": 1, "attempt": 99,
+                            "nonce": "dead"}}, 1.0)
+        assert stale["ok"] and stale["reset"] is True
+    finally:
+        w.close()
+
+
+def test_replay_journal_cancelled_and_abandoned_are_terminal(tmp_path):
+    """ISSUE 19 satellite: ``cancelled`` / ``abandoned`` journal lines
+    replay TERMINAL — a restarted router never re-executes a request
+    the client tore down or abandoned — while the torn-tail
+    skip-and-count behavior is unchanged."""
+    journal = str(tmp_path / "router-journal-slot0.jsonl")
+    lines = [
+        {"t": 1.0, "event": "accept", "rid": 0, "trace": "tr-0",
+         "replica": "slot0", "state": "accepted", "verdict": None,
+         "retries": 0},
+        {"t": 1.1, "event": "fail", "rid": 0, "trace": "tr-0",
+         "replica": "slot0", "state": "failed", "verdict": "cancelled",
+         "retries": 0},
+        {"t": 1.2, "event": "accept", "rid": 1, "trace": "tr-1",
+         "replica": "slot0", "state": "accepted", "verdict": None,
+         "retries": 0},
+        {"t": 1.3, "event": "fail", "rid": 1, "trace": "tr-1",
+         "replica": "slot0", "state": "failed", "verdict": "abandoned",
+         "retries": 0},
+        {"t": 1.4, "event": "accept", "rid": 2, "trace": "tr-2",
+         "replica": "slot0", "state": "accepted", "verdict": None,
+         "retries": 0},
+    ]
+    with open(journal, "w") as f:
+        for doc in lines:
+            f.write(json.dumps(doc) + "\n")
+        f.write('{"t": 1.5, "event": "complete", "rid": 2, "tr')
+    rt = Router([], journal_path=journal)
+    rep = rt.replay_journal()
+    assert rep["torn"] == 1
+    assert rep["requests"] == 3
+    r0, r1, r2 = rt.request(0), rt.request(1), rt.request(2)
+    assert r0.done and r0.verdict == "cancelled"
+    assert r1.done and r1.verdict == "abandoned"
+    assert r2.state == "accepted"      # the torn complete never applied
+    # polling a replayed terminal stream answers the verdict, not a
+    # re-execution: no live mirror exists, more=False, no tokens
+    doc = rt.poll(0, cursor=0)
+    assert doc["done"] and doc["verdict"] == "cancelled"
+    assert doc["more"] is False and doc["tokens"] == []
+    assert rt._next_rid == 3

@@ -829,6 +829,11 @@ def _serve_spawn(args, mem, run_dir, hb_dir, cmd, slot, attempt):
         "MXTPU_SERVE_PORT_FILE":
             os.path.join(run_dir, "serve-port-slot%d.json" % slot),
     })
+    # orphan reclamation (ISSUE 19): a fleet-wide abandon window for
+    # vanished streaming clients; operator-set env wins (ssh-env rule)
+    if getattr(args, "serve_abandon_s", 0) and \
+            "MXTPU_SERVE_ABANDON_S" not in os.environ:
+        env["MXTPU_SERVE_ABANDON_S"] = str(args.serve_abandon_s)
     if args.cpu_fake_devices:
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -1249,6 +1254,13 @@ def main(argv=None):
                         "(appended to <telemetry-dir>/stream-slot<K>"
                         ".jsonl — fleet observability with no shared "
                         "filesystem reads; 0 disables the collector)")
+    parser.add_argument("--serve-abandon-s", type=float, default=0.0,
+                        help="--serve only: reclaim a streamed request "
+                        "whose client stopped polling for this many "
+                        "seconds (typed verdict 'abandoned', slot + KV "
+                        "pages released — SERVING.md §10; exported to "
+                        "workers as MXTPU_SERVE_ABANDON_S; 0 = off; "
+                        "operator-set env wins)")
     parser.add_argument("--aot-cache-dir", default=None,
                         help="compiled-executable warm-start cache "
                         "exported to workers as MXTPU_AOT_CACHE_DIR (+ "

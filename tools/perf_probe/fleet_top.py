@@ -13,6 +13,11 @@ row (SERVING.md §9):
 - efficiency: prefix-cache hit rate, speculative acceptance rate, and
   goodput tok/s (counter deltas between refreshes — the first
   snapshot shows cumulative totals);
+- delivery (ISSUE 19): live streams (``strm``), waiting pollers
+  (``wait``), and reclaimed-orphan count (``orph``) from the engine
+  snapshot's stream block — a rising ``orph`` says clients are
+  vanishing mid-stream (the ``orphan_reclaim`` alert fires on the
+  same counter);
 - liveness: heartbeat round-trip + incarnation stamp, and — when run
   inside the router process via :func:`collect_matrix` — the local
   suspicion / breaker / fence gauges the proxies maintain (a
@@ -142,6 +147,7 @@ def collect_row(name, addr, cursor=None, timeout_s=2.0,
             "decode_steps": snap.get("decode_steps"),
             "weights_epoch": snap.get("weights_epoch"),
             "slo": snap.get("slo"),
+            "stream": snap.get("stream"),
         }
         break  # one engine per worker process in the fleet layout
     row["prefix_hit_rate"] = _rate(
@@ -202,12 +208,13 @@ def _fmt(v, pct=False):
 
 def render_matrix(matrix, out=sys.stdout):
     cols = ("replica", "state", "occ", "queue", "free_pg", "prefix",
-            "spec", "tok/s", "hb_ms", "susp", "breaker", "epoch")
+            "spec", "tok/s", "strm", "wait", "orph", "hb_ms", "susp",
+            "breaker", "epoch")
     rows = []
     for r in matrix["rows"]:
         if not r.get("up"):
             rows.append((r["replica"], "DOWN", "-", "-", "-", "-", "-",
-                         "-", "-", "-", "-",
+                         "-", "-", "-", "-", "-", "-", "-",
                          r.get("error", "")[:24]))
             continue
         eng = r.get("engine") or {}
@@ -220,12 +227,15 @@ def render_matrix(matrix, out=sys.stdout):
         occ = "-"
         if eng.get("num_slots"):
             occ = "%s/%s" % (eng.get("occupancy"), eng.get("num_slots"))
+        strm = eng.get("stream") or {}
         rows.append((
             r["replica"], state, occ, _fmt(eng.get("queued")),
             _fmt(eng.get("free_pages")),
             _fmt(r.get("prefix_hit_rate"), pct=True),
             _fmt(r.get("spec_accept_rate"), pct=True),
             _fmt(r.get("tok_s", r.get("tokens"))),
+            _fmt(strm.get("live")), _fmt(strm.get("waiting")),
+            _fmt(strm.get("abandoned")),
             _fmt(r.get("hb_rtt_ms")),
             {1: "SUSPECT", 0: "-"}.get(live.get("suspect"), "-"),
             live.get("breaker") or "-",

@@ -72,6 +72,20 @@ batch occupancy.  Hard contracts asserted by ``BENCH_MODE=serve``
   hot-path contracts (1.0 decode dispatch/step, 0 steady-state
   recompiles) must survive, with the steady-state pull itself under
   ``MXTPU_TELEMETRY_PULL_BUDGET`` µs (default 2000);
+- **streamed delivery** (``run_streaming``, ISSUE 19): a poll-per-step
+  client plane over an open-loop trace — cursor-assembled
+  streams bit-identical to the engine's token lists (exactly-once),
+  1.0 decode dispatch/step and 0 recompiles WITH polling, streamed
+  TTFT p50 < 0.5x the unary completion p50 on a decode-dominated
+  trace (under a saturating burst queue wait dominates both classes
+  equally — the ratio would measure the scheduler); a cancel drill (typed
+  ``cancelled`` verdicts mid-decode AND queued, pages restored), the
+  ``serve.client.vanish`` drill (silent pollers reclaimed
+  ``abandoned``, conservation green, ``orphan_reclaim`` alert fired),
+  and a kill-mid-stream fleet drill — a REAL SIGKILL injected only
+  once the victim's streams have delivered tokens, the client cursor
+  resuming over the survivor's bit-identical re-decode with no gap
+  and no dup, plus ``serve.stream.drop`` re-poll recovery;
 - **capacity multipliers** (``run_prefix`` / ``run_gqa``, ISSUE 15):
   a system-prompt-heavy Poisson mix with per-request sampling on half
   the requests, cache-on vs cache-off on the SAME workload — prefix
@@ -1245,6 +1259,438 @@ def run_partition(workload, reference_tokens):
             shutil.rmtree(d, ignore_errors=True)
 
 
+# -- streamed delivery drills (ISSUE 19) -----------------------------------
+
+def run_streamed(net, workload, num_slots=8, page_size=16,
+                 max_prefill_len=32, max_seq_len=48):
+    """In-process streamed-delivery phase: an open-loop workload where
+    every in-flight request is POLLED once per engine step (the
+    client-pull cadence) and its tokens assembled strictly by cursor.
+    What ``BENCH_MODE=serve`` pins on this dict:
+
+    - exactly-once assembly: the cursor-assembled streams equal the
+      engine's own token lists bit-for-bit (no gap, no dup);
+    - the hot path survives streaming: 1.0 decode dispatch/step and 0
+      steady-state recompiles WITH a poll per request per step — the
+      delivery plane never forces a dispatch;
+    - streamed TTFT p50 < 0.5x the unary completion p50: first-token
+      latency is now a client-visible number, not a telemetry-only one
+      (a unary client waits for completion).
+
+    The latency split runs on a streaming-REPRESENTATIVE trace
+    (arrival rate the slot pool absorbs, decode-dominated lengths):
+    under a saturating burst, queue wait dominates BOTH classes
+    equally and the ratio measures the scheduler, not the delivery
+    plane — the throughput/queueing contracts already own that regime
+    (``run_continuous`` and the fleet drill keep the original burst).
+    """
+    from mxnet_tpu import profiler, telemetry
+    from mxnet_tpu.serving import ServingEngine
+    import numpy as np
+
+    eng = ServingEngine(net, num_slots=num_slots, page_size=page_size,
+                        max_prefill_len=max_prefill_len,
+                        max_seq_len=max_seq_len)
+    eng.generate([np.zeros(4, np.int32)], max_new=2)
+    profiler.reset_step_stats()
+    telemetry.reset()
+    base = profiler.step_stats()
+    d0, c0 = base["dispatch_count"], base["compile_count"]
+    steps0, prefills0 = eng.decode_steps, eng.prefills
+
+    reqs, arrivals, assembled = [], [], []
+    first_token_t, done_t = [], []
+    polls = 0
+    pending = list(workload)
+    t_start = time.perf_counter()
+    while pending or not eng.sched.idle:
+        now = time.perf_counter() - t_start
+        while pending and pending[0][0] <= now:
+            arr, prompt, max_new = pending.pop(0)
+            arrivals.append(arr)
+            assembled.append([])
+            first_token_t.append(None)
+            done_t.append(None)
+            reqs.append(eng.submit(prompt, max_new))
+        if eng.step() == 0 and pending:
+            time.sleep(min(1e-4, max(0.0, pending[0][0] - now)))
+        # the client-pull cadence: one poll per in-flight stream per
+        # step, tokens appended strictly at the held cursor
+        for i, req in enumerate(reqs):
+            if done_t[i] is not None:
+                continue
+            reply = eng.poll(req.trace, cursor=len(assembled[i]))
+            polls += 1
+            t_now = time.perf_counter() - t_start
+            if reply["tokens"]:
+                if first_token_t[i] is None:
+                    first_token_t[i] = t_now
+                assembled[i].extend(reply["tokens"])
+            if reply["done"] and not reply["more"]:
+                done_t[i] = t_now
+    # drain the tail: terminal buffers answer re-polls until TTL
+    for i, req in enumerate(reqs):
+        while done_t[i] is None:
+            reply = eng.poll(req.trace, cursor=len(assembled[i]))
+            polls += 1
+            if first_token_t[i] is None and reply["tokens"]:
+                first_token_t[i] = time.perf_counter() - t_start
+            assembled[i].extend(reply["tokens"])
+            if reply["done"] and not reply["more"]:
+                done_t[i] = time.perf_counter() - t_start
+
+    stats = profiler.step_stats()
+    decode_steps = eng.decode_steps - steps0
+    prefills = eng.prefills - prefills0
+    dispatches = stats["dispatch_count"] - d0
+    streamed_ttft = sorted(t - a for t, a in zip(first_token_t,
+                                                 arrivals))
+    unary_done = sorted(t - a for t, a in zip(done_t, arrivals))
+    engine_tokens = [[int(t) for t in r.tokens] for r in reqs]
+    ttft_p50 = _pct(streamed_ttft, 0.5)
+    unary_p50 = _pct(unary_done, 0.5)
+    return {
+        "requests": len(reqs),
+        "polls": polls,
+        "exactly_once": assembled == engine_tokens,
+        "decode_dispatches_per_step": round(
+            (dispatches - prefills) / max(1, decode_steps), 4),
+        "steady_state_compiles": stats["compile_count"] - c0,
+        "streamed_ttft_p50_ms": round(ttft_p50 * 1e3, 3),
+        "streamed_ttft_p99_ms": round(
+            _pct(streamed_ttft, 0.99) * 1e3, 3),
+        "unary_completion_p50_ms": round(unary_p50 * 1e3, 3),
+        "ttft_vs_unary_ratio": round(ttft_p50 / max(1e-9, unary_p50),
+                                     4),
+        "stream_polls_counter":
+            telemetry.counter("serving.stream.polls").value,
+        "delivered_counter":
+            telemetry.counter("serving.stream.delivered").value,
+    }
+
+
+def run_cancel(net, num_slots=4, page_size=8, max_prefill_len=32,
+               max_seq_len=48):
+    """Cancellation drill: one request cancelled MID-DECODE, one
+    cancelled while QUEUED (slots full), the rest served to
+    completion.  Pins: both land the typed terminal verdict
+    ``cancelled`` (between decode steps — slot + pages released), the
+    survivors' tokens are untouched, cancel is idempotent, and the
+    page pool conserves (audit green, all pages back in the free
+    pool)."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import ServingEngine
+    import numpy as np
+
+    rng = np.random.RandomState(41)
+    eng = ServingEngine(net, num_slots=num_slots, page_size=page_size,
+                        max_prefill_len=max_prefill_len,
+                        max_seq_len=max_seq_len, prefix_cache=False)
+    eng.generate([np.zeros(4, np.int32)], max_new=2)
+    telemetry.reset()
+    free0 = eng.alloc.free_pages
+    prompts = [rng.randint(0, 256, 8).astype(np.int32)
+               for _ in range(num_slots + 1)]
+    # reference: the same prompts served with no cancellation
+    ref = eng.generate(prompts, max_new=16)
+    assert eng.alloc.free_pages == free0
+    reqs = [eng.submit(p, 16) for p in prompts]
+    eng.step()          # residents placed; the last request queues
+    victim, queued = reqs[0], reqs[-1]
+    assert queued.state == "queued", queued.state
+    eng.step()
+    mid = eng.cancel(victim.trace)          # mid-decode teardown
+    que = eng.cancel(queued.trace)          # queued teardown
+    again = eng.cancel(victim.trace)        # idempotent re-cancel
+    eng.run_until_idle()
+    eng.alloc.assert_conservation()
+    survivors = [r for r in reqs if r is not victim and r is not queued]
+    surv_ok = all(
+        [int(t) for t in r.tokens] == [int(t) for t in ref[i + 1]]
+        for i, r in enumerate(survivors))
+    return {
+        "mid_decode_verdict": mid["verdict"],
+        "queued_verdict": que["verdict"],
+        "idempotent": again["verdict"] == mid["verdict"],
+        "victim_tokens_at_cancel": mid["tokens"],
+        "survivors_completed": sum(1 for r in survivors
+                                   if r.state == "finished"),
+        "survivor_tokens_match": surv_ok,
+        "cancelled_counter":
+            telemetry.counter("serving.stream.cancelled").value,
+        "pages_restored": eng.alloc.free_pages == free0,
+        "conservation_ok": True,
+    }
+
+
+def run_vanish(net, num_slots=4, page_size=8, max_prefill_len=32,
+               max_seq_len=48, abandon_s=0.05):
+    """The ``serve.client.vanish`` drill: every request's poller runs
+    for a few steps (the requests become STREAMS), then the armed
+    fault silences two of them — their clients vanish without a
+    cancel.  After ``MXTPU_SERVE_ABANDON_S`` of poll silence the
+    engine reclaims both with the typed ``abandoned`` verdict; the
+    drill pins the reclaim count, the verdicts, conservation (audit
+    green + every page back in the free pool — a vanished client can
+    NOT pin the KV pool), the surviving streams' bit-exact delivery,
+    and the ``orphan_reclaim`` default alert rule firing on the
+    counter."""
+    from mxnet_tpu import fault, telemetry
+    from mxnet_tpu.serving import ServingEngine
+    import numpy as np
+
+    rng = np.random.RandomState(43)
+    os.environ["MXTPU_SERVE_ABANDON_S"] = str(abandon_s)
+    try:
+        eng = ServingEngine(net, num_slots=num_slots,
+                            page_size=page_size,
+                            max_prefill_len=max_prefill_len,
+                            max_seq_len=max_seq_len,
+                            prefix_cache=False)
+    finally:
+        del os.environ["MXTPU_SERVE_ABANDON_S"]
+    eng.generate([np.zeros(4, np.int32)], max_new=2)
+    telemetry.reset()
+    free0 = eng.alloc.free_pages
+    reqs = [eng.submit(rng.randint(0, 256, 8).astype(np.int32), 24)
+            for _ in range(num_slots)]
+    assembled = [[] for _ in reqs]
+    vanished = set()
+    fault.configure("serve.client.vanish:2")
+    try:
+        # a few polled steps first: every request becomes a stream
+        for _ in range(3):
+            eng.step()
+            for i, r in enumerate(reqs):
+                assembled[i].extend(
+                    eng.poll(r.trace, cursor=len(assembled[i]))
+                    ["tokens"])
+        deadline = time.monotonic() + 60
+        while not eng.sched.idle and time.monotonic() < deadline:
+            eng.step()
+            for i, r in enumerate(reqs):
+                if i in vanished or r.done:
+                    continue
+                if fault.trigger("serve.client.vanish"):
+                    vanished.add(i)   # this poller goes silent forever
+                    continue
+                assembled[i].extend(
+                    eng.poll(r.trace, cursor=len(assembled[i]))
+                    ["tokens"])
+            # the reclaim clock is real time; the engine steps faster
+            # than abandon_s on CPU, so give the sweep a chance to see
+            # the silence age past the window
+            time.sleep(abandon_s / 4)
+    finally:
+        fault.reset()
+    eng.alloc.assert_conservation()
+    fired = telemetry.check_alerts()
+    survivors = [i for i in range(len(reqs)) if i not in vanished]
+    for i in survivors:     # drain the survivors' stream tails
+        reply = eng.poll(reqs[i].trace, cursor=len(assembled[i]))
+        assembled[i].extend(reply["tokens"])
+    snap = eng.snapshot()["stream"]
+    return {
+        "requests": len(reqs),
+        "orphans": len(vanished),
+        "abandoned_verdicts": sum(1 for i in vanished
+                                  if reqs[i].verdict == "abandoned"),
+        "abandoned_counter":
+            telemetry.counter("serving.stream.abandoned").value,
+        "snapshot_abandoned": snap["abandoned"],
+        "survivors_completed": sum(
+            1 for i in survivors if reqs[i].state == "finished"),
+        "survivor_streams_exact": all(
+            assembled[i] == [int(t) for t in reqs[i].tokens]
+            for i in survivors),
+        "pages_restored": eng.alloc.free_pages == free0,
+        "conservation_ok": True,
+        "alert_fired": any(a.get("rule") == "orphan_reclaim"
+                           for a in fired),
+    }
+
+
+def run_stream_fleet(workload, reference_tokens):
+    """The kill-mid-stream drill (the ISSUE 19 tentpole contract):
+    REAL worker processes, clients streaming by cursor through the
+    router, a REAL SIGKILL landed mid-stream (injected over the
+    drill-plane RPC once tokens are flowing), plus ``serve.stream.drop``
+    armed on the survivor to blackhole poll replies.  Hard contracts:
+
+    - exactly-once delivery: every accepted request's cursor-assembled
+      stream equals both its completed journal tokens and the
+      unfaulted reference, bit-for-bit — NO gap and NO dup across the
+      failover (the router maps the client cursor onto the survivor's
+      bit-identical re-decode);
+    - >= 1 stream had delivered tokens BEFORE the kill and resumed
+      across it (the drill killed an ACTIVE stream, not an idle one);
+    - a dropped poll reply recovers by an idempotent re-poll at the
+      SAME cursor (observed as >= 1 direct proxy poll returning None,
+      with the re-poll resuming contiguously);
+    - zero dropped requests, >= 1 failover, cancel-free teardown."""
+    from mxnet_tpu.serving import Router
+    from mxnet_tpu.serving.rpc import (CircuitBreaker, RpcReplicaProxy,
+                                       port_file_path, rpc_call,
+                                       wait_port_file)
+
+    run_dir = tempfile.mkdtemp(prefix="serve-stream-")
+    cache = os.path.join(run_dir, "aot")
+    os.makedirs(cache)
+    procs, addrs = {}, {}
+
+    def inject(addr, spec, timeout=1.0):
+        return rpc_call(tuple(addr), {"method": "inject",
+                                      "spec": spec}, timeout,
+                        retries=0)
+
+    try:
+        procs["a"] = _spawn_worker(run_dir, cache, 0, 0,
+                                   {"MXTPU_RPC_ALLOW_INJECT": "1"})
+        procs["v"] = _spawn_worker(run_dir, cache, 1, 0,
+                                   {"MXTPU_RPC_ALLOW_INJECT": "1"})
+        for slot, tag in ((0, "a"), (1, "v")):
+            doc = wait_port_file(port_file_path(run_dir, slot),
+                                 timeout=300)
+            addrs[tag] = (doc.get("host", "127.0.0.1"),
+                          int(doc["port"]))
+
+        def proxy(slot, rid):
+            return RpcReplicaProxy(
+                rid, port_file=port_file_path(run_dir, slot),
+                timeout_s=0.25, retries=0,
+                breaker=CircuitBreaker(threshold=4, cooldown_s=0.4,
+                                       name=rid))
+
+        pa, pv = proxy(0, "a"), proxy(1, "v")
+        spawned = []
+
+        def spawn():
+            procs["v2"] = _spawn_worker(run_dir, cache, 1, 1)
+            fresh = pv.successor(replica_id="v2", timeout=300)
+            spawned.append(fresh)
+            return fresh
+
+        rt = Router([pa, pv], spawn=spawn, max_retries=2)
+        rrs, assembled = [], []
+        pending = list(workload)
+        killed = False
+        drop_armed = False
+        drop_seen = 0
+        drop_repoll_contiguous = None
+        cursors_at_kill = None
+        t_start = time.perf_counter()
+        while pending or not rt.idle:
+            now = time.perf_counter() - t_start
+            while pending and pending[0][0] <= now:
+                _, prompt, max_new = pending.pop(0)
+                rrs.append(rt.submit(prompt, max_new))
+                assembled.append([])
+            for p in procs.values():
+                p.poll()    # reap: SIGKILL must read as a dead pid
+            rt.step()
+            # the client poller plane: one cursor-pull per in-flight
+            # stream per loop, tokens appended strictly at the cursor
+            delivered_v = 0
+            for i, rr in enumerate(rrs):
+                reply = rt.poll(rr.rid, cursor=len(assembled[i]))
+                if reply and reply["tokens"]:
+                    assert reply["cursor"] == (len(assembled[i])
+                                               + len(reply["tokens"]))
+                    assembled[i].extend(reply["tokens"])
+                if rr.replica_id == "v" and assembled[i]:
+                    delivered_v += 1
+            # arm the poll-reply blackhole on the survivor once its
+            # streams flow: the next 2 direct polls park, the re-poll
+            # at the SAME cursor must resume contiguously
+            if not drop_armed and any(
+                    a and rr.replica_id == "a" and not rr.done
+                    for a, rr in zip(assembled, rrs)):
+                idx = next(i for i, rr in enumerate(rrs)
+                           if assembled[i] and rr.replica_id == "a"
+                           and not rr.done)
+                inject(addrs["a"], "serve.stream.drop:2")
+                drop_armed = True
+                cur = len(assembled[idx])
+                for _ in range(8):
+                    direct = pa.poll(rrs[idx].trace, cursor=cur)
+                    if direct is None:
+                        drop_seen += 1       # blackholed reply
+                        continue
+                    if direct.get("known") and direct.get("tokens"):
+                        drop_repoll_contiguous = (
+                            direct["cursor"]
+                            == cur + len(direct["tokens"]))
+                        assembled[idx].extend(direct["tokens"])
+                    break
+            # land the SIGKILL only once the victim is MID-stream:
+            # some client cursor on v must already be past 0
+            if not killed and delivered_v >= 1:
+                cursors_at_kill = [len(a) for a in assembled]
+                inject(addrs["v"], "serve.replica.sigkill:1",
+                       timeout=0.5)
+                killed = True
+            if time.perf_counter() - t_start > 300:
+                raise RuntimeError("stream fleet drill did not drain")
+            time.sleep(0.005)
+        # drain every stream tail to its terminal buffer
+        for i, rr in enumerate(rrs):
+            for _ in range(50):
+                reply = rt.poll(rr.rid, cursor=len(assembled[i]))
+                if reply is None:
+                    break
+                assembled[i].extend(reply["tokens"])
+                if not reply["more"]:
+                    break
+        completed = [rr for rr in rrs if rr.state == "completed"]
+        journal_tokens = [rr.tokens for rr in completed]
+        resumed = sum(
+            1 for i, rr in enumerate(rrs)
+            if rr.retries > 0 and cursors_at_kill is not None
+            and i < len(cursors_at_kill) and cursors_at_kill[i] > 0)
+        return {
+            "requests": len(rrs),
+            "completed": len(completed),
+            "dropped": len(rrs) - len(completed),
+            "failovers": rt.failovers,
+            "killed_mid_stream": killed,
+            "streams_resumed_across_kill": resumed,
+            "exactly_once": assembled == [rr.tokens for rr in rrs],
+            "tokens_match_unfaulted":
+                journal_tokens == reference_tokens,
+            "drop_blackholed_replies": drop_seen,
+            "drop_repoll_contiguous": drop_repoll_contiguous,
+            "replacement_spawns": len(spawned),
+        }
+    finally:
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
+def run_streaming(net, workload, reference_tokens, fleet=True):
+    """The ISSUE 19 umbrella: in-process streamed phase + cancel drill
+    + vanish drill (+ the out-of-process kill-mid-stream drill).  The
+    fleet drill replays the caller's burst ``workload`` against its
+    ``reference_tokens``; the streamed latency split gets its own
+    decode-dominated trace (see ``run_streamed``) at the same engine
+    config, so the AOT memo is shared."""
+    stream_workload = make_workload(n_requests=24,
+                                    mean_interarrival_s=0.02,
+                                    new_tokens=(16, 24), seed=11)
+    out = {
+        "streamed": run_streamed(net, stream_workload),
+        "cancel": run_cancel(net),
+        "vanish": run_vanish(net),
+    }
+    if fleet:
+        out["fleet"] = run_stream_fleet(workload, reference_tokens)
+    return out
+
+
 def measure_trace_overhead(slots=8, iters=2000, passes=5):
     """Isolated microbench of the per-decode-step tracing cost: one
     batched ``tokens`` event naming every resident trace (exactly what
@@ -1428,6 +1874,8 @@ def run(spinup=True, degraded=True, fleet=True):
         "prefix": run_prefix(net),
         "gqa": run_gqa(net),
         "spec": run_spec(),
+        "stream": run_streaming(net, workload, cont_tokens,
+                                fleet=fleet),
     }
     if degraded:
         result["degraded"] = run_degraded(net, workload, cont_tokens)

@@ -30,7 +30,15 @@ faithfully; THIS tool answers the fleet-level questions none can alone:
   re-decode — with the dominant phase and the responsible replica
   named.  "Replica a died and its victims spent 60% of their budget
   re-decoding on b" is a sentence this tool prints, not a forensic
-  project;
+  project.  Streamed requests (ISSUE 19) add a **delivery** phase:
+  the poll-gap windows between each token's emit and the first
+  successful poll that covered it — a slow poller is the client's
+  latency, never blamed on the replica's decode;
+- **streamed vs unary TTFT** (ISSUE 19) — first-token percentiles
+  split by delivery mode: the streamed class measures submit → first
+  token DELIVERED through ``poll``, the unary class measures the
+  engine's emit stamp and its completion (the whole point of
+  streaming is that the first number beats the last one);
 - **goodput and cost-per-token** — ``serving.goodput`` (tokens on
   requests that completed within deadline) vs raw ``serving.tokens``,
   joined with the compile-time ``serving.cost.*`` attribution of the
@@ -199,7 +207,7 @@ def build_requests(events):
                 "prompt_len": None, "max_new": None,
                 "deadline_s": None, "last_pos": -1,
                 "prefix_hit": None, "prefix_len": None,
-                "sampling": None,
+                "sampling": None, "poll_ts": [],
             }
         return r
 
@@ -219,6 +227,16 @@ def build_requests(events):
                 r = rec(t)
                 r["swap_s"] += args.get("dur_s") or 0.0
                 r["swap_count"] += 1
+            continue
+        if ev == "poll":
+            # delivery-plane event (ISSUE 19): trace-less like tokens/
+            # swap — it feeds the delivery phase and the streamed-TTFT
+            # split but NEVER the lifecycle record (a tail re-poll
+            # after the final verdict is lawful, not a violation)
+            t = args.get("trace")
+            if t:
+                rec(t)["poll_ts"].append((e.get("t"),
+                                          args.get("cursor") or 0))
             continue
         if not tr:
             continue
@@ -319,13 +337,53 @@ def _phase_budget(r):
         regained = ts[target - 1] if len(ts) >= target else t1
         failover += max(0.0, regained - ret["t"])
         dup = k
-    used = queue + prefill + swap + failover
+    # delivery (ISSUE 19): the poll-gap windows — for each token, the
+    # wall time between its EMIT and the first successful poll whose
+    # cursor covers it.  A streamed token nobody has pulled yet is the
+    # CLIENT's latency, not the engine's: charging it to decode would
+    # blame the replica for a slow poller.  Overlapping windows are
+    # merged (one slow poll covering 10 emits is one gap, not ten).
+    delivery = 0.0
+    polls = sorted((p for p in r["poll_ts"] if p[0] is not None),
+                   key=lambda p: p[0])
+    if polls:
+        intervals = []
+        for i, emit in enumerate(ts):
+            # first poll whose cursor is PAST token i delivered it; a
+            # never-covered token (the client vanished mid-stream)
+            # stays undelivered to the end of the record
+            cover = next((p[0] for p in polls
+                          if p[1] > i and p[0] >= emit), t1)
+            lo = max(t0, emit)
+            hi = min(t1, max(cover, emit))
+            if hi > lo:
+                intervals.append((lo, hi))
+        intervals.sort()
+        cur_lo = cur_hi = None
+        for lo, hi in intervals:
+            if cur_hi is None or lo > cur_hi:
+                if cur_hi is not None:
+                    delivery += cur_hi - cur_lo
+                cur_lo, cur_hi = lo, hi
+            else:
+                cur_hi = max(cur_hi, hi)
+        if cur_hi is not None:
+            delivery += cur_hi - cur_lo
+    elif ts and final is not None and \
+            (final.get("args") or {}).get("verdict") == "completed":
+        # never-polled COMPLETED request: the budget between its last
+        # token and its final verdict is the unary reply riding back —
+        # delivery, not decode
+        delivery = max(0.0, t1 - ts[-1])
+    used = queue + prefill + swap + failover + delivery
     decode = max(0.0, total - used)
     r["phases"] = {"total_s": total, "queue_s": queue,
                    "prefill_s": prefill, "decode_s": decode,
-                   "swap_s": swap, "failover_s": failover}
+                   "swap_s": swap, "failover_s": failover,
+                   "delivery_s": delivery}
     r["dominant"] = max(
-        ("queue_s", "prefill_s", "decode_s", "swap_s", "failover_s"),
+        ("queue_s", "prefill_s", "decode_s", "swap_s", "failover_s",
+         "delivery_s"),
         key=lambda k: r["phases"][k])[:-2]
 
 
@@ -405,6 +463,47 @@ def verdict_latency_split(reqs):
             row[key + "_p99"] = _pct(vals, 0.99)
         out[v] = row
     return out
+
+
+def stream_latency_split(reqs):
+    """TTFT percentiles split streamed-vs-unary (ISSUE 19).  A request
+    is *streamed* iff at least one ``poll`` event named its trace.  The
+    two classes measure DIFFERENT clocks on purpose: the streamed TTFT
+    is submit → the first poll that DELIVERED a token (cursor past 0 —
+    what a streaming client actually waits), while the unary TTFT is
+    the engine's emit-side ``ttft_s`` stamp plus nothing (the whole
+    reply rides back with the verdict, so first-token latency IS
+    completion latency for that class).  The acceptance bar — streamed
+    p50 well under the unary COMPLETION p50 — is what streaming buys."""
+    streamed, unary, unary_total = [], [], []
+    for r in reqs.values():
+        polls = sorted((p for p in r["poll_ts"] if p[0] is not None),
+                       key=lambda p: p[0])
+        if polls:
+            if r["submit_t"] is None:
+                continue
+            first = next((p[0] for p in polls if p[1] > 0), None)
+            if first is not None:
+                streamed.append(max(0.0, first - r["submit_t"]))
+            continue
+        if r["final"] is None:
+            continue
+        args = r["final"].get("args") or {}
+        if args.get("ttft_s") is not None:
+            unary.append(args["ttft_s"])
+        if r["submit_t"] is not None:
+            unary_total.append(max(0.0, r["final"]["t"] - r["submit_t"]))
+    streamed.sort(), unary.sort(), unary_total.sort()
+    return {
+        "streamed": {"n": len(streamed),
+                     "ttft_p50": _pct(streamed, 0.5),
+                     "ttft_p99": _pct(streamed, 0.99)},
+        "unary": {"n": len(unary),
+                  "ttft_p50": _pct(unary, 0.5),
+                  "ttft_p99": _pct(unary, 0.99),
+                  "completion_p50": _pct(unary_total, 0.5),
+                  "completion_p99": _pct(unary_total, 0.99)},
+    }
 
 
 def prefix_latency_split(reqs):
@@ -784,6 +883,7 @@ def analyze(run_dir, slo_ttft=None):
                       "ok": not violations and not open_traces},
         "matrix": replica_matrix(reqs),
         "latency": verdict_latency_split(reqs),
+        "stream": stream_latency_split(reqs),
         "prefix": prefix_latency_split(reqs),
         "arcs": arcs, "linked_arcs": linked_arcs,
         "journal_retries": journal_retries,
@@ -853,6 +953,23 @@ def render(rep, out=sys.stdout):
                      _tr._fmt_s(g["queue_p99"])))
     _tr._table(("verdict", "n", "ttft_p50", "ttft_p99", "tpot_p50",
                 "queue_p50", "queue_p99"), rows, out)
+
+    st = rep.get("stream") or {}
+    if (st.get("streamed") or {}).get("n"):
+        out.write("\n-- TTFT: streamed vs unary (ISSUE 19) --\n")
+        s, u = st["streamed"], st["unary"]
+        rows = [("streamed", s["n"], _tr._fmt_s(s["ttft_p50"]),
+                 _tr._fmt_s(s["ttft_p99"]), "-", "-"),
+                ("unary", u["n"], _tr._fmt_s(u["ttft_p50"]),
+                 _tr._fmt_s(u["ttft_p99"]),
+                 _tr._fmt_s(u["completion_p50"]),
+                 _tr._fmt_s(u["completion_p99"]))]
+        _tr._table(("class", "n", "ttft_p50", "ttft_p99",
+                    "compl_p50", "compl_p99"), rows, out)
+        out.write("  (streamed TTFT = submit -> first poll that "
+                  "delivered a token; a unary reply only lands with "
+                  "its verdict, so its first-token latency is its "
+                  "completion latency)\n")
 
     if rep["prefix"]:
         out.write("\n-- latency by prefix class (ISSUE 15) --\n")
@@ -936,7 +1053,8 @@ def render(rep, out=sys.stdout):
                                               _tr._fmt_s(p.get(k)))
                                    for k in ("queue_s", "prefill_s",
                                              "decode_s", "swap_s",
-                                             "failover_s")
+                                             "failover_s",
+                                             "delivery_s")
                                    if p.get(k)),
                          b["dominant"], b["why"]))
         blamed = {}

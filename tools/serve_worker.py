@@ -214,6 +214,11 @@ def main(argv=None):
                 if now >= next_alert_t:
                     next_alert_t = now + 1.0
                     telemetry.check_alerts(now)
+                    # an idle worker still ages out retained stream
+                    # buffers (ISSUE 19): step() runs this sweep while
+                    # decoding, but terminal buffers past their TTL
+                    # must not pin memory on a quiet replica
+                    engine.sweep_streams()
     except ReplicaLost as e:
         # a standalone replica dies retryable — the launcher respawns
         # the slot and the router's proxy confirms the death
