@@ -750,6 +750,13 @@ def bench_serve():
       slot_steps + accepted - discarded, and mixed greedy/sampled
       streams reproduce bit-exactly both on a re-run and across a
       router failover re-decode;
+    - **quantized KV pages** (ISSUE 20): int8 pages + per-page-per-KV-
+      head fp32 absmax scales vs bf16 pools — >= 1.8x residents in the
+      same pool bytes, greedy token match-rate >= 0.99 vs the fp
+      reference, kernel-vs-oracle dequant error <= 1e-5, and the hot
+      path keeps 1.0 decode dispatch/step with 0 steady-state
+      recompiles (quantize-on-scatter and dequant live INSIDE the one
+      donated program);
     - **streamed delivery** (ISSUE 19): cursor-pull streaming delivers
       every accepted request's tokens EXACTLY ONCE — in-process
       (streamed TTFT p50 < 0.5x the unary completion p50, polling
@@ -857,6 +864,43 @@ def bench_serve():
             "page-pool bytes (%d -> %d; contract: >= 1.5x)"
             % (gqa["resident_multiplier"], gqa["residents_mha"],
                gqa["residents_gqa"]))
+    kvq = result["kvq"]
+    if kvq["dequant_max_err"] > 1e-5:
+        raise AssertionError(
+            "quantized paged kernel diverged from the dequantizing "
+            "oracle on the SAME int8 pools + scales (max err %.2e; "
+            "contract: <= 1e-5 — in-kernel dequant is exact up to fp "
+            "reassociation)" % kvq["dequant_max_err"])
+    if kvq["pool_bytes_int8"] > kvq["pool_bytes_bf16"]:
+        raise AssertionError(
+            "int8 page pools used MORE bytes (%d) than the bf16 pools "
+            "(%d) — the capacity comparison is unsound"
+            % (kvq["pool_bytes_int8"], kvq["pool_bytes_bf16"]))
+    if kvq["resident_multiplier"] < 1.8:
+        raise AssertionError(
+            "int8 KV pages fit only %.2fx residents in the same pool "
+            "bytes as bf16 (%d -> %d; contract: >= 1.8x — payload "
+            "halves, scale rows cost ~8*K_kv bytes/page)"
+            % (kvq["resident_multiplier"], kvq["residents_bf16"],
+               kvq["residents_int8"]))
+    if kvq["token_match_rate"] < 0.99:
+        raise AssertionError(
+            "int8 greedy tokens matched the fp reference at only "
+            "%.4f (contract: >= 0.99 — quantized greedy is pinned to "
+            "itself, the match-rate gate pins its drift from fp)"
+            % kvq["token_match_rate"])
+    if kvq["decode_dispatches_per_step"] != 1.0:
+        raise AssertionError(
+            "with int8 KV pages the decode loop dispatched %.3f "
+            "programs/step (contract: exactly 1.0 — quantize-on-"
+            "scatter and in-kernel dequant ride the ONE donated "
+            "program)" % kvq["decode_dispatches_per_step"])
+    if kvq["steady_state_compiles"] != 0:
+        raise AssertionError(
+            "int8 serving recompiled %d time(s) under churn "
+            "(contract: the page dtype is baked at engine build, "
+            "never a steady-state shape change)"
+            % kvq["steady_state_compiles"])
     spec = result["spec"]
     if spec["speedup_tokens_per_sec"] < 1.5:
         raise AssertionError(
@@ -1233,6 +1277,9 @@ def bench_serve():
             pfx["prefill_token_reduction"],
         "prefix_hit_rate": pfx["hit_rate"],
         "gqa_resident_multiplier": gqa["resident_multiplier"],
+        "kvq_resident_multiplier": kvq["resident_multiplier"],
+        "kvq_token_match_rate": kvq["token_match_rate"],
+        "kvq_dequant_max_err": kvq["dequant_max_err"],
         "spec_speedup": spec["speedup_tokens_per_sec"],
         "spec_tokens_per_slot_step": spec["tokens_per_slot_step"],
         "spec_acceptance_rate": spec["acceptance_rate"],

@@ -65,6 +65,8 @@ from . import visualization
 from . import visualization as viz
 from . import gluon
 from . import config
+from . import precision
+from .precision import PrecisionPolicy, LossScaler
 from . import predictor
 from .predictor import Predictor
 from . import serving

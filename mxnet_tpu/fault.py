@@ -96,6 +96,15 @@ Sites wired in this package:
                           stay exactly the non-speculative one — the
                           self-correction law that makes draft quality
                           a throughput knob, never a correctness one.
+- ``serve.kv.scale_poison`` corrupt one resident request's int8 page
+                          scales (NaN into the K/V scale pools between
+                          serving steps, ISSUE 20): the quantized
+                          decode program's per-slot finite-logits
+                          guard flags the victim, which rolls back and
+                          re-prefills through the dense path —
+                          ``serving.kv.scale_repairs`` counts it, the
+                          repaired stream matches the unfaulted
+                          reference, unpoisoned residents untouched.
 - ``rpc.drop``            a serving RPC reply is blackholed: the server
                           processes the request (an accepted submit IS
                           journaled — the client retry dedups) but

@@ -591,11 +591,30 @@ def generate(net, prompt_ids, n_new, temperature=0.0, seed=0, top_k=0,
 # generate, paged decode, training forward) together.
 
 
-def decode_params(net, kv_heads=None):
+def _apply_precision(p, policy):
+    """Cast a decode-param tree per a PrecisionPolicy (None = as-is)."""
+    if policy is None:
+        return p
+    out = {k: policy.cast_params(v, "embed" if k in ("wte", "wpe")
+                                 else "final")
+           for k, v in p.items() if k != "layers"}
+    out["layers"] = [policy.cast_params(lp, "blocks.%d" % i)
+                     for i, lp in enumerate(p["layers"])]
+    return out
+
+
+def decode_params(net, kv_heads=None, policy=None):
     """Public alias of the decode-path parameter indexer (fp32 values
     keyed by layer) — the tree ``paged_decode_step``/``paged_prefill``
     take as ``p``, and what :class:`mxnet_tpu.serving.ServingEngine`
     snapshots at construction.
+
+    ``policy``: optional :class:`mxnet_tpu.precision.PrecisionPolicy`.
+    Each transformer block's leaves are cast to the policy's resolved
+    ``param`` dtype for ``blocks.<i>``; the embeddings and final LN
+    resolve under ``embed`` / ``final`` — serving precision is one
+    instance of the general per-layer policy, with the KV-page dtype
+    (``policy.kv_dtype``) handled separately by the engine's pools.
 
     ``kv_heads``: serve with ``K_kv <= H`` KV heads (grouped-query /
     multi-query attention, ISSUE 15).  ``None`` or ``H`` keeps the
@@ -607,11 +626,11 @@ def decode_params(net, kv_heads=None):
     ``qkv_w``."""
     p = _decode_params(net)
     if kv_heads is None:
-        return p
+        return _apply_precision(p, policy)
     n_heads = net.blocks._children[0].attn._num_heads
     kv_heads = int(kv_heads)
     if kv_heads == n_heads:
-        return p
+        return _apply_precision(p, policy)
     if kv_heads < 1 or n_heads % kv_heads:
         raise ValueError(
             "kv_heads must divide the model's %d query heads, got %d"
@@ -628,7 +647,7 @@ def decode_params(net, kv_heads=None):
                                .mean(axis=1).reshape(kv_heads * d, -1))
             lp[name + "_b"] = (b[:, idx].reshape(kv_heads, g, d)
                                .mean(axis=1).reshape(kv_heads * d))
-    return p
+    return _apply_precision(p, policy)
 
 
 def _block_qkv_kv(lp, x, n_heads):
@@ -660,6 +679,64 @@ def _bcast_kv(k, n_heads):
     if kv_heads == n_heads:
         return k
     return jnp.repeat(k, n_heads // kv_heads, axis=1)
+
+
+_KV_QMAX = 127.0
+
+
+def _kv_quantized(kv_pages):
+    """A per-layer entry is ``(k, v)`` for full-precision pools or
+    ``(k, v, k_scales, v_scales)`` for int8 pools with fp32
+    ``[num_pages, K_kv]`` absmax scales (ISSUE 20)."""
+    return len(kv_pages[0]) == 4
+
+
+def _quant_scatter(pool, scales, phys, offs, rows, mask):
+    """Scatter one program's K or V rows into an INT8 page pool under
+    per-page-per-KV-head absmax scales.
+
+    ``phys``/``offs``: int32 [R] physical page + in-page offset per
+    row; ``rows``: fp32 [R, K_kv, D]; ``mask``: bool [R] (False rows
+    route to scratch page 0, same as the full-precision scatter).
+    Scale discipline:
+
+    - a page receiving a row at offset 0 is FRESH (just allocated —
+      its payload and its scale slot are stale pool-reuse garbage):
+      its scale resets to 0 first, so reuse can never leak a scale;
+    - a page's scale GROWS monotonically while it is written:
+      ``s_new = max(s_base, rowmax / 127)``, and the page's existing
+      payload is re-expressed under the grown scale
+      (``round(int8 * s_old / s_new)``) — an exact identity when the
+      scale did not grow (ratio is exactly 1.0), one bounded rounding
+      when it did.  The non-fresh writers are the decode/spec tail
+      page and the copy-on-write page, both privately owned, so the
+      whole-page rewrite can never race another reader;
+    - new rows quantize under the page's FINAL scale, so scatter
+      order within one call cannot matter.
+
+    Returns ``(new_pool, new_scales)``.
+    """
+    import jax.numpy as jnp
+    rows = rows * mask[:, None, None]
+    tgt = jnp.where(mask, phys, 0)
+    fresh_tgt = jnp.where(mask & (offs == 0), phys, 0)
+    rowmax = jnp.abs(rows).max(-1)                     # [R, K_kv]
+    s0 = scales.at[fresh_tgt].set(0.0)
+    s_pre = s0[tgt]                                    # [R, K_kv]
+    s1 = s0.at[tgt].max(rowmax / _KV_QMAX)
+    s_post = s1[tgt]
+    # duplicate rows landing in one page write IDENTICAL rescaled
+    # payloads (same s_pre/s_post), so the duplicate-index scatter is
+    # deterministic
+    ratio = jnp.where(s_post > 0, s_pre / s_post, 0.0)
+    old = pool[tgt].astype(jnp.float32)                # [R, page, KV, D]
+    rescaled = jnp.clip(jnp.round(old * ratio[:, None, :, None]),
+                        -_KV_QMAX, _KV_QMAX)
+    p1 = pool.at[tgt].set(rescaled.astype(pool.dtype))
+    q = jnp.clip(
+        jnp.round(rows / jnp.maximum(s_post, 1e-30)[:, :, None]),
+        -_KV_QMAX, _KV_QMAX)
+    return p1.at[tgt, offs].set(q.astype(pool.dtype)), s1
 
 
 def _filter_logits_per_slot(logits, top_k, top_p):
@@ -728,7 +805,13 @@ def paged_decode_step(p, tokens, positions, active, kv_pages,
     - ``kv_pages``: list of per-layer ``(k_pages, v_pages)``, each
       [num_pages, page_size, K_kv, D] — donated by the caller's jit.
       ``K_kv < n_heads`` is grouped-query attention: the layer dicts
-      must be the matching :func:`decode_params` conversion;
+      must be the matching :func:`decode_params` conversion.  Pools
+      may be any float dtype (bf16 halves bytes, values cast on
+      scatter); an entry of ``(k, v, k_scales, v_scales)`` with int8
+      pools selects QUANTIZED storage (ISSUE 20): absmax
+      quantize-on-scatter here, dequant inside the paged kernel (see
+      :func:`_quant_scatter`) — every paged program in this module
+      accepts the same entry forms;
     - ``block_tables``: int32 [S, max_pages_per_seq];
     - ``sampling``: None for greedy argmax (the pre-ISSUE-15 contract,
       bit-identical), or ``(temps [S], top_ks [S], top_ps [S],
@@ -756,14 +839,29 @@ def paged_decode_step(p, tokens, positions, active, kv_pages,
     # the kernel masks keys at position >= ctx; this step's own token is
     # key position `positions`, so the inclusive context is positions+1
     ctx = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+    quantized = _kv_quantized(kv_pages)
     new_pages = []
-    for lp, (kc, vc) in zip(p["layers"], kv_pages):
+    for lp, entry in zip(p["layers"], kv_pages):
         q, k, v = _block_qkv_kv(lp, x, n_heads)     # q [S, H, 1, D]
-        kc = kc.at[phys, offs].set(k[:, :, 0, :])   # k/v [S, K_kv, 1, D]
-        vc = vc.at[phys, offs].set(v[:, :, 0, :])
-        o = paged_attention(q[:, :, 0, :], kc, vc, block_tables, ctx)
+        if quantized:
+            kc, vc, ks, vs = entry                  # k/v [S, K_kv, 1, D]
+            kc, ks = _quant_scatter(kc, ks, phys, offs, k[:, :, 0, :],
+                                    active)
+            vc, vs = _quant_scatter(vc, vs, phys, offs, v[:, :, 0, :],
+                                    active)
+            o = paged_attention(q[:, :, 0, :], kc, vc, block_tables,
+                                ctx, k_scales=ks, v_scales=vs)
+            new_pages.append((kc, vc, ks, vs))
+        else:
+            kc, vc = entry
+            kc = kc.at[phys, offs].set(
+                k[:, :, 0, :].astype(kc.dtype))
+            vc = vc.at[phys, offs].set(
+                v[:, :, 0, :].astype(vc.dtype))
+            o = paged_attention(q[:, :, 0, :], kc, vc, block_tables,
+                                ctx)
+            new_pages.append((kc, vc))
         x = _block_finish(lp, x, o.reshape(s_n, 1, c))
-        new_pages.append((kc, vc))
     h = _ln(x[:, 0], p["lnf_g"], p["lnf_b"])
     logits = h @ p["wte"].T
     if sampling is None:
@@ -917,15 +1015,33 @@ def paged_spec_decode_step(p, tokens, positions, active, draft_len,
                      0)
     offs = positions % page_size
     ctx = jnp.where(qmask, positions + 1, 0).astype(jnp.int32)
+    quantized = _kv_quantized(kv_pages)
+    flat = lambda a: a.reshape(s_n * k1)
     new_pages = []
-    for lp, (kc, vc) in zip(p["layers"], kv_pages):
+    for lp, entry in zip(p["layers"], kv_pages):
         q, k, v = _block_qkv_kv(lp, x, n_heads)   # q [S, H, K, D]
-        kc = kc.at[phys, offs].set(k.transpose(0, 2, 1, 3))
-        vc = vc.at[phys, offs].set(v.transpose(0, 2, 1, 3))
-        o = paged_attention_multi(q.transpose(0, 2, 1, 3), kc, vc,
-                                  block_tables, ctx)   # [S, K, H, D]
+        kr = k.transpose(0, 2, 1, 3)              # [S, K, K_kv, D]
+        vr = v.transpose(0, 2, 1, 3)
+        if quantized:
+            kc, vc, ks, vs = entry
+            kc, ks = _quant_scatter(
+                kc, ks, flat(phys), flat(offs),
+                kr.reshape((s_n * k1,) + kr.shape[2:]), flat(qmask))
+            vc, vs = _quant_scatter(
+                vc, vs, flat(phys), flat(offs),
+                vr.reshape((s_n * k1,) + vr.shape[2:]), flat(qmask))
+            o = paged_attention_multi(q.transpose(0, 2, 1, 3), kc, vc,
+                                      block_tables, ctx, k_scales=ks,
+                                      v_scales=vs)  # [S, K, H, D]
+            new_pages.append((kc, vc, ks, vs))
+        else:
+            kc, vc = entry
+            kc = kc.at[phys, offs].set(kr.astype(kc.dtype))
+            vc = vc.at[phys, offs].set(vr.astype(vc.dtype))
+            o = paged_attention_multi(q.transpose(0, 2, 1, 3), kc, vc,
+                                      block_tables, ctx)
+            new_pages.append((kc, vc))
         x = _block_finish(lp, x, o.reshape(s_n, k1, c))
-        new_pages.append((kc, vc))
     h = _ln(x, p["lnf_g"], p["lnf_b"])
     logits = h @ p["wte"].T                            # [S, K, V]
     draft_valid = qmask[:, 1:]          # draft at input column i+1
@@ -1018,8 +1134,10 @@ def paged_prefill(p, tokens, prompt_len, block_table_row, kv_pages,
             & valid[None, :])[None, None]
     phys = jnp.where(valid, block_table_row[pos // page_size], 0)
     offs = pos % page_size
+    quantized = _kv_quantized(kv_pages)
     new_pages = []
-    for lp, (kc, vc) in zip(p["layers"], kv_pages):
+    for lp, entry in zip(p["layers"], kv_pages):
+        kc, vc = entry[0], entry[1]
         q, k, v = _block_qkv_kv(lp, x, n_heads)   # [1, H|K_kv, T_pad, D]
         kd, vd = _bcast_kv(k, n_heads), _bcast_kv(v, n_heads)
         st = jnp.einsum("bhqd,bhkd->bhqk", q, kd) / jnp.sqrt(
@@ -1028,10 +1146,20 @@ def paged_prefill(p, tokens, prompt_len, block_table_row, kv_pages,
         pr = jax.nn.softmax(st, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", pr, vd)
         o = o.transpose(0, 2, 1, 3).reshape(1, t_pad, c)
-        kc = kc.at[phys, offs].set(k[0].transpose(1, 0, 2))
-        vc = vc.at[phys, offs].set(v[0].transpose(1, 0, 2))
+        if quantized:
+            ks, vs = entry[2], entry[3]
+            kc, ks = _quant_scatter(kc, ks, phys, offs,
+                                    k[0].transpose(1, 0, 2), valid)
+            vc, vs = _quant_scatter(vc, vs, phys, offs,
+                                    v[0].transpose(1, 0, 2), valid)
+            new_pages.append((kc, vc, ks, vs))
+        else:
+            kc = kc.at[phys, offs].set(
+                k[0].transpose(1, 0, 2).astype(kc.dtype))
+            vc = vc.at[phys, offs].set(
+                v[0].transpose(1, 0, 2).astype(vc.dtype))
+            new_pages.append((kc, vc))
         x = _block_finish(lp, x, o)
-        new_pages.append((kc, vc))
     h = _ln(x[0], p["lnf_g"], p["lnf_b"])             # [T_pad, C]
     last = lax.dynamic_index_in_dim(h, prompt_len - 1, 0,
                                     keepdims=False)
@@ -1092,18 +1220,34 @@ def paged_suffix_prefill(p, tokens, prompt_len, prefix_len,
     mask_pre = pre_valid[None, None, None, :]
     phys = jnp.where(valid, block_table_row[positions // page_size], 0)
     offs = positions % page_size
+    quantized = _kv_quantized(kv_pages)
     new_pages = []
-    for lp, (kc, vc) in zip(p["layers"], kv_pages):
+    for entry_i, lp in enumerate(p["layers"]):
+        entry = kv_pages[entry_i]
+        kc, vc = entry[0], entry[1]
         # copy-on-write FIRST: the gather below must see the copy
         kc = kc.at[cow_dst].set(kc[cow_src])
         vc = vc.at[cow_dst].set(vc[cow_src])
+        if quantized:
+            # the copy carries the donor page's SCALE row with its
+            # bytes — a COW page dequantizes identically to its donor
+            ks, vs = entry[2], entry[3]
+            ks = ks.at[cow_dst].set(ks[cow_src])
+            vs = vs.at[cow_dst].set(vs[cow_src])
+            kg = (kc[block_table_row].astype(jnp.float32)
+                  * ks[block_table_row][:, None, :, None])
+            vg = (vc[block_table_row].astype(jnp.float32)
+                  * vs[block_table_row][:, None, :, None])
+        else:
+            kg = kc[block_table_row].astype(jnp.float32)
+            vg = vc[block_table_row].astype(jnp.float32)
         q, k, v = _block_qkv_kv(lp, x, n_heads)
         kd, vd = _bcast_kv(k, n_heads), _bcast_kv(v, n_heads)
         # cached prefix K/V, gathered through the block table:
         # [mp, page, K_kv, D] -> [1, H, t_ctx, D]
-        kp = _bcast_kv(kc[block_table_row].reshape(
+        kp = _bcast_kv(kg.reshape(
             t_ctx, -1, d).transpose(1, 0, 2)[None], n_heads)
-        vp = _bcast_kv(vc[block_table_row].reshape(
+        vp = _bcast_kv(vg.reshape(
             t_ctx, -1, d).transpose(1, 0, 2)[None], n_heads)
         # positions past the cached prefix read scratch/unwritten pages
         # whose contents are GARBAGE — a NaN there (e.g. a hot-swap
@@ -1123,10 +1267,22 @@ def paged_suffix_prefill(p, tokens, prompt_len, prefix_len,
         o = jnp.einsum("bhqk,bhkd->bhqd", pr,
                        jnp.concatenate([vp, vd], axis=2))
         o = o.transpose(0, 2, 1, 3).reshape(1, t_pad, c)
-        kc = kc.at[phys, offs].set(k[0].transpose(1, 0, 2))
-        vc = vc.at[phys, offs].set(v[0].transpose(1, 0, 2))
+        if quantized:
+            # the COW page is the only written page with pre-existing
+            # content; _quant_scatter's grow-only rescale handles it
+            # (fresh pages start at an offs == 0 row and reset)
+            kc, ks = _quant_scatter(kc, ks, phys, offs,
+                                    k[0].transpose(1, 0, 2), valid)
+            vc, vs = _quant_scatter(vc, vs, phys, offs,
+                                    v[0].transpose(1, 0, 2), valid)
+            new_pages.append((kc, vc, ks, vs))
+        else:
+            kc = kc.at[phys, offs].set(
+                k[0].transpose(1, 0, 2).astype(kc.dtype))
+            vc = vc.at[phys, offs].set(
+                v[0].transpose(1, 0, 2).astype(vc.dtype))
+            new_pages.append((kc, vc))
         x = _block_finish(lp, x, o)
-        new_pages.append((kc, vc))
     h = _ln(x[0], p["lnf_g"], p["lnf_b"])             # [T_pad, C]
     last = lax.dynamic_index_in_dim(h, suffix_len - 1, 0,
                                     keepdims=False)

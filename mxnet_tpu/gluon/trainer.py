@@ -69,6 +69,16 @@ class Trainer:
         self._fused = None  # fused tree-wide step cache
         self._consec_guard_skips = 0  # divergence-guard skip streak
         self._pending_verdict = None  # (ok, indices, pre_num_update)
+        self._precision = None  # PrecisionPolicy (mxnet_tpu.precision)
+
+    def set_precision(self, policy):
+        """Install a :class:`mxnet_tpu.precision.PrecisionPolicy` (or
+        None).  Its fingerprint keys the fused tree-wide step; its loss
+        scaler threads through the dynamic ``rescale_grad`` scalar and
+        consumes the (one-step-late) divergence-guard verdict."""
+        self._precision = policy
+        self._fused_flush_to_updater()
+        self._fused = None
 
     def _init_kvstore(self):
         arg_arrays = {param.name: param.data() for param in self._params
@@ -210,11 +220,12 @@ class Trainer:
         mults = optimizer.fused_mults(idx2key)
         from ..ops.optimizer_ops import zero_stage
         want_zero = zero_stage() >= 1
+        from ..precision import policy_fingerprint
         cache_key = (id(optimizer), kind, tuple(keys),
                      tuple(sorted(mults.items())),
                      tuple(sorted(optimizer.fused_hyper().items())),
                      tuple(p.shape for _, p in live),
-                     want_zero)
+                     want_zero, policy_fingerprint(self._precision))
         if self._fused is None or self._fused["key"] != cache_key:
             # sharding resolution only on rebuild — step() is hot
             zero = self._zero_shardings(live) if want_zero else None
@@ -288,9 +299,16 @@ class Trainer:
         t = float(optimizer._index_update_count[first])
         poison = float("nan") if _fault.trigger("grad.nan") else 0.0
         t0 = time.perf_counter_ns()
+        rescale = float(optimizer.rescale_grad)
+        scaler = getattr(self._precision, "loss_scaler", None)
+        if scaler is not None:
+            # loss scaling (precision.py): the loss was pre-scaled by
+            # scaler.scale; undo it on the grads through the dynamic
+            # rescale scalar — scale moves never recompile
+            rescale *= scaler.unscale
         new_params, new_state, ok = fused["step"](
             params, grads, fused["state"], optimizer.fused_base_lr(),
-            float(optimizer.wd), float(optimizer.rescale_grad), t, poison)
+            float(optimizer.wd), rescale, t, poison)
         t1 = time.perf_counter_ns()
         fused["state"] = new_state
         # donation killed the old buffers — write back even on a skipped
@@ -324,9 +342,15 @@ class Trainer:
         from ..ops.optimizer_ops import handle_guard_verdict
         ok, indices, pre_num_update = self._pending_verdict
         self._pending_verdict = None
+        ok_host = bool(ok)
         self._consec_guard_skips = handle_guard_verdict(
-            ok, self._optimizer, indices, self._consec_guard_skips,
+            ok_host, self._optimizer, indices, self._consec_guard_skips,
             pre_num_update, raise_on_limit=False, backfill_verdict=True)
+        scaler = getattr(self._precision, "loss_scaler", None)
+        if scaler is not None:
+            # same (one-step-late) verdict the guard acted on: backoff
+            # on skip, growth on streak — skip accounting untouched
+            scaler.update(ok_host)
 
     def _fused_flush_to_updater(self):
         # state hand-offs and saves must see a settled optimizer clock

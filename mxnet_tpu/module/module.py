@@ -79,6 +79,7 @@ class Module(BaseModule):
         self._preload_opt_states = None
         self._fused = None  # fused fit_step cache (program + opt state)
         self._consec_guard_skips = 0  # divergence-guard skip streak
+        self._precision = None  # PrecisionPolicy (mxnet_tpu.precision)
 
         self._exec = None
         self._data_shapes = None
@@ -419,6 +420,16 @@ class Module(BaseModule):
         feeds = self._feed_batch(data_batch)
         self._exec.forward_backward(**feeds)
 
+    def set_precision(self, policy):
+        """Install a :class:`mxnet_tpu.precision.PrecisionPolicy` (or
+        None to clear).  The policy's fingerprint keys the fused-step
+        program — changing it rebuilds instead of replaying a stale
+        executable — and its loss scaler (if any) threads through the
+        step's dynamic ``rescale_grad`` and consumes the divergence-
+        guard verdict (skip accounting unchanged)."""
+        self._precision = policy
+        self._fused = None
+
     # -- fused fit step ----------------------------------------------------
     def _fused_eligible(self):
         """Can this configuration run fwd+bwd+update as ONE donated XLA
@@ -470,10 +481,12 @@ class Module(BaseModule):
         want_zero = zero_stage() >= 1 and mesh is not None and \
             self._exec._dp_axis in mesh.shape and \
             mesh.shape[self._exec._dp_axis] > 1
+        from ..precision import policy_fingerprint
+        precision_fp = policy_fingerprint(self._precision)
         key = (id(opt), kind, tuple(update_names),
                tuple(sorted(mults.items())),
                tuple(sorted(opt.fused_hyper().items())),
-               want_zero)
+               want_zero, precision_fp)
         if self._fused is not None and self._fused["key"] == key:
             return self._fused
         zero = self._exec.zero_shardings(update_names) \
@@ -482,7 +495,7 @@ class Module(BaseModule):
                                                     zero_shardings=zero)
         params = {n: self._exec.arg_dict[n] for n in update_names}
         if self._fused is not None and self._fused["kind"] == kind and \
-                self._fused["key"][-1] == (zero is not None) and \
+                self._fused["key"][-2] == (zero is not None) and \
                 set(self._fused["state"]) == set(update_names):
             state = self._fused["state"]  # mults changed; state carries
         else:
@@ -508,7 +521,8 @@ class Module(BaseModule):
         cache_extra = repr((graph, type(opt).__name__, kind,
                             tuple(update_names),
                             tuple(sorted(mults.items())),
-                            tuple(sorted(opt.fused_hyper().items()))))
+                            tuple(sorted(opt.fused_hyper().items())),
+                            precision_fp))
         self._fused = {
             "key": key, "kind": kind, "update_names": update_names,
             "state": state, "zero": zero,
@@ -627,6 +641,12 @@ class Module(BaseModule):
         lr = opt.fused_base_lr()
         wd = float(opt.wd)
         rescale = float(opt.rescale_grad)
+        scaler = getattr(self._precision, "loss_scaler", None)
+        if scaler is not None:
+            # loss scaling (precision.py): the graph's loss head is
+            # pre-scaled by scaler.scale; undo it on the grads through
+            # the DYNAMIC rescale scalar — scale moves never recompile
+            rescale *= scaler.unscale
         poison = float("nan") if _fault.trigger("grad.nan") else 0.0
 
         rng = _random.next_key()
@@ -670,6 +690,11 @@ class Module(BaseModule):
         self._consec_guard_skips = handle_guard_verdict(
             ok_host, opt, update_idxs, self._consec_guard_skips,
             pre_num_update)
+        if scaler is not None:
+            # the scaler consumes the SAME verdict the guard already
+            # acted on: backoff on a skipped step, growth on a clean
+            # streak — skipped_steps accounting is untouched
+            scaler.update(ok_host)
 
     def update(self):
         """Apply optimizer using accumulated grads (reference module.py:615)."""

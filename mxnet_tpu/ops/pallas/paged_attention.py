@@ -37,6 +37,16 @@ interpreter, so CPU tests exercise the identical code path.
 
 All matmuls accumulate in fp32 (MXU ``preferred_element_type``), same
 discipline as flash_attention.py.
+
+**Quantized pages** (ISSUE 20): with ``k_scales``/``v_scales`` given
+(fp32 ``[num_pages, K_kv]`` — one absmax scale per page per KV head)
+the pools may hold int8 payloads; each kernel cell dequantizes its ONE
+fetched page row in VMEM (``int8 * scale``) right before the score
+matmul, so HBM moves a quarter of the fp32 bytes while scores, softmax
+and the output accumulate in fp32 exactly as before.  The scale rows
+ride the SAME block-table index map as their pages — the gather stays
+the address computation.  ``k_scales is None`` is byte-for-byte the
+pre-quantization kernel (same specs, same op order, same AOT keys).
 """
 from __future__ import annotations
 
@@ -60,9 +70,8 @@ def _scratch(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
-def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                   o_acc, m_acc, l_acc, *, page_size, n_heads, n_kv,
-                   scale):
+def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, *rest,
+                   page_size, n_heads, n_kv, scale, quantized=False):
     """One (slot, page) grid step: online-softmax accumulate the
     physical page the block table routed in.  The KV-head axis is an
     UNROLLED loop of 2-D matmuls inside the cell — each KV head's
@@ -73,7 +82,13 @@ def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     overhead is most of the decode step's cost).  ``ctx_ref``/
     ``bt_ref`` are the scalar-prefetched context lengths and block
     table (the index maps already consumed ``bt_ref`` for the page
-    gather; only masking reads it here)."""
+    gather; only masking reads it here).  With ``quantized`` the cell
+    additionally receives the page's (1, n_kv) scale rows and
+    dequantizes the fetched K/V in VMEM before the fp32 matmuls."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, o_acc, m_acc, l_acc = rest
+    else:
+        o_ref, o_acc, m_acc, l_acc = rest
     pl = _pl()
     s = pl.program_id(0)
     j = pl.program_id(1)
@@ -99,6 +114,9 @@ def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
             q = q_ref[0, grp, :].astype(jnp.float32) * scale   # (g, D)
             k = k_ref[0, :, kv, :].astype(jnp.float32)   # (page, D)
             v = v_ref[0, :, kv, :].astype(jnp.float32)   # (page, D)
+            if quantized:
+                k = k * ks_ref[0, kv]
+                v = v * vs_ref[0, kv]
             st = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)        # (g, page)
@@ -122,8 +140,23 @@ def _decode_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (o_acc[...] / l_safe).astype(o_ref.dtype)
 
 
+def _check_scales(k_pages, k_scales, v_scales):
+    """Both scale pools or neither; shape must be [num_pages, K_kv]."""
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be given together")
+    if k_scales is None:
+        return False
+    want = (k_pages.shape[0], k_pages.shape[2])
+    for name, s in (("k_scales", k_scales), ("v_scales", v_scales)):
+        if tuple(s.shape) != want:
+            raise ValueError(
+                "%s must be [num_pages, K_kv] = %r, got %r"
+                % (name, want, tuple(s.shape)))
+    return True
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
-                    scale=None):
+                    scale=None, k_scales=None, v_scales=None):
     """Decode attention for every resident slot in ONE kernel launch.
 
     - ``q``: [S, H, D] — the current token's query per slot;
@@ -136,7 +169,12 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     - ``block_tables``: int32 [S, max_pages_per_seq] — logical page j of
       slot s lives in physical page ``block_tables[s, j]``;
     - ``context_lens``: int32 [S] — tokens of history per slot (0 for an
-      empty slot, whose output row is zeros).
+      empty slot, whose output row is zeros);
+    - ``k_scales``/``v_scales``: optional fp32 [num_pages, K_kv] —
+      per-page-per-KV-head dequant scales for quantized (int8) pools;
+      each cell multiplies its fetched page row by its scale row in
+      VMEM before the fp32 score matmul.  ``None`` (the default) is
+      the identical pre-quantization kernel.
 
     Returns [S, H, D] in ``q``'s dtype.  Raggedness is free of FLOPs:
     pages past ``context_lens[s]`` are skipped, the final partial page
@@ -151,38 +189,48 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         raise ValueError(
             "query heads (%d) must be a multiple of KV heads (%d)"
             % (h, n_kv))
+    quantized = _check_scales(k_pages, k_scales, v_scales)
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = d ** -0.5
     ctx = jnp.asarray(context_lens, jnp.int32)
     bt = jnp.asarray(block_tables, jnp.int32)
 
+    page_spec = lambda: pl.BlockSpec(                       # noqa: E731
+        (1, page_size, n_kv, d), lambda s, j, c, b: (b[s, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda s, j, c, b: (s, 0, 0)),
+        page_spec(), page_spec(),
+    ]
+    args = [ctx, bt, q, k_pages, v_pages]
+    if quantized:
+        # the scale rows ride the SAME logical->physical translation as
+        # their pages — one (1, n_kv) row per fetched page
+        scale_spec = lambda: pl.BlockSpec(                  # noqa: E731
+            (1, n_kv), lambda s, j, c, b: (b[s, j], 0))
+        in_specs += [scale_spec(), scale_spec()]
+        args += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s_n, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda s, j, c, b: (s, 0, 0)),
-            pl.BlockSpec((1, page_size, n_kv, d),
-                         lambda s, j, c, b: (b[s, j], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, n_kv, d),
-                         lambda s, j, c, b: (b[s, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda s, j, c, b: (s, 0, 0)),
         scratch_shapes=[_scratch((h, d)), _scratch((h, 1)),
                         _scratch((h, 1))],
     )
     return pl.pallas_call(
         functools.partial(_decode_kernel, page_size=page_size,
-                          n_heads=h, n_kv=n_kv, scale=float(scale)),
+                          n_heads=h, n_kv=n_kv, scale=float(scale),
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_n, h, d), q.dtype),
         interpret=_use_interpret(),
-    )(ctx, bt, q, k_pages, v_pages)
+    )(*args)
 
 
-def _verify_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                   o_acc, m_acc, l_acc, *, page_size, n_heads, n_kv,
-                   n_q, scale):
+def _verify_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, *rest,
+                   page_size, n_heads, n_kv, n_q, scale,
+                   quantized=False):
     """One (slot, page) grid step of the speculative-verify sweep: the
     SAME page stream as ``_decode_kernel`` but ``n_q`` query positions
     per slot, each with its OWN context length (query position ``i``
@@ -199,6 +247,10 @@ def _verify_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     are laid out ``[n_q * n_heads, D]`` KV-head major: row
     ``kv * n_q * g + i * g + h`` holds position ``i``, group head
     ``h`` of KV head ``kv``."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, o_acc, m_acc, l_acc = rest
+    else:
+        o_ref, o_acc, m_acc, l_acc = rest
     pl = _pl()
     s = pl.program_id(0)
     j = pl.program_id(1)
@@ -228,8 +280,13 @@ def _verify_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         q = (q_ref[0].astype(jnp.float32) * scale).reshape(
             n_q, n_kv, g, d).transpose(1, 0, 2, 3).reshape(
             n_kv, n_q * g, d)
+        kf = k_ref[0].astype(jnp.float32)          # (page, KV, D)
+        vf = v_ref[0].astype(jnp.float32)
+        if quantized:
+            kf = kf * ks_ref[0][None, :, None]
+            vf = vf * vs_ref[0][None, :, None]
         st = jax.lax.dot_general(
-            q, k_ref[0].astype(jnp.float32),
+            q, kf,
             (((2,), (2,)), ((0,), (1,))),
             preferred_element_type=jnp.float32)    # [KV, n_q * g, page]
         st = jnp.where(maskf > 0, st, _NEG_INF)
@@ -241,7 +298,7 @@ def _verify_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
             p.sum(axis=-1, keepdims=True)
         o_new = o_acc[...].reshape(n_kv, n_q * g, d) * corr + \
             jax.lax.dot_general(
-                p, v_ref[0].astype(jnp.float32),
+                p, vf,
                 (((2,), (0,)), ((0,), (1,))),
                 preferred_element_type=jnp.float32)
         m_acc[...] = m_new.reshape(n_kv * n_q * g, 1)
@@ -258,7 +315,8 @@ def _verify_kernel(ctx_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention_multi(q, k_pages, v_pages, block_tables,
-                          context_lens, scale=None):
+                          context_lens, scale=None, k_scales=None,
+                          v_scales=None):
     """Speculative-verify attention: ``n_q`` query positions per slot in
     ONE kernel launch over the same paged pools.
 
@@ -273,7 +331,9 @@ def paged_attention_multi(q, k_pages, v_pages, block_tables,
     Same grid, page stream, and per-page online softmax as
     :func:`paged_attention` — one page fetch serves all G positions —
     so ``G == 1`` with the same contexts reproduces the single-query
-    kernel's op order exactly.  Returns [S, G, H, D].
+    kernel's op order exactly.  ``k_scales``/``v_scales`` dequantize
+    the fetched page in VMEM exactly as in :func:`paged_attention`.
+    Returns [S, G, H, D].
     """
     pl = _pl()
     from jax.experimental.pallas import tpu as pltpu
@@ -284,6 +344,7 @@ def paged_attention_multi(q, k_pages, v_pages, block_tables,
         raise ValueError(
             "query heads (%d) must be a multiple of KV heads (%d)"
             % (h, n_kv))
+    quantized = _check_scales(k_pages, k_scales, v_scales)
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = d ** -0.5
@@ -294,17 +355,23 @@ def paged_attention_multi(q, k_pages, v_pages, block_tables,
             % ((s_n, n_q), tuple(ctx.shape)))
     bt = jnp.asarray(block_tables, jnp.int32)
 
+    page_spec = lambda: pl.BlockSpec(                       # noqa: E731
+        (1, page_size, n_kv, d), lambda s, j, c, b: (b[s, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, n_q, h, d),
+                     lambda s, j, c, b: (s, 0, 0, 0)),
+        page_spec(), page_spec(),
+    ]
+    args = [ctx, bt, q, k_pages, v_pages]
+    if quantized:
+        scale_spec = lambda: pl.BlockSpec(                  # noqa: E731
+            (1, n_kv), lambda s, j, c, b: (b[s, j], 0))
+        in_specs += [scale_spec(), scale_spec()]
+        args += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s_n, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, n_q, h, d),
-                         lambda s, j, c, b: (s, 0, 0, 0)),
-            pl.BlockSpec((1, page_size, n_kv, d),
-                         lambda s, j, c, b: (b[s, j], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, n_kv, d),
-                         lambda s, j, c, b: (b[s, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_q, h, d),
                                lambda s, j, c, b: (s, 0, 0, 0)),
         scratch_shapes=[_scratch((n_q * h, d)),
@@ -314,15 +381,27 @@ def paged_attention_multi(q, k_pages, v_pages, block_tables,
     return pl.pallas_call(
         functools.partial(_verify_kernel, page_size=page_size,
                           n_heads=h, n_kv=n_kv, n_q=n_q,
-                          scale=float(scale)),
+                          scale=float(scale), quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_n, n_q, h, d), q.dtype),
         interpret=_use_interpret(),
-    )(ctx, bt, q, k_pages, v_pages)
+    )(*args)
+
+
+def _dequant_pools(k_pages, v_pages, k_scales, v_scales):
+    """fp32 pools for the oracles: broadcast each page's per-KV-head
+    scale over its (page_size, D) payload."""
+    if _check_scales(k_pages, k_scales, v_scales):
+        k_pages = k_pages.astype(jnp.float32) * \
+            k_scales[:, None, :, None]
+        v_pages = v_pages.astype(jnp.float32) * \
+            v_scales[:, None, :, None]
+    return k_pages, v_pages
 
 
 def paged_attention_multi_reference(q, k_pages, v_pages, block_tables,
-                                    context_lens, scale=None):
+                                    context_lens, scale=None,
+                                    k_scales=None, v_scales=None):
     """jnp oracle for :func:`paged_attention_multi`: per-position dense
     masked softmax over the gathered pages; rows with ``ctx == 0``
     come back zero (the kernel's empty-row contract)."""
@@ -333,6 +412,8 @@ def paged_attention_multi_reference(q, k_pages, v_pages, block_tables,
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = d ** -0.5
+    k_pages, v_pages = _dequant_pools(k_pages, v_pages,
+                                      k_scales, v_scales)
     bt = jnp.asarray(block_tables, jnp.int32)
     ctx = jnp.asarray(context_lens, jnp.int32)
     k_seq = k_pages[bt].reshape(s_n, max_pages * page_size, n_kv, d)
@@ -352,7 +433,8 @@ def paged_attention_multi_reference(q, k_pages, v_pages, block_tables,
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
-                              context_lens, scale=None):
+                              context_lens, scale=None, k_scales=None,
+                              v_scales=None):
     """O(S·T) jnp oracle: gather each slot's pages contiguous, broadcast
     each KV head over its query group, dense masked softmax attention.
     Tests pin the kernel against this and against ``flash_attention``
@@ -364,6 +446,8 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = d ** -0.5
+    k_pages, v_pages = _dequant_pools(k_pages, v_pages,
+                                      k_scales, v_scales)
     bt = jnp.asarray(block_tables, jnp.int32)
     ctx = jnp.asarray(context_lens, jnp.int32)
     # [S, max_pages, page, K_kv, D] -> [S, T_max, K_kv, D]

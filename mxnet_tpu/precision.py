@@ -1,0 +1,211 @@
+"""First-class precision policy (ISSUE 20).
+
+One object answers every "what dtype does X run in?" question instead
+of per-subsystem flags: a :class:`PrecisionPolicy` resolves per-layer
+**param / compute / output** dtypes, optionally carries a serving
+``kv_dtype`` (so the quantized KV pages of serving/kv_cache.py are one
+instance of the general policy, not a one-off flag), and owns the
+:class:`LossScaler` hook the fused training steps consult.
+
+Resolution laws (pinned by tests/test_precision.py):
+
+1. ``compute`` defaults to ``param``; ``output`` defaults to
+   ``compute`` — an unqualified policy never mixes dtypes.
+2. Per-layer ``overrides`` are fnmatch patterns checked in declaration
+   order; the LAST matching pattern wins **field-wise** (a later
+   ``{"compute": ...}`` override keeps an earlier match's ``param``),
+   and unset fields fall through to the policy-wide defaults, then
+   law 1.
+3. Dtype names are canonicalised (``fp32``/``float32``/``np.float32``
+   are one name) so two spellings of the same policy hash identically.
+
+The policy's :meth:`~PrecisionPolicy.fingerprint` is folded into the
+fused-step AOT cache keys (module.Module._fused_setup and
+gluon.Trainer._fused_step): a policy change can never replay a stale
+executable, while the loss scaler's *dynamic* scale — a runtime scalar,
+not program structure — stays out of the hash so scale updates never
+recompile.
+
+Loss scaling rides the PR-2 divergence guard instead of duplicating
+it: the fused step already computes an all-finite verdict and
+``handle_guard_verdict`` already rewinds the optimizer clock on a
+skipped step.  :meth:`LossScaler.update` takes that SAME verdict —
+backoff on a skipped step, growth after a clean streak — so the
+``skipped_steps`` accounting is byte-for-byte what it was without a
+scaler.  The scale itself threads through the fused step's *dynamic*
+``rescale_grad`` scalar (grads are unscaled by ``1/scale`` inside the
+one donated program); callers scale the loss head with
+:meth:`LossScaler.scale_loss` when building the graph.
+"""
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+from collections import namedtuple
+
+__all__ = ["PrecisionPolicy", "LossScaler", "Resolved",
+           "policy_fingerprint"]
+
+#: canonical dtype names the policy speaks, and every accepted spelling
+_CANON = {
+    "fp32": "fp32", "float32": "fp32", "f32": "fp32",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "fp16": "fp16", "float16": "fp16", "f16": "fp16",
+}
+
+_JAX_NAMES = {"fp32": "float32", "bf16": "bfloat16", "fp16": "float16"}
+
+Resolved = namedtuple("Resolved", ["param", "compute", "output"])
+
+
+def _canon_dtype(dt, field):
+    """Canonical short name for a dtype spelling (law 3)."""
+    if dt is None:
+        return None
+    name = getattr(dt, "__name__", None) or getattr(dt, "name", None) \
+        or str(dt)
+    key = name.strip().lower()
+    if key not in _CANON:
+        raise ValueError(
+            "unsupported %s dtype %r (want one of %s)"
+            % (field, dt, "/".join(sorted(set(_CANON.values())))))
+    return _CANON[key]
+
+
+def jax_dtype(name):
+    """jnp dtype object for a canonical policy dtype name."""
+    import jax.numpy as jnp
+    return jnp.dtype(_JAX_NAMES[_canon_dtype(name, "jax")])
+
+
+class LossScaler:
+    """Dynamic (or static) loss scaling, driven by the divergence-guard
+    verdict.  ``update(step_ok)`` is called once per fused step with
+    the guard's all-finite verdict: a skipped step backs the scale off,
+    ``growth_interval`` consecutive good steps grow it.  The scaler
+    never decides whether a step is skipped — that stays the guard's
+    job, so skip accounting is unchanged by its presence."""
+
+    def __init__(self, init_scale=2.0 ** 15, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=200, dynamic=True,
+                 max_scale=2.0 ** 24):
+        if init_scale <= 0:
+            raise ValueError("init_scale must be positive")
+        if not (0.0 < backoff_factor < 1.0):
+            raise ValueError("backoff_factor must be in (0, 1)")
+        if growth_factor <= 1.0:
+            raise ValueError("growth_factor must be > 1")
+        self.scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.dynamic = bool(dynamic)
+        self.max_scale = float(max_scale)
+        self.good_steps = 0
+        self.overflows = 0
+
+    @property
+    def unscale(self):
+        """Multiplier that removes the loss scale from gradients —
+        folded into the fused step's dynamic ``rescale_grad`` scalar
+        (no recompile when the scale moves)."""
+        return 1.0 / self.scale
+
+    def scale_loss(self, loss):
+        """Scale a loss value/symbol/array by the current scale."""
+        return loss * self.scale
+
+    def update(self, step_ok):
+        """Consume one divergence-guard verdict.  Returns the (possibly
+        updated) scale."""
+        if not self.dynamic:
+            return self.scale
+        if step_ok:
+            self.good_steps += 1
+            if self.good_steps >= self.growth_interval:
+                self.scale = min(self.scale * self.growth_factor,
+                                 self.max_scale)
+                self.good_steps = 0
+        else:
+            self.overflows += 1
+            self.good_steps = 0
+            self.scale = max(self.scale * self.backoff_factor, 1.0)
+        return self.scale
+
+    def config_key(self):
+        """Static configuration only — the dynamic scale stays OUT so
+        scale updates never re-key a compiled program."""
+        return ("loss_scaler", self.dynamic, self.growth_factor,
+                self.backoff_factor, self.growth_interval)
+
+
+class PrecisionPolicy:
+    """Per-layer param/compute/output dtype resolution + optional
+    serving ``kv_dtype`` + optional :class:`LossScaler`.
+
+    ``overrides``: ``{fnmatch_pattern: {"param"/"compute"/"output":
+    dtype}}`` applied to layer names in declaration order, last match
+    winning field-wise (law 2)."""
+
+    def __init__(self, param_dtype="fp32", compute_dtype=None,
+                 output_dtype=None, overrides=None, kv_dtype=None,
+                 loss_scaler=None):
+        self.param_dtype = _canon_dtype(param_dtype, "param")
+        self.compute_dtype = _canon_dtype(compute_dtype, "compute")
+        self.output_dtype = _canon_dtype(output_dtype, "output")
+        self.overrides = []
+        for pat, ov in (overrides or {}).items():
+            bad = set(ov) - {"param", "compute", "output"}
+            if bad:
+                raise ValueError("unknown override fields %r for %r"
+                                 % (sorted(bad), pat))
+            self.overrides.append((str(pat), {
+                f: _canon_dtype(v, f) for f, v in ov.items()}))
+        # serving KV-page storage mode: validated by the same authority
+        # the allocator uses, so a policy can't name a mode the pools
+        # can't store
+        if kv_dtype is None:
+            self.kv_dtype = None
+        else:
+            from .serving.kv_cache import normalize_kv_dtype
+            self.kv_dtype = normalize_kv_dtype(kv_dtype)
+        self.loss_scaler = loss_scaler
+
+    def resolve(self, name):
+        """Resolved (param, compute, output) canonical dtype names for
+        layer ``name`` under laws 1–3."""
+        got = {"param": None, "compute": None, "output": None}
+        for pat, ov in self.overrides:
+            if fnmatch.fnmatchcase(str(name), pat):
+                got.update(ov)          # later match wins, field-wise
+        param = got["param"] or self.param_dtype
+        compute = got["compute"] or self.compute_dtype or param
+        output = got["output"] or self.output_dtype or compute
+        return Resolved(param, compute, output)
+
+    def cast_params(self, tree, name="*"):
+        """Cast every array leaf of a (nested) param tree to the
+        resolved ``param`` dtype for ``name`` — how decode_params
+        applies the policy to a serving parameter snapshot."""
+        import jax
+        dt = jax_dtype(self.resolve(name).param)
+        return jax.tree_util.tree_map(lambda a: a.astype(dt), tree)
+
+    def fingerprint(self):
+        """Stable hash of everything that alters compiled programs:
+        dtype layout, overrides, kv_dtype, scaler *configuration*
+        (never its dynamic scale).  Folded into the fused-step AOT
+        cache keys."""
+        scaler = self.loss_scaler.config_key() \
+            if self.loss_scaler is not None else None
+        spec = (self.param_dtype, self.compute_dtype, self.output_dtype,
+                tuple((p, tuple(sorted(ov.items())))
+                      for p, ov in self.overrides),
+                self.kv_dtype, scaler)
+        return hashlib.sha256(repr(spec).encode("utf-8")).hexdigest()
+
+
+def policy_fingerprint(policy):
+    """Fingerprint of an optional policy ('' for None) — what the fused
+    steps fold into their cache keys unconditionally."""
+    return "" if policy is None else policy.fingerprint()

@@ -90,6 +90,19 @@ Capacity multipliers (ISSUE 15):
   ``K_kv <= H`` (decode_params mean-pools the K/V projections), so KV
   bytes per resident token shrink ``H / K_kv``-fold and the same pool
   bytes hold proportionally more sequences;
+- **quantized KV pages** (``kv_dtype=`` / ``MXTPU_SERVE_KV_DTYPE``,
+  ISSUE 20) — ``bf16`` halves and ``int8`` quarters the page payload
+  vs fp32 (int8 adds per-page-per-KV-head fp32 absmax scales:
+  quantize-on-scatter in the programs, dequant inside the paged
+  kernels; scores/softmax/output stay fp32).  Composes
+  multiplicatively with GQA and prefix sharing.  Quantized greedy
+  streams are pinned to THEMSELVES across churn/hot-swap/failover —
+  NOT bit-identical to fp32 (run_kvq's token-match-rate and
+  kernel-vs-oracle gates pin the error).  int8 decode carries a
+  per-slot finite mask — the divergence guard behind the
+  ``serve.kv.scale_poison`` drill (victims re-prefill in place).
+  Telemetry: ``serving.kv.{dtype,bytes_per_token,quant_error}``
+  gauges + ``serving.kv.scale_repairs``;
 - **per-request sampling decode** — temperature/top-k/top-p as
   per-SLOT program inputs plus a seeded per-slot PRNG key advanced
   functionally inside the donated step: same (seed, params, prompt) ->
@@ -126,7 +139,7 @@ from .. import profiler as _profiler
 from .. import telemetry as _telemetry
 from .. import watchdog as _watchdog
 from ..base import MXNetError
-from .kv_cache import PagedKVAllocator, SCRATCH_PAGE
+from .kv_cache import PagedKVAllocator, SCRATCH_PAGE, normalize_kv_dtype
 from .prefix_cache import PrefixCache
 from .scheduler import (CANCELLED, ContinuousBatchingScheduler, EXPIRED,
                         FAILED, FINISHED, QUEUED, RUNNING,
@@ -218,7 +231,7 @@ class ServingEngine:
                  max_prefill_len=32, max_seq_len=None, eos_id=None,
                  record_logits=False, slo=None, default_deadline_s=None,
                  kv_heads=None, prefix_cache=None, spec_k=None,
-                 spec_drafter=None):
+                 spec_drafter=None, kv_dtype=None):
         from ..gluon.model_zoo import gpt as _gpt
 
         self._gpt = _gpt
@@ -233,6 +246,24 @@ class ServingEngine:
             kv_heads = int(os.environ.get("MXTPU_SERVE_KV_HEADS", "0")) \
                 or self._n_heads
         self.kv_heads = int(kv_heads)
+        # quantized KV pages (ISSUE 20): ``kv_dtype`` picks the page
+        # pools' storage — fp32 (default, bit-identical), bf16 (half
+        # the payload bytes, cast on scatter), or int8 (quarter the
+        # bytes: absmax quantize-on-scatter in the programs + per-page-
+        # per-KV-head fp32 scale pools dequantized inside the paged
+        # kernels).  Composes multiplicatively with GQA and prefix
+        # sharing.  Quantized greedy streams are pinned to THEMSELVES
+        # across churn/hot-swap/failover — bit-identity to the fp32
+        # path is explicitly NOT the law (a kernel-vs-oracle tolerance
+        # and the run_kvq token-match-rate gate pin the error instead).
+        # Explicit arg wins; env opt-in via MXTPU_SERVE_KV_DTYPE.
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("MXTPU_SERVE_KV_DTYPE") or None
+        elif hasattr(kv_dtype, "kv_dtype"):
+            # a mxnet_tpu.precision.PrecisionPolicy: the serving page
+            # dtype is one field of the general policy
+            kv_dtype = kv_dtype.kv_dtype
+        self.kv_dtype = normalize_kv_dtype(kv_dtype)
         self._p = _gpt.decode_params(net, kv_heads=self.kv_heads)
         self._n_layers = len(self._p["layers"])
         self._units = int(self._p["wte"].shape[1])
@@ -284,7 +315,8 @@ class ServingEngine:
         self.eos_id = None if eos_id is None else int(eos_id)
         self._record_logits = bool(record_logits)
 
-        self.alloc = PagedKVAllocator(num_pages, self.page_size)
+        self.alloc = PagedKVAllocator(num_pages, self.page_size,
+                                      kv_dtype=self.kv_dtype)
         # refcounted prefix caching (ISSUE 15): on by default
         # (MXTPU_SERVE_PREFIX_CACHE=0 / prefix_cache=False disables).
         # Admission maps a prompt's longest page-aligned cached prefix
@@ -362,11 +394,22 @@ class ServingEngine:
         self._kv = self._init_pages()
         self.decode_steps = 0
         self.prefills = 0
+        # scale-poison repairs per resident request (rid -> count): the
+        # divergence-guard recovery below re-prefills a victim at most
+        # a few times before declaring its state unrecoverable
+        self._kv_repairs = {}
         self._build_programs()
         _ENGINES.add(self)
         _telemetry.gauge("serving.kv_pages_free").set(
             self.alloc.free_pages)
         _telemetry.gauge("serving.batch_occupancy").set(0)
+        # storage-mode gauges (ISSUE 20): bits per stored K/V value and
+        # all-layer KV bytes one committed token costs (scale overhead
+        # amortized per page); serve_report / fleet_top surface both
+        _telemetry.gauge("serving.kv.dtype").set(
+            8 * self.alloc.kv_itemsize)
+        _telemetry.gauge("serving.kv.bytes_per_token").set(
+            self.kv_bytes_per_token)
 
     @staticmethod
     def _env_sampling():
@@ -410,8 +453,31 @@ class ServingEngine:
 
         shape = (self.alloc.num_pages, self.page_size, self.kv_heads,
                  self._head_dim)
-        mk = jax.jit(lambda: jnp.zeros(shape, jnp.float32))
+        if self.kv_dtype == "int8":
+            # int8 payload + per-page-per-KV-head fp32 absmax scales
+            # (gpt._quant_scatter resets a fresh page's scale before
+            # writing, so the zero init is never load-bearing)
+            sshape = (self.alloc.num_pages, self.kv_heads)
+            mk = jax.jit(lambda: (jnp.zeros(shape, jnp.int8),
+                                  jnp.zeros(sshape, jnp.float32)))
+            out = []
+            for _ in range(self._n_layers):
+                kc, ks = mk()
+                vc, vs = mk()
+                out.append((kc, vc, ks, vs))
+            return out
+        dt = jnp.bfloat16 if self.kv_dtype == "bf16" else jnp.float32
+        mk = jax.jit(lambda: jnp.zeros(shape, dt))
         return [(mk(), mk()) for _ in range(self._n_layers)]
+
+    @property
+    def kv_bytes_per_token(self):
+        """All-layer KV-cache bytes one committed token occupies under
+        this engine's ``kv_dtype`` (per-page scale overhead amortized
+        over the page) — the SERVING.md §2d sizing unit."""
+        return (self._n_layers
+                * self.alloc.page_bytes(self.kv_heads, self._head_dim)
+                / float(self.page_size))
 
     # -- program construction ---------------------------------------------
     def _config_hash(self):
@@ -433,6 +499,12 @@ class ServingEngine:
             # appended only when ON: spec-off engines keep their
             # pre-ISSUE-16 keys (and every AOT entry already on disk)
             h += "|spec%d" % self.spec_k
+        if self.kv_dtype != "fp32":
+            # same discipline (ISSUE 20): fp32 engines keep their
+            # existing keys; bf16/int8 re-key (their input trees also
+            # differ — pool dtypes, int8's scale pools, and the int8
+            # programs' extra finite-mask output)
+            h += "|kvq:%s" % self.kv_dtype
         return h
 
     def _build_programs(self):
@@ -440,6 +512,18 @@ class ServingEngine:
 
         gpt = self._gpt
         n_heads = self._n_heads
+        # int8 engines (ISSUE 20) append a per-slot finite mask over
+        # the step's logits to the decode outputs: the divergence guard
+        # for quantized storage (a poisoned/NaN page scale surfaces as
+        # non-finite logits for exactly the slots reading that page;
+        # step() re-prefills the victims with their correct tokens).
+        # fp32/bf16 programs keep their exact pre-ISSUE-20 signatures.
+        quant = self.kv_dtype == "int8"
+
+        def _finite(logits):
+            import jax.numpy as jnp
+            axes = tuple(range(1, logits.ndim))
+            return jnp.isfinite(logits).all(axes)
 
         if self.spec_k:
             # the spec-decode program: the SAME single donated dispatch
@@ -449,17 +533,19 @@ class ServingEngine:
             def decode(p, kv_pages, tokens, positions, active,
                        draft_len, block_tables, temps, top_ks, top_ps,
                        keys):
-                return gpt.paged_spec_decode_step(
+                out = gpt.paged_spec_decode_step(
                     p, tokens, positions, active, draft_len, kv_pages,
                     block_tables, n_heads,
                     sampling=(temps, top_ks, top_ps, keys))
+                return out + (_finite(out[0]),) if quant else out
         else:
             def decode(p, kv_pages, tokens, positions, active,
                        block_tables, temps, top_ks, top_ps, keys):
-                return gpt.paged_decode_step(
+                out = gpt.paged_decode_step(
                     p, tokens, positions, active, kv_pages,
                     block_tables, n_heads,
                     sampling=(temps, top_ks, top_ps, keys))
+                return out + (_finite(out[0]),) if quant else out
 
         # ONE prefill program whether the prefix cache is on or off: a
         # traced prefix_len of 0 (every admission with the cache off,
@@ -778,6 +864,7 @@ class ServingEngine:
         the numerator of the goodput-vs-raw-tokens split."""
         slot = req.slot
         self.sched.finish(req, state, verdict=verdict, error=error)
+        self._kv_repairs.pop(req.rid, None)
         # clear the slot's sampling rows: a stale temp > 0 would make
         # every later ALL-GREEDY decode step pay the sampling math
         # (the lax.cond predicate reads these rows)
@@ -1088,6 +1175,14 @@ class ServingEngine:
         if self._prefix is not None and _fault.trigger(
                 "serve.prefix.evict"):
             self.drop_prefix_cache()
+        # the ``serve.kv.scale_poison`` drill (ISSUE 20, int8 pools):
+        # NaN-poison one resident page's scale row between steps — the
+        # quantized divergence guard must catch the victim's non-finite
+        # logits on the next decode and re-prefill it with its correct
+        # tokens, leaving every other resident's stream untouched
+        if self.kv_dtype == "int8" and self.sched.running and \
+                _fault.trigger("serve.kv.scale_poison"):
+            self._poison_page_scale()
         self._expire_deadlines()
         self.sweep_streams()
         placed = self._admit_and_prefill()
@@ -1132,11 +1227,16 @@ class ServingEngine:
             active[req.slot] = True
 
         t0 = time.perf_counter_ns()
-        logits, nxt, new_keys, self._kv = self._decode(
+        res = self._decode(
             self._p, self._kv, tokens, positions, active,
             self.sched.block_tables.copy(), self._temps.copy(),
             self._top_ks.copy(), self._top_ps.copy(),
             self._keys.copy())
+        if self.kv_dtype == "int8":
+            logits, nxt, new_keys, self._kv, ok_dev = res
+        else:
+            logits, nxt, new_keys, self._kv = res
+            ok_dev = None
         t1 = time.perf_counter_ns()
         nxt = _np.asarray(nxt)           # device sync barrier
         t2 = time.perf_counter_ns()
@@ -1144,7 +1244,12 @@ class ServingEngine:
         # program; the host copy is the only carry between steps
         # (np.array, not asarray: a jax-backed view is read-only and
         # admission writes per-slot rows)
+        keys_prev = self._keys
         self._keys = _np.array(new_keys, _np.uint32)
+        victims = ()
+        if ok_dev is not None:
+            okm = _np.asarray(ok_dev)
+            victims = tuple(r for r in running if not okm[r.slot])
         _telemetry.note_train_step(t0, t1, t2, where="serve_step")
         # ONE batched ``tokens`` event per decode step naming every
         # advanced trace (all residents share the step's sync stamp
@@ -1154,16 +1259,21 @@ class ServingEngine:
         _telemetry.note_request_event(
             "", "tokens", t_ns=t2,
             args={"replica": self.trace_tag, "step": self.decode_steps,
-                  "traces": [r.trace for r in running]})
+                  "traces": [r.trace for r in running
+                             if r not in victims]})
         self.decode_steps += 1
         _watchdog.renew(self._lease, step=self.decode_steps,
                         phase="serve_step")
         logits_np = _np.asarray(logits) if self._record_logits else None
         for req in list(running):
+            if req in victims:
+                continue
             self._note_token(
                 req, nxt[req.slot],
                 None if logits_np is None else logits_np[req.slot])
             produced += 1
+        if victims:
+            self._repair_quant_victims(victims, keys_prev)
         if self.sched.idle:
             _watchdog.release(self._lease)
         self._publish_gauges()
@@ -1241,11 +1351,16 @@ class ServingEngine:
 
         t0 = time.perf_counter_ns()
         try:
-            logits, out, n_new, new_keys, self._kv = self._decode(
+            res = self._decode(
                 self._p, self._kv, tokens, positions, active,
                 draft_len, self.sched.block_tables.copy(),
                 self._temps.copy(), self._top_ks.copy(),
                 self._top_ps.copy(), self._keys.copy())
+            if self.kv_dtype == "int8":
+                logits, out, n_new, new_keys, self._kv, ok_dev = res
+            else:
+                logits, out, n_new, new_keys, self._kv = res
+                ok_dev = None
             t1 = time.perf_counter_ns()
             out = _np.asarray(out)           # device sync barrier
             n_new = _np.asarray(n_new)
@@ -1258,11 +1373,21 @@ class ServingEngine:
             if marked:
                 self.alloc.clear_speculative(marked)
         t2 = time.perf_counter_ns()
+        keys_prev = self._keys
         self._keys = _np.array(new_keys, _np.uint32)
+        victims = ()
+        if ok_dev is not None:
+            okm = _np.asarray(ok_dev)
+            victims = tuple(r for r in running if not okm[r.slot])
 
         accepted = rejected = rollbacks = 0
         emitted = {}
         for req in running:
+            if req in victims:
+                # quantized divergence guard: the whole verified run is
+                # garbage — discard it (no accept/reject accounting)
+                emitted[req] = []
+                continue
             n = int(n_new[req.slot])
             dl = int(draft_len[req.slot])
             accepted += n - 1
@@ -1305,7 +1430,75 @@ class ServingEngine:
                 self._note_token(req, tok,
                                  None if rows is None else rows[i])
                 produced += 1
+        if victims:
+            self._repair_quant_victims(victims, keys_prev)
         return produced
+
+    # -- quantized-pool divergence guard (ISSUE 20) -------------------------
+    def _poison_page_scale(self):
+        """Body of the ``serve.kv.scale_poison`` drill: NaN the layer-0
+        K-scale row of the first resident's FIRST page between steps.
+        Every subsequent dequant of that page is non-finite, so the
+        victim's next decode logits must trip the finite mask; the
+        repair path below rewrites the page (bytes AND scales) from the
+        request's own committed tokens.  Other residents never map the
+        page, so their streams must be byte-identical to an undrilled
+        run (test-pinned)."""
+        req = self.sched.running[0]
+        page = int(self.sched.block_tables[req.slot][0])
+        kc, vc, ks, vs = self._kv[0]
+        self._kv[0] = (kc, vc, ks.at[page].set(_np.nan), vs)
+
+    def _repair_quant_victims(self, victims, keys_prev):
+        """Recovery for residents whose decode logits came back
+        non-finite under int8 pools: the page state is unrecoverable in
+        place (a NaN absmax scale poisons every dequant of its page),
+        so the step's output for the victim was DISCARDED — here its
+        PRNG key rolls back and its committed context (prompt + every
+        emitted token except the still-pending last one) re-prefills IN
+        PLACE through the dense prefill branch.  That rewrites every
+        page the request owns with freshly quantized bytes + scales, so
+        the next decode step resumes the exact stream (greedy streams
+        stay pinned to themselves — the determinism law survives the
+        drill).  A victim whose committed context no longer fits the
+        prefill window, or that stays non-finite after repeated
+        repairs (torn weights, not torn pages), fails with the typed
+        ``prefill_error`` verdict instead of looping forever."""
+        for req in victims:
+            self._keys[req.slot] = keys_prev[req.slot]
+            n = self._kv_repairs.get(req.rid, 0) + 1
+            self._kv_repairs[req.rid] = n
+            ctx = _np.concatenate(
+                [_np.asarray(req.prompt, _np.int32),
+                 _np.asarray(req.tokens[:-1], _np.int32)])
+            if n > 3 or ctx.size > self.max_prefill_len:
+                self._finish(
+                    req, FAILED, verdict=VERDICT_PREFILL_ERROR,
+                    error="quantized KV state unrecoverable for "
+                          "request %d (%d repairs, committed context "
+                          "%d vs prefill window %d)"
+                          % (req.rid, n, ctx.size,
+                             self.max_prefill_len))
+                continue
+            toks = _np.zeros(self.max_prefill_len, _np.int32)
+            toks[:ctx.size] = ctx
+            # greedy sampling args: the repair NEVER consumes the
+            # request's PRNG chain — its first token is discarded (the
+            # real next token comes from the resumed decode steps)
+            samp = (_np.float32(0), _np.int32(0), _np.float32(0),
+                    _np.zeros(2, _np.uint32))
+            with _watchdog.guard("serve.prefill"):
+                _logits, _first, _key, self._kv = self._prefill(
+                    self._p, self._kv, toks, _np.int32(ctx.size),
+                    _np.int32(0),
+                    self.sched.block_tables[req.slot].copy(),
+                    _np.int32(SCRATCH_PAGE), _np.int32(SCRATCH_PAGE),
+                    *samp)
+            _telemetry.counter("serving.kv.scale_repairs").inc()
+            _telemetry.note_request_event(
+                req.trace, "kv_repair",
+                args={"replica": self.trace_tag, "rid": req.rid,
+                      "repairs": n, "context": int(ctx.size)})
 
     def _publish_gauges(self):
         _telemetry.gauge("serving.batch_occupancy").set(
@@ -1445,6 +1638,8 @@ class ServingEngine:
             "decode_steps": self.decode_steps,
             "prefills": self.prefills,
             "kv_heads": self.kv_heads,
+            "kv_dtype": self.kv_dtype,
+            "kv_bytes_per_token": round(self.kv_bytes_per_token, 3),
             "prefix_cached_pages": (None if self._prefix is None
                                     else self._prefix.cached_pages),
             "shared_pages": self.alloc.shared_pages,

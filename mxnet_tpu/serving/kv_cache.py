@@ -40,14 +40,34 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["PagedKVAllocator"]
+__all__ = ["PagedKVAllocator", "normalize_kv_dtype"]
 
 #: physical page id every masked/inactive write is routed to
 SCRATCH_PAGE = 0
 
+#: kv_dtype mode -> (payload bytes per K/V value, fp32 scale rows per
+#: page per pool).  fp32 is the bit-identical default; bf16 halves the
+#: payload with no auxiliary state; int8 (ISSUE 20) quarters it and
+#: carries one fp32 absmax scale per page per KV head per pool.
+_KV_DTYPES = {"fp32": (4, 0), "bf16": (2, 0), "int8": (1, 1)}
+_KV_ALIASES = {"float32": "fp32", "bfloat16": "bf16"}
+
+
+def normalize_kv_dtype(kv_dtype):
+    """Canonical kv_dtype name (``fp32`` / ``bf16`` / ``int8``); None
+    and '' mean the fp32 default.  Raises on anything else — a typo'd
+    env var must not silently serve full-precision pools."""
+    s = str(kv_dtype or "fp32").strip().lower()
+    s = _KV_ALIASES.get(s, s)
+    if s not in _KV_DTYPES:
+        raise ValueError(
+            "unknown kv_dtype %r (want one of %s)"
+            % (kv_dtype, "/".join(sorted(_KV_DTYPES))))
+    return s
+
 
 class PagedKVAllocator:
-    def __init__(self, num_pages, page_size):
+    def __init__(self, num_pages, page_size, kv_dtype=None):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "reserved scratch page)")
@@ -55,6 +75,12 @@ class PagedKVAllocator:
             raise ValueError("page_size must be >= 1")
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
+        #: storage mode of the pools this allocator governs (ISSUE 20).
+        #: The allocator itself stays pure page bookkeeping — the mode
+        #: only parameterizes the byte-sizing helpers below, so
+        #: capacity math (scheduler reservations, serve_report, bench)
+        #: has ONE authority for what a page costs.
+        self.kv_dtype = normalize_kv_dtype(kv_dtype)
         # LIFO free list, scratch page excluded.  Reversed so the first
         # allocations hand out low page ids (stable, test-friendly).
         self._free = list(range(self.num_pages - 1, 0, -1))
@@ -67,6 +93,27 @@ class PagedKVAllocator:
         self._spec = set()
 
     # -- sizing ------------------------------------------------------------
+    @property
+    def kv_itemsize(self):
+        """Payload bytes per stored K/V value under this kv_dtype."""
+        return _KV_DTYPES[self.kv_dtype][0]
+
+    def page_bytes(self, kv_heads, head_dim):
+        """Bytes ONE physical page costs in ONE layer: K + V payload
+        rows plus (int8 mode) the two per-page-per-KV-head fp32 scale
+        rows.  The worst-case reservation of a request is therefore
+        ``pages_for(prompt + max_new) * page_bytes(...) * n_layers``
+        (SERVING.md §2d) — quantization shrinks the BYTES, never the
+        page count, so every page-granular invariant (conservation,
+        refcounts, speculative marks) is dtype-blind."""
+        item, scale_rows = _KV_DTYPES[self.kv_dtype]
+        b = 2 * self.page_size * int(kv_heads) * int(head_dim) * item
+        return b + 2 * scale_rows * int(kv_heads) * 4
+
+    def scale_bytes(self, kv_heads):
+        """Scale-pool bytes per page (both pools; 0 unless int8)."""
+        return 2 * _KV_DTYPES[self.kv_dtype][1] * int(kv_heads) * 4
+
     def pages_for(self, tokens):
         """Pages a ``tokens``-long sequence occupies (>= 1 so even an
         empty reservation owns its first page)."""

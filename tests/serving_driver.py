@@ -790,6 +790,238 @@ def check_stream_abandon_reclaim(net):
     _idle_pages_ok(eng)
 
 
+# -- quantized KV pages (ISSUE 20) ------------------------------------------
+
+def check_kvq_pools_and_scale_accounting(net):
+    """int8 engine laws, fast tier (ONE extra compile set for the whole
+    kvq block; later checks reuse the engine / the AOT memo): 4-tuple
+    pools with fp32 ``[num_pages, K_kv]`` absmax scale rows, the
+    allocator as the ONE byte authority, conservation + finite scales
+    after staggered churn, and greedy determinism quantized-to-ITSELF
+    (a fresh identically-configured engine replays the exact streams —
+    bit-identity to the fp path is explicitly NOT the law)."""
+    import jax.numpy as jnp
+    eng = _engine(net, kv_dtype="int8")
+    assert eng.kv_dtype == "int8" and eng.alloc.kv_dtype == "int8"
+    assert eng.alloc.kv_itemsize == 1
+    kc, vc, ks, vs = eng._kv[0]
+    assert kc.dtype == jnp.int8 and vc.dtype == jnp.int8
+    assert ks.dtype == jnp.float32 and vs.dtype == jnp.float32
+    assert ks.shape == vs.shape == (eng.alloc.num_pages, eng.kv_heads)
+    # the allocator's page_bytes is the byte authority: the device
+    # pools weigh exactly num_pages * page_bytes per layer
+    total = sum(sum(np.asarray(a).nbytes for a in entry)
+                for entry in eng._kv)
+    assert total == (eng._n_layers * eng.alloc.num_pages
+                     * eng.alloc.page_bytes(eng.kv_heads,
+                                            eng._head_dim)), total
+    fp32 = _engine(net)
+    assert eng.kv_bytes_per_token < fp32.kv_bytes_per_token / 3.0
+
+    rng = np.random.RandomState(30)
+    prompts = [rng.randint(0, VOCAB, (l,)).astype(np.int32)
+               for l in (11, 4, 7)]
+    handles = []
+    for p in prompts:
+        handles.append(eng.submit(p, 6))
+        eng.step()                        # staggered joins
+    eng.run_until_idle()
+    twin = _engine(net, kv_dtype="int8")  # AOT-memo hit, fresh pools
+    for h, p in zip(handles, prompts):
+        assert h.verdict == "completed"
+        assert h.tokens == twin.generate([p], 6)[0], \
+            "quantized greedy failed to reproduce on a twin engine"
+    for entry in eng._kv:
+        assert np.isfinite(np.asarray(entry[2])).all()
+        assert np.isfinite(np.asarray(entry[3])).all()
+    _idle_pages_ok(eng)
+    return eng
+
+
+def check_kvq_cow_copies_scales(net, eng):
+    """Prefix COW on quantized pages copies BYTES AND SCALES: a
+    mid-page divergence off a cached int8 page must stream exactly what
+    a cache-off int8 engine streams (a dropped or stale scale would
+    corrupt every dequantized read of the copied page), with the
+    cow_dst scale grow-only from the donor's."""
+    rng = np.random.RandomState(31)
+    pa = rng.randint(0, VOCAB, (16,)).astype(np.int32)  # 2 FULL pages
+    off = _engine(net, kv_dtype="int8", prefix_cache=False)
+    ra = eng.generate([pa], 4)[0]        # miss; caches both pages
+    assert ra == off.generate([pa], 4)[0]
+    pc = np.concatenate([pa[:11], rng.randint(0, VOCAB, (2,))
+                         .astype(np.int32)])
+    rc = eng.submit(pc, 4)
+    eng.step()
+    assert rc.cow_src is not None and rc.cow_dst is not None
+    ks = np.asarray(eng._kv[0][2])
+    assert np.isfinite(ks[rc.cow_dst]).all()
+    # grow-only scatter: the copied page's scale never shrinks below
+    # the donor's (suffix rows can only max it upward)
+    assert (ks[rc.cow_dst] >= ks[rc.cow_src] - 1e-7).all(), \
+        (ks[rc.cow_dst], ks[rc.cow_src])
+    eng.run_until_idle()
+    assert rc.tokens == off.generate([pc], 4)[0], \
+        "COW page diverged from the cache-off quantized stream"
+    _idle_pages_ok(eng)
+
+
+def check_kvq_spec_rollback_scales(net):
+    """Speculative decoding over int8 pages: rejected draft positions
+    roll back with NO stale scale slots — the spec stream equals the
+    plain int8 engine's greedy stream, and (under the serve.spec.poison
+    drill, which forces every draft to be REJECTED) the rollback still
+    leaves clear speculative marks and finite scales everywhere."""
+    from mxnet_tpu import fault, telemetry
+    rng = np.random.RandomState(32)
+    spec = _engine(net, kv_dtype="int8", spec_k=4)
+    plain = _engine(net, kv_dtype="int8")
+    prompts = [_periodic(rng, 12), rng.randint(0, VOCAB, (5,))
+               .astype(np.int32), _periodic(rng, 7)]
+    handles = []
+    for p in prompts:
+        handles.append(spec.submit(p, 7))
+        spec.step()
+    spec.run_until_idle()
+    for h, p in zip(handles, prompts):
+        assert h.tokens == plain.generate([p], 7)[0], \
+            "int8 spec stream diverged from the int8 plain engine"
+    # force mass rejection (the rollback path) with poisoned drafts:
+    # the emitted stream must still be the plain quantized chain
+    rej0 = telemetry.counter("serving.spec.rejected").value
+    fault.configure("serve.spec.poison:999")
+    try:
+        out = spec.generate([prompts[0]], 7)[0]
+    finally:
+        fault.reset()
+    assert out == handles[0].tokens, \
+        "poisoned drafts leaked into the quantized stream"
+    assert telemetry.counter("serving.spec.rejected").value > rej0, \
+        "no rejection happened — the rollback path was not exercised"
+    assert spec.alloc.speculative_pages == 0
+    for entry in spec._kv:
+        assert np.isfinite(np.asarray(entry[2])).all()
+        assert np.isfinite(np.asarray(entry[3])).all()
+    _idle_pages_ok(spec)
+    return plain
+
+
+def check_kvq_sampled_determinism_swap_failover(net, eng, plain):
+    """Per-request SAMPLED determinism quantized-to-itself across
+    churn, hot-swap, and failover: the same seeded request reproduces
+    bit-exactly on the original engine under neighbor churn, across a
+    same-weights hot-swap mid-decode, and on a replacement engine (the
+    failover re-decode path)."""
+    from mxnet_tpu.serving import SamplingParams
+    rng = np.random.RandomState(33)
+    p0 = rng.randint(0, VOCAB, (6,)).astype(np.int32)
+    p1 = rng.randint(0, VOCAB, (9,)).astype(np.int32)
+    sp = SamplingParams(temperature=0.9, top_k=16, top_p=0.95, seed=5)
+    # churn: a greedy neighbor joins mid-flight
+    r = eng.submit(p0, 6, sampling=sp)
+    eng.step()
+    eng.submit(p1, 5)
+    eng.run_until_idle()
+    want = r.tokens
+    assert eng.generate([p0], 6, sampling=sp)[0] == want
+    # hot-swap with identical weights mid-decode: stream unchanged
+    r2 = eng.submit(p0, 6, sampling=sp)
+    eng.step()
+    eng.swap_params(eng.params_from_net(net))
+    eng.run_until_idle()
+    assert r2.tokens == want, "hot-swap perturbed a sampled stream"
+    # failover: a replacement engine re-decodes the same request
+    assert plain.generate([p0], 6, sampling=sp)[0] == want, \
+        "failover replacement diverged on a sampled quantized stream"
+    _idle_pages_ok(eng)
+
+
+def check_kvq_scale_poison_drill(net, eng):
+    """The ``serve.kv.scale_poison`` drill: one resident page's scale
+    NaN-poisoned between steps — the quantized divergence guard sees
+    non-finite victim logits, discards that step's output, and
+    re-prefills the victim's committed context; the victim still
+    completes with its unfaulted stream, neighbors never notice, one
+    ``serving.kv.scale_repairs`` tick, conservation green."""
+    from mxnet_tpu import fault, telemetry
+    rng = np.random.RandomState(34)
+    pa = rng.randint(0, VOCAB, (9,)).astype(np.int32)
+    pb = rng.randint(0, VOCAB, (5,)).astype(np.int32)
+    want_a = eng.generate([pa], 8)[0]     # unfaulted references
+    want_b = eng.generate([pb], 8)[0]
+    rep0 = telemetry.counter("serving.kv.scale_repairs").value
+    ra = eng.submit(pa, 8)
+    eng.step()                            # ra resident -> the victim
+    rb = eng.submit(pb, 8)
+    fault.configure("serve.kv.scale_poison:1")
+    try:
+        eng.run_until_idle()
+        fired = fault.fire_count("serve.kv.scale_poison")
+    finally:
+        fault.reset()
+    assert fired == 1, "the scale-poison site never fired"
+    assert ra.verdict == "completed" and rb.verdict == "completed"
+    assert ra.tokens == want_a, "victim re-prefill diverged"
+    assert rb.tokens == want_b, "a neighbor was perturbed by the repair"
+    assert telemetry.counter("serving.kv.scale_repairs").value \
+        == rep0 + 1
+    for entry in eng._kv:
+        assert np.isfinite(np.asarray(entry[2])).all()
+        assert np.isfinite(np.asarray(entry[3])).all()
+    _idle_pages_ok(eng)
+
+
+def check_kvq_dtype_sweep(net):
+    """Exhaustive kv_dtype sweep (slow tier: every mode+shape compiles
+    its own serving programs): fp32 stays bit-identical to the dense
+    reference at off-default shapes, bf16/int8 reproduce on twin
+    engines (pinned to themselves), bytes/token strictly ordered fp32 >
+    bf16 > int8, the GQA x int8 composition multiplies, and the env
+    opt-in wires through."""
+    rng = np.random.RandomState(35)
+    kw = dict(num_slots=2, page_size=4, max_prefill_len=12,
+              max_seq_len=24)
+    prompts = [rng.randint(0, VOCAB, (l,)).astype(np.int32)
+               for l in (10, 3)]
+    bpt = {}
+    for dt in ("fp32", "bf16", "int8"):
+        a = _engine(net, kv_dtype=dt, **kw)
+        b = _engine(net, kv_dtype=dt, **kw)
+        bpt[dt] = a.kv_bytes_per_token
+        ta = [a.generate([p], 6)[0] for p in prompts]
+        tb = [b.generate([p], 6)[0] for p in prompts]
+        assert ta == tb, "kv_dtype=%s failed to reproduce on a twin" % dt
+        if dt == "fp32":
+            for p, t in zip(prompts, ta):
+                assert t == _ref(net, p, 6), \
+                    "fp32 pools must stay bit-identical to dense"
+        _idle_pages_ok(a)
+        _idle_pages_ok(b)
+    assert bpt["fp32"] > bpt["bf16"] > bpt["int8"], bpt
+    # GQA x int8 composition: K_kv = H/2 halves the rows int8 already
+    # quartered — bytes/token divides multiplicatively
+    gqa8 = _engine(net, kv_dtype="int8", kv_heads=HEADS // 2, **kw)
+    assert gqa8.kv_bytes_per_token < bpt["int8"] / 1.8
+    t1 = [gqa8.generate([p], 6)[0] for p in prompts]
+    gqa8b = _engine(net, kv_dtype="int8", kv_heads=HEADS // 2, **kw)
+    assert t1 == [gqa8b.generate([p], 6)[0] for p in prompts]
+    _idle_pages_ok(gqa8)
+    # env opt-in: MXTPU_SERVE_KV_DTYPE picks the mode when the ctor
+    # arg is absent; a typo must refuse to serve
+    os.environ["MXTPU_SERVE_KV_DTYPE"] = "int8"
+    try:
+        e = _engine(net, **kw)
+        assert e.kv_dtype == "int8"
+        os.environ["MXTPU_SERVE_KV_DTYPE"] = "int9"
+        try:
+            _engine(net, **kw)
+            raise AssertionError("typo'd MXTPU_SERVE_KV_DTYPE accepted")
+        except ValueError as exc:
+            assert "kv_dtype" in str(exc)
+    finally:
+        del os.environ["MXTPU_SERVE_KV_DTYPE"]
+
+
 def main(section):
     if section in ("kernel", "all"):
         check_kernel_vs_reference_mixed_lengths()
@@ -827,12 +1059,25 @@ def main(section):
         check_stream_cancel(net)
         check_stream_abandon_reclaim(net)
         print("SERVING_STREAM_OK")
+        # ISSUE 20 quantized-KV fast laws ride the SAME subprocess:
+        # ONE int8 ENGINE_KW config (+ its spec_k=4 sibling) pays the
+        # block's compile cost once, every later check reuses those
+        # engines or the in-process AOT memo; the exhaustive
+        # dtype/shape sweep lives in the slow `capacity` section
+        kvq_eng = check_kvq_pools_and_scale_accounting(net)
+        check_kvq_cow_copies_scales(net, kvq_eng)
+        kvq_plain = check_kvq_spec_rollback_scales(net)
+        check_kvq_sampled_determinism_swap_failover(net, kvq_eng,
+                                                    kvq_plain)
+        check_kvq_scale_poison_drill(net, kvq_eng)
+        print("SERVING_KVQ_FAST_OK")
     if section in ("capacity", "all"):
         net = _net()
         check_prefix_cache_off_token_identity(net)
         check_prefix_eviction_under_pressure(net)
         check_gqa_engine_self_consistent(net)
         check_gqa_capacity_multiplier(net)
+        check_kvq_dtype_sweep(net)
         print("SERVING_CAPACITY_OK")
     if section in ("spec_sweep", "all"):
         net = _net()

@@ -240,12 +240,22 @@ def test_serving_engine_invariants():
     `cancelled` verdict (mid-decode, queued, idempotent — survivors
     bit-identical, pages conserved), and the serve.client.vanish
     abandon-sweep drill (typed `abandoned` verdict, unary requests
-    never reclaimed)."""
+    never reclaimed).
+    The fast ISSUE-20 quantized-KV laws complete the subprocess: int8
+    pool/scale-pool shape + byte accounting with allocator conservation
+    under churn, twin-engine int8 reproducibility, COW prefix reuse
+    copying scales with payload bytes (grow-only scale law), spec
+    rollback under the serve.spec.poison drill leaving no stale scale
+    slots, sampled determinism quantized-to-ITSELF across churn +
+    hot-swap + failover stand-in, and the serve.kv.scale_poison drill
+    (poisoned page scale -> finite-guard repair re-prefills the victim;
+    streams match the unfaulted reference)."""
     out = _run_driver("engine")
     assert "SERVING_ENGINE_OK" in out
     assert "SERVING_CAPACITY_FAST_OK" in out
     assert "SERVING_SPEC_FAST_OK" in out
     assert "SERVING_STREAM_OK" in out
+    assert "SERVING_KVQ_FAST_OK" in out
 
 
 @pytest.mark.slow
@@ -254,7 +264,11 @@ def test_serving_capacity_multipliers():
     engine section): cache-off/cache-on greedy token identity, LRU
     eviction under admission pressure, GQA join/leave bit-exactness,
     and the >= 1.5x resident-capacity multiplier at K_kv = H/2 in the
-    same pool bytes."""
+    same pool bytes.  The ISSUE-20 kv_dtype sweep rides here (each
+    dtype compiles its own engine programs): fp32/bf16/int8 twin-engine
+    reproduction, fp32 == the dense reference, strict bytes-per-token
+    ordering fp32 > bf16 > int8, GQA x int8 composition, and the
+    MXTPU_SERVE_KV_DTYPE env override (bad names raise ValueError)."""
     assert "SERVING_CAPACITY_OK" in _run_driver("capacity")
 
 

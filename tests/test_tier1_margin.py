@@ -1,0 +1,65 @@
+"""tools/tier1_margin.py parsing laws (ISSUE 20 bugfix): the wall-
+margin gate must read the pytest summary even when a narrow terminal
+(``COLUMNS``) wraps the summary line — the old single-line regex
+exited 2 ("no summary found") on a run that DID report, turning a
+cosmetic wrap into a CI failure."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import tier1_margin  # noqa: E402
+
+
+FLAT = "= 412 passed, 2 failed, 7 skipped in 743.21s (0:12:23) =\n"
+# pytest's own wrap points under a narrow terminal: between "in" and
+# the seconds token, and INSIDE the seconds token
+WRAP_AFTER_IN = ("= 412 passed, 2 failed, 7 skipped in\n"
+                 "743.21s (0:12:23) =\n")
+WRAP_IN_TOKEN = ("= 412 passed, 2 failed, 7 skipped in 743.2\n"
+                 "1s (0:12:23) =\n")
+
+
+def test_flat_summary_parses():
+    elapsed, m = tier1_margin.margin(FLAT, wall=870.0)
+    assert elapsed == 743.21
+    assert abs(m - (870.0 - 743.21)) < 1e-9
+
+
+def test_wrapped_summary_parses_like_flat():
+    for text in (WRAP_AFTER_IN, WRAP_IN_TOKEN):
+        elapsed, m = tier1_margin.margin(text, wall=870.0)
+        assert elapsed == 743.21, text
+        assert abs(m - (870.0 - 743.21)) < 1e-9
+
+
+def test_last_summary_wins_and_earlier_noise_ignored():
+    # a log holds MANY "in Ns" tokens (per-file short summaries, rerun
+    # sections): the gate reads the LAST one — the suite total
+    text = ("tests/test_a.py ....    [ 10%]\n"
+            "= 3 passed in 2.11s =\n" + WRAP_AFTER_IN)
+    elapsed, _ = tier1_margin.margin(text)
+    assert elapsed == 743.21
+
+
+def test_collapse_cannot_forge_a_summary_token():
+    # joining wrapped lines must not invent a match: "margin" + "5s"
+    # collapses to "margin5s", whose embedded "in" sits at no word
+    # boundary
+    text = "the suite kept a healthy margin\n5s was never reported\n"
+    assert tier1_margin.margin(text) == (None, None)
+    assert tier1_margin.margin("no summary here\n") == (None, None)
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    wrapped = tmp_path / "wrapped.log"
+    wrapped.write_text(WRAP_AFTER_IN)
+    assert tier1_margin.main([str(wrapped)]) == 0
+    assert "743.2" in capsys.readouterr().out
+    over = tmp_path / "over.log"
+    over.write_text(FLAT)
+    assert tier1_margin.main([str(over), "--wall", "700"]) == 1
+    empty = tmp_path / "empty.log"
+    empty.write_text("killed before pytest reported\n")
+    assert tier1_margin.main([str(empty)]) == 2

@@ -148,6 +148,8 @@ def collect_row(name, addr, cursor=None, timeout_s=2.0,
             "weights_epoch": snap.get("weights_epoch"),
             "slo": snap.get("slo"),
             "stream": snap.get("stream"),
+            "kv_dtype": snap.get("kv_dtype"),
+            "kv_bytes_per_token": snap.get("kv_bytes_per_token"),
         }
         break  # one engine per worker process in the fleet layout
     row["prefix_hit_rate"] = _rate(
@@ -207,14 +209,14 @@ def _fmt(v, pct=False):
 
 
 def render_matrix(matrix, out=sys.stdout):
-    cols = ("replica", "state", "occ", "queue", "free_pg", "prefix",
-            "spec", "tok/s", "strm", "wait", "orph", "hb_ms", "susp",
-            "breaker", "epoch")
+    cols = ("replica", "state", "occ", "queue", "free_pg", "kv",
+            "prefix", "spec", "tok/s", "strm", "wait", "orph", "hb_ms",
+            "susp", "breaker", "epoch")
     rows = []
     for r in matrix["rows"]:
         if not r.get("up"):
             rows.append((r["replica"], "DOWN", "-", "-", "-", "-", "-",
-                         "-", "-", "-", "-", "-", "-", "-",
+                         "-", "-", "-", "-", "-", "-", "-", "-",
                          r.get("error", "")[:24]))
             continue
         eng = r.get("engine") or {}
@@ -231,6 +233,7 @@ def render_matrix(matrix, out=sys.stdout):
         rows.append((
             r["replica"], state, occ, _fmt(eng.get("queued")),
             _fmt(eng.get("free_pages")),
+            _fmt(eng.get("kv_dtype")),
             _fmt(r.get("prefix_hit_rate"), pct=True),
             _fmt(r.get("spec_accept_rate"), pct=True),
             _fmt(r.get("tok_s", r.get("tokens"))),

@@ -165,7 +165,8 @@ def _req_stats(ttfts, tpots, waits):
 
 def run_continuous(net, workload, num_slots=8, page_size=16,
                    max_prefill_len=32, max_seq_len=48, num_pages=None,
-                   prefix_cache=None, sampling=None, spec_k=None):
+                   prefix_cache=None, sampling=None, spec_k=None,
+                   kv_dtype=None):
     """Open-loop drive of the ServingEngine; returns throughput, latency
     percentiles, occupancy, and the dispatch/compile accounting —
     WITH request-scope tracing live (it is always on: the 1.0
@@ -184,7 +185,8 @@ def run_continuous(net, workload, num_slots=8, page_size=16,
     eng = ServingEngine(net, num_slots=num_slots, page_size=page_size,
                         max_prefill_len=max_prefill_len,
                         max_seq_len=max_seq_len, num_pages=num_pages,
-                        prefix_cache=prefix_cache, spec_k=spec_k)
+                        prefix_cache=prefix_cache, spec_k=spec_k,
+                        kv_dtype=kv_dtype)
     # warmup: both programs execute once (first-call overhead, twin
     # hot-swap settle) before the timed workload
     eng.generate([np.zeros(4, np.int32)], max_new=2)
@@ -625,6 +627,121 @@ def run_gqa(net, pool_pages=13):
         "pool_bytes_mha": bytes_mha,
         "pool_bytes_gqa": bytes_gqa,
         "kv_bytes_per_token_ratio": round(bytes_gqa / bytes_mha, 4),
+    }
+
+
+def run_kvq(net, workload, reference_tokens, pool_pages=13):
+    """The quantized KV-page contract (ISSUE 20, hard-asserted by
+    BENCH_MODE=serve): int8 pages + per-page-per-KV-head fp32 absmax
+    scales vs bf16 pools on the SAME Poisson workload —
+
+    - kernel-vs-oracle dequant error <= the pinned tolerance (the
+      Pallas kernels and the jnp reference dequantize the SAME int8
+      pools + scales; published as the ``serving.kv.quant_error``
+      gauge);
+    - >= 1.8x resident sequences in the same pool bytes at int8 vs
+      bf16 (the scale rows cost ~K_kv*8 bytes/page against the
+      2*page*K_kv*D payload halving);
+    - greedy token match-rate >= 0.99 vs the fp32 reference (greedy
+      under quantization is pinned to ITSELF — bit-identity to the fp
+      path is explicitly NOT the law, the match-rate gate is);
+    - 1.0 decode dispatch/step and 0 steady-state recompiles with
+      int8 pools (quantize-on-scatter lives INSIDE the one donated
+      program)."""
+    import numpy as np
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.ops.pallas.paged_attention import (
+        paged_attention, paged_attention_reference)
+    from mxnet_tpu.serving import ServingEngine
+
+    n_heads = net.blocks._children[0].attn._num_heads
+    rng = np.random.RandomState(31)
+
+    # kernel-vs-oracle on the SAME quantized pools: absmax-quantize
+    # random fp pages per page per KV head, run both readers
+    s, d, page, n_pages, mp = 5, 16, 8, 16, 4
+    q = rng.randn(s, n_heads, d).astype(np.float32)
+
+    def quantize(pool):
+        scale = (np.abs(pool).max(axis=(1, 3)) / 127.0).astype(
+            np.float32)                      # [n_pages, K_kv]
+        qp = np.clip(np.round(
+            pool / np.maximum(scale, 1e-30)[:, None, :, None]),
+            -127, 127).astype(np.int8)
+        return qp, scale
+
+    kq, ks = quantize(rng.randn(n_pages, page, n_heads, d)
+                      .astype(np.float32))
+    vq, vs = quantize(rng.randn(n_pages, page, n_heads, d)
+                      .astype(np.float32))
+    perm = rng.permutation(n_pages - 1) + 1
+    ctx_lens = [29, 5, 0, 17, 32]
+    bt = np.zeros((s, mp), np.int32)
+    k = 0
+    for i in range(s):
+        need = -(-max(1, ctx_lens[i]) // page)
+        bt[i, :need] = perm[k:k + need]
+        k += need
+    ctx = np.asarray(ctx_lens, np.int32)
+    out = np.asarray(paged_attention(q, kq, vq, bt, ctx,
+                                     k_scales=ks, v_scales=vs))
+    ref = np.asarray(paged_attention_reference(q, kq, vq, bt, ctx,
+                                               k_scales=ks,
+                                               v_scales=vs))
+    dequant_err = float(np.abs(out - ref).max())
+    telemetry.gauge("serving.kv.quant_error").set(dequant_err)
+
+    # resident capacity in the same pool bytes: identical worst-case
+    # requests; the int8 pool buys ~2x the pages of the bf16 budget
+    kw = dict(num_slots=16, page_size=16, max_prefill_len=32,
+              max_seq_len=48, prefix_cache=False)
+
+    def residents(kv_dtype, num_pages):
+        eng = ServingEngine(net, kv_dtype=kv_dtype,
+                            num_pages=num_pages, **kw)
+        pool_bytes = sum(sum(a.nbytes for a in entry)
+                        for entry in eng._kv)
+        for _ in range(16):
+            eng.submit(rng.randint(0, 256, (32,)).astype(np.int32), 16)
+        eng.step()
+        occ = eng.sched.occupancy
+        eng.run_until_idle()
+        return occ, pool_bytes, eng.kv_bytes_per_token
+
+    occ_bf16, bytes_bf16, bpt_bf16 = residents("bf16", pool_pages)
+    d_model = int(net.wte.shape[1])
+    bf16_page = 2 * kw["page_size"] * d_model * 2
+    int8_page = 2 * kw["page_size"] * d_model + 2 * n_heads * 4
+    int8_pages = pool_pages * bf16_page // int8_page
+    occ_int8, bytes_int8, bpt_int8 = residents("int8", int8_pages)
+
+    # the same open-loop workload through an int8 engine: match-rate
+    # vs the fp32 reference tokens + the hot-path contracts.  Pages of
+    # 8 keep the absmax scale groups tight (one fp32 scale per 8 rows
+    # per KV head); the fp reference stands across page sizes — greedy
+    # fp tokens are page-layout-invariant (the paged kernel's
+    # per-page partial sums reduce in fp32)
+    cont = run_continuous(net, workload, page_size=8, kv_dtype="int8")
+    matched = total = 0
+    for got, want in zip(cont.pop("tokens"), reference_tokens):
+        total += len(want)
+        matched += sum(1 for a, b in zip(got, want) if a == b)
+    return {
+        "kv_dtype": "int8",
+        "dequant_max_err": dequant_err,
+        "residents_bf16": occ_bf16,
+        "residents_int8": occ_int8,
+        "resident_multiplier": round(occ_int8 / max(1, occ_bf16), 3),
+        "pool_bytes_bf16": bytes_bf16,
+        "pool_bytes_int8": bytes_int8,
+        "bytes_per_token_bf16": round(bpt_bf16, 2),
+        "bytes_per_token_int8": round(bpt_int8, 2),
+        "bytes_per_token_ratio": round(bpt_int8 / bpt_bf16, 4),
+        "token_match_rate": round(matched / max(1, total), 4),
+        "tokens_per_sec": cont["tokens_per_sec"],
+        "decode_dispatches_per_step":
+            cont["decode_dispatches_per_step"],
+        "steady_state_compiles": cont["steady_state_compiles"],
     }
 
 
@@ -1873,6 +1990,7 @@ def run(spinup=True, degraded=True, fleet=True):
         "collector": measure_collector_impact(net),
         "prefix": run_prefix(net),
         "gqa": run_gqa(net),
+        "kvq": run_kvq(net, workload, cont_tokens),
         "spec": run_spec(),
         "stream": run_streaming(net, workload, cont_tokens,
                                 fleet=fleet),

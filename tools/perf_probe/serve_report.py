@@ -703,7 +703,7 @@ def accounting(data, reqs):
     """Goodput vs raw tokens, traced-vs-counter reconciliation, and
     flops/bytes-per-token from the compile-time cost attribution joined
     with the measured execution counts."""
-    tokens = goodput = requests = dropped = 0
+    tokens = goodput = requests = dropped = scale_repairs = 0
     spec = {"draft_tokens": 0, "accepted": 0, "rejected": 0,
             "rollbacks": 0}
     for c in data["counters"].values():
@@ -711,6 +711,7 @@ def accounting(data, reqs):
         goodput += c.get("serving.goodput", 0)
         requests += c.get("serving.requests", 0)
         dropped += c.get("serving.trace_dropped", 0)
+        scale_repairs += c.get("serving.kv.scale_repairs", 0)
         for key in spec:
             spec[key] += c.get("serving.spec." + key, 0)
     traced = sum(len(r["token_ts"]) for r in reqs.values())
@@ -747,6 +748,7 @@ def accounting(data, reqs):
         else None,
         "bytes_per_token": (bytes_ / tokens) if have_cost and tokens
         else None,
+        "kv_scale_repairs": scale_repairs,
         "spec": spec if spec["draft_tokens"] else None,
         "acceptance_rate": (spec["accepted"] / spec["draft_tokens"]
                             if spec["draft_tokens"] else None),
@@ -934,13 +936,16 @@ def render(rep, out=sys.stdout):
         steps = snap.get("decode_steps") or 0
         pre = snap.get("prefills") or 0
         tpd = ("%.2f" % ((m["tokens"] - pre) / steps)) if steps else "-"
+        kv_bpt = snap.get("kv_bytes_per_token")
         rows.append((tag, m["admits"], m["tokens"], tpd,
+                     snap.get("kv_dtype") or "-",
+                     "%.0f" % kv_bpt if kv_bpt is not None else "-",
                      m["retries_out"],
                      "  ".join("%s=%d" % kv
                                for kv in sorted(m["verdicts"].items()))
                      or "-"))
-    _tr._table(("replica", "admits", "tokens", "tok/disp", "lost",
-                "verdicts"), rows, out)
+    _tr._table(("replica", "admits", "tokens", "tok/disp", "kv",
+                "kvB/tok", "lost", "verdicts"), rows, out)
 
     out.write("\n-- latency by verdict class --\n")
     rows = []
@@ -1076,6 +1081,10 @@ def render(rep, out=sys.stdout):
                  acc["traced_tokens"],
                  "bit-exact" if acc["tokens_match"]
                  else "MISMATCH vs serving.tokens"))
+    if acc.get("kv_scale_repairs"):
+        out.write("  kv quantization: %d scale-poison repair(s) — "
+                  "victims re-prefilled on the finite guard (ISSUE "
+                  "20)\n" % acc["kv_scale_repairs"])
     if acc["flops_per_token"] is not None:
         out.write("  cost per token: %.3g flops, %.3g bytes accessed "
                   "(compile-time attribution x measured executions)\n"

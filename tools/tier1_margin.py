@@ -13,17 +13,33 @@ the wall (negative margin), 2 when no summary line is found (the run
 died before pytest could report — e.g. the ``timeout`` harness killed
 it), so CI can gate on shrinking headroom instead of discovering the
 wall the hard way.
+
+Robust to terminal wrapping: pytest folds its summary line under a
+narrow ``COLUMNS`` (splitting ``in`` from ``743.21s``, or even the
+digits from their trailing ``s``), which used to make this tool exit 2
+on a run that DID report — scanning summary tokens across whitespace
+and, failing that, rescanning with intra-line wraps collapsed keeps
+the gate honest.
 """
 import re
 import sys
 
-_SUMMARY = re.compile(r"\bin (\d+(?:\.\d+)?)s\b")
+#: ``\s*`` (not a literal space) so a line wrap between ``in`` and the
+#: seconds token still matches without any preprocessing
+_SUMMARY = re.compile(r"\bin\s*(\d+(?:\.\d+)?)s\b")
 
 
 def margin(log_text, wall=870.0):
     """Return ``(elapsed_s, margin_s)`` from the LAST pytest summary
-    line in ``log_text``, or ``(None, None)`` when absent."""
+    token in ``log_text``, or ``(None, None)`` when absent."""
     hits = _SUMMARY.findall(log_text)
+    if not hits:
+        # a wrap INSIDE the seconds token ("743.2\n1s") defeats any
+        # line-aware scan — collapse intra-line wraps and rescan.
+        # Joining lines cannot forge a summary token: ``\bin`` needs a
+        # word boundary, so "with" + "in 5s" style joins don't match.
+        hits = _SUMMARY.findall(
+            re.sub(r"[ \t]*\n[ \t]*", "", log_text))
     if not hits:
         return None, None
     elapsed = float(hits[-1])
